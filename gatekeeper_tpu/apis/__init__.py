@@ -1,0 +1,6 @@
+from gatekeeper_tpu.apis.templates import (  # noqa: F401
+    CodeEntry,
+    ConstraintTemplate,
+    TemplateTarget,
+)
+from gatekeeper_tpu.apis.constraints import Constraint  # noqa: F401

@@ -1,0 +1,6 @@
+from gatekeeper_tpu.utils.unstructured import (  # noqa: F401
+    deep_get,
+    deep_set,
+    deep_copy,
+    load_yaml_objects,
+)

@@ -1,0 +1,70 @@
+import pytest
+
+from gatekeeper_tpu.apis import Constraint, ConstraintTemplate
+from gatekeeper_tpu.apis.constraints import ConstraintError, GATOR_EP, WEBHOOK_EP
+from gatekeeper_tpu.apis.templates import ENGINE_REGO, TemplateError
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+DEMO = "/root/reference/demo/basic/templates/k8srequiredlabels_template.yaml"
+
+
+def test_template_from_demo_yaml():
+    obj = load_yaml_file(DEMO)[0]
+    ct = ConstraintTemplate.from_unstructured(obj)
+    assert ct.name == "k8srequiredlabels"
+    assert ct.kind == "K8sRequiredLabels"
+    src = ct.targets[0].source_for(ENGINE_REGO)
+    assert "violation[{" in src["rego"]
+    crd = ct.constraint_crd()
+    assert crd["spec"]["names"]["kind"] == "K8sRequiredLabels"
+
+
+def test_template_name_kind_mismatch():
+    obj = {
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "wrongname"},
+        "spec": {"crd": {"spec": {"names": {"kind": "K8sFoo"}}},
+                 "targets": [{"target": "t", "rego": "package x"}]},
+    }
+    with pytest.raises(TemplateError):
+        ConstraintTemplate.from_unstructured(obj)
+
+
+def _constraint(action="deny", scoped=None):
+    spec = {"match": {}, "parameters": {"labels": ["owner"]}}
+    if action is not None:
+        spec["enforcementAction"] = action
+    if scoped is not None:
+        spec["scopedEnforcementActions"] = scoped
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "must-have-owner"},
+        "spec": spec,
+    }
+
+
+def test_constraint_parse_and_actions():
+    c = Constraint.from_unstructured(_constraint())
+    assert c.actions_for(WEBHOOK_EP) == ["deny"]
+    c2 = Constraint.from_unstructured(
+        _constraint(
+            action="scoped",
+            scoped=[
+                {"action": "warn", "enforcementPoints": [{"name": WEBHOOK_EP}]},
+                {"action": "deny", "enforcementPoints": [{"name": "*"}]},
+            ],
+        )
+    )
+    assert c2.actions_for(WEBHOOK_EP) == ["warn", "deny"]
+    assert c2.actions_for(GATOR_EP) == ["deny"]
+
+
+def test_constraint_scoped_validation():
+    with pytest.raises(ConstraintError):
+        Constraint.from_unstructured(_constraint(action="scoped"))
+    with pytest.raises(ConstraintError):
+        Constraint.from_unstructured(
+            _constraint(action="deny", scoped=[{"action": "warn"}])
+        )
