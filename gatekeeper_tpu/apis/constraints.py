@@ -14,9 +14,9 @@ and implements the enforcement-action model of
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any
 
-from gatekeeper_tpu.utils.unstructured import deep_get, gvk_of, name_of
+from gatekeeper_tpu.utils.unstructured import deep_get, gvk_of, labels_of, name_of
 
 CONSTRAINTS_GROUP = "constraints.gatekeeper.sh"
 
@@ -70,18 +70,26 @@ class Constraint:
             raise ConstraintError(
                 "spec.scopedEnforcementActions requires enforcementAction: scoped"
             )
-        return Constraint(
+        name = name_of(obj)
+        if not name:
+            raise ConstraintError("constraint has no metadata.name")
+        c = Constraint(
             kind=kind,
-            name=name_of(obj),
+            name=name,
             match=deep_get(obj, ("spec", "match"), {}) or {},
             parameters=deep_get(obj, ("spec", "parameters"), None),
             enforcement_action=action,
             scoped_actions=list(scoped or []),
-            labels=deep_get(obj, ("metadata", "labels"), {}) or {},
+            labels=labels_of(obj),
             raw=obj,
         )
+        c.validate_actions()
+        return c
 
     def validate_actions(self) -> None:
+        # Reference: GetEnforcementAction maps unknown actions to Unrecognized
+        # and ValidateScopedEnforcementAction rejects empty/unknown enforcement
+        # points (util/enforcement_action.go:43-107).
         if self.enforcement_action not in KNOWN_ACTIONS:
             raise ConstraintError(
                 f"unrecognized enforcementAction {self.enforcement_action!r}"
@@ -91,6 +99,17 @@ class Constraint:
                 raise ConstraintError(
                     f"unrecognized scoped action {entry.get('action')!r}"
                 )
+            eps = entry.get("enforcementPoints")
+            if not eps:
+                raise ConstraintError(
+                    "scopedEnforcementActions entry has no enforcementPoints"
+                )
+            for ep in eps:
+                ep_name = ep.get("name", "") if isinstance(ep, dict) else str(ep)
+                if ep_name != ALL_EP and ep_name not in KNOWN_EPS:
+                    raise ConstraintError(
+                        f"unrecognized enforcement point {ep_name!r}"
+                    )
 
     def actions_for(self, enforcement_point: str) -> list[str]:
         """Resolve the action list applicable at an enforcement point.
@@ -105,7 +124,9 @@ class Constraint:
         out: list[str] = []
         for entry in self.scoped_actions:
             action = entry.get("action", DENY)
-            eps = entry.get("enforcementPoints") or [{"name": ALL_EP}]
+            # Missing/empty enforcementPoints = never enabled (reference:
+            # enforcementPointEnabled returns false for an empty list).
+            eps = entry.get("enforcementPoints") or []
             for ep in eps:
                 ep_name = ep.get("name", "") if isinstance(ep, dict) else str(ep)
                 if ep_name in (ALL_EP, enforcement_point) and action not in out:

@@ -62,6 +62,11 @@ class ConstraintTemplate:
     def from_unstructured(obj: dict) -> "ConstraintTemplate":
         if obj.get("kind") != "ConstraintTemplate":
             raise TemplateError(f"not a ConstraintTemplate: kind={obj.get('kind')!r}")
+        api_version = obj.get("apiVersion", "") or ""
+        if not api_version.startswith("templates.gatekeeper.sh/"):
+            raise TemplateError(
+                f"template group must be templates.gatekeeper.sh, got {api_version!r}"
+            )
         name = deep_get(obj, ("metadata", "name"), "")
         if not name:
             raise TemplateError("template has no metadata.name")

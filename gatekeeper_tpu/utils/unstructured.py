@@ -8,8 +8,7 @@ target handler, mutation system and flattener.
 from __future__ import annotations
 
 import copy
-import io
-from typing import Any, Iterator, Optional, Sequence
+from typing import Any, Iterator, Sequence
 
 import yaml
 
@@ -42,7 +41,7 @@ def deep_copy(obj: Any) -> Any:
 
 def load_yaml_objects(text: str) -> list[dict]:
     """Parse a (possibly multi-document) YAML string into object dicts."""
-    return [doc for doc in yaml.safe_load_all(io.StringIO(text)) if doc]
+    return [doc for doc in yaml.safe_load_all(text) if doc]
 
 
 def load_yaml_file(path: str) -> list[dict]:

@@ -1,0 +1,262 @@
+"""Interpreter tests built around the reference's own policy fixtures.
+
+Expected violation messages are the byte-exact strings OPA would produce
+(reference contract: demo/basic/templates + pkg/webhook/testdata PSP suite).
+"""
+
+import glob
+
+import yaml
+
+from gatekeeper_tpu.lang.rego.interp import Interpreter, compile_modules
+from gatekeeper_tpu.lang.rego.value import RegoSet, UNDEFINED
+
+REQ_LABELS = open(
+    "/root/reference/demo/basic/templates/k8srequiredlabels_template.yaml"
+).read()
+
+
+def _rego_of(path):
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return doc["spec"]["targets"][0]["rego"]
+
+
+def run_violations(rego, input_doc, data=None, libs=()):
+    mods = compile_modules([rego, *libs])
+    pkg = list(mods.by_pkg.keys())[0]
+    interp = Interpreter(mods, data=data or {})
+    return interp.query_set_rule(pkg, "violation", input_doc)
+
+
+def test_required_labels_violation():
+    rego = yaml.safe_load(REQ_LABELS)["spec"]["targets"][0]["rego"]
+    input_doc = {
+        "review": {"object": {"metadata": {"labels": {"app": "x"}}}},
+        "parameters": {"labels": ["gatekeeper"]},
+    }
+    out = run_violations(rego, input_doc)
+    assert len(out) == 1
+    assert out[0]["msg"] == 'you must provide labels: {"gatekeeper"}'
+    assert list(out[0]["details"]["missing_labels"]) == ["gatekeeper"]
+
+
+def test_required_labels_ok():
+    rego = yaml.safe_load(REQ_LABELS)["spec"]["targets"][0]["rego"]
+    input_doc = {
+        "review": {"object": {"metadata": {"labels": {"gatekeeper": "yes"}}}},
+        "parameters": {"labels": ["gatekeeper"]},
+    }
+    assert run_violations(rego, input_doc) == []
+
+
+def test_privileged_containers():
+    rego = _rego_of(
+        "/root/reference/pkg/webhook/testdata/psp-all-violations/"
+        "psp-templates/privileged-containers-template.yaml"
+    )
+    input_doc = {
+        "review": {
+            "object": {
+                "metadata": {"name": "nginx"},
+                "spec": {
+                    "containers": [
+                        {"name": "nginx", "securityContext": {"privileged": True}},
+                        {"name": "sidecar"},
+                    ],
+                    "initContainers": [
+                        {"name": "init", "securityContext": {"privileged": True}}
+                    ],
+                },
+            }
+        },
+        "parameters": {},
+    }
+    out = run_violations(rego, input_doc)
+    msgs = sorted(v["msg"] for v in out)
+    assert msgs == [
+        "Privileged container is not allowed: init, securityContext: "
+        '{"privileged": true}',
+        "Privileged container is not allowed: nginx, securityContext: "
+        '{"privileged": true}',
+    ]
+
+
+def test_host_network_ports():
+    rego = _rego_of(
+        "/root/reference/pkg/webhook/testdata/psp-all-violations/"
+        "psp-templates/host-network-ports-template.yaml"
+    )
+    input_doc = {
+        "review": {
+            "object": {
+                "metadata": {"name": "pod1"},
+                "spec": {
+                    "hostNetwork": True,
+                    "containers": [
+                        {"name": "c1", "ports": [{"hostPort": 80}]},
+                    ],
+                },
+            }
+        },
+        "parameters": {"hostNetwork": False, "min": 1000, "max": 2000},
+    }
+    out = run_violations(rego, input_doc)
+    assert len(out) == 1
+    assert "The specified hostNetwork and hostPort are not allowed" in out[0]["msg"]
+    # allowed case
+    ok_doc = {
+        "review": {
+            "object": {
+                "metadata": {"name": "pod1"},
+                "spec": {"containers": [{"name": "c1", "ports": [{"hostPort": 1500}]}]},
+            }
+        },
+        "parameters": {"hostNetwork": True, "min": 1000, "max": 2000},
+    }
+    assert run_violations(rego, ok_doc) == []
+
+
+def test_unique_label_with_inventory():
+    rego = _rego_of(
+        "/root/reference/demo/basic/templates/k8suniquelabel_template.yaml"
+    )
+    inv = {
+        "inventory": {
+            "cluster": {
+                "v1": {
+                    "Namespace": {
+                        "other": {
+                            "apiVersion": "v1",
+                            "kind": "Namespace",
+                            "metadata": {"name": "other", "labels": {"team": "a"}},
+                        }
+                    }
+                }
+            },
+            "namespace": {},
+        }
+    }
+    input_doc = {
+        "review": {
+            "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+            "name": "mine",
+            "object": {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {"name": "mine", "labels": {"team": "a"}},
+            },
+        },
+        "parameters": {"label": "team"},
+    }
+    out = run_violations(rego, input_doc, data=inv)
+    assert len(out) == 1
+    assert out[0]["msg"] == "label team has duplicate value a"
+    # unique value: no violation
+    input_doc["review"]["object"]["metadata"]["labels"]["team"] = "b"
+    assert run_violations(rego, input_doc, data=inv) == []
+
+
+def test_all_psp_templates_parse():
+    for path in glob.glob(
+        "/root/reference/pkg/webhook/testdata/psp-all-violations/psp-templates/*.yaml"
+    ):
+        rego = _rego_of(path)
+        compile_modules([rego])
+
+
+def test_else_and_default():
+    rego = """
+package t
+
+default level = "none"
+
+level = "high" {
+  input.x > 10
+} else = "low" {
+  input.x > 0
+}
+
+violation[{"msg": msg}] {
+  msg := sprintf("level is %v", [level])
+}
+"""
+    out = run_violations(rego, {"x": 5})
+    assert out[0]["msg"] == "level is low"
+    out = run_violations(rego, {"x": 50})
+    assert out[0]["msg"] == "level is high"
+    out = run_violations(rego, {"x": -1})
+    assert out[0]["msg"] == "level is none"
+
+
+def test_comprehensions_and_sets():
+    rego = """
+package t
+
+violation[{"msg": msg}] {
+  names := {n | n := input.items[_].name}
+  banned := {n | n := input.banned[_]}
+  bad := names & banned
+  count(bad) > 0
+  msg := sprintf("banned: %v, total %d", [bad, count(names)])
+}
+"""
+    doc = {
+        "items": [{"name": "a"}, {"name": "b"}, {"name": "c"}],
+        "banned": ["b", "z"],
+    }
+    out = run_violations(rego, doc)
+    assert out[0]["msg"] == 'banned: {"b"}, total 3'
+
+
+def test_functions_multiclause():
+    rego = """
+package t
+
+fmt_av(kind) = av {
+  kind.group != ""
+  av := sprintf("%v/%v", [kind.group, kind.version])
+}
+
+fmt_av(kind) = av {
+  kind.group == ""
+  av := kind.version
+}
+
+violation[{"msg": fmt_av(input.kind)}] { true }
+"""
+    assert run_violations(rego, {"kind": {"group": "apps", "version": "v1"}})[0][
+        "msg"
+    ] == "apps/v1"
+    assert run_violations(rego, {"kind": {"group": "", "version": "v1"}})[0][
+        "msg"
+    ] == "v1"
+
+
+def test_not_and_walk():
+    rego = """
+package t
+
+violation[{"msg": "no runAsNonRoot"}] {
+  not input.review.object.spec.securityContext.runAsNonRoot
+}
+"""
+    assert len(run_violations(rego, {"review": {"object": {}}})) == 1
+    ok = {"review": {"object": {"spec": {"securityContext": {"runAsNonRoot": True}}}}}
+    assert run_violations(rego, ok) == []
+
+
+def test_startswith_arith_slicing():
+    rego = """
+package t
+
+violation[{"msg": msg}] {
+  some i
+  c := input.containers[i]
+  startswith(c.image, "bad/")
+  msg := sprintf("container %d image %v", [i, c.image])
+}
+"""
+    doc = {"containers": [{"image": "good/x"}, {"image": "bad/y"}]}
+    out = run_violations(rego, doc)
+    assert out == [{"msg": "container 1 image bad/y"}]

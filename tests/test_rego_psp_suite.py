@@ -1,0 +1,55 @@
+"""Run every PSP template in the reference's webhook-benchmark testdata against
+its example pod; each example pod is crafted to violate its template
+(reference: pkg/webhook/testdata/psp-all-violations, used by
+BenchmarkValidationHandler at pkg/webhook/policy_benchmark_test.go:251)."""
+
+import glob
+import os
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.lang.rego.interp import Interpreter, compile_modules
+
+ROOT = "/root/reference/pkg/webhook/testdata/psp-all-violations"
+
+PAIRS = [
+    ("privileged-containers-template.yaml", "privileged-containers-example.yaml",
+     "privileged-containers-constraint.yaml"),
+    ("host-filesystem-template.yaml", "host-filesystem-example.yaml",
+     "host-filesystem-constraint.yaml"),
+    ("host-namespace-template.yaml", "host-namespaces-example.yaml",
+     "host-namespaces-constraint.yaml"),
+    ("host-network-ports-template.yaml", "host-network-example.yaml",
+     "host-network-constraint.yaml"),
+    ("volume-template.yaml", "volumes-example.yaml", "volumes-constraint.yaml"),
+]
+
+
+def _load(p):
+    with open(p) as f:
+        return yaml.safe_load(f)
+
+
+@pytest.mark.parametrize("tmpl,pod,constraint", PAIRS)
+def test_psp_pod_violates(tmpl, pod, constraint):
+    t = _load(os.path.join(ROOT, "psp-templates", tmpl))
+    p = _load(os.path.join(ROOT, "psp-pods", pod))
+    c = _load(os.path.join(ROOT, "psp-constraints", constraint))
+    rego = t["spec"]["targets"][0]["rego"]
+    mods = compile_modules([rego])
+    pkg = list(mods.by_pkg.keys())[0]
+    interp = Interpreter(mods)
+    input_doc = {
+        "review": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE",
+            "name": p["metadata"]["name"],
+            "object": p,
+        },
+        "parameters": c["spec"].get("parameters") or {},
+    }
+    out = interp.query_set_rule(pkg, "violation", input_doc)
+    assert len(out) >= 1, f"{tmpl}: expected a violation"
+    for v in out:
+        assert isinstance(v["msg"], str) and v["msg"]
