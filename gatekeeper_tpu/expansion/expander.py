@@ -1,0 +1,56 @@
+"""Offline expander used by gator test (reference: pkg/gator/expand).
+
+Resolves namespaces from the supplied object set and expands generator
+resources through the expansion system.  (Expansion system itself lives in
+gatekeeper_tpu.expansion.system.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from gatekeeper_tpu.utils.unstructured import gvk_of, name_of, namespace_of
+
+
+@dataclass
+class Resultant:
+    obj: dict
+    template_name: str
+    enforcement_action: str = ""
+
+
+class Expander:
+    def __init__(self, objs: Sequence[dict]):
+        self._namespaces: dict[str, dict] = {}
+        self._system = None
+        expansion_templates = []
+        mutators = []
+        for obj in objs:
+            group, _, kind = gvk_of(obj)
+            if kind == "Namespace" and group == "":
+                self._namespaces[name_of(obj)] = obj
+            elif kind == "ExpansionTemplate" and group == "expansion.gatekeeper.sh":
+                expansion_templates.append(obj)
+            elif group == "mutations.gatekeeper.sh":
+                mutators.append(obj)
+        if expansion_templates:
+            from gatekeeper_tpu.expansion.system import ExpansionSystem
+            from gatekeeper_tpu.mutation.system import MutationSystem
+
+            mut_system = MutationSystem()
+            for m in mutators:
+                mut_system.upsert_unstructured(m)
+            self._system = ExpansionSystem(mutation_system=mut_system)
+            for et in expansion_templates:
+                self._system.upsert_template(et)
+
+    def namespace_for(self, obj: dict) -> Optional[dict]:
+        ns = namespace_of(obj)
+        return self._namespaces.get(ns) if ns else None
+
+    def expand(self, obj: dict) -> list[Resultant]:
+        if self._system is None:
+            return []
+        ns = self.namespace_for(obj)
+        return self._system.expand(obj, namespace=ns)

@@ -1,0 +1,5 @@
+from gatekeeper_tpu.gator.cli import main
+
+import sys
+
+sys.exit(main())

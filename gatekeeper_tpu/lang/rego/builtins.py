@@ -310,7 +310,8 @@ def _indexof(s, sub):
 
 @builtin("substring")
 def _substring(s, start, length):
-    if not (isinstance(s, str) and isinstance(start, int)):
+    if not (isinstance(s, str) and isinstance(start, int)
+            and isinstance(length, int)):
         return UNDEFINED
     if start < 0:
         return UNDEFINED
@@ -459,8 +460,11 @@ def glob_translate(pattern: str, delimiters=None) -> str:
                 i += 1
             else:
                 alts = pattern[i + 1 : j].split(",")
+                # glob_translate wraps in '^(?:' ... ')$'; strip to embed
                 out.append(
-                    "(?:" + "|".join(glob_translate(a, delimiters)[:-1][2:] or "" for a in alts) + ")"
+                    "(?:"
+                    + "|".join(glob_translate(a, delimiters)[4:-2] for a in alts)
+                    + ")"
                 )
                 i = j + 1
         else:
@@ -662,7 +666,7 @@ def _member2(x, coll):
 
 @builtin("json.marshal")
 def _json_marshal(v):
-    return json.dumps(to_json(v), separators=(",", ":"), sort_keys=False)
+    return json.dumps(to_json(v), separators=(",", ":"), sort_keys=True)
 
 
 @builtin("json.unmarshal")
@@ -716,12 +720,6 @@ _BYTE_UNITS = {
     "ki": 2**10, "mi": 2**20, "gi": 2**30, "ti": 2**40, "pi": 2**50, "ei": 2**60,
     "kib": 2**10, "mib": 2**20, "gib": 2**30, "tib": 2**40, "pib": 2**50, "eib": 2**60,
 }
-
-# units.parse handles milli (m) for CPU quantities, unlike parse_bytes
-_GENERIC_UNITS = dict(_BYTE_UNITS)
-_GENERIC_UNITS["m"] = 1e-3
-_GENERIC_UNITS["K"] = 10**3
-
 
 @builtin("units.parse_bytes")
 def _units_parse_bytes(s):

@@ -699,9 +699,10 @@ class _Ctx:
         rvar = self._unbound_var(mod, rhs, env)
         if lvar and rvar:
             raise UnsafeVarError(f"cannot unify two unbound vars {lvar}/{rvar}")
-        if lvar:
-            for v, env2 in self.eval_term(mod, rhs, env):
-                yield from self.unify_value(mod, lhs, v, env2)
+        if rvar and not lvar:
+            # ground LHS, unbound RHS: bind the RHS pattern
+            for v, env2 in self.eval_term(mod, lhs, env):
+                yield from self.unify_value(mod, rhs, v, env2)
             return
         for v, env2 in self.eval_term(mod, rhs, env):
             yield from self.unify_value(mod, lhs, v, env2)

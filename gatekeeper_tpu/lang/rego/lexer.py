@@ -69,15 +69,22 @@ def tokenize(src: str) -> list[Token]:
             buf = []
             while j < n and src[j] != '"':
                 if src[j] == "\\":
+                    if j + 1 >= n:
+                        raise LexError(f"unterminated escape at line {line}")
                     esc = src[j + 1]
+                    if esc == "u":
+                        hexs = src[j + 2 : j + 6]
+                        if len(hexs) < 4 or any(
+                            c not in "0123456789abcdefABCDEF" for c in hexs
+                        ):
+                            raise LexError(f"bad \\u escape at line {line}")
+                        buf.append(chr(int(hexs, 16)))
+                        j += 6
+                        continue
                     buf.append(
                         {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\",
-                         "/": "/", "b": "\b", "f": "\f"}.get(esc)
-                        or ("\\" + esc if esc != "u" else None)
+                         "/": "/", "b": "\b", "f": "\f"}.get(esc, "\\" + esc)
                     )
-                    if esc == "u":
-                        buf[-1] = chr(int(src[j + 2 : j + 6], 16))
-                        j += 4
                     j += 2
                 else:
                     buf.append(src[j])

@@ -360,8 +360,7 @@ class Parser:
             else:
                 break
         if args:
-            if isinstance(term, (ast.Var, ast.Ref, ast.Call)) or True:
-                return ast.Ref(head=term, args=tuple(args))
+            return ast.Ref(head=term, args=tuple(args))
         return term
 
     def _ref_to_call_name(self, head: ast.Node, args: list) -> str:

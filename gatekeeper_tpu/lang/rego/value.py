@@ -37,10 +37,10 @@ UNDEFINED = Undefined()
 def freeze(v: Any) -> Any:
     """Structural, hashable form of a Rego value (for set/obj keys, memo keys)."""
     if isinstance(v, RegoSet):
-        return ("set",) + tuple(sorted(freeze(e) for e in v))
+        return ("set",) + tuple(sorted((freeze(e) for e in v), key=repr))
     if isinstance(v, dict):
         return ("obj",) + tuple(
-            sorted((freeze(k), freeze(val)) for k, val in v.items())
+            sorted(((freeze(k), freeze(val)) for k, val in v.items()), key=repr)
         )
     if isinstance(v, (list, tuple)):
         return ("arr",) + tuple(freeze(e) for e in v)

@@ -1,0 +1,136 @@
+"""Client + gator test end-to-end over the reference demo fixtures
+(BASELINE config #1: K8sRequiredLabels + demo/basic constraints)."""
+
+import glob
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client, ClientError
+from gatekeeper_tpu.drivers.rego_driver import RegoDriver
+from gatekeeper_tpu.gator.test import test as gator_test
+from gatekeeper_tpu.target.review import (
+    AdmissionRequest,
+    AugmentedUnstructured,
+    RequestObjectError,
+)
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+DEMO = "/root/reference/demo/basic"
+
+
+def demo_objects():
+    objs = []
+    for path in [
+        f"{DEMO}/templates/k8srequiredlabels_template.yaml",
+        f"{DEMO}/templates/k8suniquelabel_template.yaml",
+        *sorted(glob.glob(f"{DEMO}/constraints/*.yaml")),
+        f"{DEMO}/bad/bad_ns.yaml",
+        f"{DEMO}/good/good_ns.yaml",
+    ]:
+        objs.extend(load_yaml_file(path))
+    return objs
+
+
+def test_gator_test_demo_basic():
+    responses = gator_test(demo_objects())
+    results = responses.results()
+    # bad-ns violates both the deny and the dryrun required-labels constraints
+    msgs = {(r.constraint["metadata"]["name"], r.enforcement_action)
+            for r in results}
+    assert msgs == {
+        ("ns-must-have-gk", "deny"),
+        ("ns-must-have-gk-dryrun", "dryrun"),
+    }
+    for r in results:
+        assert r.msg == 'you must provide labels: {"gatekeeper"}'
+        assert r.violating_object["metadata"]["name"] == "bad-ns"
+
+
+def _client():
+    return Client(target=K8sValidationTarget(), drivers=[RegoDriver()],
+                  enforcement_points=["gator.gatekeeper.sh"])
+
+
+def test_client_review_with_admission_request():
+    c = _client()
+    objs = demo_objects()
+    c.add_template(objs[0])  # k8srequiredlabels only
+    for o in objs[2:5]:  # the three demo constraints; K8sUniqueLabel has no
+        try:  # template here and must be rejected
+            c.add_constraint(o)
+        except ClientError:
+            pass
+    req = AdmissionRequest(
+        kind={"group": "", "version": "v1", "kind": "Namespace"},
+        name="test-ns",
+        operation="CREATE",
+        object={"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "test-ns"}},
+    )
+    resp = c.review(req, enforcement_point="gator.gatekeeper.sh")
+    results = resp.results()
+    assert len(results) == 2  # deny + dryrun constraints
+    assert all("gatekeeper" in r.msg for r in results)
+
+
+def test_delete_requires_old_object():
+    c = _client()
+    req = AdmissionRequest(
+        kind={"group": "", "version": "v1", "kind": "Pod"},
+        operation="DELETE",
+        object={"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "x"}},
+    )
+    with pytest.raises(RequestObjectError):
+        c.review(req)
+
+
+def test_delete_copies_old_object():
+    c = _client()
+    objs = demo_objects()
+    c.add_template(objs[0])
+    c.add_constraint(objs[3])  # ns-must-have-gk (deny)
+    req = AdmissionRequest(
+        kind={"group": "", "version": "v1", "kind": "Namespace"},
+        name="del-ns",
+        operation="DELETE",
+        old_object={"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "del-ns"}},
+    )
+    resp = c.review(req, enforcement_point="gator.gatekeeper.sh")
+    assert len(resp.results()) >= 1  # evaluated against oldObject copy
+
+
+def test_constraint_without_template_rejected():
+    c = _client()
+    with pytest.raises(ClientError):
+        c.add_constraint(
+            {
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": "K8sNoTemplate",
+                "metadata": {"name": "x"},
+                "spec": {},
+            }
+        )
+
+
+def test_inventory_data_flow():
+    """Referential policy: unique label across cluster namespaces."""
+    c = _client()
+    objs = demo_objects()
+    c.add_template(objs[1])  # k8suniquelabel
+    c.add_constraint(objs[2])  # all_ns_gatekeeper_label_unique
+    other = {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "other", "labels": {"gatekeeper": "dup"}}}
+    c.add_data(other)
+    mine = {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "mine", "labels": {"gatekeeper": "dup"}}}
+    resp = c.review(AugmentedUnstructured(object=mine),
+                    enforcement_point="gator.gatekeeper.sh")
+    assert len(resp.results()) == 1
+    assert "duplicate value" in resp.results()[0].msg
+    # remove the conflicting object -> no violation
+    c.remove_data(other)
+    resp = c.review(AugmentedUnstructured(object=mine),
+                    enforcement_point="gator.gatekeeper.sh")
+    assert resp.results() == []
