@@ -1,0 +1,221 @@
+"""The TPU driver: vectorized detection + exact host rendering.
+
+Registered beside the interpreter driver exactly as the reference registers
+k8scel beside rego (main.go:465-485).  Split of labor:
+
+- ``add_template`` compiles the Rego source twice: (a) interpreter modules
+  (exact oracle + message rendering), (b) lowered predicate Program where the
+  template is in the vectorizable fragment (ir/lower_rego).
+- ``query`` (single review) delegates to the interpreter — a webhook-latency
+  lane needs no device round-trip for N=1.
+- ``query_batch`` (many reviews) is the TPU path: flatten once, compute match
+  masks, run each lowered template's [C, N] verdict kernel on device, then
+  render messages host-side by re-running the interpreter only on hits.
+  Templates outside the fragment fall back to the interpreter loop for their
+  matching (constraint, object) pairs — behind the same seam, per SURVEY.md §7
+  "compile-or-fallback".
+
+The verdict grid is exact by construction (differential tests) so hit
+rendering never changes the violation set, only fills in msg/details.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from gatekeeper_tpu.apis.constraints import Constraint
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.client.types import QueryResponse, Result, Stat, StatsEntry
+from gatekeeper_tpu.drivers.base import ReviewCfg
+from gatekeeper_tpu.drivers.rego_driver import RegoDriver
+from gatekeeper_tpu.ir import masks as masks_mod
+from gatekeeper_tpu.ir.lower_rego import lower_template
+from gatekeeper_tpu.ir.program import CompiledProgram, LowerError, build_param_table
+from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab, round_up
+from gatekeeper_tpu.target.review import GkReview
+
+DRIVER_NAME = "TPU"
+
+
+class TpuDriver:
+    """Implements the Driver protocol + the batched device path."""
+
+    def __init__(self, batch_bucket: int = 256):
+        self._interp = RegoDriver()
+        self.vocab = Vocab()
+        self._programs: dict[str, CompiledProgram] = {}  # kind -> compiled
+        self._lower_errors: dict[str, str] = {}  # kind -> why fallback
+        self.batch_bucket = batch_bucket
+
+    # --- Driver protocol (delegating lifecycle to the exact engine) ------
+    def name(self) -> str:
+        return DRIVER_NAME
+
+    def has_source_for(self, template: ConstraintTemplate) -> bool:
+        return self._interp.has_source_for(template)
+
+    def add_template(self, template: ConstraintTemplate) -> None:
+        self._interp.add_template(template)
+        compiled = self._interp._templates[template.kind]
+        try:
+            program = lower_template(
+                compiled.modules,
+                compiled.package,
+                template.kind,
+                self.vocab,
+                schema_hint=template.parameters_schema,
+            )
+            self._programs[template.kind] = CompiledProgram(program)
+            self._lower_errors.pop(template.kind, None)
+        except LowerError as e:
+            self._programs.pop(template.kind, None)
+            self._lower_errors[template.kind] = str(e)
+
+    def remove_template(self, template_kind: str) -> None:
+        self._interp.remove_template(template_kind)
+        self._programs.pop(template_kind, None)
+        self._lower_errors.pop(template_kind, None)
+
+    def add_constraint(self, constraint: Constraint) -> None:
+        self._interp.add_constraint(constraint)
+
+    def remove_constraint(self, constraint: Constraint) -> None:
+        self._interp.remove_constraint(constraint)
+
+    def add_data(self, target: str, path: Sequence[str], data: Any) -> None:
+        self._interp.add_data(target, path, data)
+
+    def remove_data(self, target: str, path: Sequence[str]) -> None:
+        self._interp.remove_data(target, path)
+
+    def wipe_data(self) -> None:
+        self._interp.wipe_data()
+
+    def query(self, target, constraints, review, cfg=None) -> QueryResponse:
+        return self._interp.query(target, constraints, review, cfg)
+
+    def dump(self) -> dict:
+        d = self._interp.dump()
+        d["lowered"] = sorted(self._programs)
+        d["fallback"] = dict(self._lower_errors)
+        return d
+
+    def get_description_for_stat(self, stat_name: str) -> str:
+        return {
+            "batchEvalNS": "nanoseconds spent in the device verdict kernel",
+            "flattenNS": "nanoseconds spent flattening objects to columns",
+        }.get(stat_name, self._interp.get_description_for_stat(stat_name))
+
+    # --- the TPU path ----------------------------------------------------
+    def lowered_kinds(self) -> list[str]:
+        return sorted(self._programs)
+
+    def fallback_kinds(self) -> dict[str, str]:
+        return dict(self._lower_errors)
+
+    def query_batch(
+        self,
+        target: str,
+        constraints: Sequence[Constraint],
+        reviews: Sequence[GkReview],
+        cfg: Optional[ReviewCfg] = None,
+        render_messages: bool = True,
+    ) -> list[QueryResponse]:
+        """Evaluate all constraints against all reviews in one device pass.
+
+        Returns one QueryResponse per review.  This is the kernel behind the
+        audit sweep (SURVEY.md §3.2) and the webhook batcher.
+        """
+        cfg = cfg or ReviewCfg()
+        n = len(reviews)
+        responses = [QueryResponse() for _ in range(n)]
+        if n == 0 or not constraints:
+            return responses
+
+        objects = [r.request.object or {} for r in reviews]
+        namespaces = [r.namespace for r in reviews]
+        sources = [r.source for r in reviews]
+
+        by_kind: dict[str, list[Constraint]] = {}
+        for con in constraints:
+            by_kind.setdefault(con.kind, []).append(con)
+
+        lowered_kinds = [k for k in by_kind if k in self._programs]
+        fallback_kinds = [k for k in by_kind if k not in self._programs]
+
+        t0 = time.perf_counter_ns()
+        verdicts: dict[str, np.ndarray] = {}
+        # flatten once with the union schema (identity columns always needed
+        # for match masks, even when every kind falls back)
+        schema = Schema()
+        for kind in lowered_kinds:
+            schema.merge(self._programs[kind].program.schema)
+        # power-of-two padding above the base bucket caps the number of
+        # distinct jit shapes at log2(max N): first-compile cost is bounded
+        pad_n = self.batch_bucket
+        while pad_n < n:
+            pad_n *= 2
+        tf = time.perf_counter_ns()
+        flattener = Flattener(schema, self.vocab)
+        batch = flattener.flatten(objects, pad_n=pad_n)
+        flatten_ns = time.perf_counter_ns() - tf
+        eval_ns = 0
+        te = time.perf_counter_ns()
+        for kind in lowered_kinds:
+            prog = self._programs[kind]
+            cons = by_kind[kind]
+            table = build_param_table(prog.program, cons, self.vocab)
+            grid = prog.run(batch, table)  # [C, pad_n]
+            mask = masks_mod.constraint_masks(
+                cons, batch, self.vocab, objects, namespaces, sources
+            )
+            verdicts[kind] = grid[:, : batch.n] & mask
+        eval_ns = time.perf_counter_ns() - te
+
+        # render hits through the exact engine
+        for kind in lowered_kinds:
+            cons = by_kind[kind]
+            grid = verdicts[kind]
+            for ci, con in enumerate(cons):
+                hit_idx = np.nonzero(grid[ci, :n])[0]
+                for oi in hit_idx.tolist():
+                    if render_messages:
+                        qr = self._interp.query(
+                            target, [con], reviews[oi], cfg
+                        )
+                        responses[oi].results.extend(qr.results)
+                    else:
+                        responses[oi].results.append(
+                            Result(target=target, msg="", constraint=con.raw)
+                        )
+
+        # fallback kinds: exact engine on match-filtered pairs
+        for kind in fallback_kinds:
+            cons = by_kind[kind]
+            mask = masks_mod.constraint_masks(
+                cons, batch, self.vocab, objects, namespaces, sources
+            )
+            for ci, con in enumerate(cons):
+                for oi in np.nonzero(mask[ci, :n])[0].tolist():
+                    qr = self._interp.query(target, [con], reviews[oi], cfg)
+                    responses[oi].results.extend(qr.results)
+
+        if cfg.stats:
+            total_ns = time.perf_counter_ns() - t0
+            entry = StatsEntry(
+                scope="batch",
+                stats_for=f"{len(constraints)} constraints x {n} objects",
+                stats=[
+                    Stat("batchEvalNS", eval_ns,
+                         {"type": "engine", "value": DRIVER_NAME}),
+                    Stat("flattenNS", flatten_ns,
+                         {"type": "engine", "value": DRIVER_NAME}),
+                    Stat("totalNS", total_ns,
+                         {"type": "engine", "value": DRIVER_NAME}),
+                ],
+            )
+            responses[0].stats_entries.append(entry)
+        return responses

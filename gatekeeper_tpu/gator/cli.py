@@ -79,8 +79,8 @@ def cmd_test(argv: list[str]) -> int:
     p.add_argument("--output", "-o", default="")
     p.add_argument("--trace", "-t", action="store_true")
     p.add_argument("--stats", action="store_true")
-    p.add_argument("--enable-k8s-native-validation", action="store_true",
-                   default=True)
+    p.add_argument("--enable-k8s-native-validation",
+                   action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--deny-only", action="store_true")
     args = p.parse_args(argv)
 
@@ -115,28 +115,27 @@ def cmd_test(argv: list[str]) -> int:
     return 1 if any(_enforceable_failure(r) for r in results) else 0
 
 
-def cmd_verify(argv: list[str]) -> int:
-    from gatekeeper_tpu.gator.verify import run_cli
+def _delegate(module: str):
+    def run(argv: list[str]) -> int:
+        import importlib
 
-    return run_cli(argv)
+        try:
+            mod = importlib.import_module(f"gatekeeper_tpu.gator.{module}")
+        except ImportError:
+            print(
+                f"error: gator {module} is not available in this build",
+                file=sys.stderr,
+            )
+            return 2
+        return mod.run_cli(argv)
+
+    return run
 
 
-def cmd_expand(argv: list[str]) -> int:
-    from gatekeeper_tpu.gator.expand_cmd import run_cli
-
-    return run_cli(argv)
-
-
-def cmd_bench(argv: list[str]) -> int:
-    from gatekeeper_tpu.gator.bench import run_cli
-
-    return run_cli(argv)
-
-
-def cmd_sync(argv: list[str]) -> int:
-    from gatekeeper_tpu.gator.sync_cmd import run_cli
-
-    return run_cli(argv)
+cmd_verify = _delegate("verify")
+cmd_expand = _delegate("expand_cmd")
+cmd_bench = _delegate("bench")
+cmd_sync = _delegate("sync_cmd")
 
 
 COMMANDS = {

@@ -1,0 +1,620 @@
+"""Partial evaluation: Rego AST → predicate IR (the vectorizable fragment).
+
+This is the AddTemplate-time compile step of the TPU driver (the reference's
+analog is template compilation at constrainttemplate_controller.go:479; here
+compilation *lowers* instead of building an interpreter closure).
+
+Supported fragment (everything else raises LowerError → interpreter fallback,
+per SURVEY.md §7 "compile-or-fallback split"):
+- violation clauses whose body is a conjunction of path predicates
+- paths on input.review.object / input.review.* with trailing/nested ``[_]``
+  iteration (each wildcard nesting flattens into one ragged Axis)
+- user function/bool-rule inlining, multi-clause = OR (e.g. the PSP suite's
+  input_share_hostnetwork / input_containers set-rule axes)
+- comparisons and (in)equality against input.parameters.* and constants
+- negation of lowerable predicates
+- the required-labels set pattern:
+      provided := {l | <labels-path>[l]}
+      required := {l | l := input.parameters.X[_]}
+      missing  := required - provided
+      count(missing) > 0
+  → AnyParamStrList(X, ¬KeySetContains(labels))
+- assignments to variables only used for messages/details are skipped
+  (messages render host-side from hits)
+
+The lowered Program is *detection-only*: it must agree with the interpreter on
+violated / not-violated for every (object, constraint) pair — enforced by the
+differential tests in tests/test_lowering_differential.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Optional, Union
+
+from gatekeeper_tpu.ir import nodes as N
+from gatekeeper_tpu.ir.program import LowerError
+from gatekeeper_tpu.lang.rego import ast
+from gatekeeper_tpu.lang.rego.parser import WithWrapped
+from gatekeeper_tpu.ops.flatten import (
+    Axis,
+    KeySetCol,
+    RaggedCol,
+    ScalarCol,
+    Schema,
+)
+
+OBJECT_ROOT = ("review", "object")  # input.review.object
+
+
+# --- abstract values ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PathVal:
+    """A concrete path under input (no wildcards)."""
+
+    path: tuple  # under input, e.g. ("review","object","spec","hostNetwork")
+
+
+@dataclass(frozen=True)
+class ItemVal:
+    """An item of a ragged axis + a subpath under the item.
+
+    ``instance`` identifies the existential: two separate ``[_]`` iterations
+    over the same list are independent ∃-variables (Rego semantics), so their
+    predicates must reduce under separate AnyAxis nodes; predicates sharing a
+    bound variable share an instance and stay under one AnyAxis."""
+
+    axis: Axis
+    subpath: tuple
+    instance: int = 0
+
+
+@dataclass(frozen=True)
+class ParamVal:
+    name: str  # input.parameters.<name>
+
+
+@dataclass(frozen=True)
+class ParamElemVal:
+    """Element of a string-list parameter (inside the set-diff pattern)."""
+
+    name: str
+
+
+@dataclass(frozen=True)
+class ConstVal:
+    value: Any
+
+
+@dataclass(frozen=True)
+class KeySetVal:
+    path: tuple  # under input; set of keys of map at path
+
+
+@dataclass(frozen=True)
+class ParamListSetVal:
+    name: str
+
+
+@dataclass(frozen=True)
+class SetDiffVal:
+    required: "ParamListSetVal"
+    provided: "KeySetVal"
+
+
+@dataclass(frozen=True)
+class OpaqueVal:
+    """Value we can't lower; poisonous only if used in a predicate."""
+
+    why: str
+
+
+class _Lowerer:
+    def __init__(self, modules, entry_pkg: tuple, schema_hint: Optional[dict],
+                 vocab):
+        self.modules = modules
+        self.entry_mod = modules.by_pkg[entry_pkg]
+        self.schema = Schema()
+        self.param_kinds: dict[str, str] = {}
+        self.schema_hint = (schema_hint or {}).get("properties", {})
+        self.vocab = vocab
+        self.depth = 0
+        self._instances = 0
+
+    def _fresh_instance(self) -> int:
+        self._instances += 1
+        return self._instances
+
+    # --- public -----------------------------------------------------------
+    def lower_violation(self) -> N.Expr:
+        rule = self.entry_mod.rules.get("violation")
+        if rule is None or rule.kind != "set":
+            raise LowerError("no violation set rule")
+        clause_exprs = []
+        for clause in rule.clauses:
+            if clause.els is not None:
+                raise LowerError("else on violation clause")
+            clause_exprs.append(self._lower_body(clause.body, {}))
+        if not clause_exprs:
+            raise LowerError("violation rule has no clauses")
+        return N.Or(tuple(clause_exprs)) if len(clause_exprs) > 1 else clause_exprs[0]
+
+    # --- body lowering ----------------------------------------------------
+    def _lower_body(self, body, env: dict) -> N.Expr:
+        env = dict(env)
+        obj_preds: list[N.Expr] = []
+        axis_preds: dict[tuple, list] = {}  # (axis, instance) -> preds
+
+        def add_pred(p: N.Expr, axis_inst):
+            if axis_inst is None:
+                obj_preds.append(p)
+            else:
+                axis_preds.setdefault(axis_inst, []).append(p)
+
+        for stmt in body:
+            if isinstance(stmt, WithWrapped):
+                raise LowerError("with modifier")
+            if isinstance(stmt, ast.SomeDecl):
+                for n in stmt.names:
+                    env.pop(n, None)
+                continue
+            if isinstance(stmt, ast.AssignStmt) or isinstance(stmt, ast.UnifyStmt):
+                target = stmt.target if isinstance(stmt, ast.AssignStmt) else stmt.lhs
+                term = stmt.term if isinstance(stmt, ast.AssignStmt) else stmt.rhs
+                if not isinstance(target, ast.Var):
+                    raise LowerError("destructuring assignment")
+                env[target.name] = self._abstract(term, env)
+                continue
+            if isinstance(stmt, ast.ExprStmt):
+                pred, axis = self._lower_pred(stmt.term, env, stmt.negated)
+                if pred is not None:
+                    add_pred(pred, axis)
+                continue
+            if isinstance(stmt, ast.SomeIn):
+                raise LowerError("some..in")
+            raise LowerError(f"statement {type(stmt).__name__}")
+
+        terms = list(obj_preds)
+        for (axis, _inst), preds in axis_preds.items():
+            inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
+            terms.append(N.AnyAxis(axis, inner))
+        if not terms:
+            raise LowerError("clause lowered to no predicates")
+        return N.And(tuple(terms)) if len(terms) > 1 else terms[0]
+
+    # --- abstract evaluation of terms --------------------------------------
+    def _abstract(self, term, env: dict):
+        if isinstance(term, ast.Scalar):
+            return ConstVal(term.value)
+        if isinstance(term, ast.Var):
+            if term.name in env:
+                return env[term.name]
+            if term.name == "input":
+                return PathVal(())
+            return OpaqueVal(f"unbound var {term.name}")
+        if isinstance(term, ast.Ref):
+            return self._abstract_ref(term, env)
+        if isinstance(term, ast.SetCompr):
+            return self._abstract_set_compr(term, env)
+        if isinstance(term, ast.Call):
+            if term.op == "minus" and len(term.args) == 2:
+                a = self._abstract(term.args[0], env)
+                b = self._abstract(term.args[1], env)
+                if isinstance(a, ParamListSetVal) and isinstance(b, KeySetVal):
+                    return SetDiffVal(a, b)
+                return OpaqueVal("minus of non set-pattern")
+            return OpaqueVal(f"call {term.op}")
+        return OpaqueVal(type(term).__name__)
+
+    def _abstract_ref(self, term: ast.Ref, env: dict):
+        base = self._abstract(term.head, env)
+        for arg in term.args:
+            if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
+                base = self._step(base, arg.value)
+            elif isinstance(arg, ast.Var) and (
+                arg.name.startswith("$w") or arg.name not in env
+            ):
+                # wildcard / fresh var: iteration
+                base = self._iterate(base)
+            else:
+                return OpaqueVal("computed ref index")
+            if isinstance(base, OpaqueVal):
+                return base
+        return base
+
+    def _step(self, base, key: str):
+        if isinstance(base, PathVal):
+            if base.path == ("parameters",):
+                return ParamVal(key)
+            return PathVal(base.path + (key,))
+        if isinstance(base, ItemVal):
+            return ItemVal(base.axis, base.subpath + (key,), base.instance)
+        if isinstance(base, ParamVal):
+            return OpaqueVal(f"nested parameter path {base.name}.{key}")
+        if isinstance(base, OpaqueVal):
+            return base
+        return OpaqueVal(f"step on {type(base).__name__}")
+
+    def _iterate(self, base):
+        """A `[_]` step: iterate a list → ragged axis."""
+        if isinstance(base, PathVal):
+            if len(base.path) < 2 or base.path[:2] != OBJECT_ROOT:
+                return OpaqueVal("iteration outside review object")
+            rel = base.path[2:]
+            return ItemVal(Axis(((rel,),)), (), self._fresh_instance())
+        if isinstance(base, ItemVal):
+            # nested list: extend every segment with the subpath as a part
+            segs = tuple(seg + (base.subpath,) for seg in base.axis.segments)
+            return ItemVal(Axis(segs), (), self._fresh_instance())
+        if isinstance(base, ParamVal):
+            return ParamElemVal(base.name)
+        if isinstance(base, OpaqueVal):
+            return base
+        return OpaqueVal(f"iterate {type(base).__name__}")
+
+    def _abstract_set_compr(self, term: ast.SetCompr, env: dict):
+        # {l | <labels-path>[l]}  → KeySetVal
+        # {l | l := input.parameters.X[_]} → ParamListSetVal
+        if not isinstance(term.term, ast.Var):
+            return OpaqueVal("set comprehension head")
+        v = term.term.name
+        if len(term.body) != 1:
+            return OpaqueVal("multi-stmt set comprehension")
+        stmt = term.body[0]
+        if isinstance(stmt, ast.ExprStmt) and isinstance(stmt.term, ast.Ref):
+            ref = stmt.term
+            if (ref.args and isinstance(ref.args[-1], ast.Var)
+                    and ref.args[-1].name == v):
+                base = self._abstract(
+                    ast.Ref(ref.head, ref.args[:-1]), env
+                )
+                if isinstance(base, PathVal):
+                    return KeySetVal(base.path)
+            return OpaqueVal("set comprehension ref form")
+        if isinstance(stmt, ast.AssignStmt) and isinstance(stmt.target, ast.Var) \
+                and stmt.target.name == v:
+            inner = self._abstract(stmt.term, env)
+            if isinstance(inner, ParamElemVal):
+                return ParamListSetVal(inner.name)
+            return OpaqueVal("set comprehension assign form")
+        return OpaqueVal("set comprehension body")
+
+    # --- predicates ---------------------------------------------------------
+    def _lower_pred(self, term, env: dict, negated: bool):
+        """Returns (expr|None, (axis, instance)|None); None expr = skip.
+
+        Negation closes over the wildcard existential:  ``not p(x[_])`` is
+        ¬∃i.p(x[i]), an object-level predicate — never ∃i.¬p(x[i])."""
+        pred, axis_inst = self._lower_pred_inner(term, env)
+        if pred is None:
+            return None, None
+        if negated:
+            if axis_inst is not None:
+                return N.Not(N.AnyAxis(axis_inst[0], pred)), None
+            return N.Not(pred), None
+        return pred, axis_inst
+
+    def _lower_pred_inner(self, term, env: dict):
+        if isinstance(term, (ast.Ref, ast.Var)):
+            val = self._abstract(term, env)
+            return self._truthy(val)
+        if isinstance(term, ast.Call):
+            return self._lower_call_pred(term, env)
+        if isinstance(term, ast.Scalar):
+            return N.ConstBool(term.value is not False), None
+        raise LowerError(f"predicate {type(term).__name__}")
+
+    def _truthy(self, val):
+        if isinstance(val, PathVal):
+            col = self._scalar_col(val)
+            return N.Truthy(col), None
+        if isinstance(val, ItemVal):
+            col = self._ragged_col(val)
+            return N.Truthy(col), (val.axis, val.instance)
+        if isinstance(val, ParamVal):
+            self._note_param(val.name, "bool")
+            return N.ParamTruthy(val.name), None
+        if isinstance(val, ConstVal):
+            return N.ConstBool(val.value is not False and val.value is not None), None
+        if isinstance(val, OpaqueVal):
+            raise LowerError(f"opaque predicate: {val.why}")
+        raise LowerError(f"truthiness of {type(val).__name__}")
+
+    def _lower_call_pred(self, term: ast.Call, env: dict):
+        op = term.op
+        if op in ("lt", "lte", "gt", "gte", "equal", "neq"):
+            return self._lower_cmp(op, term.args, env)
+        if op == "count":
+            raise LowerError("bare count call as predicate")
+        # user function / bool rule inlining:
+        fn_rule = self.entry_mod.rules.get(op)
+        if fn_rule is not None:
+            return self._inline_rule(fn_rule, term.args, env)
+        raise LowerError(f"call {op}")
+
+    def _lower_cmp(self, op: str, args, env: dict):
+        lhs_t, rhs_t = args
+        # count(X) OP n
+        if (isinstance(lhs_t, ast.Call) and lhs_t.op == "count"
+                and isinstance(rhs_t, ast.Scalar)):
+            return self._lower_count_cmp(op, lhs_t.args[0], rhs_t.value, env)
+        lhs = self._abstract(lhs_t, env)
+        rhs = self._abstract(rhs_t, env)
+        axis = None
+        for v in (lhs, rhs):
+            if isinstance(v, ItemVal):
+                if axis is not None and (v.axis, v.instance) != axis:
+                    # two independent existentials can't fuse elementwise
+                    raise LowerError("cross-instance comparison")
+                axis = (v.axis, v.instance)
+        # equality against a boolean constant: x == true / x == false
+        if op in ("equal", "neq"):
+            for a, b in ((lhs, rhs), (rhs, lhs)):
+                if isinstance(b, ConstVal) and isinstance(b.value, bool):
+                    pred, paxis = self._bool_eq(a, b.value)
+                    if op == "neq":
+                        pred = N.Not(pred)
+                    return pred, paxis
+        str_side = self._is_stringy(lhs) or self._is_stringy(rhs)
+        if str_side:
+            if op not in ("equal", "neq"):
+                raise LowerError("ordered comparison on strings")
+            lo = self._sid_operand(lhs)
+            ro = self._sid_operand(rhs)
+            return N.EqStr(lo, ro, negate=(op == "neq")), axis
+        lo = self._num_operand(lhs)
+        ro = self._num_operand(rhs)
+        op_map = {"equal": "eq", "neq": "neq"}
+        return N.CmpNum(lo, op_map.get(op, op), ro), axis
+
+    def _bool_eq(self, val, want: bool):
+        """x == true  ⇔ kind==K_TRUE; x == false ⇔ kind==K_FALSE.  Truthy
+        covers ==true only for bools; use explicit kind tests via Truthy and
+        Present: (x==true) = Truthy∧IsBool… we approximate with Truthy-based
+        forms that are exact for boolean-valued fields."""
+        if isinstance(val, PathVal):
+            col = self._scalar_col(val)
+            axis = None
+        elif isinstance(val, ItemVal):
+            col = self._ragged_col(val)
+            axis = (val.axis, val.instance)
+        elif isinstance(val, ParamVal):
+            self._note_param(val.name, "bool")
+            p = N.ParamTruthy(val.name)
+            return (p if want else N.And((N.ParamPresent(val.name), N.Not(p)))), None
+        else:
+            raise LowerError("bool equality operand")
+        t = N.Truthy(col)
+        if want:
+            return t, axis
+        return N.And((N.Present(col), N.Not(t))), axis
+
+    def _lower_count_cmp(self, op: str, set_term, n, env: dict):
+        val = self._abstract(set_term, env)
+        if not isinstance(val, SetDiffVal):
+            raise LowerError("count() of non set-diff pattern")
+        self._note_param(val.required.name, "strlist")
+        keyset = KeySetCol(path=val.provided.path[2:]) if (
+            val.provided.path[:2] == OBJECT_ROOT
+        ) else None
+        if keyset is None:
+            raise LowerError("keyset outside review object")
+        if keyset not in self.schema.keysets:
+            self.schema.keysets.append(keyset)
+        missing_any = N.AnyParamStrList(
+            val.required.name,
+            N.Not(N.KeySetContains(keyset, N.ParamElemSid())),
+        )
+        if op == "gt" and n == 0:
+            return missing_any, None
+        if op in ("equal", "lte") and n == 0:
+            return N.Not(missing_any), None
+        raise LowerError(f"count comparison {op} {n}")
+
+    def _inline_rule(self, rule: ast.Rule, args, env: dict):
+        self.depth += 1
+        if self.depth > 16:
+            raise LowerError("function inlining too deep")
+        try:
+            if rule.kind not in ("function", "complete"):
+                raise LowerError(f"call of {rule.kind} rule")
+            arg_vals = [self._abstract(a, env) for a in args]
+            clause_exprs = []
+            for clause in rule.clauses:
+                if clause.els is not None:
+                    raise LowerError("else in inlined function")
+                if clause.value is not None and not (
+                    isinstance(clause.value, ast.Scalar)
+                    and clause.value.value is True
+                ):
+                    raise LowerError("non-boolean function result")
+                fenv: dict = {}
+                params = clause.args or ()
+                if len(params) != len(arg_vals):
+                    raise LowerError("arity mismatch in inlined call")
+                for p, v in zip(params, arg_vals):
+                    if not isinstance(p, ast.Var):
+                        raise LowerError("pattern parameter")
+                    fenv[p.name] = v
+                clause_exprs.append(self._lower_body(clause.body, fenv))
+            if not clause_exprs:
+                raise LowerError("empty function")
+            expr = (
+                N.Or(tuple(clause_exprs))
+                if len(clause_exprs) > 1
+                else clause_exprs[0]
+            )
+            return expr, None
+        finally:
+            self.depth -= 1
+
+    # --- operand helpers ----------------------------------------------------
+    def _is_stringy(self, val) -> bool:
+        if isinstance(val, ConstVal):
+            return isinstance(val.value, str)
+        if isinstance(val, ParamVal):
+            hint = self.schema_hint.get(val.name, {})
+            return hint.get("type") == "string"
+        if isinstance(val, ParamElemVal):
+            return True
+        return False
+
+    def _num_operand(self, val):
+        if isinstance(val, ConstVal):
+            if isinstance(val.value, bool) or not isinstance(val.value, (int, float)):
+                raise LowerError(f"non-numeric constant {val.value!r}")
+            return N.ConstNum(float(val.value))
+        if isinstance(val, ParamVal):
+            self._note_param(val.name, "num")
+            return N.ParamNum(val.name)
+        if isinstance(val, PathVal):
+            return N.FeatNum(self._scalar_col(val))
+        if isinstance(val, ItemVal):
+            return N.FeatNum(self._ragged_col(val))
+        raise LowerError(f"numeric operand {type(val).__name__}")
+
+    def _sid_operand(self, val):
+        if isinstance(val, ConstVal):
+            if not isinstance(val.value, str):
+                raise LowerError("non-string constant in string compare")
+            return N.ConstSid(self._intern_const(val.value))
+        if isinstance(val, ParamVal):
+            self._note_param(val.name, "str")
+            return N.ParamSid(val.name)
+        if isinstance(val, ParamElemVal):
+            return N.ParamElemSid()
+        if isinstance(val, PathVal):
+            return N.FeatSid(self._scalar_col(val))
+        if isinstance(val, ItemVal):
+            return N.FeatSid(self._ragged_col(val))
+        raise LowerError(f"string operand {type(val).__name__}")
+
+    def _intern_const(self, s: str) -> int:
+        # Vocab ids are stable once assigned, so interning at compile time is
+        # safe across later batches.
+        return self.vocab.intern(s)
+
+    def _scalar_col(self, val: PathVal) -> ScalarCol:
+        if val.path[:2] != OBJECT_ROOT:
+            # allow review-level scalars too (e.g. review.operation)
+            if val.path[:1] != ("review",):
+                raise LowerError(f"path outside review: {val.path}")
+        col = ScalarCol(path=val.path[2:] if val.path[:2] == OBJECT_ROOT
+                        else ("__review__",) + val.path[1:])
+        if val.path[:2] != OBJECT_ROOT:
+            raise LowerError("review-level scalars not yet columnized")
+        if col not in self.schema.scalars:
+            self.schema.scalars.append(col)
+        return col
+
+    def _ragged_col(self, val: ItemVal) -> RaggedCol:
+        col = RaggedCol(axis=val.axis, subpath=val.subpath)
+        if col not in self.schema.raggeds:
+            self.schema.raggeds.append(col)
+        return col
+
+    def _note_param(self, name: str, kind: str):
+        prev = self.param_kinds.get(name)
+        if prev is not None and prev != kind:
+            # bool usage is compatible with any (truthiness of any param)
+            if "bool" in (prev, kind):
+                self.param_kinds[name] = prev if prev != "bool" else kind
+                return
+            raise LowerError(f"param {name} used as {prev} and {kind}")
+        self.param_kinds[name] = kind
+
+
+def lower_template(modules, entry_pkg: tuple, template_kind: str,
+                   vocab, schema_hint: Optional[dict] = None) -> N.Program:
+    """Lower a compiled template to a Program, or raise LowerError."""
+    low = _Lowerer(modules, entry_pkg, schema_hint, vocab)
+    # set rules referenced with [_] (e.g. input_containers) are handled when
+    # the reference is abstract-evaluated; pre-bind them as union axes.
+    low.entry_axis_rules = _collect_axis_rules(low)
+    expr = _with_axis_rules(low)
+    params = tuple(
+        N.ParamSpec(name=k, kind=v) for k, v in sorted(low.param_kinds.items())
+    )
+    return N.Program(
+        template_kind=template_kind,
+        expr=expr,
+        params=params,
+        schema=low.schema,
+    )
+
+
+def _collect_axis_rules(low: _Lowerer) -> dict:
+    """Set rules of the form  name[c] { c := <list-path>[_] }  become union
+    axes usable via  name[_]  (PSP pattern input_containers)."""
+    out: dict[str, Axis] = {}
+    for name, rule in low.entry_mod.rules.items():
+        if rule.kind != "set":
+            continue
+        if name == "violation":
+            continue
+        segments = []
+        ok = True
+        for clause in rule.clauses:
+            seg = _clause_as_list_path(low, clause)
+            if seg is None:
+                ok = False
+                break
+            segments.extend(seg)
+        if ok and segments:
+            out[name] = Axis(tuple(segments))
+    return out
+
+
+def _clause_as_list_path(low: _Lowerer, clause) -> Optional[list]:
+    if clause.els is not None or clause.args is not None:
+        return None
+    if not isinstance(clause.key, ast.Var) or len(clause.body) != 1:
+        return None
+    stmt = clause.body[0]
+    if not isinstance(stmt, ast.AssignStmt):
+        return None
+    if not isinstance(stmt.target, ast.Var) or stmt.target.name != clause.key.name:
+        return None
+    val = low._abstract(stmt.term, {})
+    if isinstance(val, ItemVal) and not val.subpath:
+        return list(val.axis.segments)
+    return None
+
+
+def _with_axis_rules(low: _Lowerer) -> N.Expr:
+    """Patch the lowerer so refs to axis set-rules resolve, then lower."""
+    axis_rules = low.entry_axis_rules
+
+    orig_abstract = low._abstract
+
+    def patched(term, env):
+        if isinstance(term, ast.Ref) and isinstance(term.head, ast.Var):
+            name = term.head.name
+            if name in axis_rules and name not in env:
+                base = ItemVal(axis_rules[name], ())
+                consumed = False
+                cur = base
+                for arg in term.args:
+                    if not consumed:
+                        # first arg must be the iteration wildcard
+                        if isinstance(arg, ast.Var) and (
+                            arg.name.startswith("$w") or arg.name not in env
+                        ):
+                            consumed = True
+                            continue
+                        return OpaqueVal("axis rule indexed oddly")
+                    if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
+                        cur = low._step(cur, arg.value)
+                    elif isinstance(arg, ast.Var) and (
+                        arg.name.startswith("$w") or arg.name not in env
+                    ):
+                        cur = low._iterate(cur)
+                    else:
+                        return OpaqueVal("axis rule computed index")
+                return cur
+        return orig_abstract(term, env)
+
+    low._abstract = patched
+    return low.lower_violation()

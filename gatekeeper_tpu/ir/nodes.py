@@ -1,0 +1,180 @@
+"""Predicate IR: the lowered form of a template's violation conditions.
+
+A template lowers to one boolean expression per violation clause (OR'd); the
+expression reads flattened columns (gatekeeper_tpu.ops.flatten) and one
+constraint's parameter row.  The JAX evaluator (gatekeeper_tpu.ir.program)
+vmaps the expression over the constraint axis and jits over the object batch —
+the "constraint-program × object batch" grid of SURVEY.md §5.7.
+
+Messages and details are NOT lowered: the device detects violations, the host
+renders messages by re-running the exact interpreter only on hits (sparse).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Union
+
+from gatekeeper_tpu.ops.flatten import Axis, KeySetCol, RaggedCol, ScalarCol
+
+FeatCol = Union[ScalarCol, RaggedCol]
+
+
+class Expr:
+    __slots__ = ()
+
+
+# --- leaf references ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Truthy(Expr):
+    """Rego statement-truthiness of the value at a column: defined and not
+    false (null/0/"" are truthy in Rego)."""
+
+    col: FeatCol
+
+
+@dataclass(frozen=True)
+class Present(Expr):
+    col: FeatCol
+
+
+@dataclass(frozen=True)
+class FeatNum(Expr):
+    col: FeatCol
+
+
+@dataclass(frozen=True)
+class FeatSid(Expr):
+    col: FeatCol
+
+
+@dataclass(frozen=True)
+class ParamNum(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class ParamSid(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class ParamTruthy(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class ParamPresent(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class ConstNum(Expr):
+    value: float
+
+
+@dataclass(frozen=True)
+class ConstSid(Expr):
+    sid: int
+
+
+@dataclass(frozen=True)
+class ParamElemSid(Expr):
+    """Current element inside AnyParamStrList."""
+
+
+# --- predicates -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CmpNum(Expr):
+    """Numeric comparison; false unless both sides are defined numbers."""
+
+    lhs: Expr
+    op: str  # lt | lte | gt | gte | eq | neq
+    rhs: Expr
+
+
+@dataclass(frozen=True)
+class EqStr(Expr):
+    lhs: Expr  # FeatSid / ParamSid / ConstSid / ParamElemSid
+    rhs: Expr
+    negate: bool = False
+
+
+@dataclass(frozen=True)
+class InStrList(Expr):
+    """value ∈ string-list parameter."""
+
+    needle: Expr  # sid-valued
+    param: str
+
+
+@dataclass(frozen=True)
+class KeySetContains(Expr):
+    """needle ∈ keys of map column (e.g. a label key in metadata.labels)."""
+
+    keyset: KeySetCol
+    needle: Expr  # sid-valued
+
+
+# --- combinators ----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    inner: Expr
+
+
+@dataclass(frozen=True)
+class And(Expr):
+    terms: tuple
+
+
+@dataclass(frozen=True)
+class Or(Expr):
+    terms: tuple
+
+
+@dataclass(frozen=True)
+class AnyAxis(Expr):
+    """∃ item on ragged axis satisfying inner (inner may use the axis's
+    RaggedCols)."""
+
+    axis: Axis
+    inner: Expr
+
+
+@dataclass(frozen=True)
+class AnyParamStrList(Expr):
+    """∃ element of string-list parameter satisfying inner (inner uses
+    ParamElemSid) — e.g. required-labels: any required label missing."""
+
+    param: str
+    inner: Expr
+
+
+@dataclass(frozen=True)
+class ConstBool(Expr):
+    value: bool
+
+
+# --- parameter specs ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParamSpec:
+    name: str
+    kind: str  # num | str | bool | strlist | numlist
+
+
+@dataclass
+class Program:
+    """A lowered template: violation ⇔ expr true for (object, constraint)."""
+
+    template_kind: str
+    expr: Expr
+    params: tuple  # tuple[ParamSpec]
+    schema: "object"  # ops.flatten.Schema with the columns this expr reads

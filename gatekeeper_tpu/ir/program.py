@@ -1,0 +1,349 @@
+"""JAX evaluation of predicate programs: the TPU kernel of the framework.
+
+Execution model (TPU-first):
+- One compiled XLA program per (template, batch-shape bucket).  Inside, the
+  expression is evaluated in plain jnp ops — elementwise/compare/gather ops
+  that XLA fuses into a handful of kernels — and ``vmap`` lifts it over the
+  constraint axis, giving the [C, N] verdict grid in one launch.
+- All shapes static: ragged axes are pad+count (round_up buckets), string ids
+  int32, numbers float32, verdict bool.
+- The same compiled fn serves webhook microbatches (small N) and audit sweeps
+  (large N, sharded over a Mesh by the caller — see parallel/).
+
+Reference anchor: this replaces the per-constraint Go loop at
+pkg/drivers/k8scel/driver.go:194 and the per-object audit loop at
+pkg/audit/manager.go:686-774 with a single masked vmap'd evaluation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gatekeeper_tpu.ir import nodes as N
+from gatekeeper_tpu.ops.flatten import (
+    ColumnBatch,
+    K_NUM,
+    K_STR,
+    K_TRUE,
+    KeySetCol,
+    RaggedCol,
+    ScalarCol,
+    Vocab,
+    round_up,
+)
+
+
+def col_key(spec) -> str:
+    """Stable string key for a column spec (jit pytrees need sortable dict
+    keys)."""
+    if isinstance(spec, ScalarCol):
+        return "sc:" + ".".join(spec.path)
+    if isinstance(spec, RaggedCol):
+        return "rg:" + spec.axis.key() + ":" + ".".join(spec.subpath)
+    if isinstance(spec, KeySetCol):
+        return "ks:" + ".".join(spec.path)
+    raise LowerError(f"unknown column spec {spec}")
+
+
+def axis_key(axis) -> str:
+    return "ax:" + axis.key()
+
+
+class LowerError(Exception):
+    """Raised when a template/expression is outside the vectorizable subset."""
+
+
+# --------------------------------------------------------------------------
+# parameter tables
+# --------------------------------------------------------------------------
+
+
+def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
+    """Pack constraint parameters into arrays [C, ...] for vmap.
+
+    Unseen strings are interned (parameters are part of the program, so their
+    vocabulary must be in the table before eval).
+    """
+    c = len(constraints)
+    # always one leaf so vmap has a mapped axis even for param-less templates
+    table: dict[str, Any] = {"__row__": jnp.zeros(c, jnp.int8)}
+    for spec in program.params:
+        params = [
+            (con.parameters or {}) if isinstance(con.parameters, dict) else {}
+            for con in constraints
+        ]
+        vals = [p.get(spec.name) for p in params]
+        if spec.kind == "num":
+            table[f"{spec.name}__num"] = jnp.asarray(
+                [float(v) if isinstance(v, (int, float)) and not isinstance(v, bool)
+                 else 0.0 for v in vals], jnp.float32)
+            table[f"{spec.name}__present"] = jnp.asarray(
+                [isinstance(v, (int, float)) and not isinstance(v, bool)
+                 for v in vals], jnp.bool_)
+        elif spec.kind == "str":
+            table[f"{spec.name}__sid"] = jnp.asarray(
+                [vocab.intern(v) if isinstance(v, str) else -2 for v in vals],
+                jnp.int32)
+            table[f"{spec.name}__present"] = jnp.asarray(
+                [isinstance(v, str) for v in vals], jnp.bool_)
+        elif spec.kind == "bool":
+            # kind-style: 0 absent, 1 false, 2 true
+            table[f"{spec.name}__kind"] = jnp.asarray(
+                [0 if not isinstance(v, bool) and v is None else
+                 (2 if v is True else (1 if v is False else 2))
+                 for v in vals], jnp.int8)
+        elif spec.kind == "strlist":
+            lists = [
+                [vocab.intern(x) for x in v if isinstance(x, str)]
+                if isinstance(v, list) else [] for v in vals
+            ]
+            k = round_up(max((len(x) for x in lists), default=0))
+            arr = np.full((c, k), -1, np.int32)
+            cnt = np.zeros(c, np.int32)
+            for i, xs in enumerate(lists):
+                cnt[i] = len(xs)
+                arr[i, : len(xs)] = xs
+            table[f"{spec.name}__sids"] = jnp.asarray(arr)
+            table[f"{spec.name}__count"] = jnp.asarray(cnt)
+        elif spec.kind == "numlist":
+            lists = [
+                [float(x) for x in v
+                 if isinstance(x, (int, float)) and not isinstance(x, bool)]
+                if isinstance(v, list) else [] for v in vals
+            ]
+            k = round_up(max((len(x) for x in lists), default=0))
+            arr = np.zeros((c, k), np.float32)
+            cnt = np.zeros(c, np.int32)
+            for i, xs in enumerate(lists):
+                cnt[i] = len(xs)
+                arr[i, : len(xs)] = xs
+            table[f"{spec.name}__nums"] = jnp.asarray(arr)
+            table[f"{spec.name}__count"] = jnp.asarray(cnt)
+        else:
+            raise LowerError(f"unknown param kind {spec.kind}")
+    return table
+
+
+# --------------------------------------------------------------------------
+# expression evaluation (single constraint row; vmap adds the C axis)
+# --------------------------------------------------------------------------
+
+
+class _Ctx:
+    __slots__ = ("cols", "row", "axis", "elem_k")
+
+    def __init__(self, cols: dict, row: dict):
+        self.cols = cols  # column spec -> arrays dict
+        self.row = row  # one constraint's parameter row
+        self.axis = None  # active Axis inside AnyAxis
+        self.elem_k = None  # active K inside AnyParamStrList
+
+
+def _feat_arrays(ctx: _Ctx, col) -> dict:
+    try:
+        return ctx.cols[col_key(col)]
+    except KeyError:
+        raise LowerError(f"column {col} not in batch") from None
+
+
+def _expand_for_ctx(ctx: _Ctx, arr, is_ragged: bool):
+    """Bring a feature array to the active [N, M?, K?] shape."""
+    if ctx.axis is not None and not is_ragged:
+        arr = arr[:, None]
+    if ctx.elem_k is not None:
+        arr = arr[..., None]
+    return arr
+
+
+def _eval_numlike(ctx: _Ctx, e: N.Expr):
+    """Returns (value_array, valid_array) broadcastable in the active shape."""
+    if isinstance(e, N.FeatNum):
+        a = _feat_arrays(ctx, e.col)
+        ragged = isinstance(e.col, RaggedCol)
+        return (
+            _expand_for_ctx(ctx, a["num"], ragged),
+            _expand_for_ctx(ctx, a["kind"] == K_NUM, ragged),
+        )
+    if isinstance(e, N.ParamNum):
+        return ctx.row[f"{e.name}__num"], ctx.row[f"{e.name}__present"]
+    if isinstance(e, N.ConstNum):
+        return jnp.float32(e.value), jnp.bool_(True)
+    raise LowerError(f"not a numeric operand: {e}")
+
+
+def _eval_sidlike(ctx: _Ctx, e: N.Expr):
+    if isinstance(e, N.FeatSid):
+        a = _feat_arrays(ctx, e.col)
+        ragged = isinstance(e.col, RaggedCol)
+        return (
+            _expand_for_ctx(ctx, a["sid"], ragged),
+            _expand_for_ctx(ctx, a["kind"] == K_STR, ragged),
+        )
+    if isinstance(e, N.ParamSid):
+        return ctx.row[f"{e.name}__sid"], ctx.row[f"{e.name}__present"]
+    if isinstance(e, N.ConstSid):
+        return jnp.int32(e.sid), jnp.bool_(True)
+    if isinstance(e, N.ParamElemSid):
+        if ctx.elem_k is None:
+            raise LowerError("ParamElemSid outside AnyParamStrList")
+        return ctx.elem_k, jnp.bool_(True)
+    raise LowerError(f"not a string operand: {e}")
+
+
+_CMP = {
+    "lt": jnp.less,
+    "lte": jnp.less_equal,
+    "gt": jnp.greater,
+    "gte": jnp.greater_equal,
+    "eq": jnp.equal,
+    "neq": jnp.not_equal,
+}
+
+
+def eval_expr(ctx: _Ctx, e: N.Expr):
+    if isinstance(e, N.ConstBool):
+        return jnp.bool_(e.value)
+    if isinstance(e, N.Truthy):
+        a = _feat_arrays(ctx, e.col)
+        ragged = isinstance(e.col, RaggedCol)
+        return _expand_for_ctx(ctx, a["kind"] >= K_TRUE, ragged)
+    if isinstance(e, N.Present):
+        a = _feat_arrays(ctx, e.col)
+        ragged = isinstance(e.col, RaggedCol)
+        return _expand_for_ctx(ctx, a["kind"] > 0, ragged)
+    if isinstance(e, N.ParamTruthy):
+        return ctx.row[f"{e.name}__kind"] >= 2
+    if isinstance(e, N.ParamPresent):
+        return ctx.row[f"{e.name}__kind"] > 0
+    if isinstance(e, N.CmpNum):
+        lv, lok = _eval_numlike(ctx, e.lhs)
+        rv, rok = _eval_numlike(ctx, e.rhs)
+        return lok & rok & _CMP[e.op](lv, rv)
+    if isinstance(e, N.EqStr):
+        lv, lok = _eval_sidlike(ctx, e.lhs)
+        rv, rok = _eval_sidlike(ctx, e.rhs)
+        eq = jnp.equal(lv, rv)
+        out = lok & rok & (jnp.logical_not(eq) if e.negate else eq)
+        return out
+    if isinstance(e, N.InStrList):
+        nv, nok = _eval_sidlike(ctx, e.needle)
+        sids = ctx.row[f"{e.param}__sids"]  # [K]
+        cnt = ctx.row[f"{e.param}__count"]
+        k = sids.shape[-1]
+        valid = jnp.arange(k) < cnt
+        hit = jnp.any(
+            (nv[..., None] == sids) & valid, axis=-1
+        )
+        return nok & hit
+    if isinstance(e, N.KeySetContains):
+        col = ctx.cols.get(col_key(e.keyset))
+        if col is None:
+            raise LowerError(f"keyset column {e.keyset} not in batch")
+        nv, nok = _eval_sidlike(ctx, e.needle)
+        keys = col["sid"]  # [N, L]
+        cnt = col["count"]  # [N]
+        l = keys.shape[-1]
+        valid = jnp.arange(l) < cnt[:, None]  # [N, L]
+        if ctx.axis is not None:
+            keys, valid = keys[:, None, :], valid[:, None, :]
+        if ctx.elem_k is not None:
+            # needle is [K]; keys [N(,1),L] -> compare [N(,1),K,L]
+            hit = jnp.any(
+                (keys[..., None, :] == nv[..., :, None]) & valid[..., None, :],
+                axis=-1,
+            )
+            return hit & nok
+        hit = jnp.any((keys == nv[..., None]) & valid, axis=-1)
+        return hit & nok
+    if isinstance(e, N.Not):
+        return jnp.logical_not(eval_expr(ctx, e.inner))
+    if isinstance(e, N.And):
+        out = None
+        for t in e.terms:
+            v = eval_expr(ctx, t)
+            out = v if out is None else (out & v)
+        return out if out is not None else jnp.bool_(True)
+    if isinstance(e, N.Or):
+        out = None
+        for t in e.terms:
+            v = eval_expr(ctx, t)
+            out = v if out is None else (out | v)
+        return out if out is not None else jnp.bool_(False)
+    if isinstance(e, N.AnyAxis):
+        if ctx.axis is not None:
+            raise LowerError("nested AnyAxis unsupported (flatten the axis)")
+        counts = ctx.cols[axis_key(e.axis)]  # [N]
+        ctx.axis = e.axis
+        try:
+            inner = eval_expr(ctx, e.inner)  # [N, M] (+K)
+        finally:
+            ctx.axis = None
+        m = inner.shape[1]
+        valid = jnp.arange(m) < counts[:, None]
+        if inner.ndim == 3:
+            valid = valid[..., None]
+        return jnp.any(inner & valid, axis=1)
+    if isinstance(e, N.AnyParamStrList):
+        if ctx.elem_k is not None:
+            raise LowerError("nested AnyParamStrList unsupported")
+        sids = ctx.row[f"{e.param}__sids"]  # [K]
+        cnt = ctx.row[f"{e.param}__count"]
+        ctx.elem_k = sids
+        try:
+            inner = eval_expr(ctx, e.inner)  # [..., K]
+        finally:
+            ctx.elem_k = None
+        k = sids.shape[-1]
+        valid = jnp.arange(k) < cnt
+        return jnp.any(inner & valid, axis=-1)
+    raise LowerError(f"cannot evaluate IR node {e}")
+
+
+# --------------------------------------------------------------------------
+# compiled program
+# --------------------------------------------------------------------------
+
+
+class CompiledProgram:
+    """One template's verdict kernel: (batch arrays, param table) -> [C, N]."""
+
+    def __init__(self, program: N.Program):
+        self.program = program
+        self._fn = jax.jit(self._build())  # retraces per shape bucket
+
+    def _build(self):
+        expr = self.program.expr
+        schema = self.program.schema
+
+        def single(row: dict, col_arrays: dict):
+            ctx = _Ctx(col_arrays, row)
+            return eval_expr(ctx, expr)
+
+        def batch_fn(param_table: dict, col_arrays: dict):
+            return jax.vmap(lambda row: single(row, col_arrays))(param_table)
+
+        return batch_fn
+
+    def run(self, batch: ColumnBatch, param_table: dict) -> np.ndarray:
+        """Returns verdicts [C, N] (numpy bool)."""
+        cols: dict = {}
+        for spec, col in batch.scalars.items():
+            cols[col_key(spec)] = {"kind": jnp.asarray(col.kind),
+                                   "num": jnp.asarray(col.num),
+                                   "sid": jnp.asarray(col.sid)}
+        for spec, col in batch.raggeds.items():
+            cols[col_key(spec)] = {"kind": jnp.asarray(col.kind),
+                                   "num": jnp.asarray(col.num),
+                                   "sid": jnp.asarray(col.sid)}
+        for axis, cnt in batch.axis_counts.items():
+            cols[axis_key(axis)] = jnp.asarray(cnt)
+        for spec, col in batch.keysets.items():
+            cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
+                                   "count": jnp.asarray(col.count)}
+        out = self._fn(param_table, cols)
+        return np.asarray(out)

@@ -1,0 +1,306 @@
+"""Columnar flattening of Kubernetes objects — the host→device boundary.
+
+The TPU eval plane never sees JSON.  At template-compile time the lowering pass
+requests *columns* (scalar paths, ragged axes, map-key sets); this module
+extracts those columns from a batch of objects into dense numpy arrays with
+interned strings, pad+count ragged encoding, and per-value kind tags.  This is
+the TPU-native replacement for the reference's per-object ``unstructured``
+walking inside the Rego VM (SURVEY.md §7: "objects flatten to a columnar
+encoding with segment IDs for ragged lists").
+
+Design notes
+- Strings are interned into a growing ``Vocab`` (host side).  Device programs
+  only ever compare int32 ids; message text never reaches the device.
+- Every scalar column carries (kind, num, sid) triples so one column encoding
+  serves truthiness, numeric and string predicates:
+      kind: 0=absent 1=false 2=true 3=number 4=string 5=other(list/dict/null)
+- Ragged axes pad to the batch max (bucketed by the caller to limit
+  recompiles); counts gate reductions so padding never changes verdicts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+# value-kind tags
+K_ABSENT, K_FALSE, K_TRUE, K_NUM, K_STR, K_OTHER = 0, 1, 2, 3, 4, 5
+
+
+class Vocab:
+    """Host-side string interner.  id 0 is reserved for ""; -1 means absent."""
+
+    def __init__(self):
+        self._to_id: dict[str, int] = {"": 0}
+        self._to_str: list[str] = [""]
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Intern-free lookup: -2 if unseen (never equal to any feature id)."""
+        return self._to_id.get(s, -2)
+
+    def string(self, i: int) -> str:
+        return self._to_str[i]
+
+    def __len__(self):
+        return len(self._to_str)
+
+
+# --- column specs (requested by the lowering pass) ------------------------
+
+
+@dataclass(frozen=True)
+class Axis:
+    """A ragged iteration axis: one or more nested list paths, unioned.
+
+    Each segment is a tuple of path-parts; the first part locates the outer
+    list under the object root, each subsequent part locates a nested list
+    under an item.  E.g.
+        (("spec", "containers"),)                  -> containers
+        (("spec", "containers"), ("ports",))       -> all ports of all containers
+    Multiple segments concatenate (reference pattern: input_containers unions
+    containers + initContainers, psp templates).
+    """
+
+    segments: tuple
+
+    def key(self) -> str:
+        return "|".join(
+            "/".join(".".join(p) for p in seg) for seg in self.segments
+        )
+
+
+@dataclass(frozen=True)
+class ScalarCol:
+    path: tuple  # keys under the review-object root
+
+
+@dataclass(frozen=True)
+class RaggedCol:
+    axis: Axis
+    subpath: tuple  # keys under an axis item ( () = the item itself )
+
+
+@dataclass(frozen=True)
+class KeySetCol:
+    """The set of keys of the map at ``path`` (e.g. metadata.labels)."""
+
+    path: tuple
+
+
+@dataclass
+class Schema:
+    scalars: list = field(default_factory=list)
+    raggeds: list = field(default_factory=list)
+    keysets: list = field(default_factory=list)
+
+    def merge(self, other: "Schema") -> None:
+        for s in other.scalars:
+            if s not in self.scalars:
+                self.scalars.append(s)
+        for r in other.raggeds:
+            if r not in self.raggeds:
+                self.raggeds.append(r)
+        for k in other.keysets:
+            if k not in self.keysets:
+                self.keysets.append(k)
+
+    def axes(self) -> list:
+        out = []
+        for r in self.raggeds:
+            if r.axis not in out:
+                out.append(r.axis)
+        return out
+
+
+# --- flattened batch ------------------------------------------------------
+
+
+@dataclass
+class ScalarColumn:
+    kind: np.ndarray  # [N] int8
+    num: np.ndarray  # [N] float32
+    sid: np.ndarray  # [N] int32
+
+
+@dataclass
+class RaggedColumn:
+    kind: np.ndarray  # [N, M] int8
+    num: np.ndarray  # [N, M] float32
+    sid: np.ndarray  # [N, M] int32
+
+
+@dataclass
+class KeySetColumn:
+    sid: np.ndarray  # [N, L] int32, -1 padded
+    count: np.ndarray  # [N] int32
+
+
+@dataclass
+class ColumnBatch:
+    n: int
+    scalars: dict  # ScalarCol -> ScalarColumn
+    raggeds: dict  # RaggedCol -> RaggedColumn
+    axis_counts: dict  # Axis -> np.ndarray [N] int32
+    keysets: dict  # KeySetCol -> KeySetColumn
+    # identity columns for match masks
+    group_sid: np.ndarray = None
+    kind_sid: np.ndarray = None
+    ns_sid: np.ndarray = None
+    name_sid: np.ndarray = None
+
+    def arrays(self) -> dict[str, np.ndarray]:
+        """Stable name -> array mapping (the device-transfer payload)."""
+        out = {}
+        for i, (spec, col) in enumerate(sorted(
+                self.scalars.items(), key=lambda kv: kv[0].path)):
+            out[f"s{i}_kind"], out[f"s{i}_num"], out[f"s{i}_sid"] = (
+                col.kind, col.num, col.sid)
+        for i, (spec, col) in enumerate(sorted(
+                self.raggeds.items(), key=lambda kv: (kv[0].axis.key(), kv[0].subpath))):
+            out[f"r{i}_kind"], out[f"r{i}_num"], out[f"r{i}_sid"] = (
+                col.kind, col.num, col.sid)
+        for i, (axis, cnt) in enumerate(sorted(
+                self.axis_counts.items(), key=lambda kv: kv[0].key())):
+            out[f"a{i}_count"] = cnt
+        for i, (spec, col) in enumerate(sorted(
+                self.keysets.items(), key=lambda kv: kv[0].path)):
+            out[f"k{i}_sid"], out[f"k{i}_count"] = col.sid, col.count
+        return out
+
+
+def _classify(v: Any, vocab: Vocab):
+    if isinstance(v, bool):
+        return (K_TRUE if v else K_FALSE), 0.0, -1
+    if isinstance(v, (int, float)):
+        return K_NUM, float(v), -1
+    if isinstance(v, str):
+        return K_STR, 0.0, vocab.intern(v)
+    if v is None or isinstance(v, (list, dict)):
+        return K_OTHER, 0.0, -1
+    return K_OTHER, 0.0, -1
+
+
+def _walk(obj: Any, path: Sequence[str]):
+    _MISSING = object()
+    cur = obj
+    for p in path:
+        if not isinstance(cur, dict):
+            return _MISSING, False
+        if p not in cur:
+            return _MISSING, False
+        cur = cur[p]
+    return cur, True
+
+
+def _axis_items(obj: dict, axis: Axis) -> list:
+    items: list = []
+    for seg in axis.segments:
+        level = [obj]
+        for part in seg:
+            nxt = []
+            for node in level:
+                val, ok = _walk(node, part)
+                if ok and isinstance(val, list):
+                    nxt.extend(val)
+            level = nxt
+        items.append(level)
+    return [x for level in items for x in level]
+
+
+def round_up(n: int, bucket: int = 8) -> int:
+    """Pad ragged widths to buckets so jit shapes stay stable."""
+    if n <= 0:
+        return bucket
+    return ((n + bucket - 1) // bucket) * bucket
+
+
+class Flattener:
+    def __init__(self, schema: Schema, vocab: Optional[Vocab] = None):
+        self.schema = schema
+        self.vocab = vocab or Vocab()
+
+    def flatten(self, objects: Sequence[dict],
+                pad_n: Optional[int] = None) -> ColumnBatch:
+        n_real = len(objects)
+        n = pad_n or n_real
+        vocab = self.vocab
+        batch = ColumnBatch(n=n, scalars={}, raggeds={}, axis_counts={},
+                            keysets={})
+
+        # identity columns
+        batch.group_sid = np.full(n, -1, np.int32)
+        batch.kind_sid = np.full(n, -1, np.int32)
+        batch.ns_sid = np.full(n, -1, np.int32)
+        batch.name_sid = np.full(n, -1, np.int32)
+        from gatekeeper_tpu.utils.unstructured import gvk_of
+
+        for i, obj in enumerate(objects):
+            group, _, kind = gvk_of(obj)
+            meta = obj.get("metadata") or {}
+            batch.group_sid[i] = vocab.intern(group)
+            batch.kind_sid[i] = vocab.intern(kind)
+            batch.ns_sid[i] = vocab.intern(meta.get("namespace", "") or "")
+            batch.name_sid[i] = vocab.intern(meta.get("name", "") or "")
+
+        for spec in self.schema.scalars:
+            kind = np.zeros(n, np.int8)
+            num = np.zeros(n, np.float32)
+            sid = np.full(n, -1, np.int32)
+            for i, obj in enumerate(objects):
+                val, ok = _walk(obj, spec.path)
+                if ok:
+                    kind[i], num[i], sid[i] = _classify(val, vocab)
+            batch.scalars[spec] = ScalarColumn(kind, num, sid)
+
+        # axes first (items shared by all ragged columns on the axis)
+        axis_items: dict[Axis, list[list]] = {}
+        for axis in self.schema.axes():
+            per_obj = [_axis_items(obj, axis) for obj in objects]
+            per_obj += [[] for _ in range(n - n_real)]
+            axis_items[axis] = per_obj
+            batch.axis_counts[axis] = np.array(
+                [len(x) for x in per_obj], np.int32
+            )
+
+        for spec in self.schema.raggeds:
+            per_obj = axis_items[spec.axis]
+            m = round_up(max((len(x) for x in per_obj), default=0))
+            kind = np.zeros((n, m), np.int8)
+            num = np.zeros((n, m), np.float32)
+            sid = np.full((n, m), -1, np.int32)
+            for i, items in enumerate(per_obj):
+                for j, item in enumerate(items):
+                    val, ok = (
+                        _walk(item, spec.subpath) if spec.subpath else (item, True)
+                    )
+                    if ok:
+                        kind[i, j], num[i, j], sid[i, j] = _classify(val, vocab)
+            batch.raggeds[spec] = RaggedColumn(kind, num, sid)
+
+        for spec in self.schema.keysets:
+            per_obj_keys = []
+            for obj in objects:
+                val, ok = _walk(obj, spec.path)
+                keys = sorted(val.keys()) if ok and isinstance(val, dict) else []
+                per_obj_keys.append(keys)
+            per_obj_keys += [[] for _ in range(n - n_real)]
+            l = round_up(max((len(k) for k in per_obj_keys), default=0))
+            sid = np.full((n, l), -1, np.int32)
+            count = np.zeros(n, np.int32)
+            for i, keys in enumerate(per_obj_keys):
+                count[i] = len(keys)
+                for j, k in enumerate(keys):
+                    sid[i, j] = vocab.intern(k)
+            batch.keysets[spec] = KeySetColumn(sid, count)
+
+        return batch

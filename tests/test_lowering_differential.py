@@ -1,0 +1,253 @@
+"""Differential tests: the device verdict grid must agree with the exact
+interpreter on every (object, constraint) pair — the kernel-vs-reference
+harness SURVEY.md §4 calls non-negotiable."""
+
+import glob
+import random
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.apis.constraints import Constraint
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.drivers.rego_driver import RegoDriver
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.target.review import AugmentedUnstructured
+from gatekeeper_tpu.target.target import K8sValidationTarget
+
+PSP = "/root/reference/pkg/webhook/testdata/psp-all-violations"
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+def _load(p):
+    with open(p) as f:
+        return yaml.safe_load(f)
+
+
+def _template(path):
+    return ConstraintTemplate.from_unstructured(_load(path))
+
+
+def _constraint(path):
+    return Constraint.from_unstructured(_load(path))
+
+
+def make_pod(rng: random.Random, i: int) -> dict:
+    containers = []
+    for j in range(rng.randint(0, 3)):
+        c = {"name": f"c{j}", "image": rng.choice(["nginx", "bad/x", "repo/y"])}
+        if rng.random() < 0.4:
+            c["securityContext"] = {
+                "privileged": rng.choice([True, False, "yes"])
+            }
+        if rng.random() < 0.5:
+            c["ports"] = [
+                {"hostPort": rng.choice([80, 443, 8080, 9999, 22])}
+                for _ in range(rng.randint(0, 2))
+            ]
+        containers.append(c)
+    spec = {"containers": containers}
+    if rng.random() < 0.3:
+        spec["initContainers"] = [
+            {"name": "init", "securityContext": {"privileged": rng.random() < 0.5}}
+        ]
+    for key in ("hostNetwork", "hostPID", "hostIPC"):
+        if rng.random() < 0.3:
+            spec[key] = rng.choice([True, False])
+    labels = {}
+    for lab in ("app", "owner", "team", "gatekeeper"):
+        if rng.random() < 0.4:
+            labels[lab] = f"v{rng.randint(0, 3)}"
+    meta = {"name": f"pod-{i}", "namespace": rng.choice(
+        ["default", "kube-system", "prod", "dev"])}
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": spec}
+
+
+@pytest.fixture(scope="module")
+def drivers_and_fixtures():
+    tpu = TpuDriver(batch_bucket=16)
+    templates = [
+        _template(f"{PSP}/psp-templates/privileged-containers-template.yaml"),
+        _template(f"{PSP}/psp-templates/host-namespace-template.yaml"),
+        _template(f"{PSP}/psp-templates/host-network-ports-template.yaml"),
+        _template(f"{PSP}/psp-templates/volume-template.yaml"),
+        _template(f"{PSP}/psp-templates/host-filesystem-template.yaml"),
+        _template(
+            "/root/reference/demo/basic/templates/"
+            "k8srequiredlabels_template.yaml"
+        ),
+    ]
+    for t in templates:
+        tpu.add_template(t)
+    constraints = [
+        _constraint(f"{PSP}/psp-constraints/privileged-containers-constraint.yaml"),
+        _constraint(f"{PSP}/psp-constraints/host-namespaces-constraint.yaml"),
+        _constraint(f"{PSP}/psp-constraints/host-network-constraint.yaml"),
+        _constraint(f"{PSP}/psp-constraints/volumes-constraint.yaml"),
+        _constraint(f"{PSP}/psp-constraints/host-filesystem-constraint.yaml"),
+        Constraint.from_unstructured({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": "pods-must-have-owner"},
+            "spec": {
+                "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                          "namespaces": ["prod", "kube-*"]},
+                "parameters": {"labels": ["owner", "team"]},
+            },
+        }),
+    ]
+    for c in constraints:
+        tpu.add_constraint(c)
+    return tpu, constraints
+
+
+def test_expected_templates_lower(drivers_and_fixtures):
+    tpu, _ = drivers_and_fixtures
+    lowered = set(tpu.lowered_kinds())
+    assert {"K8sPSPPrivilegedContainer", "K8sPSPHostNamespace",
+            "K8sPSPHostNetworkingPorts", "K8sRequiredLabels"} <= lowered
+    # these use set-comprehension-over-item-keys / array params of objects:
+    # interpreter fallback is the correct behavior
+    fallback = tpu.fallback_kinds()
+    assert "K8sPSPVolumeTypes" in fallback
+    assert "K8sPSPHostFilesystem" in fallback
+
+
+def test_differential_verdicts(drivers_and_fixtures):
+    tpu, constraints = drivers_and_fixtures
+    rng = random.Random(42)
+    pods = [make_pod(rng, i) for i in range(200)]
+    # include the reference example pods
+    for p in sorted(glob.glob(f"{PSP}/psp-pods/*.yaml")):
+        pods.append(_load(p))
+
+    target = K8sValidationTarget()
+    reviews = [target.handle_review(AugmentedUnstructured(object=p))
+               for p in pods]
+
+    batch_responses = tpu.query_batch(TARGET, constraints, reviews)
+
+    # oracle: interpreter + host matcher per (constraint, object)
+    interp = tpu._interp
+    for oi, review in enumerate(reviews):
+        expected = []
+        for con in constraints:
+            if not target.to_matcher(con.match).match(review):
+                continue
+            qr = interp.query(TARGET, [con], review)
+            expected.extend(qr.results)
+        got = batch_responses[oi].results
+        key = lambda r: (r.constraint["metadata"]["name"], r.msg)
+        assert sorted(map(key, got)) == sorted(map(key, expected)), (
+            f"divergence on pod {oi}: {pods[oi]}"
+        )
+
+
+def test_batch_faster_than_interp_smoke(drivers_and_fixtures):
+    """Not a perf gate (CPU, tiny batch) — just ensures the batch path runs
+    end-to-end and produces violations on the reference example pods."""
+    tpu, constraints = drivers_and_fixtures
+    target = K8sValidationTarget()
+    pods = [_load(p) for p in sorted(glob.glob(f"{PSP}/psp-pods/*.yaml"))]
+    reviews = [target.handle_review(AugmentedUnstructured(object=p))
+               for p in pods]
+    responses = tpu.query_batch(TARGET, constraints, reviews)
+    assert sum(len(r.results) for r in responses) >= 5
+
+
+def test_independent_wildcards_are_independent_existentials():
+    """`containers[_].a; containers[_].b` is (∃i. a_i) ∧ (∃j. b_j), not
+    ∃i. a_i ∧ b_i."""
+    from gatekeeper_tpu.apis.templates import ConstraintTemplate
+
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.add_template(ConstraintTemplate.from_unstructured({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8stwowild"},
+        "spec": {"crd": {"spec": {"names": {"kind": "K8sTwoWild"}}},
+                 "targets": [{"target": TARGET, "rego": """
+package k8stwowild
+
+violation[{"msg": "both"}] {
+  input.review.object.spec.containers[_].privileged
+  input.review.object.spec.containers[_].hostBad
+}
+"""}]},
+    }))
+    assert "K8sTwoWild" in tpu.lowered_kinds()
+    con = Constraint.from_unstructured({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sTwoWild", "metadata": {"name": "x"}, "spec": {}})
+    tpu.add_constraint(con)
+    target = K8sValidationTarget()
+    pods = [
+        # different containers satisfy the two conditions -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"privileged": True}, {"hostBad": True}]}},
+        # only one condition -> no violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"privileged": True}]}},
+    ]
+    reviews = [target.handle_review(AugmentedUnstructured(object=p))
+               for p in pods]
+    resp = tpu.query_batch(TARGET, [con], reviews)
+    assert len(resp[0].results) == 1
+    assert len(resp[1].results) == 0
+
+
+def test_negated_wildcard_closes_over_existential():
+    """`not containers[_].privileged` is ¬∃i, not ∃i.¬."""
+    from gatekeeper_tpu.apis.templates import ConstraintTemplate
+
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.add_template(ConstraintTemplate.from_unstructured({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8snegwild"},
+        "spec": {"crd": {"spec": {"names": {"kind": "K8sNegWild"}}},
+                 "targets": [{"target": TARGET, "rego": """
+package k8snegwild
+
+violation[{"msg": "no privileged container found"}] {
+  not input.review.object.spec.containers[_].privileged
+}
+"""}]},
+    }))
+    assert "K8sNegWild" in tpu.lowered_kinds()
+    con = Constraint.from_unstructured({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sNegWild", "metadata": {"name": "x"}, "spec": {}})
+    tpu.add_constraint(con)
+    target = K8sValidationTarget()
+    pods = [
+        # one privileged among two -> ∃ privileged -> NOT a violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"privileged": True}, {"name": "x"}]}},
+        # none privileged -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"name": "x"}]}},
+    ]
+    reviews = [target.handle_review(AugmentedUnstructured(object=p))
+               for p in pods]
+    resp = tpu.query_batch(TARGET, [con], reviews)
+    assert len(resp[0].results) == 0
+    assert len(resp[1].results) == 1
+
+
+def test_mask_generate_name_objects():
+    from gatekeeper_tpu.ir import masks as masks_mod
+    from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
+
+    con = Constraint.from_unstructured({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sX", "metadata": {"name": "m"},
+        "spec": {"match": {"name": "web-*"}}})
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"generateName": "web-", "namespace": "d"}}]
+    vocab = Vocab()
+    batch = Flattener(Schema(), vocab).flatten(objs)
+    mask = masks_mod.constraint_masks([con], batch, vocab, objs)
+    assert mask[0, 0]  # generateName "web-" matches name glob "web-*"
