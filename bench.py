@@ -1,0 +1,98 @@
+"""Benchmark: full-constraint-set audit sweep rate on one chip.
+
+Prints ONE JSON line:
+  {"metric": "audit admission reviews/sec/chip", "value": N,
+   "unit": "reviews/s", "vs_baseline": R}
+
+A "review" is one object evaluated against the full constraint set (the
+reference's Client.Review unit, pkg/webhook/policy.go:664).  The workload is
+BASELINE config #2-shaped: synthetic Pods with ragged container lists against
+a policy library of lowerable templates (PSP subset + required-labels
+variants).  End-to-end timing includes host flattening, match-mask
+computation, the device verdict kernels, top-k extraction and message
+rendering for kept violations — the full audit-sweep path
+(gatekeeper_tpu.audit + parallel.sharded).
+
+``vs_baseline`` is value / 100_000 — the BASELINE.json north-star target
+(>=100k reviews/sec/chip); the reference publishes no absolute numbers
+(BASELINE.md) so the target is the comparison point.
+
+Device-only and component timings go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build():
+    import __graft_entry__ as g
+    from gatekeeper_tpu.apis.constraints import Constraint
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+
+    tpu = g._build_driver(
+        [g._PRIV_TEMPLATE, g._REQ_LABELS_TEMPLATE, g._HOST_NS_TEMPLATE]
+    )
+    cons = g._constraints(n_labels=38)  # 40 constraints total
+    assert len(tpu.fallback_kinds()) == 0, tpu.fallback_kinds()
+    mesh = make_mesh()  # all local devices (1 chip under the driver)
+    evaluator = ShardedEvaluator(tpu, mesh, violations_limit=20)
+    return tpu, cons, evaluator
+
+
+def main():
+    import jax
+
+    import __graft_entry__ as g
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+    tpu, cons, evaluator = build()
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    log(f"generating {n} synthetic pods...")
+    pods = g._make_pods(n)
+
+    # warmup: compile all shape buckets for the timed run
+    log("warmup (jit compile)...")
+    evaluator.sweep(cons, pods[:1024])
+    warm = evaluator.sweep(cons, pods)  # compiles the full-size bucket
+    del warm
+
+    log("timed sweep...")
+    t0 = time.perf_counter()
+    swept = evaluator.sweep(cons, pods)
+    total_violations = sum(int(c[3].sum()) for c in swept.values())
+    t1 = time.perf_counter()
+    elapsed = t1 - t0
+    reviews_per_s = n / elapsed
+
+    # component breakdown (device-only): rerun kernels on the resident batch
+    log(
+        f"end-to-end: {elapsed:.3f}s for {n} pods x {len(cons)} constraints "
+        f"({total_violations} total violations) -> {reviews_per_s:,.0f} "
+        "reviews/s"
+    )
+    log(
+        f"constraint-evals/sec: {n * len(cons) / elapsed:,.0f}"
+    )
+
+    print(json.dumps({
+        "metric": "audit admission reviews/sec/chip",
+        "value": round(reviews_per_s, 1),
+        "unit": "reviews/s",
+        "vs_baseline": round(reviews_per_s / 100_000, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,309 @@
+"""Audit sweep: the 1M-object enforcement point.
+
+Reference flow (pkg/audit/manager.go:258-973, SURVEY.md §3.2):
+list every auditable object (chunked) → review each against all constraints →
+keep top-K violations per constraint (LimitQueue) → write constraint status +
+export + logs.
+
+TPU-native middle: each chunk flattens to columns and the whole
+constraint × chunk grid evaluates in one sharded device pass
+(parallel/sharded.ShardedEvaluator); only the ≤K kept violations per
+constraint are rendered to messages through the exact interpreter.  Fallback
+(non-lowered) kinds run the interpreter loop behind the same seam.
+
+Flags mirrored from the reference (manager.go:55-71): audit-interval (60s),
+constraint-violations-limit (20), audit-chunk-size (500),
+audit-match-kind-only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP, Constraint
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.base import ReviewCfg
+from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+from gatekeeper_tpu.target.review import AugmentedUnstructured
+from gatekeeper_tpu.utils.unstructured import gvk_of
+
+
+@dataclass
+class AuditConfig:
+    interval_s: float = 60.0
+    violations_limit: int = 20  # --constraint-violations-limit
+    chunk_size: int = 500  # --audit-chunk-size
+    match_kind_only: bool = False  # --audit-match-kind-only
+    from_cache: bool = False  # --audit-from-cache
+
+
+@dataclass
+class Violation:
+    constraint: Constraint
+    message: str
+    enforcement_action: str
+    group: str
+    version: str
+    kind: str
+    name: str
+    namespace: str
+    details: Any = None
+
+
+@dataclass
+class AuditRun:
+    timestamp: str = ""
+    total_objects: int = 0
+    total_violations: dict = field(default_factory=dict)  # (kind,name) -> int
+    kept: dict = field(default_factory=dict)  # (kind,name) -> list[Violation]
+    duration_s: float = 0.0
+
+
+class AuditManager:
+    """One audit plane instance (the reference's audit Deployment pod)."""
+
+    def __init__(
+        self,
+        client: Client,
+        lister: Callable[[], Iterable[dict]],
+        config: Optional[AuditConfig] = None,
+        evaluator=None,  # parallel.sharded.ShardedEvaluator (optional)
+        status_writer: Optional[Callable] = None,
+        export_system=None,
+        event_sink: Optional[Callable] = None,
+    ):
+        self.client = client
+        self.lister = lister
+        self.config = config or AuditConfig()
+        self.evaluator = evaluator
+        self.status_writer = status_writer
+        self.export_system = export_system
+        self.event_sink = event_sink
+        self._stop = threading.Event()
+
+    # --- loop (reference: auditManagerLoop, manager.go:831) -------------
+    def run_forever(self):
+        while not self._stop.wait(self.config.interval_s):
+            self.audit()
+
+    def stop(self):
+        self._stop.set()
+
+    # --- one sweep (reference: audit(), manager.go:258) -----------------
+    def audit(self) -> AuditRun:
+        t0 = time.time()
+        run = AuditRun(timestamp=_now_rfc3339())
+        constraints = [
+            c for c in self.client.constraints()
+            if c.actions_for(AUDIT_EP)
+        ]
+        if self.export_system is not None:
+            self.export_system.publish_audit_started(run.timestamp)
+        if not constraints:
+            run.duration_s = time.time() - t0
+            self._finish(run)
+            return run
+
+        kind_filter = None
+        if self.config.match_kind_only:
+            kind_filter = self._kinds_of(constraints)
+
+        limit = self.config.violations_limit
+        kept: dict = {(c.kind, c.name): [] for c in constraints}
+        totals: dict = {(c.kind, c.name): 0 for c in constraints}
+
+        chunk: list[dict] = []
+        for obj in self.lister():
+            if kind_filter is not None:
+                _, _, k = gvk_of(obj)
+                if k not in kind_filter:
+                    continue
+            chunk.append(obj)
+            run.total_objects += 1
+            if len(chunk) >= self.config.chunk_size:
+                self._audit_chunk(chunk, constraints, kept, totals, limit)
+                chunk = []
+        if chunk:
+            self._audit_chunk(chunk, constraints, kept, totals, limit)
+
+        run.total_violations = totals
+        run.kept = kept
+        run.duration_s = time.time() - t0
+        self._write_statuses(run, constraints)
+        self._finish(run)
+        return run
+
+    def _kinds_of(self, constraints: Sequence[Constraint]) -> set:
+        """--audit-match-kind-only prefilter (manager.go:427-483): only valid
+        when every constraint names concrete kinds."""
+        kinds: set = set()
+        for c in constraints:
+            entries = (c.match or {}).get("kinds") or []
+            if not entries:
+                return None  # a constraint matches all kinds: no prefilter
+            for e in entries:
+                ks = e.get("kinds") or []
+                if not ks or "*" in ks:
+                    return None
+                kinds.update(ks)
+        return kinds
+
+    # --- chunk evaluation ------------------------------------------------
+    def _audit_chunk(self, objects, constraints, kept, totals, limit):
+        target = self.client.target
+        reviews = None
+
+        def get_reviews():
+            nonlocal reviews
+            if reviews is None:
+                reviews = [
+                    target.handle_review(
+                        AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
+                    )
+                    for o in objects
+                ]
+            return reviews
+
+        driver = None
+        for d in self.client.drivers:
+            if hasattr(d, "query_batch"):
+                driver = d
+                break
+
+        if self.evaluator is not None and driver is not None:
+            swept = self.evaluator.sweep(constraints, objects)
+            counts = {}
+            for kind, (cons, idx, valid, ccounts) in swept.items():
+                for ci, con in enumerate(cons):
+                    key = con.key()
+                    totals[key] += int(ccounts[ci])
+                    for j in range(idx.shape[1]):
+                        if not valid[ci, j] or len(kept[key]) >= limit:
+                            continue
+                        oi = int(idx[ci, j])
+                        self._render_kept(
+                            driver, con, objects[oi], get_reviews()[oi],
+                            kept[key]
+                        )
+            # fallback kinds through the exact engine
+            fallback_cons = [
+                c for c in constraints
+                if c.kind in driver.fallback_kinds()
+            ]
+            if fallback_cons:
+                self._chunk_via_query_batch(
+                    driver, fallback_cons, objects, get_reviews(), kept,
+                    totals, limit
+                )
+            return
+
+        if driver is not None:
+            self._chunk_via_query_batch(
+                driver, constraints, objects, get_reviews(), kept, totals,
+                limit
+            )
+            return
+
+        # pure interpreter path (no batch-capable driver registered)
+        for oi, obj in enumerate(objects):
+            review = get_reviews()[oi]
+            for con in constraints:
+                if not target.to_matcher(con.match).match(review):
+                    continue
+                qr = self.client._template_driver[con.kind].query(
+                    target.name, [con], review, ReviewCfg(
+                        enforcement_point=AUDIT_EP)
+                )
+                key = con.key()
+                totals[key] += len(qr.results)
+                for r in qr.results:
+                    if len(kept[key]) < limit:
+                        kept[key].append(self._violation(con, obj, r.msg,
+                                                         r.details))
+
+    def _chunk_via_query_batch(self, driver, constraints, objects, reviews,
+                               kept, totals, limit):
+        responses = driver.query_batch(
+            self.client.target.name, constraints, reviews,
+            ReviewCfg(enforcement_point=AUDIT_EP),
+        )
+        for oi, resp in enumerate(responses):
+            for r in resp.results:
+                ckind = r.constraint.get("kind", "")
+                cname = (r.constraint.get("metadata") or {}).get("name", "")
+                key = (ckind, cname)
+                if key not in totals:
+                    continue
+                totals[key] += 1
+                if len(kept[key]) < limit:
+                    con = self.client.get_constraint(ckind, cname)
+                    kept[key].append(
+                        self._violation(con, objects[oi], r.msg, r.details)
+                    )
+
+    def _render_kept(self, driver, con, obj, review, out_list):
+        qr = driver._interp.query(
+            self.client.target.name, [con], review,
+            ReviewCfg(enforcement_point=AUDIT_EP),
+        )
+        for r in qr.results:
+            out_list.append(self._violation(con, obj, r.msg, r.details))
+
+    def _violation(self, con, obj, msg, details) -> Violation:
+        group, version, kind = gvk_of(obj)
+        meta = obj.get("metadata") or {}
+        actions = con.actions_for(AUDIT_EP)
+        return Violation(
+            constraint=con,
+            message=msg,
+            enforcement_action=actions[0] if actions else con.enforcement_action,
+            group=group,
+            version=version,
+            kind=kind,
+            name=meta.get("name", "") or "",
+            namespace=meta.get("namespace", "") or "",
+            details=details,
+        )
+
+    # --- status writeback (reference: writeAuditResults, manager.go:947) -
+    def _write_statuses(self, run: AuditRun, constraints):
+        for con in constraints:
+            key = con.key()
+            status = {
+                "auditTimestamp": run.timestamp,
+                "totalViolations": run.total_violations.get(key, 0),
+                "violations": [
+                    {
+                        "message": v.message,
+                        "enforcementAction": v.enforcement_action,
+                        "group": v.group,
+                        "version": v.version,
+                        "kind": v.kind,
+                        "name": v.name,
+                        "namespace": v.namespace,
+                    }
+                    for v in run.kept.get(key, [])
+                ],
+            }
+            if self.status_writer is not None:
+                self.status_writer(con, status)
+            else:
+                con.raw.setdefault("status", {}).update(status)
+
+    def _finish(self, run: AuditRun):
+        if self.export_system is not None:
+            for key, violations in run.kept.items():
+                for v in violations:
+                    self.export_system.publish_violation(run.timestamp, v)
+            self.export_system.publish_audit_ended(run.timestamp)
+        if self.event_sink is not None:
+            self.event_sink(run)
+
+
+def _now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

@@ -119,9 +119,12 @@ def _delegate(module: str):
     def run(argv: list[str]) -> int:
         import importlib
 
+        name = f"gatekeeper_tpu.gator.{module}"
         try:
-            mod = importlib.import_module(f"gatekeeper_tpu.gator.{module}")
-        except ImportError:
+            mod = importlib.import_module(name)
+        except ModuleNotFoundError as e:
+            if e.name != name:
+                raise  # a real bug inside the module, not a missing command
             print(
                 f"error: gator {module} is not available in this build",
                 file=sys.stderr,

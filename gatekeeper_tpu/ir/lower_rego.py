@@ -111,6 +111,15 @@ class OpaqueVal:
     why: str
 
 
+@dataclass(frozen=True)
+class IterBinding:
+    """env marker: a named iteration variable (containers[i]) — reuses of
+    the same variable over the same axis share one existential instance."""
+
+    axis: Any
+    instance: int
+
+
 class _Lowerer:
     def __init__(self, modules, entry_pkg: tuple, schema_hint: Optional[dict],
                  vocab):
@@ -166,6 +175,12 @@ class _Lowerer:
                 if not isinstance(target, ast.Var):
                     raise LowerError("destructuring assignment")
                 env[target.name] = self._abstract(term, env)
+                # an assignment in Rego fails when its RHS is undefined; even
+                # message-only assignments gate the clause, so emit their
+                # definedness predicates (e.g. msg := sprintf(..., [c.name])
+                # requires c.name defined)
+                for pred, axis_inst in self._definedness_preds(term, env):
+                    add_pred(pred, axis_inst)
                 continue
             if isinstance(stmt, ast.ExprStmt):
                 pred, axis = self._lower_pred(stmt.term, env, stmt.negated)
@@ -183,6 +198,52 @@ class _Lowerer:
         if not terms:
             raise LowerError("clause lowered to no predicates")
         return N.And(tuple(terms)) if len(terms) > 1 else terms[0]
+
+    def _definedness_preds(self, term, env: dict) -> list:
+        """Present-predicates implied by evaluating ``term`` (undefined refs
+        make a Rego statement fail).  Raises LowerError for terms whose
+        definedness we can't express."""
+        if isinstance(term, ast.Scalar):
+            return []
+        if isinstance(term, (ast.SetCompr, ast.ArrayCompr, ast.ObjectCompr)):
+            return []  # comprehensions are total (empty on no solutions)
+        if isinstance(term, (ast.Var, ast.Ref)):
+            val = self._abstract(term, env)
+            return self._definedness_of_val(val)
+        if isinstance(term, ast.ArrayTerm):
+            out = []
+            for it in term.items:
+                out.extend(self._definedness_preds(it, env))
+            return out
+        if isinstance(term, ast.ObjectTerm):
+            out = []
+            for k, v in term.pairs:
+                out.extend(self._definedness_preds(k, env))
+                out.extend(self._definedness_preds(v, env))
+            return out
+        if isinstance(term, ast.Call):
+            out = []
+            for a in term.args:
+                out.extend(self._definedness_preds(a, env))
+            return out
+        raise LowerError(f"definedness of {type(term).__name__}")
+
+    def _definedness_of_val(self, val) -> list:
+        if isinstance(val, PathVal):
+            if val.path[:2] != OBJECT_ROOT:
+                return []  # input/review roots always defined
+            return [(N.Present(self._scalar_col(val)), None)]
+        if isinstance(val, ItemVal):
+            return [(N.Present(self._ragged_col(val)),
+                     (val.axis, val.instance))]
+        if isinstance(val, ParamVal):
+            self._note_param(val.name, "bool")
+            return [(N.ParamPresent(val.name), None)]
+        if isinstance(val, (ConstVal, KeySetVal, ParamListSetVal, SetDiffVal)):
+            return []
+        if isinstance(val, OpaqueVal):
+            raise LowerError(f"definedness of opaque value: {val.why}")
+        return []
 
     # --- abstract evaluation of terms --------------------------------------
     def _abstract(self, term, env: dict):
@@ -213,11 +274,26 @@ class _Lowerer:
         for arg in term.args:
             if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
                 base = self._step(base, arg.value)
-            elif isinstance(arg, ast.Var) and (
-                arg.name.startswith("$w") or arg.name not in env
+            elif isinstance(arg, ast.Var) and arg.name.startswith("$w"):
+                base = self._iterate(base)  # wildcard: fresh existential
+            elif isinstance(arg, ast.Var) and isinstance(
+                env.get(arg.name), IterBinding
             ):
-                # wildcard / fresh var: iteration
+                # reuse of a named iteration variable: same instance, same
+                # axis (containers[i].a; containers[i].b share one ∃i)
+                binding = env[arg.name]
                 base = self._iterate(base)
+                if isinstance(base, ItemVal):
+                    if base.axis != binding.axis:
+                        return OpaqueVal(
+                            f"var {arg.name} indexes two collections"
+                        )
+                    base = ItemVal(base.axis, base.subpath, binding.instance)
+            elif isinstance(arg, ast.Var) and arg.name not in env:
+                # first use of a named var: iterate and bind the instance
+                base = self._iterate(base)
+                if isinstance(base, ItemVal):
+                    env[arg.name] = IterBinding(base.axis, base.instance)
             else:
                 return OpaqueVal("computed ref index")
             if isinstance(base, OpaqueVal):
@@ -382,14 +458,12 @@ class _Lowerer:
             axis = (val.axis, val.instance)
         elif isinstance(val, ParamVal):
             self._note_param(val.name, "bool")
-            p = N.ParamTruthy(val.name)
-            return (p if want else N.And((N.ParamPresent(val.name), N.Not(p)))), None
+            return N.ParamBoolIs(val.name, want), None
         else:
             raise LowerError("bool equality operand")
-        t = N.Truthy(col)
-        if want:
-            return t, axis
-        return N.And((N.Present(col), N.Not(t))), axis
+        # exact: only actual booleans equal true/false (a string "yes" is
+        # truthy but != true), so test the kind tag, not truthiness
+        return N.KindIs(col, 2 if want else 1), axis
 
     def _lower_count_cmp(self, op: str, set_term, n, env: dict):
         val = self._abstract(set_term, env)
@@ -498,13 +572,10 @@ class _Lowerer:
 
     def _scalar_col(self, val: PathVal) -> ScalarCol:
         if val.path[:2] != OBJECT_ROOT:
-            # allow review-level scalars too (e.g. review.operation)
-            if val.path[:1] != ("review",):
-                raise LowerError(f"path outside review: {val.path}")
-        col = ScalarCol(path=val.path[2:] if val.path[:2] == OBJECT_ROOT
-                        else ("__review__",) + val.path[1:])
-        if val.path[:2] != OBJECT_ROOT:
-            raise LowerError("review-level scalars not yet columnized")
+            # review-level scalars (review.operation etc.) are not columnized
+            # yet; templates reading them fall back to the interpreter
+            raise LowerError(f"path outside review object: {val.path}")
+        col = ScalarCol(path=val.path[2:])
         if col not in self.schema.scalars:
             self.schema.scalars.append(col)
         return col
@@ -593,26 +664,42 @@ def _with_axis_rules(low: _Lowerer) -> N.Expr:
         if isinstance(term, ast.Ref) and isinstance(term.head, ast.Var):
             name = term.head.name
             if name in axis_rules and name not in env:
-                base = ItemVal(axis_rules[name], ())
                 consumed = False
-                cur = base
+                cur = None
                 for arg in term.args:
                     if not consumed:
-                        # first arg must be the iteration wildcard
-                        if isinstance(arg, ast.Var) and (
-                            arg.name.startswith("$w") or arg.name not in env
+                        # first arg is the iteration variable / wildcard
+                        if isinstance(arg, ast.Var) and arg.name.startswith("$w"):
+                            cur = ItemVal(axis_rules[name], (),
+                                          low._fresh_instance())
+                            consumed = True
+                            continue
+                        if isinstance(arg, ast.Var) and isinstance(
+                            env.get(arg.name), IterBinding
                         ):
+                            b = env[arg.name]
+                            if b.axis != axis_rules[name]:
+                                return OpaqueVal(
+                                    f"var {arg.name} indexes two collections"
+                                )
+                            cur = ItemVal(b.axis, (), b.instance)
+                            consumed = True
+                            continue
+                        if isinstance(arg, ast.Var) and arg.name not in env:
+                            cur = ItemVal(axis_rules[name], (),
+                                          low._fresh_instance())
+                            env[arg.name] = IterBinding(cur.axis, cur.instance)
                             consumed = True
                             continue
                         return OpaqueVal("axis rule indexed oddly")
                     if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
                         cur = low._step(cur, arg.value)
-                    elif isinstance(arg, ast.Var) and (
-                        arg.name.startswith("$w") or arg.name not in env
-                    ):
+                    elif isinstance(arg, ast.Var) and arg.name.startswith("$w"):
                         cur = low._iterate(cur)
                     else:
                         return OpaqueVal("axis rule computed index")
+                if cur is None:
+                    return OpaqueVal("axis rule referenced without iteration")
                 return cur
         return orig_abstract(term, env)
 

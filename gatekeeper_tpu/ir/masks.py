@@ -47,6 +47,11 @@ def constraint_masks(
     any_generate_name = any(
         "generateName" in (o.get("metadata") or {}) for o in objects
     )
+    # constraint-independent namespace context, hoisted out of the loop
+    eff_ns = np.where(is_namespace_obj, name_ids, ns_ids)
+    has_ns = eff_ns != vocab.lookup("")
+    uniq_eff_ns = np.unique(eff_ns).tolist()
+    uniq_names = None
 
     for ci, con in enumerate(constraints):
         m = con.match or {}
@@ -92,16 +97,13 @@ def constraint_masks(
 
         # --- namespaces / excludedNamespaces (match.go:118-179) ---
         # effective ns: Namespace objects use their own name
-        eff_ns = np.where(is_namespace_obj, name_ids, ns_ids)
-        has_ns = eff_ns != vocab.lookup("")
         for key, include in (("namespaces", True), ("excludedNamespaces", False)):
             patterns = m.get(key) or []
             if not patterns:
                 continue
             # map each unique eff-ns id -> matched?
-            uniq = np.unique(eff_ns)
             table = {}
-            for sid in uniq.tolist():
+            for sid in uniq_eff_ns:
                 s = vocab.string(sid) if sid >= 0 else ""
                 table[sid] = any(wildcard.matches(p, s) for p in patterns)
             hit = np.array([table[s] for s in eff_ns.tolist()], bool)
@@ -115,12 +117,13 @@ def constraint_masks(
         # path above ---
         pattern = m.get("name", "") or ""
         if pattern:
-            uniq = np.unique(name_ids)
+            if uniq_names is None:
+                uniq_names = np.unique(name_ids).tolist()
             table = {
                 sid: wildcard.matches(
                     pattern, vocab.string(sid) if sid >= 0 else ""
                 )
-                for sid in uniq.tolist()
+                for sid in uniq_names
             }
             hit = np.array([table[s] for s in name_ids.tolist()], bool)
             out[ci, :n_real] &= hit

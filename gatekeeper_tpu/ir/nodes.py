@@ -161,6 +161,22 @@ class ConstBool(Expr):
     value: bool
 
 
+@dataclass(frozen=True)
+class KindIs(Expr):
+    """Exact value-kind test: kind tag equals (1=false, 2=true, ...)."""
+
+    col: FeatCol
+    kind: int
+
+
+@dataclass(frozen=True)
+class ParamBoolIs(Expr):
+    """Exact boolean equality for a parameter (kind tag test)."""
+
+    name: str
+    want: bool
+
+
 # --- parameter specs ------------------------------------------------------
 
 

@@ -72,12 +72,18 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
     c = len(constraints)
     # always one leaf so vmap has a mapped axis even for param-less templates
     table: dict[str, Any] = {"__row__": jnp.zeros(c, jnp.int8)}
+    params_by_con = [
+        (con.parameters or {}) if isinstance(con.parameters, dict) else {}
+        for con in constraints
+    ]
     for spec in program.params:
-        params = [
-            (con.parameters or {}) if isinstance(con.parameters, dict) else {}
-            for con in constraints
-        ]
+        params = params_by_con
         vals = [p.get(spec.name) for p in params]
+        # every param row carries its kind tag so truthiness/presence nodes
+        # work regardless of the inferred value kind
+        table[f"{spec.name}__kind"] = jnp.asarray(
+            [0 if v is None else (2 if v is True else (1 if v is False else 2))
+             for v in vals], jnp.int8)
         if spec.kind == "num":
             table[f"{spec.name}__num"] = jnp.asarray(
                 [float(v) if isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -92,11 +98,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
             table[f"{spec.name}__present"] = jnp.asarray(
                 [isinstance(v, str) for v in vals], jnp.bool_)
         elif spec.kind == "bool":
-            # kind-style: 0 absent, 1 false, 2 true
-            table[f"{spec.name}__kind"] = jnp.asarray(
-                [0 if not isinstance(v, bool) and v is None else
-                 (2 if v is True else (1 if v is False else 2))
-                 for v in vals], jnp.int8)
+            pass  # the __kind tag above is the entire encoding
         elif spec.kind == "strlist":
             lists = [
                 [vocab.intern(x) for x in v if isinstance(x, str)]
@@ -220,6 +222,12 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         return ctx.row[f"{e.name}__kind"] >= 2
     if isinstance(e, N.ParamPresent):
         return ctx.row[f"{e.name}__kind"] > 0
+    if isinstance(e, N.ParamBoolIs):
+        return ctx.row[f"{e.name}__kind"] == (2 if e.want else 1)
+    if isinstance(e, N.KindIs):
+        a = _feat_arrays(ctx, e.col)
+        ragged = isinstance(e.col, RaggedCol)
+        return _expand_for_ctx(ctx, a["kind"] == e.kind, ragged)
     if isinstance(e, N.CmpNum):
         lv, lok = _eval_numlike(ctx, e.lhs)
         rv, rok = _eval_numlike(ctx, e.rhs)

@@ -1,0 +1,220 @@
+"""Multi-chip sharded evaluation: the audit sweep's scale-out plane.
+
+Domain mapping of the parallelism axes (SURVEY.md §2.9: the reference is a
+policy controller — its "parallelism" is request/constraint/object loops, not
+DP/TP/PP; these are the TPU-native equivalents):
+
+- **data axis ('data')**   — the object batch (the reference's per-object
+  audit loop, manager.go:686). Sharded across chips over ICI; across hosts
+  over DCN in multi-host deployments.
+- **model axis ('model')** — the constraint axis (the reference's serial
+  per-constraint loop, k8scel/driver.go:194). Constraint parameter tables
+  shard across it when constraint counts are large; small tables replicate.
+- ragged item axis stays local to a chip (sequence-analog; items of one
+  object never split across chips).
+
+XLA inserts the collectives: verdict grids are elementwise so sharded inputs
+need none; the per-constraint top-k reduction gathers across the data axis
+(all-gather of per-shard top-k candidates — the device analog of the
+LimitQueue merge at pkg/audit/manager.go:886-945).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gatekeeper_tpu.ir.program import build_param_table
+from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              model_parallel: int = 1) -> Mesh:
+    """A (data, model) mesh over available devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by mp={model_parallel}")
+    arr = np.array(devs).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, ("data", "model"))
+
+
+def shard_batch_arrays(cols: dict, mesh: Mesh) -> dict:
+    """device_put column arrays with the object axis sharded over 'data'.
+
+    Columns are [N] or [N, M]; N shards, M stays local (ragged items of one
+    object live on one chip).
+    """
+    out = {}
+    for key, val in cols.items():
+        if isinstance(val, dict):
+            out[key] = {
+                k: jax.device_put(
+                    v, NamedSharding(mesh, P("data", *([None] * (v.ndim - 1))))
+                )
+                for k, v in val.items()
+            }
+        else:
+            out[key] = jax.device_put(
+                val, NamedSharding(mesh, P("data", *([None] * (val.ndim - 1))))
+            )
+    return out
+
+
+def shard_param_table(table: dict, mesh: Mesh, shard_constraints: bool) -> dict:
+    """Parameter rows: shard over 'model' when requested, else replicate."""
+    spec_axis = "model" if shard_constraints else None
+    out = {}
+    for k, v in table.items():
+        out[k] = jax.device_put(
+            v, NamedSharding(mesh, P(spec_axis, *([None] * (v.ndim - 1))))
+        )
+    return out
+
+
+def topk_violations(verdicts: jnp.ndarray, k: int) -> tuple:
+    """Per-constraint top-k violating object indices, lowest-index-first —
+    the device analog of the reference's LimitQueue (bounded max-heap,
+    audit/manager.go:161-202).
+
+    verdicts: [C, N] bool.  Returns (idx [C, k] int32, valid [C, k] bool).
+    Runs under jit; over a sharded N axis XLA all-gathers the per-shard
+    candidates.
+    """
+    c, n = verdicts.shape
+    k = min(k, n)
+    # score = 1 for violation, tie-broken toward low indices: top_k of
+    # (violation * N + (N - index)) picks violations with lowest indices first
+    idxs = jnp.arange(n, dtype=jnp.int32)
+    score = jnp.where(verdicts, n - idxs, 0).astype(jnp.int32)
+    top_scores, top_idx = jax.lax.top_k(score, k)
+    return top_idx, top_scores > 0
+
+
+class ShardedEvaluator:
+    """Runs a TpuDriver's compiled programs over a device mesh.
+
+    One instance per (driver, mesh); reuses the driver's vocab so interned
+    ids agree with single-chip evaluation.
+    """
+
+    def __init__(self, driver, mesh: Mesh, violations_limit: int = 20):
+        self.driver = driver
+        self.mesh = mesh
+        self.violations_limit = violations_limit
+        self._topk = jax.jit(topk_violations, static_argnums=(1,))
+        self._sweep_fns: dict = {}
+
+    def _sweep_fn(self, kinds: tuple, k: int):
+        """One fused jitted program for the whole sweep: every template's
+        verdict grid + mask + top-k + totals, returning ONE packed int32
+        array [C_total, 2k+1] = [idx(k) | valid(k) | count].
+
+        Device→host fetches are ~100ms RTT on tunneled TPU backends, so the
+        entire chunk result must come back in a single transfer.
+        """
+        key = (kinds, k)
+        fn = self._sweep_fns.get(key)
+        if fn is not None:
+            return fn
+        builders = [self.driver._programs[kind]._build() for kind in kinds]
+
+        def fused(tables: tuple, cols: dict, mask):
+            grids = [b(t, cols) for b, t in zip(builders, tables)]
+            grid = jnp.concatenate(grids, axis=0) & mask
+            idx, valid = topk_violations(grid, k)
+            counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+            return jnp.concatenate(
+                [idx, valid.astype(jnp.int32), counts[:, None]], axis=1
+            )
+
+        fn = jax.jit(fused)
+        self._sweep_fns[key] = fn
+        return fn
+
+    def sweep(self, constraints: Sequence, objects: Sequence[dict]):
+        """One audit sweep chunk: returns {kind: (cons, idx, valid)} with
+        idx/valid [C, k] numpy arrays of violating object indices.
+
+        Fallback (non-lowered) kinds are handled by the caller via
+        driver.query_batch; this path is the mass-scan for lowered kinds.
+        """
+        by_kind: dict[str, list] = {}
+        for con in constraints:
+            by_kind.setdefault(con.kind, []).append(con)
+        lowered = [k for k in by_kind if k in self.driver._programs]
+        if not lowered:
+            return {}
+
+        schema = Schema()
+        for kind in lowered:
+            schema.merge(self.driver._programs[kind].program.schema)
+        n = len(objects)
+        pad_n = self._pad(n)
+        batch = Flattener(schema, self.driver.vocab).flatten(objects, pad_n=pad_n)
+
+        from gatekeeper_tpu.ir import masks as masks_mod
+        from gatekeeper_tpu.ir.program import col_key, axis_key
+
+        cols: dict = {}
+        for spec, col in batch.scalars.items():
+            cols[col_key(spec)] = {"kind": col.kind, "num": col.num,
+                                   "sid": col.sid}
+        for spec, col in batch.raggeds.items():
+            cols[col_key(spec)] = {"kind": col.kind, "num": col.num,
+                                   "sid": col.sid}
+        for axis, cnt in batch.axis_counts.items():
+            cols[axis_key(axis)] = cnt
+        for spec, col in batch.keysets.items():
+            cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
+        sharded_cols = shard_batch_arrays(cols, self.mesh)
+
+        kinds = tuple(sorted(lowered))
+        k = self.violations_limit
+        tables = []
+        mask_rows = []
+        offsets = {}
+        c_off = 0
+        for kind in kinds:
+            prog = self.driver._programs[kind]
+            cons = by_kind[kind]
+            table = build_param_table(prog.program, cons, self.driver.vocab)
+            tables.append(shard_param_table(table, self.mesh,
+                                            shard_constraints=False))
+            mask_rows.append(masks_mod.constraint_masks(
+                cons, batch, self.driver.vocab, objects
+            ))
+            offsets[kind] = (c_off, c_off + len(cons))
+            c_off += len(cons)
+        mask = np.concatenate(mask_rows, axis=0)
+        mask_dev = jax.device_put(
+            mask, NamedSharding(self.mesh, P(None, "data"))
+        )
+        packed = self._sweep_fn(kinds, k)(tuple(tables), sharded_cols,
+                                          mask_dev)
+        packed_np = np.asarray(packed)  # the single device->host fetch
+
+        # top_k clamps k to the padded batch width; recover the effective k
+        # from the packed layout [idx(k') | valid(k') | count]
+        k_eff = (packed_np.shape[1] - 1) // 2
+        out = {}
+        for kind in kinds:
+            lo, hi = offsets[kind]
+            idx_np = packed_np[lo:hi, :k_eff]
+            valid_np = (packed_np[lo:hi, k_eff: 2 * k_eff] != 0) & (idx_np < n)
+            counts_np = packed_np[lo:hi, 2 * k_eff]
+            out[kind] = (by_kind[kind], idx_np, valid_np, counts_np)
+        return out
+
+    def _pad(self, n: int) -> int:
+        base = self.mesh.shape["data"] * 8
+        p = base
+        while p < n:
+            p *= 2
+        return p

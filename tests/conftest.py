@@ -1,18 +1,30 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
-Tests must run without TPU hardware; multi-chip sharding paths are exercised on
-a virtual CPU mesh (the driver separately dry-runs the multichip path via
-``__graft_entry__.dryrun_multichip``).  Env must be set before jax imports.
+Tests must run without TPU hardware; multi-chip sharding paths are exercised
+on a virtual CPU mesh (the driver separately dry-runs the multichip path via
+``__graft_entry__.dryrun_multichip``).
+
+Note: this environment's axon TPU plugin prepends itself to
+``jax_platforms`` regardless of the JAX_PLATFORMS env var, so the env var
+alone is NOT enough — the config must be updated explicitly before any
+backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
 
 import pytest  # noqa: E402
 

@@ -251,3 +251,94 @@ def test_mask_generate_name_objects():
     batch = Flattener(Schema(), vocab).flatten(objs)
     mask = masks_mod.constraint_masks([con], batch, vocab, objs)
     assert mask[0, 0]  # generateName "web-" matches name glob "web-*"
+
+
+def _mini_driver(rego, kind):
+    from gatekeeper_tpu.apis.templates import ConstraintTemplate
+
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.add_template(ConstraintTemplate.from_unstructured({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                 "targets": [{"target": TARGET, "rego": rego}]},
+    }))
+    con = Constraint.from_unstructured({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind, "metadata": {"name": "x"}, "spec": {}})
+    tpu.add_constraint(con)
+    return tpu, con
+
+
+def _verdicts(tpu, con, pods):
+    target = K8sValidationTarget()
+    reviews = [target.handle_review(AugmentedUnstructured(object=p))
+               for p in pods]
+    resp = tpu.query_batch(TARGET, [con], reviews, render_messages=False)
+    return [len(r.results) for r in resp]
+
+
+def test_named_iteration_var_shares_instance():
+    """containers[i].a; containers[i].b requires the SAME container."""
+    tpu, con = _mini_driver("""
+package k8ssamevar
+
+violation[{"msg": "same"}] {
+  input.review.object.spec.containers[i].privileged
+  input.review.object.spec.containers[i].hostBad
+}
+""", "K8sSameVar")
+    assert "K8sSameVar" in tpu.lowered_kinds()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"privileged": True}, {"hostBad": True}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"privileged": True, "hostBad": True}]}},
+    ]
+    assert _verdicts(tpu, con, pods) == [0, 1]
+
+
+def test_message_assignment_definedness_gates_clause():
+    """msg := sprintf(..., [c.name]) makes the clause undefined when c.name
+    is missing (interpreter semantics preserved in the lowered program)."""
+    tpu, con = _mini_driver("""
+package k8smsgdef
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  c.securityContext.privileged
+  msg := sprintf("bad: %v", [c.name])
+}
+""", "K8sMsgDef")
+    assert "K8sMsgDef" in tpu.lowered_kinds()
+    pods = [
+        # privileged but NO name -> sprintf arg undefined -> no violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"securityContext": {"privileged": True}}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [
+             {"name": "c1", "securityContext": {"privileged": True}}]}},
+    ]
+    assert _verdicts(tpu, con, pods) == [0, 1]
+
+
+def test_bool_equality_is_exact_on_kind():
+    """x == true must not match truthy non-booleans."""
+    tpu, con = _mini_driver("""
+package k8sbooleq
+
+violation[{"msg": "hostNetwork true"}] {
+  input.review.object.spec.hostNetwork == true
+}
+""", "K8sBoolEq")
+    assert "K8sBoolEq" in tpu.lowered_kinds()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"hostNetwork": True}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"hostNetwork": "yes"}},  # truthy string, not == true
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {}},
+    ]
+    assert _verdicts(tpu, con, pods) == [1, 0, 0]
