@@ -40,6 +40,12 @@ class AuditConfig:
     chunk_size: int = 500  # --audit-chunk-size
     match_kind_only: bool = False  # --audit-match-kind-only
     from_cache: bool = False  # --audit-from-cache
+    # exact totals = reference parity: totalViolations counts every violation
+    # *result* (a pod with 2 privileged containers contributes 2), which
+    # requires rendering every hit through the interpreter.  False counts
+    # violating objects from the device grid — faster on violation-dense
+    # clusters, at the cost of undercounting multi-violation objects.
+    exact_totals: bool = True
 
 
 @dataclass
@@ -176,20 +182,32 @@ class AuditManager:
                 break
 
         if self.evaluator is not None and driver is not None:
-            swept = self.evaluator.sweep(constraints, objects)
-            counts = {}
-            for kind, (cons, idx, valid, ccounts) in swept.items():
+            exact = self.config.exact_totals
+            swept = self.evaluator.sweep(constraints, objects,
+                                         return_bits=exact)
+            n_obj = len(objects)
+            for kind, (cons, idx, valid, ccounts, bits) in swept.items():
                 for ci, con in enumerate(cons):
                     key = con.key()
-                    totals[key] += int(ccounts[ci])
-                    for j in range(idx.shape[1]):
-                        if not valid[ci, j] or len(kept[key]) >= limit:
-                            continue
-                        oi = int(idx[ci, j])
-                        self._render_kept(
-                            driver, con, objects[oi], get_reviews()[oi],
-                            kept[key]
-                        )
+                    if exact and bits is not None:
+                        hit_idx = np.nonzero(
+                            np.unpackbits(bits[ci], count=n_obj)
+                        )[0]
+                        for oi in hit_idx.tolist():
+                            totals[key] += self._render_kept(
+                                driver, con, objects[oi],
+                                get_reviews()[oi], kept[key], limit
+                            )
+                    else:
+                        totals[key] += int(ccounts[ci])
+                        for j in range(idx.shape[1]):
+                            if not valid[ci, j] or len(kept[key]) >= limit:
+                                continue
+                            oi = int(idx[ci, j])
+                            self._render_kept(
+                                driver, con, objects[oi], get_reviews()[oi],
+                                kept[key], limit
+                            )
             # fallback kinds through the exact engine
             fallback_cons = [
                 c for c in constraints
@@ -246,13 +264,18 @@ class AuditManager:
                         self._violation(con, objects[oi], r.msg, r.details)
                     )
 
-    def _render_kept(self, driver, con, obj, review, out_list):
+    def _render_kept(self, driver, con, obj, review, out_list, limit) -> int:
+        """Render one hit through the exact engine; append to ``out_list``
+        up to ``limit`` (the reference's LimitQueue cap applies to *results*,
+        audit/manager.go:161-202).  Returns the number of results."""
         qr = driver._interp.query(
             self.client.target.name, [con], review,
             ReviewCfg(enforcement_point=AUDIT_EP),
         )
         for r in qr.results:
-            out_list.append(self._violation(con, obj, r.msg, r.details))
+            if len(out_list) < limit:
+                out_list.append(self._violation(con, obj, r.msg, r.details))
+        return len(qr.results)
 
     def _violation(self, con, obj, msg, details) -> Violation:
         group, version, kind = gvk_of(obj)

@@ -283,17 +283,21 @@ class _Lowerer:
                 # axis (containers[i].a; containers[i].b share one ∃i)
                 binding = env[arg.name]
                 base = self._iterate(base)
-                if isinstance(base, ItemVal):
-                    if base.axis != binding.axis:
-                        return OpaqueVal(
-                            f"var {arg.name} indexes two collections"
-                        )
-                    base = ItemVal(base.axis, base.subpath, binding.instance)
+                if not isinstance(base, ItemVal):
+                    # correlation over non-axis bases (e.g. parameters[i])
+                    # can't be expressed; fall back to the interpreter
+                    return OpaqueVal(f"correlated index var {arg.name}")
+                if base.axis != binding.axis:
+                    return OpaqueVal(
+                        f"var {arg.name} indexes two collections"
+                    )
+                base = ItemVal(base.axis, base.subpath, binding.instance)
             elif isinstance(arg, ast.Var) and arg.name not in env:
                 # first use of a named var: iterate and bind the instance
                 base = self._iterate(base)
-                if isinstance(base, ItemVal):
-                    env[arg.name] = IterBinding(base.axis, base.instance)
+                if not isinstance(base, ItemVal):
+                    return OpaqueVal(f"correlated index var {arg.name}")
+                env[arg.name] = IterBinding(base.axis, base.instance)
             else:
                 return OpaqueVal("computed ref index")
             if isinstance(base, OpaqueVal):

@@ -77,12 +77,12 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
         for con in constraints
     ]
     for spec in program.params:
-        params = params_by_con
-        vals = [p.get(spec.name) for p in params]
-        # every param row carries its kind tag so truthiness/presence nodes
-        # work regardless of the inferred value kind
+        vals = [p.get(spec.name) for p in params_by_con]
+        # every param row carries a kind tag: 0 absent, 1 false, 2 true,
+        # 3 present-non-bool — so ParamTruthy (>=2), ParamPresent (>0) and
+        # the exact ParamBoolIs (==2 / ==1) all read the same encoding
         table[f"{spec.name}__kind"] = jnp.asarray(
-            [0 if v is None else (2 if v is True else (1 if v is False else 2))
+            [0 if v is None else (2 if v is True else (1 if v is False else 3))
              for v in vals], jnp.int8)
         if spec.kind == "num":
             table[f"{spec.name}__num"] = jnp.asarray(

@@ -225,12 +225,59 @@ def round_up(n: int, bucket: int = 8) -> int:
 
 
 class Flattener:
-    def __init__(self, schema: Schema, vocab: Optional[Vocab] = None):
+    def __init__(self, schema: Schema, vocab: Optional[Vocab] = None,
+                 use_native: bool = True):
         self.schema = schema
         self.vocab = vocab or Vocab()
+        self.use_native = use_native
 
     def flatten(self, objects: Sequence[dict],
                 pad_n: Optional[int] = None) -> ColumnBatch:
+        if self.use_native:
+            from gatekeeper_tpu.ops import native
+
+            mod = native.load()
+            if mod is not None:
+                return self._flatten_native(mod, objects, pad_n)
+        return self._flatten_py(objects, pad_n)
+
+    def _flatten_native(self, mod, objects: Sequence[dict],
+                        pad_n: Optional[int]) -> ColumnBatch:
+        """Columnarize via the C extension (native/flattenmod.c); layout and
+        interning are bit-identical to the Python path (differential-tested
+        in tests/test_native_flatten.py)."""
+        schema = self.schema
+        axes = schema.axes()
+        axis_index = {a: i for i, a in enumerate(axes)}
+        out = mod.flatten_batch(
+            list(objects),
+            [tuple(s.path) for s in schema.scalars],
+            [a.segments for a in axes],
+            [(axis_index[r.axis], tuple(r.subpath)) for r in schema.raggeds],
+            [tuple(k.path) for k in schema.keysets],
+            self.vocab._to_id,
+            self.vocab._to_str,
+            int(pad_n or len(objects)),
+            8,  # ragged bucket, matches round_up()
+        )
+        n = max(pad_n or 0, len(objects))
+        batch = ColumnBatch(n=n, scalars={}, raggeds={}, axis_counts={},
+                            keysets={})
+        batch.group_sid, batch.kind_sid, batch.ns_sid, batch.name_sid = (
+            out["identity"]
+        )
+        for spec, (kind, num, sid) in zip(schema.scalars, out["scalars"]):
+            batch.scalars[spec] = ScalarColumn(kind, num, sid)
+        for axis, cnt in zip(axes, out["axes"]):
+            batch.axis_counts[axis] = cnt
+        for spec, (kind, num, sid) in zip(schema.raggeds, out["raggeds"]):
+            batch.raggeds[spec] = RaggedColumn(kind, num, sid)
+        for spec, (sid, cnt) in zip(schema.keysets, out["keysets"]):
+            batch.keysets[spec] = KeySetColumn(sid, cnt)
+        return batch
+
+    def _flatten_py(self, objects: Sequence[dict],
+                    pad_n: Optional[int] = None) -> ColumnBatch:
         n_real = len(objects)
         n = pad_n or n_real
         vocab = self.vocab

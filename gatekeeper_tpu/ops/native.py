@@ -1,0 +1,78 @@
+"""Lazy build + load of the native flattener (native/flattenmod.c).
+
+Builds with the in-image toolchain (g++/cc via setuptools, no network); on
+any failure the Python flattener in ops/flatten.py remains authoritative.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                          "build")
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                    "flattenmod.c")
+
+_mod = None
+_tried = False
+
+
+def load() -> Optional[object]:
+    """Returns the gtpu_flatten module, building it on first use."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    _tried = True
+    try:
+        import gtpu_flatten  # already importable (built earlier)
+
+        _mod = gtpu_flatten
+        return _mod
+    except ImportError:
+        pass
+    try:
+        _mod = _build()
+    except subprocess.CalledProcessError as e:
+        sys.stderr.write(
+            f"gtpu_flatten build failed ({e}):\n{e.stderr}\n"
+            "using Python flattener\n"
+        )
+        _mod = None
+    except Exception as e:  # build env problems -> Python fallback
+        sys.stderr.write(f"gtpu_flatten build failed ({e}); "
+                         "using Python flattener\n")
+        _mod = None
+    return _mod
+
+
+def _build():
+    import numpy as np
+
+    src = os.path.abspath(_SRC)
+    out_dir = os.path.abspath(_BUILD_DIR)
+    os.makedirs(out_dir, exist_ok=True)
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(out_dir, "gtpu_flatten" + ext)
+    if not os.path.exists(out) or (
+        os.path.getmtime(out) < os.path.getmtime(src)
+    ):
+        cc = sysconfig.get_config_var("CC") or "cc"
+        cflags = (sysconfig.get_config_var("CFLAGS") or "").split()
+        include = sysconfig.get_path("include")
+        np_include = np.get_include()
+        cmd = (
+            cc.split()
+            + ["-O3", "-shared", "-fPIC", src, "-o", out,
+               f"-I{include}", f"-I{np_include}"]
+            + [f for f in cflags if f.startswith("-f") or f.startswith("-m")]
+        )
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    if out_dir not in sys.path:
+        sys.path.insert(0, out_dir)
+    import importlib
+
+    return importlib.import_module("gtpu_flatten")

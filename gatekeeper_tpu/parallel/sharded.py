@@ -108,10 +108,9 @@ class ShardedEvaluator:
         self.driver = driver
         self.mesh = mesh
         self.violations_limit = violations_limit
-        self._topk = jax.jit(topk_violations, static_argnums=(1,))
         self._sweep_fns: dict = {}
 
-    def _sweep_fn(self, kinds: tuple, k: int):
+    def _sweep_fn(self, kinds: tuple, k: int, return_bits: bool = False):
         """One fused jitted program for the whole sweep: every template's
         verdict grid + mask + top-k + totals, returning ONE packed int32
         array [C_total, 2k+1] = [idx(k) | valid(k) | count].
@@ -119,7 +118,7 @@ class ShardedEvaluator:
         Device→host fetches are ~100ms RTT on tunneled TPU backends, so the
         entire chunk result must come back in a single transfer.
         """
-        key = (kinds, k)
+        key = (kinds, k, return_bits)
         fn = self._sweep_fns.get(key)
         if fn is not None:
             return fn
@@ -130,19 +129,29 @@ class ShardedEvaluator:
             grid = jnp.concatenate(grids, axis=0) & mask
             idx, valid = topk_violations(grid, k)
             counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
-            return jnp.concatenate(
+            packed = jnp.concatenate(
                 [idx, valid.astype(jnp.int32), counts[:, None]], axis=1
             )
+            if return_bits:
+                # bit-packed verdict rows: the exact hit set travels to the
+                # host at N/8 bytes per constraint (audit exact-totals mode)
+                return packed, jnp.packbits(
+                    grid.astype(jnp.uint8), axis=1
+                )
+            return packed
 
         fn = jax.jit(fused)
         self._sweep_fns[key] = fn
         return fn
 
-    def sweep(self, constraints: Sequence, objects: Sequence[dict]):
-        """One audit sweep chunk: returns {kind: (cons, idx, valid)} with
-        idx/valid [C, k] numpy arrays of violating object indices.
+    def sweep(self, constraints: Sequence, objects: Sequence[dict],
+              return_bits: bool = False):
+        """One audit sweep chunk: {kind: (cons, idx, valid, counts, bits)}.
 
-        Fallback (non-lowered) kinds are handled by the caller via
+        idx/valid [C, k]: top-k violating object indices per constraint;
+        counts [C]: violating-object totals; bits: bit-packed verdict rows
+        [C, ceil(pad_n/8)] when ``return_bits`` (exact audit totals), else
+        None.  Fallback (non-lowered) kinds are handled by the caller via
         driver.query_batch; this path is the mass-scan for lowered kinds.
         """
         by_kind: dict[str, list] = {}
@@ -196,9 +205,15 @@ class ShardedEvaluator:
         mask_dev = jax.device_put(
             mask, NamedSharding(self.mesh, P(None, "data"))
         )
-        packed = self._sweep_fn(kinds, k)(tuple(tables), sharded_cols,
-                                          mask_dev)
-        packed_np = np.asarray(packed)  # the single device->host fetch
+        result = self._sweep_fn(kinds, k, return_bits)(
+            tuple(tables), sharded_cols, mask_dev
+        )
+        if return_bits:
+            packed_np = np.asarray(result[0])
+            bits_np = np.asarray(result[1])
+        else:
+            packed_np = np.asarray(result)  # the single device->host fetch
+            bits_np = None
 
         # top_k clamps k to the padded batch width; recover the effective k
         # from the packed layout [idx(k') | valid(k') | count]
@@ -209,7 +224,8 @@ class ShardedEvaluator:
             idx_np = packed_np[lo:hi, :k_eff]
             valid_np = (packed_np[lo:hi, k_eff: 2 * k_eff] != 0) & (idx_np < n)
             counts_np = packed_np[lo:hi, 2 * k_eff]
-            out[kind] = (by_kind[kind], idx_np, valid_np, counts_np)
+            kb = bits_np[lo:hi] if bits_np is not None else None
+            out[kind] = (by_kind[kind], idx_np, valid_np, counts_np, kb)
         return out
 
     def _pad(self, n: int) -> int:

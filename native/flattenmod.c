@@ -1,0 +1,504 @@
+/* gtpu_flatten: native columnar flattener.
+ *
+ * The host→device boundary of the framework: walks a batch of Kubernetes
+ * objects (Python dicts) once and fills the columnar arrays the TPU verdict
+ * kernels consume (see gatekeeper_tpu/ops/flatten.py for the semantics —
+ * this module is a drop-in accelerated implementation of
+ * Flattener.flatten; the Python version remains the reference oracle and
+ * fallback, differential-tested in tests/test_native_flatten.py).
+ *
+ * The reference has no native components (SURVEY.md §2.9: pure Go); in the
+ * TPU build the JSON→columns flattening is the host-side hot loop of the
+ * audit sweep (pkg/audit/manager.go:668-774 analog), hence native.
+ *
+ * Interning writes straight into the Vocab's underlying dict/list
+ * (vocab._to_id / vocab._to_str) so ids agree with the Python path.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+/* value-kind tags (must match ops/flatten.py) */
+enum { K_ABSENT = 0, K_FALSE = 1, K_TRUE = 2, K_NUM = 3, K_STR = 4,
+       K_OTHER = 5 };
+
+typedef struct {
+    PyObject *to_id;  /* dict: str -> int */
+    PyObject *to_str; /* list: id -> str */
+} Vocab;
+
+static long
+vocab_intern(Vocab *v, PyObject *s)
+{
+    PyObject *hit = PyDict_GetItem(v->to_id, s); /* borrowed */
+    if (hit != NULL)
+        return PyLong_AsLong(hit);
+    Py_ssize_t id = PyList_GET_SIZE(v->to_str);
+    PyObject *idobj = PyLong_FromSsize_t(id);
+    if (idobj == NULL)
+        return -1;
+    if (PyDict_SetItem(v->to_id, s, idobj) < 0 ||
+        PyList_Append(v->to_str, s) < 0) {
+        Py_DECREF(idobj);
+        return -1;
+    }
+    Py_DECREF(idobj);
+    return (long)id;
+}
+
+/* walk a key path through nested dicts; returns borrowed ref or NULL */
+static PyObject *
+walk(PyObject *obj, PyObject *path /* tuple of str */)
+{
+    PyObject *cur = obj;
+    Py_ssize_t n = PyTuple_GET_SIZE(path);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!PyDict_Check(cur))
+            return NULL;
+        cur = PyDict_GetItem(cur, PyTuple_GET_ITEM(path, i));
+        if (cur == NULL)
+            return NULL;
+    }
+    return cur;
+}
+
+/* classify a value into (kind, num, sid); returns 0 ok, -1 error */
+static int
+classify(Vocab *vocab, PyObject *val, signed char *kind, float *num,
+         int *sid)
+{
+    *num = 0.0f;
+    *sid = -1;
+    if (val == Py_True) {
+        *kind = K_TRUE;
+    } else if (val == Py_False) {
+        *kind = K_FALSE;
+    } else if (PyLong_Check(val)) {
+        *kind = K_NUM;
+        *num = (float)PyLong_AsDouble(val);
+    } else if (PyFloat_Check(val)) {
+        *kind = K_NUM;
+        *num = (float)PyFloat_AS_DOUBLE(val);
+    } else if (PyUnicode_Check(val)) {
+        *kind = K_STR;
+        long id = vocab_intern(vocab, val);
+        if (id < 0 && PyErr_Occurred())
+            return -1;
+        *sid = (int)id;
+    } else {
+        *kind = K_OTHER; /* None / list / dict */
+    }
+    return 0;
+}
+
+static PyArrayObject *
+new_array(int nd, npy_intp *dims, int typenum, int fill_minus1)
+{
+    PyArrayObject *a = (PyArrayObject *)PyArray_ZEROS(nd, dims, typenum, 0);
+    if (a == NULL)
+        return NULL;
+    if (fill_minus1) {
+        /* sid arrays start at -1 (absent) */
+        int *data = (int *)PyArray_DATA(a);
+        npy_intp total = PyArray_SIZE(a);
+        for (npy_intp i = 0; i < total; i++)
+            data[i] = -1;
+    }
+    return a;
+}
+
+/* append items of a (possibly nested) list path into out (PyList) */
+static int
+collect_segment(PyObject *obj, PyObject *segment /* tuple of path tuples */,
+                PyObject *out)
+{
+    PyObject *level = PyList_New(0);
+    if (level == NULL)
+        return -1;
+    if (PyList_Append(level, obj) < 0) {
+        Py_DECREF(level);
+        return -1;
+    }
+    Py_ssize_t nparts = PyTuple_GET_SIZE(segment);
+    for (Py_ssize_t p = 0; p < nparts; p++) {
+        PyObject *part = PyTuple_GET_ITEM(segment, p);
+        PyObject *next = PyList_New(0);
+        if (next == NULL) {
+            Py_DECREF(level);
+            return -1;
+        }
+        Py_ssize_t nl = PyList_GET_SIZE(level);
+        for (Py_ssize_t i = 0; i < nl; i++) {
+            PyObject *node = PyList_GET_ITEM(level, i);
+            PyObject *val = walk(node, part);
+            if (val != NULL && PyList_Check(val)) {
+                Py_ssize_t ni = PyList_GET_SIZE(val);
+                for (Py_ssize_t j = 0; j < ni; j++) {
+                    if (PyList_Append(next, PyList_GET_ITEM(val, j)) < 0) {
+                        Py_DECREF(level);
+                        Py_DECREF(next);
+                        return -1;
+                    }
+                }
+            }
+        }
+        Py_DECREF(level);
+        level = next;
+    }
+    Py_ssize_t nl = PyList_GET_SIZE(level);
+    for (Py_ssize_t i = 0; i < nl; i++) {
+        if (PyList_Append(out, PyList_GET_ITEM(level, i)) < 0) {
+            Py_DECREF(level);
+            return -1;
+        }
+    }
+    Py_DECREF(level);
+    return 0;
+}
+
+/* flatten_batch(objects, scalars, axes, raggeds, keysets, to_id, to_str,
+ *               pad_n, ragged_bucket)
+ *
+ *   objects: list[dict]
+ *   scalars: list[tuple[str, ...]]                      (paths)
+ *   axes:    list[tuple[segment, ...]]; segment = tuple[part,...];
+ *            part = tuple[str, ...]
+ *   raggeds: list[tuple[int axis_idx, tuple[str,...] subpath]]
+ *   keysets: list[tuple[str, ...]]
+ *
+ * Returns dict:
+ *   "identity": (group_sid, kind_sid, ns_sid, name_sid)   int32 [N]
+ *   "scalars":  list[(kind, num, sid)]
+ *   "axes":     list[counts]
+ *   "raggeds":  list[(kind, num, sid)]                    [N, M]
+ *   "keysets":  list[(sid [N, L], count [N])]
+ */
+static PyObject *
+flatten_batch(PyObject *self, PyObject *args)
+{
+    PyObject *objects, *scalars, *axes, *raggeds, *keysets;
+    PyObject *to_id, *to_str;
+    Py_ssize_t pad_n;
+    long ragged_bucket;
+    if (!PyArg_ParseTuple(args, "OOOOOOOnl", &objects, &scalars, &axes,
+                          &raggeds, &keysets, &to_id, &to_str, &pad_n,
+                          &ragged_bucket))
+        return NULL;
+    if (!PyList_Check(objects)) {
+        PyErr_SetString(PyExc_TypeError, "objects must be a list");
+        return NULL;
+    }
+    Vocab vocab = {to_id, to_str};
+    Py_ssize_t n_real = PyList_GET_SIZE(objects);
+    Py_ssize_t n = pad_n > n_real ? pad_n : n_real;
+    npy_intp dims1[1] = {(npy_intp)n};
+
+    PyObject *result = PyDict_New();
+    if (result == NULL)
+        return NULL;
+
+    /* --- identity columns ------------------------------------------- */
+    PyObject *apiVersion_key = PyUnicode_InternFromString("apiVersion");
+    PyObject *kind_key = PyUnicode_InternFromString("kind");
+    PyObject *metadata_key = PyUnicode_InternFromString("metadata");
+    PyObject *name_key = PyUnicode_InternFromString("name");
+    PyObject *namespace_key = PyUnicode_InternFromString("namespace");
+    PyObject *empty_str = PyUnicode_InternFromString("");
+
+    PyArrayObject *gid = new_array(1, dims1, NPY_INT32, 1);
+    PyArrayObject *kid = new_array(1, dims1, NPY_INT32, 1);
+    PyArrayObject *nsid = new_array(1, dims1, NPY_INT32, 1);
+    PyArrayObject *nmid = new_array(1, dims1, NPY_INT32, 1);
+    if (!gid || !kid || !nsid || !nmid)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n_real; i++) {
+        PyObject *obj = PyList_GET_ITEM(objects, i);
+        if (!PyDict_Check(obj))
+            continue;
+        PyObject *av = PyDict_GetItem(obj, apiVersion_key);
+        PyObject *group = NULL;
+        if (av != NULL && PyUnicode_Check(av)) {
+            Py_ssize_t slash = PyUnicode_FindChar(av, '/', 0,
+                                                  PyUnicode_GET_LENGTH(av), 1);
+            if (slash >= 0)
+                group = PyUnicode_Substring(av, 0, slash); /* new ref */
+        }
+        PyObject *g = group ? group : empty_str;
+        ((int *)PyArray_DATA(gid))[i] = (int)vocab_intern(&vocab, g);
+        Py_XDECREF(group);
+
+        PyObject *kv = PyDict_GetItem(obj, kind_key);
+        ((int *)PyArray_DATA(kid))[i] = (int)vocab_intern(
+            &vocab, (kv && PyUnicode_Check(kv)) ? kv : empty_str);
+
+        PyObject *meta = PyDict_GetItem(obj, metadata_key);
+        PyObject *nm = NULL, *ns = NULL;
+        if (meta != NULL && PyDict_Check(meta)) {
+            nm = PyDict_GetItem(meta, name_key);
+            ns = PyDict_GetItem(meta, namespace_key);
+        }
+        ((int *)PyArray_DATA(nsid))[i] = (int)vocab_intern(
+            &vocab, (ns && PyUnicode_Check(ns)) ? ns : empty_str);
+        ((int *)PyArray_DATA(nmid))[i] = (int)vocab_intern(
+            &vocab, (nm && PyUnicode_Check(nm)) ? nm : empty_str);
+    }
+    {
+        PyObject *identity = Py_BuildValue("(NNNN)", gid, kid, nsid, nmid);
+        gid = kid = nsid = nmid = NULL;
+        if (identity == NULL || PyDict_SetItemString(result, "identity",
+                                                     identity) < 0) {
+            Py_XDECREF(identity);
+            goto fail;
+        }
+        Py_DECREF(identity);
+    }
+
+    /* --- scalar columns ---------------------------------------------- */
+    {
+        Py_ssize_t ns_ = PyList_GET_SIZE(scalars);
+        PyObject *out = PyList_New(ns_);
+        if (out == NULL)
+            goto fail;
+        for (Py_ssize_t s = 0; s < ns_; s++) {
+            PyObject *path = PyList_GET_ITEM(scalars, s);
+            PyArrayObject *a_kind = new_array(1, dims1, NPY_INT8, 0);
+            PyArrayObject *a_num = new_array(1, dims1, NPY_FLOAT32, 0);
+            PyArrayObject *a_sid = new_array(1, dims1, NPY_INT32, 1);
+            if (!a_kind || !a_num || !a_sid) {
+                Py_XDECREF(a_kind); Py_XDECREF(a_num); Py_XDECREF(a_sid);
+                Py_DECREF(out);
+                goto fail;
+            }
+            signed char *dk = (signed char *)PyArray_DATA(a_kind);
+            float *dn = (float *)PyArray_DATA(a_num);
+            int *ds = (int *)PyArray_DATA(a_sid);
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *val = walk(PyList_GET_ITEM(objects, i), path);
+                if (val != NULL) {
+                    if (classify(&vocab, val, &dk[i], &dn[i], &ds[i]) < 0) {
+                        Py_DECREF(a_kind); Py_DECREF(a_num); Py_DECREF(a_sid);
+                        Py_DECREF(out);
+                        goto fail;
+                    }
+                }
+            }
+            PyList_SET_ITEM(out, s, Py_BuildValue("(NNN)", a_kind, a_num,
+                                                  a_sid));
+        }
+        if (PyDict_SetItemString(result, "scalars", out) < 0) {
+            Py_DECREF(out);
+            goto fail;
+        }
+        Py_DECREF(out);
+    }
+
+    /* --- axes: collect items + counts --------------------------------- */
+    Py_ssize_t n_axes = PyList_GET_SIZE(axes);
+    PyObject *axis_items = PyList_New(n_axes); /* per axis: list per object */
+    if (axis_items == NULL)
+        goto fail;
+    {
+        PyObject *counts_out = PyList_New(n_axes);
+        if (counts_out == NULL) {
+            Py_DECREF(axis_items);
+            goto fail;
+        }
+        for (Py_ssize_t a = 0; a < n_axes; a++) {
+            PyObject *segments = PyList_GET_ITEM(axes, a);
+            PyArrayObject *cnt = new_array(1, dims1, NPY_INT32, 0);
+            PyObject *per_obj = PyList_New(n_real);
+            if (!cnt || !per_obj) {
+                Py_XDECREF((PyObject *)cnt); Py_XDECREF(per_obj);
+                Py_DECREF(axis_items); Py_DECREF(counts_out);
+                goto fail;
+            }
+            int *dc = (int *)PyArray_DATA(cnt);
+            Py_ssize_t nseg = PyTuple_GET_SIZE(segments);
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *items = PyList_New(0);
+                if (items == NULL) {
+                    Py_DECREF((PyObject *)cnt); Py_DECREF(per_obj);
+                    Py_DECREF(axis_items); Py_DECREF(counts_out);
+                    goto fail;
+                }
+                for (Py_ssize_t g = 0; g < nseg; g++) {
+                    if (collect_segment(PyList_GET_ITEM(objects, i),
+                                        PyTuple_GET_ITEM(segments, g),
+                                        items) < 0) {
+                        Py_DECREF(items); Py_DECREF((PyObject *)cnt);
+                        Py_DECREF(per_obj); Py_DECREF(axis_items);
+                        Py_DECREF(counts_out);
+                        goto fail;
+                    }
+                }
+                dc[i] = (int)PyList_GET_SIZE(items);
+                PyList_SET_ITEM(per_obj, i, items);
+            }
+            PyList_SET_ITEM(axis_items, a, per_obj);
+            PyList_SET_ITEM(counts_out, a, (PyObject *)cnt);
+        }
+        if (PyDict_SetItemString(result, "axes", counts_out) < 0) {
+            Py_DECREF(counts_out); Py_DECREF(axis_items);
+            goto fail;
+        }
+        Py_DECREF(counts_out);
+    }
+
+    /* --- ragged columns ------------------------------------------------ */
+    {
+        Py_ssize_t nr = PyList_GET_SIZE(raggeds);
+        PyObject *out = PyList_New(nr);
+        if (out == NULL) {
+            Py_DECREF(axis_items);
+            goto fail;
+        }
+        for (Py_ssize_t r = 0; r < nr; r++) {
+            PyObject *entry = PyList_GET_ITEM(raggeds, r);
+            long axis_idx = PyLong_AsLong(PyTuple_GET_ITEM(entry, 0));
+            PyObject *subpath = PyTuple_GET_ITEM(entry, 1);
+            PyObject *per_obj = PyList_GET_ITEM(axis_items, axis_idx);
+            /* m = bucketed max count */
+            Py_ssize_t maxc = 0;
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                Py_ssize_t c = PyList_GET_SIZE(PyList_GET_ITEM(per_obj, i));
+                if (c > maxc)
+                    maxc = c;
+            }
+            Py_ssize_t m = ragged_bucket;
+            while (m < maxc)
+                m += ragged_bucket;
+            npy_intp dims2[2] = {(npy_intp)n, (npy_intp)m};
+            PyArrayObject *a_kind = new_array(2, dims2, NPY_INT8, 0);
+            PyArrayObject *a_num = new_array(2, dims2, NPY_FLOAT32, 0);
+            PyArrayObject *a_sid = new_array(2, dims2, NPY_INT32, 1);
+            if (!a_kind || !a_num || !a_sid) {
+                Py_XDECREF(a_kind); Py_XDECREF(a_num); Py_XDECREF(a_sid);
+                Py_DECREF(out); Py_DECREF(axis_items);
+                goto fail;
+            }
+            signed char *dk = (signed char *)PyArray_DATA(a_kind);
+            float *dn = (float *)PyArray_DATA(a_num);
+            int *ds = (int *)PyArray_DATA(a_sid);
+            int has_subpath = PyTuple_GET_SIZE(subpath) > 0;
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *items = PyList_GET_ITEM(per_obj, i);
+                Py_ssize_t c = PyList_GET_SIZE(items);
+                for (Py_ssize_t j = 0; j < c; j++) {
+                    PyObject *item = PyList_GET_ITEM(items, j);
+                    PyObject *val =
+                        has_subpath ? walk(item, subpath) : item;
+                    if (val != NULL) {
+                        Py_ssize_t off = i * m + j;
+                        if (classify(&vocab, val, &dk[off], &dn[off],
+                                     &ds[off]) < 0) {
+                            Py_DECREF(a_kind); Py_DECREF(a_num);
+                            Py_DECREF(a_sid); Py_DECREF(out);
+                            Py_DECREF(axis_items);
+                            goto fail;
+                        }
+                    }
+                }
+            }
+            PyList_SET_ITEM(out, r, Py_BuildValue("(NNN)", a_kind, a_num,
+                                                  a_sid));
+        }
+        if (PyDict_SetItemString(result, "raggeds", out) < 0) {
+            Py_DECREF(out); Py_DECREF(axis_items);
+            goto fail;
+        }
+        Py_DECREF(out);
+    }
+    Py_DECREF(axis_items);
+    axis_items = NULL;
+
+    /* --- keyset columns ------------------------------------------------ */
+    {
+        Py_ssize_t nk = PyList_GET_SIZE(keysets);
+        PyObject *out = PyList_New(nk);
+        if (out == NULL)
+            goto fail;
+        for (Py_ssize_t s = 0; s < nk; s++) {
+            PyObject *path = PyList_GET_ITEM(keysets, s);
+            /* pass 1: max key count */
+            Py_ssize_t maxc = 0;
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *val = walk(PyList_GET_ITEM(objects, i), path);
+                if (val != NULL && PyDict_Check(val)) {
+                    Py_ssize_t c = PyDict_Size(val);
+                    if (c > maxc)
+                        maxc = c;
+                }
+            }
+            Py_ssize_t l = ragged_bucket;
+            while (l < maxc)
+                l += ragged_bucket;
+            npy_intp dims2[2] = {(npy_intp)n, (npy_intp)l};
+            PyArrayObject *a_sid = new_array(2, dims2, NPY_INT32, 1);
+            PyArrayObject *a_cnt = new_array(1, dims1, NPY_INT32, 0);
+            if (!a_sid || !a_cnt) {
+                Py_XDECREF(a_sid); Py_XDECREF(a_cnt); Py_DECREF(out);
+                goto fail;
+            }
+            int *ds = (int *)PyArray_DATA(a_sid);
+            int *dc = (int *)PyArray_DATA(a_cnt);
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *val = walk(PyList_GET_ITEM(objects, i), path);
+                if (val == NULL || !PyDict_Check(val))
+                    continue;
+                /* sorted keys to match the Python flattener exactly */
+                PyObject *keys = PyDict_Keys(val);
+                if (keys == NULL || PyList_Sort(keys) < 0) {
+                    Py_XDECREF(keys); Py_DECREF(out);
+                    goto fail;
+                }
+                Py_ssize_t c = PyList_GET_SIZE(keys);
+                dc[i] = (int)c;
+                for (Py_ssize_t j = 0; j < c && j < l; j++) {
+                    PyObject *kk = PyList_GET_ITEM(keys, j);
+                    if (PyUnicode_Check(kk))
+                        ds[i * l + j] = (int)vocab_intern(&vocab, kk);
+                }
+                Py_DECREF(keys);
+            }
+            PyList_SET_ITEM(out, s, Py_BuildValue("(NN)", a_sid, a_cnt));
+        }
+        if (PyDict_SetItemString(result, "keysets", out) < 0) {
+            Py_DECREF(out);
+            goto fail;
+        }
+        Py_DECREF(out);
+    }
+
+    Py_DECREF(apiVersion_key); Py_DECREF(kind_key); Py_DECREF(metadata_key);
+    Py_DECREF(name_key); Py_DECREF(namespace_key); Py_DECREF(empty_str);
+    return result;
+
+fail:
+    Py_XDECREF((PyObject *)gid); Py_XDECREF((PyObject *)kid);
+    Py_XDECREF((PyObject *)nsid); Py_XDECREF((PyObject *)nmid);
+    Py_XDECREF(apiVersion_key); Py_XDECREF(kind_key);
+    Py_XDECREF(metadata_key); Py_XDECREF(name_key);
+    Py_XDECREF(namespace_key); Py_XDECREF(empty_str);
+    Py_DECREF(result);
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"flatten_batch", flatten_batch, METH_VARARGS,
+     "Flatten a batch of objects into columnar arrays."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "gtpu_flatten", NULL, -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit_gtpu_flatten(void)
+{
+    import_array();
+    return PyModule_Create(&moduledef);
+}

@@ -104,3 +104,59 @@ def test_audit_interpreter_only_path_agrees():
     )
     run_shard = mgr_shard.audit()
     assert run_plain.total_violations == run_shard.total_violations
+
+
+def test_exact_totals_count_results_not_objects():
+    """Reference parity: a pod with 2 privileged containers contributes 2 to
+    totalViolations (audit/manager.go counts results, not objects)."""
+    client, tpu = build_client()
+    pods = [{
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "multi", "namespace": "default",
+                     "labels": {"owner": "x"}},
+        "spec": {"containers": [
+            {"name": "a", "securityContext": {"privileged": True}},
+            {"name": "b", "securityContext": {"privileged": True}},
+        ]},
+    }]
+    key = ("K8sPSPPrivilegedContainer", "psp-privileged-container")
+    mesh = make_mesh(2)
+    run_exact = AuditManager(
+        client, lister=lambda: iter(pods),
+        config=AuditConfig(exact_totals=True),
+        evaluator=ShardedEvaluator(tpu, mesh),
+    ).audit()
+    assert run_exact.total_violations[key] == 2
+    assert len(run_exact.kept[key]) == 2
+    # interpreter-only path agrees
+    run_plain = AuditManager(client, lister=lambda: iter(pods)).audit()
+    assert run_plain.total_violations[key] == 2
+    # approximate mode counts objects
+    run_approx = AuditManager(
+        client, lister=lambda: iter(pods),
+        config=AuditConfig(exact_totals=False),
+        evaluator=ShardedEvaluator(tpu, mesh),
+    ).audit()
+    assert run_approx.total_violations[key] == 1
+
+
+def test_kept_respects_limit_with_multi_result_objects():
+    client, tpu = build_client()
+    pods = [{
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"m{i}", "namespace": "default",
+                     "labels": {"owner": "x"}},
+        "spec": {"containers": [
+            {"name": "a", "securityContext": {"privileged": True}},
+            {"name": "b", "securityContext": {"privileged": True}},
+            {"name": "c", "securityContext": {"privileged": True}},
+        ]},
+    } for i in range(4)]
+    key = ("K8sPSPPrivilegedContainer", "psp-privileged-container")
+    run = AuditManager(
+        client, lister=lambda: iter(pods),
+        config=AuditConfig(violations_limit=5, exact_totals=True),
+        evaluator=ShardedEvaluator(tpu, make_mesh(2), violations_limit=5),
+    ).audit()
+    assert run.total_violations[key] == 12  # all results counted
+    assert len(run.kept[key]) == 5  # but kept hard-capped at the limit
