@@ -94,10 +94,14 @@ class RegoDriver:
 
     # --- data plane ---------------------------------------------------
     def add_data(self, target: str, path: Sequence[str], data: Any) -> None:
+        import copy
+
         node = self._data.setdefault("inventory", {})
         for p in path[:-1]:
             node = node.setdefault(p, {})
-        node[path[-1]] = data
+        # independent copy: OPA's store snapshots data on write; callers may
+        # mutate the object afterwards (gator expand mutates bases in place)
+        node[path[-1]] = copy.deepcopy(data)
 
     def remove_data(self, target: str, path: Sequence[str]) -> None:
         node = self._data.get("inventory")

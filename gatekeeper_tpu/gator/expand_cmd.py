@@ -1,0 +1,60 @@
+"""gator expand: offline expansion preview (reference: cmd/gator/expand).
+
+Reads resources + ExpansionTemplates + mutators, prints the resultant
+resources as YAML documents (sorted keys, --- separated), or writes them to
+--outputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+from gatekeeper_tpu.expansion.expander import Expander
+from gatekeeper_tpu.gator import reader
+
+
+def run_cli(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="gator expand")
+    p.add_argument("--filename", "-f", action="append", default=[])
+    p.add_argument("--output", "-o", default="",
+                   help="write to file instead of stdout")
+    p.add_argument("--format", default="yaml", choices=["yaml", "json"])
+    args = p.parse_args(argv)
+
+    try:
+        objs = reader.read_sources(args.filename, use_stdin=not args.filename)
+    except OSError as e:
+        print(f"error: reading: {e}", file=sys.stderr)
+        return 1
+    if not objs:
+        print("no input data identified", file=sys.stderr)
+        return 1
+
+    try:
+        expander = Expander(objs)
+        resultants = []
+        for obj in objs:
+            resultants.extend(expander.expand(obj))
+    except Exception as e:
+        print(f"error: expanding resources: {e}", file=sys.stderr)
+        return 1
+
+    docs = [r.obj for r in resultants]
+    if args.format == "json":
+        import json
+
+        out = json.dumps(docs, indent=4)
+    else:
+        out = "---\n".join(
+            yaml.safe_dump(d, sort_keys=True, default_flow_style=False)
+            for d in docs
+        )
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+    else:
+        sys.stdout.write(out)
+    return 0

@@ -1,0 +1,158 @@
+"""Mutation system: ordered registry + fixed-point apply loop.
+
+Reference: pkg/mutation/system.go —
+- mutators sorted by ID, applied in order (system.go:146-246)
+- iterate until no mutator changes the object; max ``len(mutators)+1``
+  iterations, else ErrNotConverging (system.go:174-246)
+- mutators whose path schemas conflict (same node treated as object by one
+  and list by another) are ALL disabled (pkg/mutation/schema, ErrConflicting-
+  Schema)
+- external-data placeholders resolve at convergence
+  (system_external_data.go)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional, Sequence
+
+from gatekeeper_tpu.mutation.core import _deep_equal
+from gatekeeper_tpu.mutation.mutators import BaseMutator, MutatorID, from_unstructured
+from gatekeeper_tpu.mutation.path_parser import ListNode, ObjectNode
+
+
+class NotConvergingError(Exception):
+    """Reference: ErrNotConverging (system.go:34)."""
+
+
+class MutationSystem:
+    def __init__(self, reporter=None, provider_cache=None):
+        self._mutators: dict[MutatorID, BaseMutator] = {}
+        self._conflicts: set[MutatorID] = set()
+        self.reporter = reporter
+        self.provider_cache = provider_cache
+
+    # --- registry (reference: Upsert system.go:80, Remove :121) ----------
+    def upsert(self, mutator: BaseMutator) -> None:
+        self._mutators[mutator.id] = mutator
+        self._recompute_conflicts()
+
+    def upsert_unstructured(self, obj: dict) -> BaseMutator:
+        m = from_unstructured(obj)
+        self.upsert(m)
+        return m
+
+    def remove(self, mutator_id: MutatorID) -> None:
+        self._mutators.pop(mutator_id, None)
+        self._recompute_conflicts()
+
+    def get(self, mutator_id: MutatorID) -> Optional[BaseMutator]:
+        return self._mutators.get(mutator_id)
+
+    def mutators(self) -> list[BaseMutator]:
+        return [self._mutators[k] for k in sorted(self._mutators,
+                                                  key=str)]
+
+    def conflicts(self) -> set:
+        return set(self._conflicts)
+
+    def _recompute_conflicts(self) -> None:
+        """Schema conflict detection (reference: pkg/mutation/schema) —
+        if two mutators disagree on whether a path node is an object or a
+        keyed list, none of the conflicting mutators may run."""
+        by_prefix: dict[tuple, dict] = {}
+        conflicts: set[MutatorID] = set()
+        for m in self._mutators.values():
+            prefix: tuple = ()
+            for node in m.path:
+                if isinstance(node, ObjectNode):
+                    kind, detail = "object", node.name
+                    key = ("o", node.name)
+                else:
+                    kind, detail = "list", node.key_field
+                    key = ("l",)
+                slot = by_prefix.setdefault(prefix, {})
+                entry = slot.setdefault("kinds", {})
+                entry.setdefault(kind, set()).add(m.id)
+                if kind == "list":
+                    keyfields = slot.setdefault("keyfields", {})
+                    keyfields.setdefault(node.key_field, set()).add(m.id)
+                prefix = prefix + (key,)
+        for slot in by_prefix.values():
+            kinds = slot.get("kinds", {})
+            if "object" in kinds and "list" in kinds:
+                for ids in kinds.values():
+                    conflicts.update(ids)
+            keyfields = slot.get("keyfields", {})
+            if len(keyfields) > 1:
+                for ids in keyfields.values():
+                    conflicts.update(ids)
+        self._conflicts = conflicts
+
+    # --- the apply loop (reference: Mutate system.go:146-246) ------------
+    def mutate(self, obj: dict, namespace: Optional[dict] = None,
+               source: str = "") -> bool:
+        """Fixed-point application; mutates ``obj`` in place, returns
+        changed?"""
+        active = [m for m in self.mutators() if m.id not in self._conflicts]
+        if not active:
+            return False
+        original = copy.deepcopy(obj)
+        max_iterations = len(active) + 1
+        any_change = False
+        for _ in range(max_iterations):
+            iteration_changed = False
+            for m in active:
+                if not m.matches(obj, namespace=namespace, source=source):
+                    continue
+                old = copy.deepcopy(obj)
+                if m.mutate_obj(obj) and not _deep_equal(old, obj):
+                    iteration_changed = True
+                    any_change = True
+            if not iteration_changed:
+                self._resolve_placeholders(obj)
+                return any_change
+        # restore: a non-converging system must not half-mutate (the
+        # reference returns the error without applying)
+        obj.clear()
+        obj.update(original)
+        raise NotConvergingError(
+            f"mutation system failed to converge after {max_iterations} "
+            "iterations"
+        )
+
+    def _resolve_placeholders(self, obj: Any) -> None:
+        """Resolve external-data placeholders at convergence
+        (reference: system.go:214 → system_external_data.go)."""
+        from gatekeeper_tpu.externaldata.placeholders import (
+            ExternalDataPlaceholder,
+        )
+
+        def walk(node):
+            if isinstance(node, dict):
+                for k, v in list(node.items()):
+                    if isinstance(v, ExternalDataPlaceholder):
+                        node[k] = self._resolve_one(v)
+                    else:
+                        walk(v)
+            elif isinstance(node, list):
+                for i, v in enumerate(node):
+                    if isinstance(v, ExternalDataPlaceholder):
+                        node[i] = self._resolve_one(v)
+                    else:
+                        walk(v)
+
+        walk(obj)
+
+    def _resolve_one(self, ph) -> Any:
+        if self.provider_cache is None:
+            # no providers configured: keep the original value semantics of
+            # failurePolicy
+            if ph.failure_policy == "UseDefault":
+                return ph.default
+            if ph.failure_policy == "Ignore":
+                return ph.original_value
+            raise RuntimeError(
+                f"external data provider {ph.provider!r} unavailable"
+            )
+        return self.provider_cache.resolve(ph)

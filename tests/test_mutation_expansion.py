@@ -1,0 +1,305 @@
+"""Mutation + expansion subsystem tests, golden-checked against the
+reference's gator-expand fixtures (test/gator/expand/fixtures)."""
+
+import glob
+import os
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.expansion.expander import Expander
+from gatekeeper_tpu.gator import reader
+from gatekeeper_tpu.mutation import path_parser
+from gatekeeper_tpu.mutation.core import MutateError
+from gatekeeper_tpu.mutation.mutators import (
+    MutatorError,
+    from_unstructured,
+    split_image,
+)
+from gatekeeper_tpu.mutation.path_parser import ListNode, ObjectNode
+from gatekeeper_tpu.mutation.system import MutationSystem, NotConvergingError
+
+FIXTURES = "/root/reference/test/gator/expand/fixtures"
+
+
+# --- path parser ----------------------------------------------------------
+
+
+def test_path_parser_basic():
+    nodes = path_parser.parse("spec.containers[name: foo].securityContext")
+    assert nodes == [
+        ObjectNode("spec"),
+        ObjectNode("containers"),
+        ListNode("name", "foo"),
+        ObjectNode("securityContext"),
+    ]
+
+
+def test_path_parser_glob_and_quotes():
+    nodes = path_parser.parse('metadata.labels."my.dotted/key"')
+    assert nodes[-1] == ObjectNode("my.dotted/key")
+    nodes = path_parser.parse("spec.containers[name:*].image")
+    assert nodes[2] == ListNode("name", None)
+    assert nodes[2].glob
+
+
+def test_path_parser_errors():
+    for bad in ("", "a..b", "a[name foo]", "a[name: x", 'a."unterminated'):
+        with pytest.raises(Exception):
+            path_parser.parse(bad)
+
+
+# --- mutators -------------------------------------------------------------
+
+
+def _assign(location, value, apply_kinds=("Pod",), extra_params=None,
+            match=None):
+    params = {"assign": {"value": value}}
+    if extra_params:
+        params.update(extra_params)
+    spec = {
+        "applyTo": [{"groups": [""], "versions": ["v1"],
+                     "kinds": list(apply_kinds)}],
+        "location": location,
+        "parameters": params,
+    }
+    if match is not None:
+        spec["match"] = match
+    return from_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign",
+        "metadata": {"name": "m"},
+        "spec": spec,
+    })
+
+
+def pod(**spec):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"}, "spec": spec}
+
+
+def test_assign_scalar_and_creation():
+    m = _assign("spec.priorityClassName", "low")
+    obj = pod()
+    assert m.mutate_obj(obj)
+    assert obj["spec"]["priorityClassName"] == "low"
+    assert not m.mutate_obj(obj)  # idempotent
+
+
+def test_assign_keyed_list_glob():
+    m = _assign("spec.containers[name: *].imagePullPolicy", "Always")
+    obj = pod(containers=[{"name": "a"}, {"name": "b"}])
+    assert m.mutate_obj(obj)
+    assert all(c["imagePullPolicy"] == "Always"
+               for c in obj["spec"]["containers"])
+
+
+def test_assign_keyed_list_creates_missing_item():
+    m = _assign("spec.tolerations[key: reserved]",
+                {"operator": "Exists", "effect": "NoSchedule"})
+    obj = pod()
+    assert m.mutate_obj(obj)
+    assert obj["spec"]["tolerations"] == [
+        {"key": "reserved", "operator": "Exists", "effect": "NoSchedule"}
+    ]
+
+
+def test_assign_key_invariance():
+    m = _assign("spec.containers[name: a]", {"name": "CHANGED"})
+    obj = pod(containers=[{"name": "a"}])
+    with pytest.raises(MutateError):
+        m.mutate_obj(obj)
+
+
+def test_assign_if_in_not_in():
+    m = _assign("spec.dnsPolicy", "ClusterFirst",
+                extra_params={"assignIf": {"in": ["Default", "None"]}})
+    obj = pod(dnsPolicy="Default")
+    assert m.mutate_obj(obj)
+    obj2 = pod(dnsPolicy="ClusterFirstWithHostNet")
+    assert not m.mutate_obj(obj2)
+    obj3 = pod()  # absent: 'in' requires a current value
+    assert not m.mutate_obj(obj3)
+    m2 = _assign("spec.dnsPolicy", "ClusterFirst",
+                 extra_params={"assignIf": {"notIn": ["ClusterFirst"]}})
+    obj4 = pod()
+    assert m2.mutate_obj(obj4)
+
+
+def test_assign_cannot_touch_metadata():
+    with pytest.raises(MutatorError):
+        _assign("metadata.labels.x", "y")
+
+
+def test_path_tests():
+    m = _assign(
+        "spec.securityContext.runAsNonRoot", True,
+        extra_params={"pathTests": [
+            {"subPath": "spec.securityContext", "condition": "MustExist"}
+        ]},
+    )
+    obj = pod()
+    assert not m.mutate_obj(obj)  # securityContext missing -> no-op
+    obj2 = pod(securityContext={})
+    assert m.mutate_obj(obj2)
+    assert obj2["spec"]["securityContext"]["runAsNonRoot"] is True
+
+
+def test_assign_metadata_never_overwrites():
+    m = from_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1beta1",
+        "kind": "AssignMetadata",
+        "metadata": {"name": "owner"},
+        "spec": {"location": "metadata.labels.owner",
+                 "parameters": {"assign": {"value": "admin"}}},
+    })
+    obj = pod()
+    assert m.mutate_obj(obj)
+    assert obj["metadata"]["labels"]["owner"] == "admin"
+    obj2 = pod()
+    obj2["metadata"]["labels"] = {"owner": "someone"}
+    assert not m.mutate_obj(obj2)
+    assert obj2["metadata"]["labels"]["owner"] == "someone"
+
+
+def test_modify_set_merge_prune():
+    base = {
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "ModifySet",
+        "metadata": {"name": "args"},
+        "spec": {
+            "applyTo": [{"groups": [""], "versions": ["v1"],
+                         "kinds": ["Pod"]}],
+            "location": "spec.containers[name: *].args",
+            "parameters": {"values": {"fromList": ["--verbose"]}},
+        },
+    }
+    m = from_unstructured(base)
+    obj = pod(containers=[{"name": "a", "args": ["--x"]}, {"name": "b"}])
+    assert m.mutate_obj(obj)
+    assert obj["spec"]["containers"][0]["args"] == ["--x", "--verbose"]
+    assert obj["spec"]["containers"][1]["args"] == ["--verbose"]
+    assert not m.mutate_obj(obj)
+    import copy
+
+    prune = copy.deepcopy(base)
+    prune["spec"]["parameters"]["operation"] = "prune"
+    mp = from_unstructured(prune)
+    assert mp.mutate_obj(obj)
+    assert obj["spec"]["containers"][0]["args"] == ["--x"]
+
+
+def test_split_image():
+    assert split_image("nginx") == ("", "nginx", "")
+    assert split_image("nginx:1.14") == ("", "nginx", ":1.14")
+    assert split_image("library/nginx") == ("", "library/nginx", "")
+    assert split_image("docker.io/library/nginx:v1") == (
+        "docker.io", "library/nginx", ":v1")
+    assert split_image("localhost:5000/img@sha256:abc") == (
+        "localhost:5000", "img", "@sha256:abc")
+
+
+def test_assign_image():
+    m = from_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+        "kind": "AssignImage",
+        "metadata": {"name": "img"},
+        "spec": {
+            "applyTo": [{"groups": [""], "versions": ["v1"],
+                         "kinds": ["Pod"]}],
+            "location": "spec.containers[name:*].image",
+            "parameters": {"assignDomain": "registry.corp", "assignTag": ":v2"},
+        },
+    })
+    obj = pod(containers=[{"name": "a", "image": "nginx:1.14"}])
+    assert m.mutate_obj(obj)
+    assert obj["spec"]["containers"][0]["image"] == "registry.corp/nginx:v2"
+
+
+# --- system ---------------------------------------------------------------
+
+
+def test_system_fixed_point_and_order():
+    s = MutationSystem()
+    s.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign", "metadata": {"name": "b-second"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.a", "parameters": {"assign": {"value": 1}}},
+    })
+    s.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign", "metadata": {"name": "a-first"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.b", "parameters": {"assign": {"value": 2}}},
+    })
+    obj = pod()
+    assert s.mutate(obj)
+    assert obj["spec"] == {"a": 1, "b": 2}
+
+
+def test_system_schema_conflict_disables_both():
+    s = MutationSystem()
+    s.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign", "metadata": {"name": "as-object"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.containers.x",
+                 "parameters": {"assign": {"value": 1}}},
+    })
+    s.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign", "metadata": {"name": "as-list"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.containers[name: a].x",
+                 "parameters": {"assign": {"value": 2}}},
+    })
+    assert len(s.conflicts()) == 2
+    obj = pod()
+    assert not s.mutate(obj)  # both disabled
+    s.remove(list(s.conflicts())[0])
+    # hmm: removal by id; conflicts recompute
+    assert len(s.conflicts()) == 0
+
+
+# --- expansion golden fixtures -------------------------------------------
+
+
+def _expand_fixture(name):
+    objs = reader.read_sources([os.path.join(FIXTURES, name, "input")])
+    expander = Expander(objs)
+    out = []
+    for obj in objs:
+        out.extend(expander.expand(obj))
+    return [r.obj for r in out]
+
+
+def _golden(name):
+    path = os.path.join(FIXTURES, name, "output", "output.yaml")
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+@pytest.mark.parametrize("name", [
+    "basic-expansion",
+    "basic-expansion-nonmatching-configs",
+    "expand-cr",
+    "expand-with-ns",
+])
+def test_expand_fixture_golden(name):
+    got = _expand_fixture(name)
+    want = _golden(name)
+    for doc in want:
+        assert doc in got, (
+            f"{name}: expected resultant missing.\nWANT: {doc}\nGOT: {got}"
+        )
+
+
+def test_expand_missing_ns_no_error():
+    # reference bats: exit 0, no output assertions (empty golden)
+    got = _expand_fixture("expand-with-missing-ns")
+    assert isinstance(got, list)
