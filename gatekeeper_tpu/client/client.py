@@ -215,6 +215,110 @@ class Client:
         responses.by_target[self.target.name] = response
         return responses
 
+    def review_batch(
+        self,
+        review_objs: Sequence[Any],
+        enforcement_point: str = "",
+        tracing: bool = False,
+        stats: bool = False,
+    ) -> list:
+        """Batched reviews (the webhook microbatch lane / audit-from-cache).
+
+        Returns one entry per input: a ``Responses`` on success or an
+        ``Exception`` for that input alone (a bad request must not poison the
+        rest of a coalesced webhook batch).  Constraint kinds owned by a
+        batch-capable driver evaluate in one ``query_batch`` pass; kinds
+        owned by other drivers fan out per-review exactly like ``review``.
+        """
+        batch_driver = next(
+            (d for d in self.drivers if hasattr(d, "query_batch")), None
+        )
+        if batch_driver is None:
+            out = []
+            for obj in review_objs:
+                try:
+                    out.append(self.review(obj, enforcement_point, tracing,
+                                           stats))
+                except Exception as e:
+                    out.append(e)
+            return out
+
+        entries: list = []  # per input: GkReview or Exception
+        for obj in review_objs:
+            try:
+                r = self.target.handle_review(obj)
+                if r is None:
+                    raise ClientError(
+                        f"unrecognized review type {type(obj)}"
+                    )
+                entries.append(r)
+            except Exception as e:
+                entries.append(e)
+
+        active = [
+            c for c in sorted(self.constraints(), key=Constraint.key)
+            if (c.actions_for(enforcement_point) if enforcement_point
+                else [c.enforcement_action])
+        ]
+        batch_cons = [
+            c for c in active
+            if self._template_driver.get(c.kind) is batch_driver
+        ]
+        other_cons = [
+            c for c in active
+            if self._template_driver.get(c.kind) is not batch_driver
+        ]
+        cfg = ReviewCfg(enforcement_point=enforcement_point, tracing=tracing,
+                        stats=stats)
+
+        valid_idx = [i for i, e in enumerate(entries)
+                     if not isinstance(e, Exception)]
+        reviews = [entries[i] for i in valid_idx]
+        q_responses = batch_driver.query_batch(
+            self.target.name, batch_cons, reviews, cfg
+        ) if batch_cons else [QueryResponse() for _ in reviews]
+
+        out: list = [None] * len(entries)
+        for slot, (i, qr) in enumerate(zip(valid_idx, q_responses)):
+            responses = Responses()
+            response = Response(target=self.target.name)
+            for result in qr.results:
+                constraint = self._constraint_for_result(result)
+                if constraint is not None:
+                    self._resolve_actions(result, constraint,
+                                          enforcement_point)
+                response.results.append(result)
+            responses.stats_entries.extend(qr.stats_entries)
+            if qr.trace:
+                response.trace = qr.trace
+            # kinds owned by non-batch drivers: per-review query, matching
+            # review()'s per-driver fan-out
+            review = reviews[slot]
+            try:
+                for con in other_cons:
+                    if not self.target.to_matcher(con.match).match(review):
+                        continue
+                    driver = self._template_driver[con.kind]
+                    oqr = driver.query(self.target.name, [con], review, cfg)
+                    for result in oqr.results:
+                        self._resolve_actions(result, con, enforcement_point)
+                        response.results.append(result)
+                    responses.stats_entries.extend(oqr.stats_entries)
+                    if oqr.trace:
+                        response.trace = (
+                            (response.trace + "\n" + oqr.trace)
+                            if response.trace else oqr.trace
+                        )
+            except Exception as e:
+                out[i] = e
+                continue
+            responses.by_target[self.target.name] = response
+            out[i] = responses
+        for i, e in enumerate(entries):
+            if isinstance(e, Exception):
+                out[i] = e
+        return out
+
     def _constraint_for_result(self, result) -> Optional[Constraint]:
         c = result.constraint or {}
         kind = c.get("kind", "")

@@ -187,6 +187,11 @@ class TpuDriver:
                             target, [con], reviews[oi], cfg
                         )
                         responses[oi].results.extend(qr.results)
+                        if qr.trace:
+                            responses[oi].trace = (
+                                (responses[oi].trace + "\n" + qr.trace)
+                                if responses[oi].trace else qr.trace
+                            )
                     else:
                         responses[oi].results.append(
                             Result(target=target, msg="", constraint=con.raw)

@@ -1,0 +1,57 @@
+"""Namespace-label webhook (reference: pkg/webhook/namespacelabel.go).
+
+Blocks unprivileged requests from self-exempting namespaces with the
+``admission.gatekeeper.sh/ignore`` label; service accounts on the exemption
+list may (namespacelabel.go:21-41).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from gatekeeper_tpu.webhook.policy import parse_admission_review
+
+IGNORE_LABEL = "admission.gatekeeper.sh/ignore"
+
+
+@dataclass
+class LabelResponse:
+    allowed: bool
+    message: str = ""
+    code: int = 200
+    uid: str = ""
+
+
+class NamespaceLabelHandler:
+    def __init__(self, exempt_users: Iterable[str] = (),
+                 exempt_prefixes: Iterable[str] = (),
+                 exempt_suffixes: Iterable[str] = ()):
+        self.exempt_users = set(exempt_users)
+        self.exempt_prefixes = tuple(exempt_prefixes)
+        self.exempt_suffixes = tuple(exempt_suffixes)
+
+    def handle(self, review_body: dict) -> LabelResponse:
+        req = parse_admission_review(review_body)
+        if req.operation == "DELETE":
+            return LabelResponse(allowed=True, uid=req.uid)
+        username = (req.user_info or {}).get("username", "")
+        if (
+            username in self.exempt_users
+            or any(username.startswith(p) for p in self.exempt_prefixes)
+            or any(username.endswith(s) for s in self.exempt_suffixes)
+        ):
+            return LabelResponse(allowed=True, uid=req.uid)
+        obj = req.object or {}
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        if IGNORE_LABEL in labels:
+            return LabelResponse(
+                allowed=False,
+                code=403,
+                message=(
+                    f"only exempt users can add the {IGNORE_LABEL} label to "
+                    "a namespace"
+                ),
+                uid=req.uid,
+            )
+        return LabelResponse(allowed=True, uid=req.uid)

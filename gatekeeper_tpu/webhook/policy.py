@@ -1,0 +1,309 @@
+"""Validating admission handler (reference: pkg/webhook/policy.go).
+
+Flow (§3.1 of SURVEY.md):
+- self-management bypass for the gatekeeper service account (policy.go:142)
+- gatekeeper-resource meta-validation fast path (templates/constraints/
+  expansion templates/mutators validated structurally, policy.go:359-401)
+- namespace exclusion via the process excluder (policy.go:170)
+- review of the request (+ expansion resultants, policy.go:602-646)
+- deny/warn partition by enforcement action incl. scoped (policy.go:256-353)
+
+TPU twist: instead of the reference's goroutine-per-request capped by a
+semaphore (policy.go:116-120), requests funnel into a **microbatching lane**
+(Batcher) that coalesces concurrent admissions into one ``review_batch``
+call on the device; latency is bounded by the batch window.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from gatekeeper_tpu.apis.constraints import (
+    CONSTRAINTS_GROUP,
+    Constraint,
+    ConstraintError,
+    WEBHOOK_EP,
+)
+from gatekeeper_tpu.apis.templates import ConstraintTemplate, TemplateError
+from gatekeeper_tpu.expansion.system import EXPANSION_GROUP, ExpansionTemplate
+from gatekeeper_tpu.match.match import SOURCE_GENERATED, SOURCE_ORIGINAL
+from gatekeeper_tpu.mutation.mutators import (
+    MUTATIONS_GROUP,
+    MUTATOR_KINDS,
+    MutatorError,
+    from_unstructured as mutator_from_unstructured,
+)
+from gatekeeper_tpu.expansion.system import ExpansionError
+from gatekeeper_tpu.target.review import AdmissionRequest, AugmentedReview
+from gatekeeper_tpu.utils.unstructured import gvk_of
+
+GATEKEEPER_SA_PREFIX = "system:serviceaccount:gatekeeper-system:"
+TEMPLATES_GROUP = "templates.gatekeeper.sh"
+
+
+@dataclass
+class ValidationResponse:
+    allowed: bool
+    message: str = ""
+    code: int = 200
+    warnings: list = field(default_factory=list)
+    uid: str = ""
+
+
+def parse_admission_review(body: dict) -> AdmissionRequest:
+    req = body.get("request") or {}
+    return AdmissionRequest(
+        uid=req.get("uid", "") or "",
+        kind=req.get("kind") or {},
+        resource=req.get("resource") or {},
+        sub_resource=req.get("subResource", "") or "",
+        name=req.get("name", "") or "",
+        namespace=req.get("namespace", "") or "",
+        operation=req.get("operation", "") or "",
+        user_info=req.get("userInfo") or {},
+        object=req.get("object"),
+        old_object=req.get("oldObject"),
+        dry_run=bool(req.get("dryRun", False)),
+        options=req.get("options"),
+    )
+
+
+class ValidationHandler:
+    def __init__(
+        self,
+        client,
+        expansion_system=None,
+        process_excluder=None,
+        namespace_lookup=None,  # name -> Namespace object
+        batcher: Optional["Batcher"] = None,
+        log_denies: bool = False,
+        event_sink=None,
+    ):
+        self.client = client
+        self.expansion_system = expansion_system
+        self.process_excluder = process_excluder
+        self.namespace_lookup = namespace_lookup or (lambda name: None)
+        self.batcher = batcher
+        self.log_denies = log_denies
+        self.event_sink = event_sink
+
+    # --- the handler (reference: validationHandler.Handle, policy.go:139) -
+    def handle(self, review_body: dict) -> ValidationResponse:
+        req = parse_admission_review(review_body)
+        username = (req.user_info or {}).get("username", "")
+
+        # self-management bypass (policy.go:142)
+        if username.startswith(GATEKEEPER_SA_PREFIX):
+            return ValidationResponse(allowed=True, uid=req.uid)
+
+        # gatekeeper resource meta-validation fast path (policy.go:359-401)
+        group, _, _ = gvk_of(req.object or {})
+        if group in (TEMPLATES_GROUP, CONSTRAINTS_GROUP, EXPANSION_GROUP,
+                     MUTATIONS_GROUP):
+            return self._validate_gatekeeper_resource(req)
+
+        # namespace exclusion (policy.go:170)
+        if self.process_excluder is not None and req.namespace:
+            if self.process_excluder.is_excluded("webhook", req.namespace):
+                return ValidationResponse(allowed=True, uid=req.uid)
+
+        # review (+ expansion)
+        ns_obj = self.namespace_lookup(req.namespace) if req.namespace else None
+        augmented = AugmentedReview(
+            admission_request=req, namespace=ns_obj,
+            source=SOURCE_ORIGINAL, is_admission=True,
+        )
+        try:
+            responses = self._review(augmented)
+        except Exception as e:
+            # review errors fail open with a warning (webhook failurePolicy
+            # ignore, policy.go:83 marker); real deploys choose fail-closed
+            return ValidationResponse(
+                allowed=True, uid=req.uid,
+                warnings=[f"review failed: {e}"],
+            )
+
+        expansion_warnings: list = []
+        if self.expansion_system is not None and req.object:
+            from gatekeeper_tpu.expansion import aggregate
+            from gatekeeper_tpu.target.review import AugmentedUnstructured
+
+            try:
+                resultants = self.expansion_system.expand(
+                    dict(req.object), namespace=ns_obj,
+                    username=username, source=SOURCE_ORIGINAL,
+                )
+            except ExpansionError as e:
+                # the reference errors the request, which fails open under
+                # failurePolicy=ignore (policy.go:626-631) — surface a warning
+                resultants = []
+                expansion_warnings.append(f"expansion failed: {e}")
+            for r in resultants:
+                r_aug = AugmentedUnstructured(
+                    object=r.obj, namespace=ns_obj, source=SOURCE_GENERATED
+                )
+                r_resp = self.client.review(
+                    r_aug, enforcement_point=WEBHOOK_EP
+                )
+                aggregate.override_enforcement_action(
+                    r.enforcement_action, r_resp
+                )
+                aggregate.aggregate_responses(r.template_name, responses,
+                                              r_resp)
+
+        denies, warns = self._partition(responses)
+        warns = warns + expansion_warnings
+        if denies:
+            msg = "\n".join(denies)
+            resp = ValidationResponse(
+                allowed=False, message=msg, code=403, warnings=warns,
+                uid=req.uid,
+            )
+        else:
+            resp = ValidationResponse(allowed=True, warnings=warns,
+                                      uid=req.uid)
+        if self.event_sink is not None and (denies or warns):
+            self.event_sink(req, denies, warns)
+        return resp
+
+    def _review(self, augmented):
+        if self.batcher is not None:
+            return self.batcher.review(augmented)
+        return self.client.review(augmented, enforcement_point=WEBHOOK_EP)
+
+    # --- deny/warn partition (reference: getValidationMessages,
+    # policy.go:205-355) --------------------------------------------------
+    @staticmethod
+    def _partition(responses) -> tuple[list, list]:
+        denies, warns = [], []
+        for result in responses.results():
+            actions = []
+            if result.enforcement_action == "scoped":
+                actions = result.scoped_enforcement_actions
+            else:
+                actions = [result.enforcement_action]
+            for action in actions:
+                if action == "deny":
+                    denies.append(
+                        f"[{_constraint_label(result)}] {result.msg}"
+                    )
+                elif action == "warn":
+                    warns.append(
+                        f"[{_constraint_label(result)}] {result.msg}"
+                    )
+                # dryrun: recorded in logs/metrics only
+        return denies, warns
+
+    # --- gatekeeper resource validation (policy.go:403-580) --------------
+    def _validate_gatekeeper_resource(self, req) -> ValidationResponse:
+        obj = req.object or {}
+        group, _, kind = gvk_of(obj)
+        if req.operation == "DELETE":
+            return ValidationResponse(allowed=True, uid=req.uid)
+        try:
+            if group == TEMPLATES_GROUP and kind == "ConstraintTemplate":
+                self.client.create_crd(obj)  # dry-run compile (policy.go:430)
+                # also ensure the engine can compile the source
+                t = ConstraintTemplate.from_unstructured(obj)
+                for driver in self.client.drivers:
+                    if driver.has_source_for(t):
+                        break
+                else:
+                    raise TemplateError(
+                        f"template {t.name}: no driver understands its source"
+                    )
+            elif group == CONSTRAINTS_GROUP:
+                self.client.validate_constraint(obj)
+            elif group == EXPANSION_GROUP and kind == "ExpansionTemplate":
+                ExpansionTemplate.from_unstructured(obj)
+            elif group == MUTATIONS_GROUP and kind in MUTATOR_KINDS:
+                mutator_from_unstructured(obj)
+        except (TemplateError, ConstraintError, MutatorError,
+                ExpansionError, Exception) as e:
+            return ValidationResponse(
+                allowed=False, message=str(e), code=422, uid=req.uid
+            )
+        return ValidationResponse(allowed=True, uid=req.uid)
+
+
+def _constraint_label(result) -> str:
+    c = result.constraint or {}
+    kind = c.get("kind", "")
+    name = (c.get("metadata") or {}).get("name", "")
+    return f"{kind}] [{name}"
+
+
+class Batcher:
+    """Microbatching lane: coalesce concurrent reviews into one device pass.
+
+    The reference bounds concurrency with a semaphore
+    (--max-serving-threads, policy.go:116-120); on TPU the equivalent
+    resource is the batch axis — requests wait at most ``window_s`` to share
+    a verdict-grid launch (dual-queue design of SURVEY.md §7: the webhook is
+    the small-batch low-latency lane, audit the big-batch lane).
+    """
+
+    def __init__(self, client, window_s: float = 0.003, max_batch: int = 64):
+        self.client = client
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._queue: queue.Queue = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def review(self, augmented):
+        done = threading.Event()
+        slot: dict = {}
+        self._queue.put((augmented, done, slot))
+        done.wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["responses"]
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=timeout))
+                except queue.Empty:
+                    break
+            reviews = [b[0] for b in batch]
+            try:
+                all_responses = self.client.review_batch(
+                    reviews, enforcement_point=WEBHOOK_EP
+                )
+                for (_, done, slot), responses in zip(batch, all_responses):
+                    # per-slot isolation: one bad request must not poison the
+                    # coalesced batch (review_batch returns Exception entries)
+                    if isinstance(responses, Exception):
+                        slot["error"] = responses
+                    else:
+                        slot["responses"] = responses
+                    done.set()
+            except Exception as e:
+                for _, done, slot in batch:
+                    slot["error"] = e
+                    done.set()

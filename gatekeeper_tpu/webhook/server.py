@@ -1,0 +1,163 @@
+"""Admission webhook HTTP server.
+
+Reference: controller-runtime webhook server hosting /v1/admit (policy.go),
+/v1/mutate (mutation.go), /v1/admitlabel (namespacelabel.go) with TLS
+(main.go:244-275, cert rotation via cert-controller).  Here: a threaded
+stdlib HTTP server speaking the AdmissionReview v1 protocol; TLS is optional
+(certfile/keyfile) since test harnesses terminate TLS separately.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+ADMIT_PATH = "/v1/admit"
+MUTATE_PATH = "/v1/mutate"
+ADMIT_LABEL_PATH = "/v1/admitlabel"
+HEALTH_PATH = "/healthz"
+
+
+def admission_response(uid: str, allowed: bool, message: str = "",
+                       code: int = 200, warnings=None, patch=None) -> dict:
+    resp: dict = {"uid": uid, "allowed": allowed}
+    if message or code != 200:
+        resp["status"] = {"code": code if not allowed else 200,
+                          "message": message}
+    if warnings:
+        resp["warnings"] = list(warnings)
+    if patch is not None:
+        resp["patchType"] = "JSONPatch"
+        resp["patch"] = base64.b64encode(
+            json.dumps(patch).encode()
+        ).decode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
+
+
+class WebhookServer:
+    def __init__(
+        self,
+        validation_handler=None,
+        mutation_handler=None,
+        namespace_label_handler=None,
+        host: str = "127.0.0.1",
+        port: int = 8443,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+        readiness_check=None,  # callable -> bool
+    ):
+        self.validation_handler = validation_handler
+        self.mutation_handler = mutation_handler
+        self.namespace_label_handler = namespace_label_handler
+        self.readiness_check = readiness_check
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == HEALTH_PATH:
+                    ready = (outer.readiness_check is None
+                             or outer.readiness_check())
+                    self._reply(200 if ready else 503,
+                                {"ready": bool(ready)})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    body = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    self._reply(400, {"error": "invalid JSON body"})
+                    return
+                uid = ((body.get("request") or {}).get("uid", "")) or ""
+                try:
+                    if self.path == ADMIT_PATH:
+                        self._admit(body, uid)
+                    elif self.path == MUTATE_PATH:
+                        self._mutate(body, uid)
+                    elif self.path == ADMIT_LABEL_PATH:
+                        self._admit_label(body, uid)
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except Exception as e:  # handler bug: fail open like the
+                    # reference's Errored response + failurePolicy
+                    self._reply(200, admission_response(
+                        uid, True, warnings=[f"webhook error: {e}"]
+                    ))
+
+            def _admit(self, body, uid):
+                h = outer.validation_handler
+                if h is None:
+                    self._reply(200, admission_response(uid, True))
+                    return
+                v = h.handle(body)
+                self._reply(200, admission_response(
+                    v.uid or uid, v.allowed, v.message, v.code, v.warnings
+                ))
+
+            def _mutate(self, body, uid):
+                h = outer.mutation_handler
+                if h is None:
+                    self._reply(200, admission_response(uid, True))
+                    return
+                m = h.handle(body)
+                self._reply(200, admission_response(
+                    m.uid or uid, m.allowed, m.message, patch=m.patch
+                ))
+
+            def _admit_label(self, body, uid):
+                h = outer.namespace_label_handler
+                if h is None:
+                    self._reply(200, admission_response(uid, True))
+                    return
+                r = h.handle(body)
+                self._reply(200, admission_response(
+                    r.uid or uid, r.allowed, r.message, r.code
+                ))
+
+            def _reply(self, status: int, payload: dict):
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "WebhookServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -1,0 +1,244 @@
+"""Webhook plane: real HTTP AdmissionReview round-trips.
+
+Reference behaviors exercised: deny/warn partition incl. scoped + dryrun
+(policy.go:205-355), gatekeeper-resource validation fast path, gk service
+account bypass, namespace exclusion, mutation JSON patch, namespace-label
+guard, the microbatch lane.
+"""
+
+import base64
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.mutation.system import MutationSystem
+from gatekeeper_tpu.sync.process import ProcessExcluder
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+from gatekeeper_tpu.webhook.mutation import MutationHandler, json_patch
+from gatekeeper_tpu.webhook.namespacelabel import NamespaceLabelHandler
+from gatekeeper_tpu.webhook.policy import Batcher, ValidationHandler
+from gatekeeper_tpu.webhook.server import WebhookServer
+
+DEMO = "/root/reference/demo/basic"
+
+
+def make_client():
+    client = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                    enforcement_points=["validation.gatekeeper.sh"])
+    client.add_template(load_yaml_file(
+        f"{DEMO}/templates/k8srequiredlabels_template.yaml")[0])
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "ns-must-have-gk"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Namespace"]}]},
+                 "parameters": {"labels": ["gatekeeper"]}},
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "warn-owner"},
+        "spec": {"enforcementAction": "warn",
+                 "match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Namespace"]}]},
+                 "parameters": {"labels": ["owner"]}},
+    })
+    return client
+
+
+def admission_review(obj, operation="CREATE", username="alice", uid="u1",
+                     namespace=""):
+    from gatekeeper_tpu.utils.unstructured import gvk_of
+
+    group, version, kind = gvk_of(obj)
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "kind": {"group": group, "version": version, "kind": kind},
+            "name": (obj.get("metadata") or {}).get("name", ""),
+            "namespace": namespace,
+            "operation": operation,
+            "userInfo": {"username": username},
+            "object": obj,
+        },
+    }
+
+
+def post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def server():
+    client = make_client()
+    excluder = ProcessExcluder()
+    excluder.add(["webhook"], ["kube-*"])
+    handler = ValidationHandler(client, process_excluder=excluder)
+    mut_system = MutationSystem()
+    mut_system.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign", "metadata": {"name": "pull-policy"},
+        "spec": {
+            "applyTo": [{"groups": [""], "versions": ["v1"],
+                         "kinds": ["Pod"]}],
+            "location": "spec.containers[name: *].imagePullPolicy",
+            "parameters": {"assign": {"value": "Always"}},
+        },
+    })
+    srv = WebhookServer(
+        validation_handler=handler,
+        mutation_handler=MutationHandler(mut_system),
+        namespace_label_handler=NamespaceLabelHandler(
+            exempt_users=["system:serviceaccount:kube-system:admin"]),
+        port=0,
+        readiness_check=lambda: True,
+    ).start()
+    yield srv
+    srv.stop()
+
+
+def ns(name, labels=None):
+    meta = {"name": name}
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": meta}
+
+
+def test_deny_and_warn_partition(server):
+    out = post(server.port, "/v1/admit", admission_review(ns("bad")))
+    r = out["response"]
+    assert r["allowed"] is False
+    assert r["status"]["code"] == 403
+    assert 'you must provide labels: {"gatekeeper"}' in r["status"]["message"]
+    assert any("owner" in w for w in r.get("warnings", []))
+    assert r["uid"] == "u1"
+
+
+def test_allow_with_warning_only(server):
+    out = post(server.port, "/v1/admit",
+               admission_review(ns("ok", {"gatekeeper": "x"})))
+    r = out["response"]
+    assert r["allowed"] is True
+    assert any("owner" in w for w in r.get("warnings", []))
+
+
+def test_gk_service_account_bypass(server):
+    out = post(server.port, "/v1/admit", admission_review(
+        ns("bad"), username="system:serviceaccount:gatekeeper-system:gk"))
+    assert out["response"]["allowed"] is True
+
+
+def test_namespace_exclusion(server):
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "kube-system"}}
+    out = post(server.port, "/v1/admit",
+               admission_review(pod, namespace="kube-system"))
+    assert out["response"]["allowed"] is True
+
+
+def test_template_validation_fast_path(server):
+    bad_template = load_yaml_file(f"{DEMO}/bad/bad_template.yaml")[0]
+    out = post(server.port, "/v1/admit", admission_review(bad_template))
+    r = out["response"]
+    assert r["allowed"] is False
+    assert "lowercase" in r["status"]["message"]
+    good = load_yaml_file(f"{DEMO}/templates/k8srequiredlabels_template.yaml")
+    out = post(server.port, "/v1/admit", admission_review(good[0]))
+    assert out["response"]["allowed"] is True
+
+
+def test_constraint_validation_fast_path(server):
+    bad = {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+           "kind": "K8sRequiredLabels",
+           "metadata": {"name": "x"},
+           "spec": {"enforcementAction": "maybe"}}
+    out = post(server.port, "/v1/admit", admission_review(bad))
+    assert out["response"]["allowed"] is False
+
+
+def test_mutation_patch(server):
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "default"},
+           "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+    out = post(server.port, "/v1/mutate", admission_review(pod))
+    r = out["response"]
+    assert r["allowed"] is True
+    assert r["patchType"] == "JSONPatch"
+    patch = json.loads(base64.b64decode(r["patch"]))
+    assert {"op": "add",
+            "path": "/spec/containers/0/imagePullPolicy",
+            "value": "Always"} in patch or any(
+        p["op"] == "replace" and "containers" in p["path"] for p in patch)
+
+
+def test_mutate_delete_passthrough(server):
+    pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p"}}
+    body = admission_review(pod, operation="DELETE")
+    body["request"]["oldObject"] = pod
+    out = post(server.port, "/v1/mutate", body)
+    assert out["response"]["allowed"] is True
+    assert "patch" not in out["response"]
+
+
+def test_namespace_label_guard(server):
+    labeled = ns("sneaky", {"admission.gatekeeper.sh/ignore": "true"})
+    out = post(server.port, "/v1/admitlabel", admission_review(labeled))
+    assert out["response"]["allowed"] is False
+    out = post(server.port, "/v1/admitlabel", admission_review(
+        labeled, username="system:serviceaccount:kube-system:admin"))
+    assert out["response"]["allowed"] is True
+    out = post(server.port, "/v1/admitlabel", admission_review(ns("plain")))
+    assert out["response"]["allowed"] is True
+
+
+def test_health_endpoint(server):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/healthz"
+    ) as resp:
+        assert json.loads(resp.read())["ready"] is True
+
+
+def test_json_patch_generator():
+    before = {"a": 1, "b": {"c": [1, 2]}, "d": "x"}
+    after = {"a": 1, "b": {"c": [1, 2, 3]}, "e": True}
+    ops = json_patch(before, after)
+    assert {"op": "remove", "path": "/d"} in ops
+    assert {"op": "add", "path": "/e", "value": True} in ops
+    assert {"op": "replace", "path": "/b/c", "value": [1, 2, 3]} in ops
+
+
+def test_batcher_coalesces_requests():
+    client = make_client()
+    batcher = Batcher(client, window_s=0.02, max_batch=16).start()
+    try:
+        handler = ValidationHandler(client, batcher=batcher)
+        results = {}
+
+        def one(i):
+            body = admission_review(ns(f"n{i}"), uid=f"u{i}")
+            results[i] = handler.handle(body)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 8
+        assert all(not r.allowed for r in results.values())
+        assert all("gatekeeper" in r.message for r in results.values())
+    finally:
+        batcher.stop()
