@@ -775,3 +775,52 @@ def _object_subset(sup, sub):
         return freeze(a) == freeze(b)
 
     return subset(sup, sub)
+
+
+def _str_coll(v):
+    if isinstance(v, str):
+        return [v]
+    if isinstance(v, (list, tuple, RegoSet)):
+        items = list(v)
+        if all(isinstance(x, str) for x in items):
+            return items
+    return None
+
+
+@builtin("strings.any_prefix_match")
+def _any_prefix_match(search, base):
+    searches, bases = _str_coll(search), _str_coll(base)
+    if searches is None or bases is None:
+        return UNDEFINED
+    return any(s.startswith(b) for s in searches for b in bases)
+
+
+@builtin("strings.any_suffix_match")
+def _any_suffix_match(search, base):
+    searches, bases = _str_coll(search), _str_coll(base)
+    if searches is None or bases is None:
+        return UNDEFINED
+    return any(s.endswith(b) for s in searches for b in bases)
+
+
+@builtin("strings.replace_n")
+def _replace_n(patterns, s):
+    # single pass like Go's strings.NewReplacer (OPA semantics): earlier
+    # replacements are never re-replaced by later patterns
+    if not isinstance(patterns, dict) or not isinstance(s, str):
+        return UNDEFINED
+    pairs = list(patterns.items())
+    if not all(isinstance(o, str) and isinstance(n, str) for o, n in pairs):
+        return UNDEFINED
+    out = []
+    i = 0
+    while i < len(s):
+        for old, new in pairs:
+            if old and s.startswith(old, i):
+                out.append(new)
+                i += len(old)
+                break
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)

@@ -1,0 +1,286 @@
+"""CEL engine + driver tests (reference fixtures: the bats CEL template and
+gator bench cel fixtures)."""
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.apis.constraints import Constraint
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELCompileError, CELDriver
+from gatekeeper_tpu.drivers.rego_driver import RegoDriver
+from gatekeeper_tpu.lang.cel.cel import CelError, Program
+from gatekeeper_tpu.target.review import AugmentedUnstructured
+from gatekeeper_tpu.target.target import K8sValidationTarget
+
+
+def ev(src, **bindings):
+    return Program(src).eval(bindings)
+
+
+def test_cel_basics():
+    assert ev("1 + 2 * 3") == 7
+    assert ev("(1 + 2) * 3") == 9
+    assert ev('"a" + "b"') == "ab"
+    assert ev("[1, 2] + [3]") == [1, 2, 3]
+    assert ev("7 / 2") == 3  # int division truncates
+    assert ev("-7 / 2") == -3  # toward zero
+    assert ev("-7 % 2") == -1
+    assert ev("7.0 / 2") == 3.5
+    assert ev("true ? 1 : 2") == 1
+    assert ev('size("abc")') == 3
+    assert ev('"abc".contains("b")')
+    assert ev('"v1.2".matches("^v[0-9]+")')
+    assert ev('string(42)') == "42"
+    assert ev('int("42")') == 42
+    assert ev('type(1)') == "int"
+
+
+def test_cel_collections():
+    assert ev("[1,2,3].all(x, x > 0)")
+    assert not ev("[1,-2,3].all(x, x > 0)")
+    assert ev("[1,2,3].exists(x, x == 2)")
+    assert ev("[1,2,3].exists_one(x, x > 2)")
+    assert ev("[1,2,3].filter(x, x > 1)") == [2, 3]
+    assert ev("[1,2,3].map(x, x * 2)") == [2, 4, 6]
+    assert ev('{"a": 1, "b": 2}.all(k, k != "c")')
+    assert ev('"b" in {"a": 1, "b": 2}')
+    assert ev("2 in [1, 2]")
+    assert ev('{"a": 1}["a"]') == 1
+
+
+def test_cel_has_and_errors():
+    obj = {"spec": {"x": 1}}
+    assert ev("has(object.spec)", object=obj)
+    assert not ev("has(object.status)", object=obj)
+    assert not ev("has(object.status.phase)", object=obj)
+    with pytest.raises(CelError):
+        ev("object.status.phase", object=obj)
+    # || absorbs an error when the other side decides
+    assert ev("true || object.a.b", object={})
+    assert ev("object.a.b || true", object={})
+    with pytest.raises(CelError):
+        ev("false || object.a.b", object={})
+    # && likewise
+    assert ev("false && object.a.b", object={}) is False
+    # macro error absorption: exists decided by another element
+    assert ev("[{}, {'privileged': true}].exists(c, c.privileged)")
+
+
+def test_cel_equality_semantics():
+    assert ev("1 == 1.0")
+    assert not ev("1 == true")
+    assert not ev('1 == "1"')
+    assert ev("null == null")
+    assert ev("[1, [2]] == [1, [2]]")
+
+
+CEL_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8scelrequiredlabels"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "K8sCelRequiredLabels"},
+                         "validation": {"openAPIV3Schema": {
+                             "type": "object"}}}},
+        "targets": [{
+            "target": "admission.k8s.gatekeeper.sh",
+            "code": [{
+                "engine": "K8sNativeValidation",
+                "source": {
+                    "variables": [
+                        {"name": "missing",
+                         "expression": (
+                             "variables.params.labels.filter(l, "
+                             "!has(object.metadata.labels) || "
+                             "!(l in object.metadata.labels))"
+                         )},
+                    ],
+                    "validations": [{
+                        "expression": "size(variables.missing) == 0",
+                        "messageExpression": (
+                            '"missing required labels: " + '
+                            'variables.missing.join(", ")'
+                        ),
+                    }],
+                },
+            }],
+        }],
+    },
+}
+
+
+def make_client():
+    # driver priority: CEL first so CEL-sourced templates land there,
+    # mirroring gator's WithK8sCEL registration
+    return Client(
+        target=K8sValidationTarget(),
+        drivers=[RegoDriver(), CELDriver()],
+        enforcement_points=["gator.gatekeeper.sh"],
+    )
+
+
+def test_cel_driver_end_to_end():
+    client = make_client()
+    client.add_template(CEL_TEMPLATE)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sCelRequiredLabels",
+        "metadata": {"name": "need-owner-team"},
+        "spec": {"parameters": {"labels": ["owner", "team"]}},
+    })
+    bad = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "labels": {"owner": "x"}}}
+    resp = client.review(AugmentedUnstructured(object=bad),
+                         enforcement_point="gator.gatekeeper.sh")
+    results = resp.results()
+    assert len(results) == 1
+    assert results[0].msg == "missing required labels: team"
+    good = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "labels": {"owner": "x", "team": "y"}}}
+    resp = client.review(AugmentedUnstructured(object=good),
+                         enforcement_point="gator.gatekeeper.sh")
+    assert resp.results() == []
+
+
+def test_cel_reference_bats_template():
+    """The reference's namespaceObject CEL template, evaluated verbatim."""
+    t = yaml.safe_load(open(
+        "/root/reference/test/bats/tests/templates/"
+        "k8snamespacelabelcheck_template_cel.yaml"))
+    client = make_client()
+    client.add_template(t)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": t["spec"]["crd"]["spec"]["names"]["kind"],
+        "metadata": {"name": "ns-check"},
+        "spec": {"parameters": {"requiredLabel": "team"}},
+    })
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "ns1"}}
+    ns_with = {"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": "ns1", "labels": {"team": "a"}}}
+    ns_without = {"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "ns1"}}
+    ok = client.review(
+        AugmentedUnstructured(object=pod, namespace=ns_with),
+        enforcement_point="gator.gatekeeper.sh")
+    assert ok.results() == []
+    bad = client.review(
+        AugmentedUnstructured(object=pod, namespace=ns_without),
+        enforcement_point="gator.gatekeeper.sh")
+    assert len(bad.results()) == 1
+    assert "does not have required label: team" in bad.results()[0].msg
+
+
+def test_cel_match_conditions_and_failure_policy():
+    template = {
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8scelmc"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sCelMc"}}},
+            "targets": [{
+                "target": "admission.k8s.gatekeeper.sh",
+                "code": [{"engine": "K8sNativeValidation", "source": {
+                    "matchCondition": [
+                        {"name": "only-pods",
+                         "expression": 'request.kind.kind == "Pod"'},
+                    ],
+                    "validations": [
+                        {"expression": "false", "message": "always denied"},
+                    ],
+                }}],
+            }],
+        },
+    }
+    client = make_client()
+    client.add_template(template)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sCelMc", "metadata": {"name": "mc"}, "spec": {},
+    })
+    pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p"}}
+    svc = {"apiVersion": "v1", "kind": "Service", "metadata": {"name": "s"}}
+    assert len(client.review(
+        AugmentedUnstructured(object=pod),
+        enforcement_point="gator.gatekeeper.sh").results()) == 1
+    assert client.review(
+        AugmentedUnstructured(object=svc),
+        enforcement_point="gator.gatekeeper.sh").results() == []
+
+
+def test_cel_delete_normalization():
+    """driver.go:184-186: object is null on DELETE for CEL."""
+    template = {
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sceldel"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sCelDel"}}},
+            "targets": [{
+                "target": "admission.k8s.gatekeeper.sh",
+                "code": [{"engine": "K8sNativeValidation", "source": {
+                    "validations": [
+                        {"expression": "object != null",
+                         "message": "object is null on delete"},
+                    ],
+                }}],
+            }],
+        },
+    }
+    client = make_client()
+    client.add_template(template)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sCelDel", "metadata": {"name": "d"}, "spec": {},
+    })
+    from gatekeeper_tpu.target.review import AdmissionRequest
+
+    req = AdmissionRequest(
+        kind={"group": "", "version": "v1", "kind": "Pod"},
+        operation="DELETE",
+        old_object={"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p"}},
+    )
+    resp = client.review(req, enforcement_point="gator.gatekeeper.sh")
+    assert len(resp.results()) == 1
+    assert resp.results()[0].msg == "object is null on delete"
+
+
+def test_reserved_prefix_rejected():
+    t = {
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8scelbad"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sCelBad"}}},
+            "targets": [{
+                "target": "admission.k8s.gatekeeper.sh",
+                "code": [{"engine": "K8sNativeValidation", "source": {
+                    "variables": [{"name": "gatekeeper_internal_x",
+                                   "expression": "1"}],
+                    "validations": [{"expression": "true"}],
+                }}],
+            }],
+        },
+    }
+    with pytest.raises(CELCompileError):
+        CELDriver().add_template(ConstraintTemplate.from_unstructured(t))
+
+
+def test_vap_codegen():
+    driver = CELDriver()
+    t = ConstraintTemplate.from_unstructured(CEL_TEMPLATE)
+    driver.add_template(t)
+    vap = driver.template_to_vap(t)
+    assert vap["kind"] == "ValidatingAdmissionPolicy"
+    assert vap["spec"]["validations"][0]["expression"] == (
+        "size(variables.missing) == 0")
+    assert any(v["name"] == "params" for v in vap["spec"]["variables"])
+    con = Constraint.from_unstructured({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sCelRequiredLabels",
+        "metadata": {"name": "c1"}, "spec": {}})
+    vapb = driver.constraint_to_vap_binding(con, t)
+    assert vapb["spec"]["policyName"] == "gatekeeper-k8scelrequiredlabels"
