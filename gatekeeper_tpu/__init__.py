@@ -34,3 +34,28 @@ L10         ``gatekeeper_tpu.metrics`` / ``.export``
 """
 
 __version__ = "0.1.0"
+
+
+def _honor_jax_platforms_env():
+    """Pin jax to the platform named in JAX_PLATFORMS.
+
+    Some accelerator plugins (e.g. the axon TPU plugin) prepend themselves to
+    ``jax_platforms`` regardless of the env var; when the accelerator is
+    unreachable that hangs every consumer on first device init.  Honoring the
+    operator's explicit JAX_PLATFORMS here protects every entry point
+    (webhook server, audit pod, gator CLI, library use).
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # backends already initialized or jax unavailable
+
+
+_honor_jax_platforms_env()

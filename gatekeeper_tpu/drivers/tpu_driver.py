@@ -160,7 +160,17 @@ class TpuDriver:
             pad_n *= 2
         tf = time.perf_counter_ns()
         flattener = Flattener(schema, self.vocab)
-        batch = flattener.flatten(objects, pad_n=pad_n)
+        review_docs = [
+            {
+                "kind": r.request.kind,
+                "operation": r.request.operation,
+                "name": r.request.name,
+                "namespace": r.request.namespace,
+                "userInfo": r.request.user_info,
+            }
+            for r in reviews
+        ]
+        batch = flattener.flatten(objects, pad_n=pad_n, reviews=review_docs)
         flatten_ns = time.perf_counter_ns() - tf
         eval_ns = 0
         te = time.perf_counter_ns()
@@ -168,7 +178,7 @@ class TpuDriver:
             prog = self._programs[kind]
             cons = by_kind[kind]
             table = build_param_table(prog.program, cons, self.vocab)
-            grid = prog.run(batch, table)  # [C, pad_n]
+            grid = prog.run(batch, table, vocab=self.vocab)  # [C, pad_n]
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )

@@ -1,0 +1,194 @@
+"""gator bench: policy evaluation benchmark harness.
+
+Reference: pkg/gator/bench/bench.go — per-engine setup-vs-eval timing with
+warmup, P50/P90/P99 latencies, reviews/sec (>=1000 iterations recommended
+for P99 validity, bench.go:29-31).  Engines: rego | cel | all — plus the
+TPU-native addition ``tpu`` which drives the batched verdict-grid path
+(query_batch) instead of the per-review loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from dataclasses import dataclass, field
+
+from gatekeeper_tpu.apis.constraints import GATOR_EP
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.rego_driver import RegoDriver
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.gator import reader
+from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+from gatekeeper_tpu.target.review import AugmentedUnstructured
+from gatekeeper_tpu.target.target import K8sValidationTarget
+
+
+@dataclass
+class BenchResult:
+    engine: str
+    iterations: int
+    objects: int
+    setup_client_s: float = 0.0
+    setup_templates_s: float = 0.0
+    setup_constraints_s: float = 0.0
+    setup_data_s: float = 0.0
+    total_eval_s: float = 0.0
+    reviews_per_sec: float = 0.0
+    p50_ms: float = 0.0
+    p90_ms: float = 0.0
+    p99_ms: float = 0.0
+    violations: int = 0
+
+    def to_dict(self) -> dict:
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self.__dict__.items()}
+
+
+def _drivers_for(engine: str):
+    if engine == "rego":
+        return [RegoDriver()]
+    if engine == "cel":
+        return [CELDriver()]
+    if engine == "tpu":
+        return [TpuDriver()]
+    return [RegoDriver(), CELDriver()]  # all
+
+
+def run_bench(objs, engine: str, iterations: int) -> BenchResult:
+    templates = [o for o in objs if reader.is_template(o)]
+    constraints = [o for o in objs if reader.is_constraint(o)]
+    data = [o for o in objs
+            if not reader.is_template(o) and not reader.is_constraint(o)]
+    r = BenchResult(engine=engine, iterations=iterations, objects=len(data))
+
+    t0 = time.perf_counter()
+    client = Client(target=K8sValidationTarget(),
+                    drivers=_drivers_for(engine),
+                    enforcement_points=[GATOR_EP])
+    r.setup_client_s = time.perf_counter() - t0
+
+    from gatekeeper_tpu.apis.templates import TemplateError
+    from gatekeeper_tpu.utils.unstructured import deep_get
+
+    skipped_kinds = set()
+    t0 = time.perf_counter()
+    for t in templates:
+        try:
+            client.add_template(t)
+        except TemplateError:
+            # template has no source for this engine (e.g. rego-only template
+            # under --engine cel): skip it and its constraints
+            skipped_kinds.add(deep_get(
+                t, ("spec", "crd", "spec", "names", "kind"), ""))
+    r.setup_templates_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for c in constraints:
+        if c.get("kind") in skipped_kinds:
+            continue
+        client.add_constraint(c)
+    r.setup_constraints_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for d in data:
+        client.add_data(d)
+    r.setup_data_s = time.perf_counter() - t0
+
+    reviews = [AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
+               for o in data]
+    latencies = []
+    violations = 0
+
+    if engine == "tpu":
+        # batched lane: one latency sample per batch pass over all objects
+        client.review_batch(reviews, enforcement_point=GATOR_EP)  # warmup
+        t_all0 = time.perf_counter()
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            out = client.review_batch(reviews, enforcement_point=GATOR_EP)
+            latencies.append((time.perf_counter() - t0) * 1000)
+            violations = sum(
+                len(o.results()) for o in out
+                if not isinstance(o, Exception)
+            )
+        r.total_eval_s = time.perf_counter() - t_all0
+        total_reviews = iterations * len(reviews)
+    else:
+        for rv in reviews:  # warmup pass (bench.go warmup)
+            client.review(rv, enforcement_point=GATOR_EP)
+        t_all0 = time.perf_counter()
+        for _ in range(iterations):
+            for rv in reviews:
+                t0 = time.perf_counter()
+                resp = client.review(rv, enforcement_point=GATOR_EP)
+                latencies.append((time.perf_counter() - t0) * 1000)
+            violations = sum(1 for _ in resp.results())
+        r.total_eval_s = time.perf_counter() - t_all0
+        total_reviews = iterations * len(reviews)
+
+    r.reviews_per_sec = (total_reviews / r.total_eval_s
+                         if r.total_eval_s else 0.0)
+    if latencies:
+        qs = statistics.quantiles(latencies, n=100, method="inclusive") if (
+            len(latencies) > 1) else [latencies[0]] * 99
+        r.p50_ms, r.p90_ms, r.p99_ms = qs[49], qs[89], qs[98]
+    r.violations = violations
+    return r
+
+
+def format_text(results: list) -> str:
+    lines = []
+    for r in results:
+        lines.append(f"engine: {r.engine}")
+        lines.append(
+            f"  setup: client={r.setup_client_s * 1000:.1f}ms "
+            f"templates={r.setup_templates_s * 1000:.1f}ms "
+            f"constraints={r.setup_constraints_s * 1000:.1f}ms "
+            f"data={r.setup_data_s * 1000:.1f}ms"
+        )
+        lines.append(
+            f"  eval: {r.iterations} iterations x {r.objects} objects in "
+            f"{r.total_eval_s:.3f}s -> {r.reviews_per_sec:,.0f} reviews/sec"
+        )
+        lines.append(
+            f"  latency: P50={r.p50_ms:.3f}ms P90={r.p90_ms:.3f}ms "
+            f"P99={r.p99_ms:.3f}ms"
+        )
+        lines.append(f"  violations (last pass): {r.violations}")
+    return "\n".join(lines)
+
+
+def run_cli(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="gator bench")
+    p.add_argument("--filename", "-f", action="append", default=[])
+    p.add_argument("--engine", default="all",
+                   choices=["rego", "cel", "all", "tpu"])
+    p.add_argument("--iterations", "-n", type=int, default=10)
+    p.add_argument("--output", "-o", default="", choices=["", "json"])
+    args = p.parse_args(argv)
+
+    try:
+        objs = reader.read_sources(args.filename, use_stdin=not args.filename)
+    except OSError as e:
+        print(f"error: reading: {e}", file=sys.stderr)
+        return 1
+    if not objs:
+        print("no input data identified", file=sys.stderr)
+        return 1
+
+    engines = ([args.engine] if args.engine != "all"
+               else ["rego", "cel", "all"])
+    results = []
+    for engine in engines:
+        try:
+            results.append(run_bench(objs, engine, args.iterations))
+        except Exception as e:
+            print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
+            return 1
+    if args.output == "json":
+        print(json.dumps([r.to_dict() for r in results], indent=2))
+    else:
+        print(format_text(results))
+    return 0

@@ -78,9 +78,47 @@ class ParamVal:
 
 @dataclass(frozen=True)
 class ParamElemVal:
-    """Element of a string-list parameter (inside the set-diff pattern)."""
+    """Element of a list parameter; ``instance`` is the existential id."""
 
     name: str
+    instance: int = 0
+
+
+@dataclass(frozen=True)
+class ParamElemFieldVal:
+    """Field of an object-list parameter element: params.xs[_].key."""
+
+    name: str
+    field: tuple
+    instance: int = 0
+
+
+@dataclass(frozen=True)
+class XformElemVal:
+    """String transform of a param element: prefix + elem + suffix
+    (the concat(":", ["", tag]) idiom)."""
+
+    inner: Any  # ParamElemVal | ParamElemFieldVal
+    prefix: str = ""
+    suffix: str = ""
+
+
+@dataclass(frozen=True)
+class StrFnVal:
+    """units.parse / units.parse_bytes applied to an abstract value."""
+
+    fn: str
+    inner: Any
+
+
+@dataclass(frozen=True)
+class BoolComprVal:
+    """[b | e := params.X[_]; b = pred(..., e)] — a per-param-element
+    boolean vector; any()/all() reduce it."""
+
+    param: str
+    pred: Any  # N.Expr using _ElemListSid(param)
+    axis_inst: Any  # (axis, instance) | None from the subject feature
 
 
 @dataclass(frozen=True)
@@ -96,6 +134,7 @@ class KeySetVal:
 @dataclass(frozen=True)
 class ParamListSetVal:
     name: str
+    field: tuple = ()  # nonempty: set of elem fields (params.xs[_].key)
 
 
 @dataclass(frozen=True)
@@ -131,6 +170,7 @@ class _Lowerer:
         self.vocab = vocab
         self.depth = 0
         self._instances = 0
+        self.param_fields: dict[str, dict] = {}
 
     def _fresh_instance(self) -> int:
         self._instances += 1
@@ -154,13 +194,14 @@ class _Lowerer:
     def _lower_body(self, body, env: dict) -> N.Expr:
         env = dict(env)
         obj_preds: list[N.Expr] = []
-        axis_preds: dict[tuple, list] = {}  # (axis, instance) -> preds
+        # group key: ("axis", Axis, inst) | ("param", name, inst)
+        axis_preds: dict[tuple, list] = {}
 
-        def add_pred(p: N.Expr, axis_inst):
-            if axis_inst is None:
+        def add_pred(p: N.Expr, group):
+            if group is None:
                 obj_preds.append(p)
             else:
-                axis_preds.setdefault(axis_inst, []).append(p)
+                axis_preds.setdefault(group, []).append(p)
 
         for stmt in body:
             if isinstance(stmt, WithWrapped):
@@ -192,9 +233,12 @@ class _Lowerer:
             raise LowerError(f"statement {type(stmt).__name__}")
 
         terms = list(obj_preds)
-        for (axis, _inst), preds in axis_preds.items():
+        for group, preds in axis_preds.items():
             inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
-            terms.append(N.AnyAxis(axis, inner))
+            if group[0] == "axis":
+                terms.append(N.AnyAxis(group[1], inner))
+            else:  # param-element existential
+                terms.append(N.AnyParamList(group[1], inner))
         if not terms:
             raise LowerError("clause lowered to no predicates")
         return N.And(tuple(terms)) if len(terms) > 1 else terms[0]
@@ -235,7 +279,7 @@ class _Lowerer:
             return [(N.Present(self._scalar_col(val)), None)]
         if isinstance(val, ItemVal):
             return [(N.Present(self._ragged_col(val)),
-                     (val.axis, val.instance))]
+                     ("axis", val.axis, val.instance))]
         if isinstance(val, ParamVal):
             self._note_param(val.name, "bool")
             return [(N.ParamPresent(val.name), None)]
@@ -251,9 +295,21 @@ class _Lowerer:
             return ConstVal(term.value)
         if isinstance(term, ast.Var):
             if term.name in env:
-                return env[term.name]
+                v = env[term.name]
+                if isinstance(v, IterBinding):
+                    # the iteration KEY itself (maps) is not columnized
+                    return OpaqueVal(f"iteration key {term.name} as value")
+                return v
             if term.name == "input":
                 return PathVal(())
+            rule = self.entry_mod.rules.get(term.name)
+            if rule is not None and rule.kind == "complete" and (
+                len(rule.clauses) == 1
+                and not rule.clauses[0].body
+                and rule.clauses[0].value is not None
+            ):
+                # zero-arg value rule: object_name = input.review...name
+                return self._abstract(rule.clauses[0].value, {})
             return OpaqueVal(f"unbound var {term.name}")
         if isinstance(term, ast.Ref):
             return self._abstract_ref(term, env)
@@ -266,8 +322,88 @@ class _Lowerer:
                 if isinstance(a, ParamListSetVal) and isinstance(b, KeySetVal):
                     return SetDiffVal(a, b)
                 return OpaqueVal("minus of non set-pattern")
+            if term.op in ("units.parse", "units.parse_bytes") and (
+                len(term.args) == 1
+            ):
+                return StrFnVal(term.op, self._abstract(term.args[0], env))
+            if term.op == "concat" and len(term.args) == 2 and isinstance(
+                term.args[1], ast.ArrayTerm
+            ):
+                return self._abstract_concat(term, env)
             return OpaqueVal(f"call {term.op}")
+        if isinstance(term, ast.ArrayCompr):
+            return self._abstract_bool_compr(term, env)
         return OpaqueVal(type(term).__name__)
+
+    def _abstract_concat(self, term: ast.Call, env: dict):
+        sep = self._abstract(term.args[0], env)
+        if not (isinstance(sep, ConstVal) and isinstance(sep.value, str)):
+            return OpaqueVal("concat with non-constant separator")
+        parts = [self._abstract(it, env) for it in term.args[1].items]
+        elem_idx = None
+        for i, pv in enumerate(parts):
+            if isinstance(pv, (ParamElemVal, ParamElemFieldVal)):
+                if elem_idx is not None:
+                    return OpaqueVal("concat with multiple elements")
+                elem_idx = i
+            elif not (isinstance(pv, ConstVal)
+                      and isinstance(pv.value, str)):
+                return OpaqueVal("concat with non-constant part")
+        if elem_idx is None:
+            return ConstVal(sep.value.join(p.value for p in parts))
+        prefix = sep.value.join(
+            [p.value for p in parts[:elem_idx]] + [""]
+        ) if elem_idx > 0 else ""
+        suffix = (sep.value + sep.value.join(
+            p.value for p in parts[elem_idx + 1:]
+        )) if elem_idx < len(parts) - 1 else ""
+        # join semantics: elements are glued with sep on both sides
+        if elem_idx > 0 and not prefix.endswith(sep.value):
+            prefix += sep.value
+        return XformElemVal(parts[elem_idx], prefix, suffix)
+
+    def _abstract_bool_compr(self, term: ast.ArrayCompr, env: dict):
+        """[b | e = params.X[_]; b = pred(feat, e)] — the allowed-repos
+        idiom; reduces with any()/all()."""
+        if not isinstance(term.term, ast.Var):
+            return OpaqueVal("array comprehension head")
+        head = term.term.name
+        if len(term.body) != 2:
+            return OpaqueVal("array comprehension body")
+        s1, s2 = term.body
+        def _assign_parts(stmt):
+            if isinstance(stmt, ast.AssignStmt):
+                return stmt.target, stmt.term
+            if isinstance(stmt, ast.UnifyStmt) and isinstance(stmt.lhs,
+                                                             ast.Var):
+                return stmt.lhs, stmt.rhs
+            return None, None
+        t1, e1 = _assign_parts(s1)
+        t2, e2 = _assign_parts(s2)
+        if t1 is None or t2 is None or not isinstance(t1, ast.Var) \
+                or t2.name != head:
+            return OpaqueVal("array comprehension shape")
+        cenv = dict(env)
+        elem = self._abstract(e1, cenv)
+        if not isinstance(elem, ParamElemVal):
+            return OpaqueVal("comprehension source not a param list")
+        cenv[t1.name] = elem
+        if not isinstance(e2, ast.Call):
+            return OpaqueVal("comprehension predicate not a call")
+        if e2.op not in self._STR_PREDS or len(e2.args) != 2:
+            return OpaqueVal("comprehension predicate not a string pred")
+        table_op, si, ni = self._STR_PREDS[e2.op]
+        subject = self._abstract(e2.args[si], cenv)
+        needle = self._abstract(e2.args[ni], cenv)
+        try:
+            pred, sgroup, pgroup = self._lower_str_pred_raw(
+                table_op, subject, needle)
+        except LowerError as err:
+            return OpaqueVal(str(err))
+        if pgroup is not None and pgroup[1] != elem.name:
+            return OpaqueVal("comprehension over foreign existential")
+        self._note_param(elem.name, "strlist")
+        return BoolComprVal(elem.name, pred, sgroup)
 
     def _abstract_ref(self, term: ast.Ref, env: dict):
         base = self._abstract(term.head, env)
@@ -280,24 +416,33 @@ class _Lowerer:
                 env.get(arg.name), IterBinding
             ):
                 # reuse of a named iteration variable: same instance, same
-                # axis (containers[i].a; containers[i].b share one ∃i)
+                # collection (containers[i].a; containers[i].b share one ∃i)
                 binding = env[arg.name]
                 base = self._iterate(base)
-                if not isinstance(base, ItemVal):
-                    # correlation over non-axis bases (e.g. parameters[i])
-                    # can't be expressed; fall back to the interpreter
+                if isinstance(base, ItemVal):
+                    if binding.axis != base.axis:
+                        return OpaqueVal(
+                            f"var {arg.name} indexes two collections"
+                        )
+                    base = ItemVal(base.axis, base.subpath, binding.instance)
+                elif isinstance(base, ParamElemVal):
+                    if binding.axis != ("param", base.name):
+                        return OpaqueVal(
+                            f"var {arg.name} indexes two collections"
+                        )
+                    base = ParamElemVal(base.name, binding.instance)
+                else:
                     return OpaqueVal(f"correlated index var {arg.name}")
-                if base.axis != binding.axis:
-                    return OpaqueVal(
-                        f"var {arg.name} indexes two collections"
-                    )
-                base = ItemVal(base.axis, base.subpath, binding.instance)
             elif isinstance(arg, ast.Var) and arg.name not in env:
                 # first use of a named var: iterate and bind the instance
                 base = self._iterate(base)
-                if not isinstance(base, ItemVal):
+                if isinstance(base, ItemVal):
+                    env[arg.name] = IterBinding(base.axis, base.instance)
+                elif isinstance(base, ParamElemVal):
+                    env[arg.name] = IterBinding(("param", base.name),
+                                                base.instance)
+                else:
                     return OpaqueVal(f"correlated index var {arg.name}")
-                env[arg.name] = IterBinding(base.axis, base.instance)
             else:
                 return OpaqueVal("computed ref index")
             if isinstance(base, OpaqueVal):
@@ -311,6 +456,11 @@ class _Lowerer:
             return PathVal(base.path + (key,))
         if isinstance(base, ItemVal):
             return ItemVal(base.axis, base.subpath + (key,), base.instance)
+        if isinstance(base, ParamElemVal):
+            return ParamElemFieldVal(base.name, (key,), base.instance)
+        if isinstance(base, ParamElemFieldVal):
+            return ParamElemFieldVal(base.name, base.field + (key,),
+                                     base.instance)
         if isinstance(base, ParamVal):
             return OpaqueVal(f"nested parameter path {base.name}.{key}")
         if isinstance(base, OpaqueVal):
@@ -329,7 +479,7 @@ class _Lowerer:
             segs = tuple(seg + (base.subpath,) for seg in base.axis.segments)
             return ItemVal(Axis(segs), (), self._fresh_instance())
         if isinstance(base, ParamVal):
-            return ParamElemVal(base.name)
+            return ParamElemVal(base.name, self._fresh_instance())
         if isinstance(base, OpaqueVal):
             return base
         return OpaqueVal(f"iterate {type(base).__name__}")
@@ -358,6 +508,8 @@ class _Lowerer:
             inner = self._abstract(stmt.term, env)
             if isinstance(inner, ParamElemVal):
                 return ParamListSetVal(inner.name)
+            if isinstance(inner, ParamElemFieldVal):
+                return ParamListSetVal(inner.name, inner.field)
             return OpaqueVal("set comprehension assign form")
         return OpaqueVal("set comprehension body")
 
@@ -367,16 +519,32 @@ class _Lowerer:
 
         Negation closes over the wildcard existential:  ``not p(x[_])`` is
         ¬∃i.p(x[i]), an object-level predicate — never ∃i.¬p(x[i])."""
-        pred, axis_inst = self._lower_pred_inner(term, env)
+        before = self._instances
+        pred, group = self._lower_pred_inner(term, env)
         if pred is None:
             return None, None
         if negated:
-            if axis_inst is not None:
-                return N.Not(N.AnyAxis(axis_inst[0], pred)), None
-            return N.Not(pred), None
-        return pred, axis_inst
+            if group is None:
+                return N.Not(pred), None
+            if group[2] > before:
+                # the existential was introduced INSIDE the negated term
+                # (e.g. `not containers[_].privileged`): negation closes over
+                # it — ¬∃
+                if group[0] == "axis":
+                    return N.Not(N.AnyAxis(group[1], pred)), None
+                return N.Not(N.AnyParamList(group[1], pred)), None
+            # the variable was bound before the negation
+            # (`c := containers[_]; not c.privileged`): per-item negation
+            # under the clause's shared existential — ∃c.¬
+            return N.Not(pred), group
+        return pred, group
 
     def _lower_pred_inner(self, term, env: dict):
+        if isinstance(term, ast.Var) and term.name not in env:
+            rule = self.entry_mod.rules.get(term.name)
+            if rule is not None and rule.kind in ("complete", "function"):
+                # zero-arg boolean rule used as a guard (bad_port { ... })
+                return self._inline_rule(rule, (), env)
         if isinstance(term, (ast.Ref, ast.Var)):
             val = self._abstract(term, env)
             return self._truthy(val)
@@ -392,7 +560,7 @@ class _Lowerer:
             return N.Truthy(col), None
         if isinstance(val, ItemVal):
             col = self._ragged_col(val)
-            return N.Truthy(col), (val.axis, val.instance)
+            return N.Truthy(col), ("axis", val.axis, val.instance)
         if isinstance(val, ParamVal):
             self._note_param(val.name, "bool")
             return N.ParamTruthy(val.name), None
@@ -402,17 +570,92 @@ class _Lowerer:
             raise LowerError(f"opaque predicate: {val.why}")
         raise LowerError(f"truthiness of {type(val).__name__}")
 
+    _STR_PREDS = {
+        "startswith": ("startswith", 0, 1),  # (table op, subject, needle)
+        "endswith": ("endswith", 0, 1),
+        "contains": ("contains", 0, 1),
+        "re_match": ("re_match", 1, 0),
+        "regex.match": ("re_match", 1, 0),
+    }
+
     def _lower_call_pred(self, term: ast.Call, env: dict):
         op = term.op
         if op in ("lt", "lte", "gt", "gte", "equal", "neq"):
             return self._lower_cmp(op, term.args, env)
         if op == "count":
             raise LowerError("bare count call as predicate")
+        if op in self._STR_PREDS and len(term.args) == 2:
+            table_op, si, ni = self._STR_PREDS[op]
+            subject = self._abstract(term.args[si], env)
+            needle = self._abstract(term.args[ni], env)
+            return self._lower_str_pred(table_op, subject, needle)
+        if op in ("any", "all") and len(term.args) == 1:
+            val = self._abstract(term.args[0], env)
+            if isinstance(val, BoolComprVal):
+                reduced = N.AnyParamList(val.param, val.pred)
+                if op == "all":
+                    # all([]) is true; all = ¬∃¬
+                    reduced = N.Not(N.AnyParamList(val.param,
+                                                   N.Not(val.pred)))
+                return reduced, val.axis_inst
+            raise LowerError(f"{op}() of non-comprehension")
         # user function / bool rule inlining:
         fn_rule = self.entry_mod.rules.get(op)
         if fn_rule is not None:
             return self._inline_rule(fn_rule, term.args, env)
         raise LowerError(f"call {op}")
+
+    def _lower_str_pred_raw(self, table_op: str, subject, needle):
+        """Returns (StrPred, subject_group|None, param_group|None)."""
+        from gatekeeper_tpu.ir.program import _ElemListSid
+
+        if isinstance(subject, PathVal):
+            subj = N.FeatSid(self._scalar_col(subject))
+            group = None
+        elif isinstance(subject, ItemVal):
+            subj = N.FeatSid(self._ragged_col(subject))
+            group = ("axis", subject.axis, subject.instance)
+        else:
+            raise LowerError(
+                f"string-pred subject {type(subject).__name__}"
+            )
+        prefix = suffix = ""
+        if isinstance(needle, XformElemVal):
+            prefix, suffix = needle.prefix, needle.suffix
+            needle = needle.inner
+        if isinstance(needle, ConstVal) and isinstance(needle.value, str):
+            ndl = N.ConstSid(self._intern_const(
+                prefix + needle.value + suffix))
+            return N.StrPred(table_op, subj, ndl), group, None
+        if isinstance(needle, ParamVal):
+            if prefix or suffix:
+                raise LowerError("transformed scalar-param needle")
+            self._note_param(needle.name, "str")
+            return N.StrPred(table_op, subj, N.ParamSid(needle.name)), \
+                group, None
+        if isinstance(needle, ParamElemVal):
+            self._note_param(needle.name, "strlist")
+            ndl = _ElemListSid(needle.name, prefix, suffix)
+            return N.StrPred(table_op, subj, ndl), group, (
+                "param", needle.name, needle.instance)
+        if isinstance(needle, ParamElemFieldVal):
+            self._note_param_field(needle.name, needle.field, "str")
+            ndl = N.ParamElemFieldSid(needle.name, needle.field, prefix,
+                                      suffix)
+            return N.StrPred(table_op, subj, ndl), group, (
+                "param", needle.name, needle.instance)
+        raise LowerError(f"string-pred needle {type(needle).__name__}")
+
+    def _lower_str_pred(self, table_op: str, subject, needle):
+        pred, sgroup, pgroup = self._lower_str_pred_raw(table_op, subject,
+                                                        needle)
+        if pgroup is None:
+            return pred, sgroup
+        if sgroup is None:
+            return pred, pgroup
+        # both existentials: reduce the param element axis here, leaving an
+        # axis-level predicate ([N, M, K] -> any over K)
+        return N.AnyParamList(pgroup[1], pred), sgroup
 
     def _lower_cmp(self, op: str, args, env: dict):
         lhs_t, rhs_t = args
@@ -424,11 +667,29 @@ class _Lowerer:
         rhs = self._abstract(rhs_t, env)
         axis = None
         for v in (lhs, rhs):
+            g = None
             if isinstance(v, ItemVal):
-                if axis is not None and (v.axis, v.instance) != axis:
+                g = ("axis", v.axis, v.instance)
+            elif isinstance(v, (ParamElemVal, ParamElemFieldVal)):
+                g = ("param", v.name, v.instance)
+            elif isinstance(v, StrFnVal) and isinstance(
+                v.inner, (ItemVal, ParamElemVal, ParamElemFieldVal)
+            ):
+                iv = v.inner
+                g = (("axis", iv.axis, iv.instance)
+                     if isinstance(iv, ItemVal)
+                     else ("param", iv.name, iv.instance))
+            if g is not None:
+                if axis is not None and g != axis:
+                    if axis[0] == "axis" and g[0] == "param":
+                        # feature × param-element: the param existential wins
+                        # the group; the feature axis must be object-level
+                        raise LowerError(
+                            "ragged feature compared to param element"
+                        )
                     # two independent existentials can't fuse elementwise
                     raise LowerError("cross-instance comparison")
-                axis = (v.axis, v.instance)
+                axis = g
         # equality against a boolean constant: x == true / x == false
         if op in ("equal", "neq"):
             for a, b in ((lhs, rhs), (rhs, lhs)):
@@ -449,6 +710,13 @@ class _Lowerer:
         op_map = {"equal": "eq", "neq": "neq"}
         return N.CmpNum(lo, op_map.get(op, op), ro), axis
 
+    def _group_of(self, val):
+        if isinstance(val, ItemVal):
+            return ("axis", val.axis, val.instance)
+        if isinstance(val, (ParamElemVal, ParamElemFieldVal)):
+            return ("param", val.name, val.instance)
+        return None
+
     def _bool_eq(self, val, want: bool):
         """x == true  ⇔ kind==K_TRUE; x == false ⇔ kind==K_FALSE.  Truthy
         covers ==true only for bools; use explicit kind tests via Truthy and
@@ -459,7 +727,7 @@ class _Lowerer:
             axis = None
         elif isinstance(val, ItemVal):
             col = self._ragged_col(val)
-            axis = (val.axis, val.instance)
+            axis = ("axis", val.axis, val.instance)
         elif isinstance(val, ParamVal):
             self._note_param(val.name, "bool")
             return N.ParamBoolIs(val.name, want), None
@@ -473,7 +741,14 @@ class _Lowerer:
         val = self._abstract(set_term, env)
         if not isinstance(val, SetDiffVal):
             raise LowerError("count() of non set-diff pattern")
-        self._note_param(val.required.name, "strlist")
+        if val.required.field:
+            self._note_param_field(val.required.name, val.required.field,
+                                   "str")
+            elem_needle = N.ParamElemFieldSid(val.required.name,
+                                              val.required.field)
+        else:
+            self._note_param(val.required.name, "strlist")
+            elem_needle = N.ParamElemSid()
         keyset = KeySetCol(path=val.provided.path[2:]) if (
             val.provided.path[:2] == OBJECT_ROOT
         ) else None
@@ -481,9 +756,9 @@ class _Lowerer:
             raise LowerError("keyset outside review object")
         if keyset not in self.schema.keysets:
             self.schema.keysets.append(keyset)
-        missing_any = N.AnyParamStrList(
+        missing_any = N.AnyParamList(
             val.required.name,
-            N.Not(N.KeySetContains(keyset, N.ParamElemSid())),
+            N.Not(N.KeySetContains(keyset, elem_needle)),
         )
         if op == "gt" and n == 0:
             return missing_any, None
@@ -551,6 +826,19 @@ class _Lowerer:
             return N.FeatNum(self._scalar_col(val))
         if isinstance(val, ItemVal):
             return N.FeatNum(self._ragged_col(val))
+        if isinstance(val, ParamElemFieldVal):
+            self._note_param_field(val.name, val.field, "num")
+            return N.ParamElemFieldNum(val.name, val.field)
+        if isinstance(val, StrFnVal):
+            inner = val.inner
+            if isinstance(inner, PathVal):
+                return N.StrFnNum(val.fn, N.FeatSid(self._scalar_col(inner)))
+            if isinstance(inner, ItemVal):
+                return N.StrFnNum(val.fn, N.FeatSid(self._ragged_col(inner)))
+            if isinstance(inner, ParamVal):
+                self._note_param(inner.name, "str")
+                return N.ParamFnNum(val.fn, inner.name)
+            raise LowerError(f"string-fn of {type(inner).__name__}")
         raise LowerError(f"numeric operand {type(val).__name__}")
 
     def _sid_operand(self, val):
@@ -563,6 +851,9 @@ class _Lowerer:
             return N.ParamSid(val.name)
         if isinstance(val, ParamElemVal):
             return N.ParamElemSid()
+        if isinstance(val, ParamElemFieldVal):
+            self._note_param_field(val.name, val.field, "str")
+            return N.ParamElemFieldSid(val.name, val.field)
         if isinstance(val, PathVal):
             return N.FeatSid(self._scalar_col(val))
         if isinstance(val, ItemVal):
@@ -575,11 +866,18 @@ class _Lowerer:
         return self.vocab.intern(s)
 
     def _scalar_col(self, val: PathVal) -> ScalarCol:
-        if val.path[:2] != OBJECT_ROOT:
-            # review-level scalars (review.operation etc.) are not columnized
-            # yet; templates reading them fall back to the interpreter
-            raise LowerError(f"path outside review object: {val.path}")
-        col = ScalarCol(path=val.path[2:])
+        if val.path[:2] == OBJECT_ROOT:
+            col = ScalarCol(path=val.path[2:])
+        elif val.path[:1] == ("review",) and val.path[1:2] and (
+            val.path[1] in ("kind", "operation", "name", "namespace",
+                            "userInfo")
+        ):
+            # review-level scalars columnized from the review document (only
+            # the fields the batch paths populate — anything else must fall
+            # back so lowered verdicts can't silently read absent data)
+            col = ScalarCol(path=("__review__",) + val.path[1:])
+        else:
+            raise LowerError(f"path outside review: {val.path}")
         if col not in self.schema.scalars:
             self.schema.scalars.append(col)
         return col
@@ -589,6 +887,16 @@ class _Lowerer:
         if col not in self.schema.raggeds:
             self.schema.raggeds.append(col)
         return col
+
+    def _note_param_field(self, name: str, field: tuple, ftype: str):
+        self._note_param(name, "objlist")
+        fields = self.param_fields.setdefault(name, {})
+        prev = fields.get(field)
+        if prev is not None and prev != ftype:
+            raise LowerError(
+                f"param {name}.{'.'.join(field)} used as {prev} and {ftype}"
+            )
+        fields[field] = ftype
 
     def _note_param(self, name: str, kind: str):
         prev = self.param_kinds.get(name)
@@ -610,7 +918,11 @@ def lower_template(modules, entry_pkg: tuple, template_kind: str,
     low.entry_axis_rules = _collect_axis_rules(low)
     expr = _with_axis_rules(low)
     params = tuple(
-        N.ParamSpec(name=k, kind=v) for k, v in sorted(low.param_kinds.items())
+        N.ParamSpec(
+            name=k, kind=v,
+            fields=tuple(sorted(low.param_fields.get(k, {}).items())),
+        )
+        for k, v in sorted(low.param_kinds.items())
     )
     return N.Program(
         template_kind=template_kind,

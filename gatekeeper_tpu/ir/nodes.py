@@ -82,7 +82,55 @@ class ConstSid(Expr):
 
 @dataclass(frozen=True)
 class ParamElemSid(Expr):
-    """Current element inside AnyParamStrList."""
+    """Current element inside AnyParamList (string lists)."""
+
+
+@dataclass(frozen=True)
+class ParamElemFieldSid(Expr):
+    """String field of the current object-list element: params.xs[_].key.
+    ``prefix``/``suffix`` apply a static string transform when used as a
+    StrPred needle (concat idiom)."""
+
+    param: str
+    field: tuple
+    prefix: str = ""
+    suffix: str = ""
+
+
+@dataclass(frozen=True)
+class ParamElemFieldNum(Expr):
+    """Numeric field of the current object-list element."""
+
+    param: str
+    field: tuple
+
+
+@dataclass(frozen=True)
+class StrFnNum(Expr):
+    """Vocab-table numeric function of a string feature (units.parse /
+    units.parse_bytes): table[sid] with validity mask."""
+
+    fn: str
+    operand: Expr  # sid-valued
+
+
+@dataclass(frozen=True)
+class ParamFnNum(Expr):
+    """Numeric function applied to a scalar string parameter (computed at
+    table-build time)."""
+
+    fn: str
+    name: str
+
+
+@dataclass(frozen=True)
+class StrPred(Expr):
+    """String predicate via vocab table: op(subject, needle) where needle is
+    a constraint-parameter value (startswith/endswith/contains/re_match)."""
+
+    op: str
+    subject: Expr  # sid-valued feature
+    needle: Expr  # ParamElemSid / ParamElemFieldSid / ParamSid / ConstSid
 
 
 # --- predicates -----------------------------------------------------------
@@ -148,12 +196,16 @@ class AnyAxis(Expr):
 
 
 @dataclass(frozen=True)
-class AnyParamStrList(Expr):
-    """∃ element of string-list parameter satisfying inner (inner uses
-    ParamElemSid) — e.g. required-labels: any required label missing."""
+class AnyParamList(Expr):
+    """∃ element of a list parameter satisfying inner (inner uses
+    ParamElemSid / ParamElemField*) — e.g. required-labels: any required
+    label missing."""
 
     param: str
     inner: Expr
+
+
+AnyParamStrList = AnyParamList  # historical alias
 
 
 @dataclass(frozen=True)
@@ -183,7 +235,8 @@ class ParamBoolIs(Expr):
 @dataclass(frozen=True)
 class ParamSpec:
     name: str
-    kind: str  # num | str | bool | strlist | numlist
+    kind: str  # num | str | bool | strlist | numlist | objlist
+    fields: tuple = ()  # objlist: ((path_tuple, "num"|"str"), ...)
 
 
 @dataclass

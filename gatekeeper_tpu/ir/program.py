@@ -63,6 +63,150 @@ class LowerError(Exception):
 # --------------------------------------------------------------------------
 
 
+def _walk_expr(e, out: list):
+    out.append(e)
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, N.Expr):
+            _walk_expr(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, N.Expr):
+                    _walk_expr(item, out)
+
+
+def expr_nodes(program: N.Program) -> list:
+    out: list = []
+    _walk_expr(program.expr, out)
+    return out
+
+
+# --- vocab-derived tables (cached on the Vocab instance, extended lazily) --
+
+_STR_FNS = {
+    "units.parse_bytes": None,
+    "units.parse": None,
+}
+
+
+def _apply_str_fn(fn: str, s: str):
+    from gatekeeper_tpu.lang.rego import builtins as rb
+    from gatekeeper_tpu.lang.rego.value import UNDEFINED
+
+    v = rb.REGISTRY[fn](s)
+    return None if v is UNDEFINED else float(v)
+
+
+_VOCAB_BUCKET = 1024
+
+
+def _vpad(v: int) -> int:
+    # vocab-axis bucketing: tables grow in steps so jit shapes stay stable
+    # across batches that intern a few new strings (matching the pad_n
+    # bucketing philosophy everywhere else)
+    return ((v + _VOCAB_BUCKET - 1) // _VOCAB_BUCKET) * _VOCAB_BUCKET
+
+
+def fn_table(vocab: Vocab, fn: str):
+    """[Vpad] (num f32, valid bool) for a string->number builtin, lazily
+    extended as the vocab grows."""
+    cache = vocab.__dict__.setdefault("_fn_tables", {})
+    num, valid, upto = cache.get(fn, (None, None, 0))
+    v = len(vocab)
+    if upto < v or num is None:
+        import numpy as _np
+
+        vp = _vpad(v)
+        new_num = _np.zeros(vp, _np.float32)
+        new_valid = _np.zeros(vp, bool)
+        if num is not None:
+            new_num[:upto] = num[:upto]
+            new_valid[:upto] = valid[:upto]
+        for i in range(upto, v):
+            r = _apply_str_fn(fn, vocab.string(i))
+            if r is not None:
+                new_num[i] = r
+                new_valid[i] = True
+        num, valid = new_num, new_valid
+        cache[fn] = (num, valid, v)
+    return num, valid
+
+
+_PRED_IMPL = {
+    "startswith": lambda s, n: s.startswith(n),
+    "endswith": lambda s, n: s.endswith(n),
+    "contains": lambda s, n: n in s,
+}
+
+
+def _re_pred(s: str, pattern: str) -> bool:
+    import re as _re
+
+    try:
+        return _re.search(pattern, s) is not None
+    except _re.error:
+        return False
+
+
+_PRED_IMPL["re_match"] = _re_pred
+
+
+def pred_table_row(vocab: Vocab, op: str, needle: str) -> int:
+    """Register (op, needle); returns the row index in the op's [T, V]
+    matrix (see pred_matrix)."""
+    cache = vocab.__dict__.setdefault("_pred_tables", {})
+    rows, _ = cache.setdefault(op, ({}, []))
+    if needle not in rows:
+        rows[needle] = len(rows)
+    return rows[needle]
+
+
+def pred_matrix(vocab: Vocab, op: str):
+    """[T, Vpad] bool matrix for op, rows in registration order, extended
+    incrementally as needles/vocab grow (bucketed V keeps jit shapes
+    stable)."""
+    import numpy as _np
+
+    cache = vocab.__dict__.setdefault("_pred_tables", {})
+    rows, memo = cache.setdefault(op, ({}, []))
+    v = len(vocab)
+    impl = _PRED_IMPL[op]
+    if memo:
+        (prev_t, prev_v), mat = memo
+        if prev_t == len(rows) and prev_v >= v and mat.shape[1] >= v:
+            return mat
+        vp = max(_vpad(v), mat.shape[1])
+        new = _np.zeros((max(len(rows), 1), vp), bool)
+        new[: mat.shape[0], : mat.shape[1]] = mat
+        # new needles: full scan; existing needles: only new vocab entries
+        for needle, ri in rows.items():
+            start = 0 if ri >= prev_t else prev_v
+            for sid in range(start, v):
+                new[ri, sid] = impl(vocab.string(sid), needle)
+        mat = new
+    else:
+        vp = _vpad(v)
+        mat = _np.zeros((max(len(rows), 1), vp), bool)
+        for needle, ri in rows.items():
+            for sid in range(v):
+                mat[ri, sid] = impl(vocab.string(sid), needle)
+    memo.clear()
+    memo.extend(((len(rows), v), mat))
+    return mat
+
+
+def strtab_key(op: str, needle) -> str:
+    if isinstance(needle, N.ParamElemFieldSid):
+        base = f"{needle.param}.{'.'.join(needle.field)}"
+        xf = f"|{needle.prefix}|{needle.suffix}" if (
+            needle.prefix or needle.suffix) else ""
+        return f"{base}__strtab_{op}{xf}"
+    base = needle.param
+    xf = f"|{needle.prefix}|{needle.suffix}" if (
+        needle.prefix or needle.suffix) else ""
+    return f"{base}__strtab_{op}{xf}"
+
+
 def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
     """Pack constraint parameters into arrays [C, ...] for vmap.
 
@@ -126,9 +270,169 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 arr[i, : len(xs)] = xs
             table[f"{spec.name}__nums"] = jnp.asarray(arr)
             table[f"{spec.name}__count"] = jnp.asarray(cnt)
+        elif spec.kind == "objlist":
+            lists = [v if isinstance(v, list) else [] for v in vals]
+            k = round_up(max((len(x) for x in lists), default=0))
+            cnt = np.zeros(c, np.int32)
+            for i, xs in enumerate(lists):
+                cnt[i] = len(xs)
+            table[f"{spec.name}__count"] = jnp.asarray(cnt)
+            for field, ftype in spec.fields:
+                dotted = ".".join(field)
+                if ftype == "num":
+                    arr = np.zeros((c, k), np.float32)
+                    ok = np.zeros((c, k), bool)
+                else:
+                    arr = np.full((c, k), -2, np.int32)
+                    ok = np.zeros((c, k), bool)
+                for i, xs in enumerate(lists):
+                    for j, item in enumerate(xs):
+                        cur = item
+                        for part in field:
+                            cur = cur.get(part) if isinstance(cur, dict) \
+                                else None
+                        if ftype == "num" and isinstance(cur, (int, float)) \
+                                and not isinstance(cur, bool):
+                            arr[i, j] = float(cur)
+                            ok[i, j] = True
+                        elif ftype == "str" and isinstance(cur, str):
+                            arr[i, j] = vocab.intern(cur)
+                            ok[i, j] = True
+                suffix = "__nums" if ftype == "num" else "__sids"
+                table[f"{spec.name}.{dotted}{suffix}"] = jnp.asarray(arr)
+                table[f"{spec.name}.{dotted}__ok"] = jnp.asarray(ok)
         else:
             raise LowerError(f"unknown param kind {spec.kind}")
+
+    # --- derived entries: string-fn params and string-pred needle rows ----
+    for node in expr_nodes(program):
+        if isinstance(node, N.ParamFnNum):
+            vals = [p.get(node.name) for p in params_by_con]
+            nums = np.zeros(c, np.float32)
+            ok = np.zeros(c, bool)
+            for i, v in enumerate(vals):
+                if isinstance(v, str):
+                    r = _apply_str_fn(node.fn, v)
+                    if r is not None:
+                        nums[i] = r
+                        ok[i] = True
+            table[f"{node.name}__fn_{node.fn}__num"] = jnp.asarray(nums)
+            table[f"{node.name}__fn_{node.fn}__ok"] = jnp.asarray(ok)
+        elif isinstance(node, N.StrPred):
+            needle = node.needle
+            if isinstance(needle, N.ParamElemSid):
+                raise LowerError(
+                    "StrPred over bare string-list elements needs the "
+                    "param name; use ParamElemFieldSid or the lowering's "
+                    "strlist path"
+                )
+            if isinstance(needle, N.ParamElemFieldSid):
+                # rows per (constraint, element): [C, K]
+                key = strtab_key(node.op, needle)
+                if key in table:
+                    continue
+                lists = [
+                    (p.get(needle.param) if isinstance(
+                        p.get(needle.param), list) else [])
+                    for p in params_by_con
+                ]
+                k = round_up(max((len(x) for x in lists), default=0))
+                rowidx = np.zeros((c, k), np.int32)
+                ok = np.zeros((c, k), bool)
+                for i, xs in enumerate(lists):
+                    for j, item in enumerate(xs):
+                        cur = item
+                        for part in needle.field:
+                            cur = cur.get(part) if isinstance(cur, dict) \
+                                else None
+                        if isinstance(cur, str):
+                            rowidx[i, j] = pred_table_row(
+                                vocab, node.op,
+                                needle.prefix + cur + needle.suffix)
+                            ok[i, j] = True
+                table[key] = jnp.asarray(rowidx)
+                table[key + "__ok"] = jnp.asarray(ok)
+            elif isinstance(needle, _ELEM_OF):
+                # string-list elements: rows [C, K] from the list itself
+                pname = needle.param
+                key = strtab_key(node.op, needle)
+                if key in table:
+                    continue
+                lists = [
+                    [x for x in (p.get(pname) or []) if isinstance(x, str)]
+                    if isinstance(p.get(pname), list) else []
+                    for p in params_by_con
+                ]
+                k = round_up(max((len(x) for x in lists), default=0))
+                rowidx = np.zeros((c, k), np.int32)
+                ok = np.zeros((c, k), bool)
+                for i, xs in enumerate(lists):
+                    for j, x in enumerate(xs):
+                        rowidx[i, j] = pred_table_row(
+                            vocab, node.op,
+                            needle.prefix + x + needle.suffix)
+                        ok[i, j] = True
+                table[key] = jnp.asarray(rowidx)
+                table[key + "__ok"] = jnp.asarray(ok)
+            elif isinstance(needle, N.ParamSid):
+                key = f"{needle.name}__strtab_{node.op}"
+                if key in table:
+                    continue
+                vals2 = [p.get(needle.name) for p in params_by_con]
+                rowidx = np.zeros(c, np.int32)
+                ok = np.zeros(c, bool)
+                for i, v in enumerate(vals2):
+                    if isinstance(v, str):
+                        rowidx[i] = pred_table_row(vocab, node.op, v)
+                        ok[i] = True
+                table[key] = jnp.asarray(rowidx)
+                table[key + "__ok"] = jnp.asarray(ok)
+            elif isinstance(needle, N.ConstSid):
+                key = f"__const{needle.sid}__strtab_{node.op}"
+                if key in table:
+                    continue
+                rowidx = np.full(
+                    c, pred_table_row(vocab, node.op,
+                                      vocab.string(needle.sid)), np.int32)
+                table[key] = jnp.asarray(rowidx)
+                table[key + "__ok"] = jnp.asarray(np.ones(c, bool))
     return table
+
+
+class _ElemListSid(N.Expr):
+    """Marker: StrPred needle iterating a plain string-list param, with an
+    optional static prefix/suffix transform (concat idiom)."""
+
+    __slots__ = ("param", "prefix", "suffix")
+
+    def __init__(self, param: str, prefix: str = "", suffix: str = ""):
+        self.param = param
+        self.prefix = prefix
+        self.suffix = suffix
+
+    def __hash__(self):
+        return hash(("_ElemListSid", self.param, self.prefix, self.suffix))
+
+    def __eq__(self, other):
+        return (isinstance(other, _ElemListSid)
+                and (other.param, other.prefix, other.suffix)
+                == (self.param, self.prefix, self.suffix))
+
+
+_ELEM_OF = _ElemListSid
+
+
+def vocab_tables(program: N.Program, vocab: Vocab) -> dict:
+    """Shared (non-vmapped) vocab-derived arrays for the cols dict."""
+    out = {}
+    for node in expr_nodes(program):
+        if isinstance(node, N.StrFnNum):
+            num, valid = fn_table(vocab, node.fn)
+            out[f"fn:{node.fn}:num"] = num
+            out[f"fn:{node.fn}:ok"] = valid
+        elif isinstance(node, N.StrPred):
+            out[f"st:{node.op}"] = pred_matrix(vocab, node.op)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -175,6 +479,21 @@ def _eval_numlike(ctx: _Ctx, e: N.Expr):
         return ctx.row[f"{e.name}__num"], ctx.row[f"{e.name}__present"]
     if isinstance(e, N.ConstNum):
         return jnp.float32(e.value), jnp.bool_(True)
+    if isinstance(e, N.ParamElemFieldNum):
+        if ctx.elem_k is None:
+            raise LowerError("ParamElemFieldNum outside AnyParamList")
+        dotted = ".".join(e.field)
+        return (ctx.row[f"{e.param}.{dotted}__nums"],
+                ctx.row[f"{e.param}.{dotted}__ok"])
+    if isinstance(e, N.ParamFnNum):
+        return (ctx.row[f"{e.name}__fn_{e.fn}__num"],
+                ctx.row[f"{e.name}__fn_{e.fn}__ok"])
+    if isinstance(e, N.StrFnNum):
+        sid, sok = _eval_sidlike(ctx, e.operand)
+        num = ctx.cols[f"fn:{e.fn}:num"]
+        ok = ctx.cols[f"fn:{e.fn}:ok"]
+        safe = jnp.clip(sid, 0, num.shape[0] - 1)
+        return num[safe], sok & (sid >= 0) & ok[safe]
     raise LowerError(f"not a numeric operand: {e}")
 
 
@@ -192,8 +511,14 @@ def _eval_sidlike(ctx: _Ctx, e: N.Expr):
         return jnp.int32(e.sid), jnp.bool_(True)
     if isinstance(e, N.ParamElemSid):
         if ctx.elem_k is None:
-            raise LowerError("ParamElemSid outside AnyParamStrList")
+            raise LowerError("ParamElemSid outside AnyParamList")
         return ctx.elem_k, jnp.bool_(True)
+    if isinstance(e, N.ParamElemFieldSid):
+        if ctx.elem_k is None:
+            raise LowerError("ParamElemFieldSid outside AnyParamList")
+        dotted = ".".join(e.field)
+        return (ctx.row[f"{e.param}.{dotted}__sids"],
+                ctx.row[f"{e.param}.{dotted}__ok"])
     raise LowerError(f"not a string operand: {e}")
 
 
@@ -268,6 +593,39 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             return hit & nok
         hit = jnp.any((keys == nv[..., None]) & valid, axis=-1)
         return hit & nok
+    if isinstance(e, N.StrPred):
+        matrix = ctx.cols[f"st:{e.op}"]  # [T, V]
+        needle = e.needle
+        if isinstance(needle, (N.ParamElemFieldSid, _ElemListSid)):
+            if ctx.elem_k is None:
+                raise LowerError("elem-needle StrPred outside AnyParamList")
+            key = strtab_key(e.op, needle)
+            rowidx = ctx.row[key]  # [K]
+            rok = ctx.row[key + "__ok"]  # [K]
+            # evaluate the subject WITHOUT elem expansion; add the K axis
+            # explicitly via the table rows
+            saved_elem = ctx.elem_k
+            ctx.elem_k = None
+            try:
+                sid, sok = _eval_sidlike(ctx, e.subject)  # [N] or [N, M]
+            finally:
+                ctx.elem_k = saved_elem
+            safe = jnp.clip(sid, 0, matrix.shape[1] - 1)
+            rows = matrix[rowidx]  # [K, V]
+            hit = jnp.moveaxis(rows[:, safe], 0, -1)  # [..., K]
+            return hit & rok & ((sid >= 0) & sok)[..., None]
+        if isinstance(needle, (N.ParamSid, N.ConstSid)):
+            sid, sok = _eval_sidlike(ctx, e.subject)
+            if isinstance(needle, N.ParamSid):
+                key = f"{needle.name}__strtab_{e.op}"
+            else:
+                key = f"__const{needle.sid}__strtab_{e.op}"
+            rowidx = ctx.row[key]  # scalar per constraint
+            rok = ctx.row[key + "__ok"]
+            row = matrix[rowidx]  # [V]
+            safe = jnp.clip(sid, 0, matrix.shape[1] - 1)
+            return row[safe] & rok & (sid >= 0) & sok
+        raise LowerError(f"StrPred needle {needle}")
     if isinstance(e, N.Not):
         return jnp.logical_not(eval_expr(ctx, e.inner))
     if isinstance(e, N.And):
@@ -296,17 +654,29 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         if inner.ndim == 3:
             valid = valid[..., None]
         return jnp.any(inner & valid, axis=1)
-    if isinstance(e, N.AnyParamStrList):
+    if isinstance(e, N.AnyParamList):
         if ctx.elem_k is not None:
-            raise LowerError("nested AnyParamStrList unsupported")
-        sids = ctx.row[f"{e.param}__sids"]  # [K]
+            raise LowerError("nested AnyParamList unsupported")
         cnt = ctx.row[f"{e.param}__count"]
-        ctx.elem_k = sids
+        sids = ctx.row.get(f"{e.param}__sids")
+        if sids is None:
+            # object-list param: elem axis width from the count's table; any
+            # field array carries K
+            k = None
+            for key, vv in ctx.row.items():
+                if key.startswith(f"{e.param}.") and vv.ndim >= 1:
+                    k = vv.shape[-1]
+                    break
+            if k is None:
+                raise LowerError(f"param {e.param} has no element arrays")
+            ctx.elem_k = jnp.zeros((k,), jnp.int32)  # placeholder axis
+        else:
+            k = sids.shape[-1]
+            ctx.elem_k = sids
         try:
             inner = eval_expr(ctx, e.inner)  # [..., K]
         finally:
             ctx.elem_k = None
-        k = sids.shape[-1]
         valid = jnp.arange(k) < cnt
         return jnp.any(inner & valid, axis=-1)
     raise LowerError(f"cannot evaluate IR node {e}")
@@ -337,7 +707,8 @@ class CompiledProgram:
 
         return batch_fn
 
-    def run(self, batch: ColumnBatch, param_table: dict) -> np.ndarray:
+    def run(self, batch: ColumnBatch, param_table: dict,
+            vocab: Optional[Vocab] = None) -> np.ndarray:
         """Returns verdicts [C, N] (numpy bool)."""
         cols: dict = {}
         for spec, col in batch.scalars.items():
@@ -353,5 +724,8 @@ class CompiledProgram:
         for spec, col in batch.keysets.items():
             cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
                                    "count": jnp.asarray(col.count)}
+        if vocab is not None:
+            for k, v in vocab_tables(self.program, vocab).items():
+                cols[k] = jnp.asarray(v)
         out = self._fn(param_table, cols)
         return np.asarray(out)

@@ -627,12 +627,16 @@ def _call(ast: Call, env: Env) -> Any:
     name = ast.name
     if ast.target is None:
         if name == "has":
+            # cel-go: has(a.b.c) tests presence of c on a.b; errors reaching
+            # a.b (missing intermediate key) PROPAGATE — guard chains with
+            # has(a.b) && has(a.b.c) as VAP templates do
             sel: Select = ast.args[0]
-            try:
-                base = evaluate(sel.base, env)
-            except CelError:
-                return False
-            return isinstance(base, dict) and sel.field in base
+            base = evaluate(sel.base, env)
+            if isinstance(base, dict):
+                return sel.field in base
+            raise CelError(
+                f"has() on {_type_name(base)}"
+            )
         args = [evaluate(a, env) for a in ast.args]
         return _global_fn(name, args)
     target = evaluate(ast.target, env)
@@ -711,6 +715,13 @@ def _method(target: Any, name: str, args: list) -> Any:
         if name == "size":
             return len(target)
         if name == "split":
+            if len(args) == 2:
+                limit = args[1]
+                if limit == 0:
+                    return []
+                if limit < 0:
+                    return target.split(args[0])
+                return target.split(args[0], limit - 1)
             return target.split(args[0])
         if name == "lowerAscii":
             return target.lower()

@@ -824,3 +824,18 @@ def _replace_n(patterns, s):
             out.append(s[i])
             i += 1
     return "".join(out)
+
+
+@builtin("any")
+def _any(coll):
+    # deprecated in OPA but widely used by library policies
+    if isinstance(coll, (list, tuple, RegoSet)):
+        return any(v is True for v in coll)
+    return UNDEFINED
+
+
+@builtin("all")
+def _all(coll):
+    if isinstance(coll, (list, tuple, RegoSet)):
+        return all(v is True for v in coll)
+    return UNDEFINED

@@ -393,6 +393,11 @@ class Parser:
             if t.value == "_":
                 return self.fresh_wildcard()
             return ast.Var(t.value)
+        if t.kind == "keyword" and t.value == "contains":
+            # `contains` is v1 rule-head sugar but also an OPA builtin; in
+            # term position it is always the builtin reference
+            self.next()
+            return ast.Var("contains")
         if t.kind == "op" and t.value == "(":
             self.next()
             self.skip_newlines()

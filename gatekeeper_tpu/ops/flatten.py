@@ -212,9 +212,28 @@ def _axis_items(obj: dict, axis: Axis) -> list:
                 val, ok = _walk(node, part)
                 if ok and isinstance(val, list):
                     nxt.extend(val)
+                elif ok and isinstance(val, dict):
+                    # Rego xs[_] iterates map VALUES too (interp semantics)
+                    nxt.extend(val.values())
             level = nxt
         items.append(level)
     return [x for level in items for x in level]
+
+
+def _synth_review(obj: dict) -> dict:
+    """Review doc fields derivable from a bare object (audit sweeps review
+    cluster objects; gvk/name/namespace mirror AugmentedUnstructured
+    coercion, target.go:159-179)."""
+    from gatekeeper_tpu.utils.unstructured import gvk_of
+
+    group, version, kind = gvk_of(obj)
+    meta = obj.get("metadata") or {}
+    return {
+        "kind": {"group": group, "version": version, "kind": kind},
+        "operation": "",
+        "name": meta.get("name", "") or "",
+        "namespace": meta.get("namespace", "") or "",
+    }
 
 
 def round_up(n: int, bucket: int = 8) -> int:
@@ -232,14 +251,44 @@ class Flattener:
         self.use_native = use_native
 
     def flatten(self, objects: Sequence[dict],
-                pad_n: Optional[int] = None) -> ColumnBatch:
-        if self.use_native:
+                pad_n: Optional[int] = None,
+                reviews: Optional[Sequence[dict]] = None) -> ColumnBatch:
+        """``reviews``: per-object review documents (kind/operation/...)
+        backing __review__-rooted scalar columns; synthesized from the
+        objects when not supplied (the audit path)."""
+        review_cols = [c for c in self.schema.scalars
+                       if c.path[:1] == ("__review__",)]
+        schema = self.schema
+        if review_cols:
+            schema = Schema()
+            schema.scalars = [c for c in self.schema.scalars
+                              if c.path[:1] != ("__review__",)]
+            schema.raggeds = list(self.schema.raggeds)
+            schema.keysets = list(self.schema.keysets)
+        inner = Flattener(schema, self.vocab, self.use_native)
+        if inner.use_native:
             from gatekeeper_tpu.ops import native
 
             mod = native.load()
-            if mod is not None:
-                return self._flatten_native(mod, objects, pad_n)
-        return self._flatten_py(objects, pad_n)
+            batch = (inner._flatten_native(mod, objects, pad_n)
+                     if mod is not None
+                     else inner._flatten_py(objects, pad_n))
+        else:
+            batch = inner._flatten_py(objects, pad_n)
+        if review_cols:
+            if reviews is None:
+                reviews = [_synth_review(o) for o in objects]
+            n = batch.n
+            for spec in review_cols:
+                kind = np.zeros(n, np.int8)
+                num = np.zeros(n, np.float32)
+                sid = np.full(n, -1, np.int32)
+                for i, rdoc in enumerate(reviews):
+                    val, ok = _walk(rdoc, spec.path[1:])
+                    if ok:
+                        kind[i], num[i], sid[i] = _classify(val, self.vocab)
+                batch.scalars[spec] = ScalarColumn(kind, num, sid)
+        return batch
 
     def _flatten_native(self, mod, objects: Sequence[dict],
                         pad_n: Optional[int]) -> ColumnBatch:

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gatekeeper_tpu.ir.program import build_param_table
+from gatekeeper_tpu.ir.program import build_param_table, vocab_tables
 from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
 
 
@@ -53,6 +53,12 @@ def shard_batch_arrays(cols: dict, mesh: Mesh) -> dict:
     """
     out = {}
     for key, val in cols.items():
+        if key.startswith(("fn:", "st:")):
+            # vocab-derived tables are shared lookup state: replicate
+            out[key] = jax.device_put(
+                val, NamedSharding(mesh, P(*([None] * val.ndim)))
+            )
+            continue
         if isinstance(val, dict):
             out[key] = {
                 k: jax.device_put(
@@ -182,7 +188,6 @@ class ShardedEvaluator:
             cols[axis_key(axis)] = cnt
         for spec, col in batch.keysets.items():
             cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
-        sharded_cols = shard_batch_arrays(cols, self.mesh)
 
         kinds = tuple(sorted(lowered))
         k = self.violations_limit
@@ -193,6 +198,8 @@ class ShardedEvaluator:
         for kind in kinds:
             prog = self.driver._programs[kind]
             cons = by_kind[kind]
+            # param tables FIRST: they register StrPred needle rows that the
+            # vocab tables below must include
             table = build_param_table(prog.program, cons, self.driver.vocab)
             tables.append(shard_param_table(table, self.mesh,
                                             shard_constraints=False))
@@ -201,6 +208,12 @@ class ShardedEvaluator:
             ))
             offsets[kind] = (c_off, c_off + len(cons))
             c_off += len(cons)
+        for kind in kinds:
+            for tk, tv in vocab_tables(
+                self.driver._programs[kind].program, self.driver.vocab
+            ).items():
+                cols[tk] = tv
+        sharded_cols = shard_batch_arrays(cols, self.mesh)
         mask = np.concatenate(mask_rows, axis=0)
         mask_dev = jax.device_put(
             mask, NamedSharding(self.mesh, P(None, "data"))

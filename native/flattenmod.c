@@ -143,6 +143,17 @@ collect_segment(PyObject *obj, PyObject *segment /* tuple of path tuples */,
                         return -1;
                     }
                 }
+            } else if (val != NULL && PyDict_Check(val)) {
+                /* Rego xs[_] iterates map VALUES too */
+                PyObject *k2, *v2;
+                Py_ssize_t pos = 0;
+                while (PyDict_Next(val, &pos, &k2, &v2)) {
+                    if (PyList_Append(next, v2) < 0) {
+                        Py_DECREF(level);
+                        Py_DECREF(next);
+                        return -1;
+                    }
+                }
             }
         }
         Py_DECREF(level);

@@ -31,6 +31,8 @@ def test_cel_basics():
     assert ev('size("abc")') == 3
     assert ev('"abc".contains("b")')
     assert ev('"v1.2".matches("^v[0-9]+")')
+    assert ev('"a,b,c".split(",", 2)') == ["a", "b,c"]
+    assert ev('"a,b,c".split(",", 0)') == []
     assert ev('string(42)') == "42"
     assert ev('int("42")') == 42
     assert ev('type(1)') == "int"
@@ -53,7 +55,12 @@ def test_cel_has_and_errors():
     obj = {"spec": {"x": 1}}
     assert ev("has(object.spec)", object=obj)
     assert not ev("has(object.status)", object=obj)
-    assert not ev("has(object.status.phase)", object=obj)
+    # cel-go: a missing INTERMEDIATE key errors — hence the chained
+    # has(a.b) && has(a.b.c) idiom in VAP templates
+    with pytest.raises(CelError):
+        ev("has(object.status.phase)", object=obj)
+    assert not ev(
+        "has(object.status) && has(object.status.phase)", object=obj)
     with pytest.raises(CelError):
         ev("object.status.phase", object=obj)
     # || absorbs an error when the other side decides

@@ -1,0 +1,116 @@
+"""gator verify/bench/sync CLIs + the shipped policy library."""
+
+import glob
+import io
+import os
+
+import yaml
+
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.gator import verify as verify_mod
+from gatekeeper_tpu.gator.bench import run_bench
+from gatekeeper_tpu.gator.sync_cmd import missing_requirements
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+LIBRARY = os.path.join(os.path.dirname(__file__), "..", "library")
+REF_VERIFY = "/root/reference/test/gator/verify/suite.yaml"
+
+
+def test_reference_verify_suite_passes():
+    sr = verify_mod.run_suite(REF_VERIFY)
+    assert not sr.failed(), [
+        (t.name, c.name, c.error) for t in sr.tests for c in t.cases
+        if c.error
+    ]
+    assert len(sr.tests) == 5
+
+
+def test_library_suites_all_pass():
+    suites = verify_mod.find_suites([LIBRARY])
+    assert len(suites) >= 11
+    for path in suites:
+        sr = verify_mod.run_suite(path)
+        assert not sr.failed(), (path, [
+            (t.name, c.name, c.error or t.error)
+            for t in sr.tests for c in t.cases or [type("x", (), {
+                "name": "", "error": ""})()]
+        ])
+
+
+def test_assertion_semantics():
+    class R:
+        def __init__(self, msg):
+            self.msg = msg
+
+    results = [R("foo is bad"), R("bar is bad")]
+    assert verify_mod._assert_case([{"violations": 2}], results) is None
+    assert verify_mod._assert_case(
+        [{"violations": 1, "message": "foo"}], results) is None
+    assert verify_mod._assert_case([{"violations": "no"}], []) is None
+    assert verify_mod._assert_case([{}], results) is None  # default yes
+    assert verify_mod._assert_case([{}], []) is not None
+    assert verify_mod._assert_case([{"violations": 3}], results) is not None
+    assert verify_mod._assert_case(
+        [{"violations": "maybe"}], results) is not None
+
+
+def test_library_templates_lowering_coverage():
+    """Most shipped Rego policies should compile to the TPU verdict path."""
+    tpu = TpuDriver()
+    rego_kinds = []
+    for path in sorted(glob.glob(f"{LIBRARY}/general/*/template.yaml")):
+        doc = load_yaml_file(path)[0]
+        t = ConstraintTemplate.from_unstructured(doc)
+        if not t.targets[0].rego:
+            continue  # CEL-engine library entries
+        rego_kinds.append(t.kind)
+        tpu.add_template(t)
+    lowered = set(tpu.lowered_kinds())
+    assert {"K8sHostNamespace", "K8sHostNetworkingPorts", "K8sBlockNodePort",
+            "K8sAllowedRepos", "K8sDisallowedTags", "K8sContainerLimits",
+            "K8sReplicaLimits"} <= lowered
+    # legitimately interpreter-bound: map-key/value iteration with regex
+    # (requiredlabels/annotations clause 2), dynamic field access by param
+    # (requiredprobes), referential data (uniqueingresshost)
+    assert len(lowered) * 2 >= len(rego_kinds), (
+        sorted(lowered), tpu.fallback_kinds()
+    )
+
+
+def test_bench_runs_on_library_sample():
+    objs = []
+    for f in ("template.yaml", "samples/constraint.yaml",
+              "samples/example_allowed.yaml",
+              "samples/example_disallowed.yaml"):
+        objs.extend(load_yaml_file(
+            os.path.join(LIBRARY, "general", "allowedrepos", f)))
+    r = run_bench(objs, "rego", iterations=3)
+    assert r.reviews_per_sec > 0
+    assert r.violations == 1
+    r_tpu = run_bench(objs, "tpu", iterations=2)
+    assert r_tpu.violations == 1
+
+
+def test_sync_requirements():
+    t = load_yaml_file(os.path.join(
+        LIBRARY, "general", "uniqueingresshost", "template.yaml"))[0]
+    missing = missing_requirements([t])
+    assert "k8suniqueingresshost" in missing
+    syncset = {
+        "apiVersion": "syncset.gatekeeper.sh/v1alpha1",
+        "kind": "SyncSet",
+        "metadata": {"name": "s"},
+        "spec": {"gvks": [{"group": "networking.k8s.io", "version": "v1",
+                           "kind": "Ingress"}]},
+    }
+    assert missing_requirements([t, syncset]) == {}
+    config = {
+        "apiVersion": "config.gatekeeper.sh/v1alpha1",
+        "kind": "Config",
+        "metadata": {"name": "config"},
+        "spec": {"sync": {"syncOnly": [
+            {"group": "networking.k8s.io", "version": "v1",
+             "kind": "Ingress"}]}},
+    }
+    assert missing_requirements([t, config]) == {}

@@ -342,3 +342,117 @@ violation[{"msg": "hostNetwork true"}] {
          "spec": {}},
     ]
     assert _verdicts(tpu, con, pods) == [1, 0, 0]
+
+
+def test_library_differential():
+    """Every library template (lowered or fallback) must agree with the
+    interpreter across a randomized object population."""
+    import os
+
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    lib = os.path.join(os.path.dirname(__file__), "..", "library", "general")
+    tpu = TpuDriver(batch_bucket=16)
+    constraints = []
+    for name in sorted(os.listdir(lib)):
+        tdoc = load_yaml_file(os.path.join(lib, name, "template.yaml"))[0]
+        t = ConstraintTemplate.from_unstructured(tdoc)
+        if not t.targets[0].rego:
+            continue
+        tpu.add_template(t)
+        cdoc = load_yaml_file(
+            os.path.join(lib, name, "samples", "constraint.yaml"))[0]
+        con = Constraint.from_unstructured(cdoc)
+        tpu.add_constraint(con)
+        constraints.append(con)
+
+    rng = random.Random(1234)
+
+    def rand_obj(i):
+        kind = rng.choice(["Pod", "Deployment", "Service", "Namespace"])
+        meta = {"name": f"o{i}", "namespace": rng.choice(
+            ["default", "prod", ""]) or None}
+        meta = {k: v for k, v in meta.items() if v}
+        if rng.random() < 0.5:
+            meta["labels"] = {
+                k: rng.choice(["user.agilebank.demo", "user", "x"])
+                for k in rng.sample(["owner", "app", "team"],
+                                    rng.randint(1, 3))
+            }
+        obj = {"apiVersion": "apps/v1" if kind == "Deployment" else "v1",
+               "kind": kind, "metadata": meta}
+        spec = {}
+        if kind in ("Pod",):
+            containers = []
+            for j in range(rng.randint(0, 3)):
+                c = {"name": f"c{j}",
+                     "image": rng.choice([
+                         "openpolicyagent/opa:0.9.2", "nginx",
+                         "nginx:latest", "repo/app:v1", "nginx:1.19",
+                     ])}
+                if rng.random() < 0.5:
+                    c["resources"] = {"limits": {
+                        "cpu": rng.choice(["100m", "500m", 1, "2"]),
+                        "memory": rng.choice(["512Mi", "2Gi", "64Mi"]),
+                    }}
+                if rng.random() < 0.2:
+                    del c["image"]
+                if rng.random() < 0.3:
+                    c["ports"] = [{"hostPort": rng.choice([79, 808, 9001])}]
+                containers.append(c)
+            spec["containers"] = containers
+            if rng.random() < 0.2:
+                spec["hostPID"] = True
+            if rng.random() < 0.2:
+                spec["hostNetwork"] = True
+        if kind == "Deployment":
+            if rng.random() < 0.8:
+                spec["replicas"] = rng.choice([1, 3, 50, 100])
+        if kind == "Service":
+            spec["type"] = rng.choice(["ClusterIP", "NodePort"])
+        obj["spec"] = spec
+        return obj
+
+    objects = [rand_obj(i) for i in range(300)]
+    target = K8sValidationTarget()
+    reviews = [target.handle_review(AugmentedUnstructured(object=o))
+               for o in objects]
+    got = tpu.query_batch(TARGET, constraints, reviews)
+    interp = tpu._interp
+    for oi, review in enumerate(reviews):
+        expected = []
+        for con in constraints:
+            if not target.to_matcher(con.match).match(review):
+                continue
+            expected.extend(interp.query(TARGET, [con], review).results)
+        key = lambda r: (r.constraint["metadata"]["name"], r.msg)
+        assert sorted(map(key, got[oi].results)) == sorted(
+            map(key, expected)), (
+            f"divergence on object {oi}: {objects[oi]}\n"
+            f"got={sorted(map(key, got[oi].results))}\n"
+            f"want={sorted(map(key, expected))}"
+        )
+
+
+def test_map_value_iteration_matches_interpreter():
+    """xs[_] over a MAP iterates values (flattener must enumerate dict
+    values, not return an empty axis)."""
+    tpu, con = _mini_driver("""
+package k8smapiter
+
+violation[{"msg": "sensitive volume"}] {
+  v := input.review.object.spec.volumes[_]
+  v.hostPath
+}
+""", "K8sMapIter")
+    assert "K8sMapIter" in tpu.lowered_kinds()
+    pods = [
+        # volumes as a MAP keyed by name (CRD-style): values iterated
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"volumes": {"cache": {"hostPath": {"path": "/tmp"}}}}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"volumes": [{"hostPath": {"path": "/x"}}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"volumes": {"data": {"emptyDir": {}}}}},
+    ]
+    assert _verdicts(tpu, con, pods) == [1, 1, 0]
