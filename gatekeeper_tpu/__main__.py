@@ -1,0 +1,150 @@
+"""Process entry: run the framework against a manifest directory.
+
+Reference: main.go — two deployment shapes share one binary, split by
+--operation (audit pod vs controller-manager/webhook pod,
+deploy/gatekeeper.yaml:5744,5852).  This entry reconciles manifests from
+--manifests into the systems, then serves the webhook and/or runs the audit
+loop:
+
+    python -m gatekeeper_tpu --manifests ./manifests \
+        --operation webhook --operation audit --port 8443
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu")
+    p.add_argument("--manifests", action="append", default=[],
+                   help="directory/file of templates, constraints, config, "
+                        "mutators, data objects")
+    p.add_argument("--operation", action="append", default=[],
+                   help="audit|webhook|mutation-webhook (repeatable; "
+                        "default all)")
+    p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--audit-interval", type=float, default=60.0)
+    p.add_argument("--constraint-violations-limit", type=int, default=20)
+    p.add_argument("--audit-chunk-size", type=int, default=500)
+    p.add_argument("--export-dir", default="",
+                   help="enable disk export of audit violations")
+    p.add_argument("--once", action="store_true",
+                   help="run one audit sweep and exit (no servers)")
+    args = p.parse_args(argv)
+
+    from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.controller.manager import ALL_OPERATIONS, Manager
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.export.system import ExportSystem
+    from gatekeeper_tpu.gator import reader
+    from gatekeeper_tpu.metrics.registry import MetricsRegistry
+    from gatekeeper_tpu.sync.source import FakeCluster, FileSource
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.webhook.mutation import MutationHandler
+    from gatekeeper_tpu.webhook.namespacelabel import NamespaceLabelHandler
+    from gatekeeper_tpu.webhook.policy import Batcher, ValidationHandler
+    from gatekeeper_tpu.webhook.server import WebhookServer
+
+    operations = args.operation or list(ALL_OPERATIONS)
+    metrics = MetricsRegistry()
+    tpu = TpuDriver()
+    client = Client(target=K8sValidationTarget(),
+                    drivers=[tpu, CELDriver()],
+                    enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
+    cluster = FakeCluster()
+    export = ExportSystem()
+    if args.export_dir:
+        export.upsert_connection("disk", "disk", {"path": args.export_dir})
+    mgr = Manager(client, cluster, operations=operations,
+                  export_system=export, metrics=metrics).start()
+
+    if args.manifests:
+        FileSource(*args.manifests).populate(cluster)
+    mgr.tracker.all_populated()
+
+    lowered = tpu.lowered_kinds()
+    print(f"templates: {len(client.templates())} "
+          f"({len(lowered)} on the TPU verdict path), "
+          f"constraints: {len(client.constraints())}", file=sys.stderr)
+
+    audit_mgr = None
+    if mgr.is_assigned("audit") or args.once:
+        from gatekeeper_tpu.parallel.sharded import (
+            ShardedEvaluator,
+            make_mesh,
+        )
+
+        evaluator = ShardedEvaluator(
+            tpu, make_mesh(),
+            violations_limit=args.constraint_violations_limit)
+        audit_mgr = AuditManager(
+            client,
+            lister=lambda: iter(cluster.list()),
+            config=AuditConfig(
+                interval_s=args.audit_interval,
+                violations_limit=args.constraint_violations_limit,
+                chunk_size=args.audit_chunk_size,
+            ),
+            evaluator=evaluator,
+            export_system=export if args.export_dir else None,
+        )
+
+    if args.once:
+        run = audit_mgr.audit()
+        total = sum(run.total_violations.values())
+        print(f"audit: {run.total_objects} objects, {total} violations "
+              f"in {run.duration_s:.2f}s", file=sys.stderr)
+        for key, kept in sorted(run.kept.items()):
+            for v in kept:
+                print(f"  {key[0]}/{key[1]}: {v.kind} "
+                      f"{v.namespace + '/' if v.namespace else ''}{v.name}: "
+                      f"{v.message}")
+        return 0
+
+    batcher = Batcher(client).start()
+    server = None
+    if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
+        server = WebhookServer(
+            validation_handler=ValidationHandler(
+                client,
+                expansion_system=mgr.expansion_system,
+                process_excluder=mgr.excluder,
+                namespace_lookup=lambda name: cluster.get(
+                    ("", "v1", "Namespace"), "", name),
+                batcher=batcher,
+            ) if mgr.is_assigned("webhook") else None,
+            mutation_handler=MutationHandler(
+                mgr.mutation_system,
+                namespace_lookup=lambda name: cluster.get(
+                    ("", "v1", "Namespace"), "", name),
+                process_excluder=mgr.excluder,
+            ) if mgr.is_assigned("mutation-webhook") else None,
+            namespace_label_handler=NamespaceLabelHandler(),
+            port=args.port,
+            readiness_check=mgr.tracker.satisfied,
+        ).start()
+        print(f"webhook serving on :{server.port}", file=sys.stderr)
+
+    try:
+        if audit_mgr is not None:
+            audit_mgr.run_forever()
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        batcher.stop()
+        if server:
+            server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""Reconciliation manager: cluster state → framework state.
+
+The reference wires 17 controllers over controller-runtime
+(pkg/controller/controller.go:178-293); the equivalents here subscribe to the
+cluster source and reconcile each resource family into its system:
+
+- ConstraintTemplate → client.add_template (+ dynamic constraint-kind watch,
+  mirroring constrainttemplate_controller.go:516) → constraints →
+  client.add_constraint
+- Config → process excluder + CacheManager.upsert_source (config_controller)
+- SyncSet → CacheManager.upsert_source (syncset_controller)
+- Assign/AssignMetadata/ModifySet/AssignImage → mutation system
+- ExpansionTemplate → expansion system
+- Provider → provider cache
+- Connection → export system
+
+Operation gating mirrors ``--operation`` pod sharding
+(pkg/operations/operations.go): a webhook pod runs no audit, the audit pod
+serves no admission — both reconcile the shared state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+from gatekeeper_tpu.apis.constraints import CONSTRAINTS_GROUP
+from gatekeeper_tpu.expansion.system import EXPANSION_GROUP, ExpansionSystem
+from gatekeeper_tpu.externaldata.providers import PROVIDER_GROUP, ProviderCache
+from gatekeeper_tpu.mutation.mutators import MUTATIONS_GROUP, MUTATOR_KINDS
+from gatekeeper_tpu.mutation.system import MutationSystem
+from gatekeeper_tpu.readiness.tracker import Tracker
+from gatekeeper_tpu.sync.cachemanager import CacheManager
+from gatekeeper_tpu.sync.process import ProcessExcluder
+from gatekeeper_tpu.sync.source import DELETED, Event, FakeCluster
+from gatekeeper_tpu.utils.unstructured import deep_get, gvk_of, name_of
+
+TEMPLATES_GVK = ("templates.gatekeeper.sh", "v1", "ConstraintTemplate")
+CONFIG_GVK = ("config.gatekeeper.sh", "v1alpha1", "Config")
+SYNCSET_GVK = ("syncset.gatekeeper.sh", "v1alpha1", "SyncSet")
+EXPANSION_GVK = (EXPANSION_GROUP, "v1alpha1", "ExpansionTemplate")
+PROVIDER_GVK = (PROVIDER_GROUP, "v1beta1", "Provider")
+CONNECTION_GVK = ("connection.gatekeeper.sh", "v1alpha1", "Connection")
+
+ALL_OPERATIONS = ("audit", "webhook", "mutation-webhook",
+                  "mutation-controller", "status", "generate")
+
+
+class Manager:
+    def __init__(
+        self,
+        client,
+        cluster: FakeCluster,
+        operations: Iterable[str] = ALL_OPERATIONS,
+        mutation_system: Optional[MutationSystem] = None,
+        expansion_system: Optional[ExpansionSystem] = None,
+        provider_cache: Optional[ProviderCache] = None,
+        export_system=None,
+        metrics=None,
+    ):
+        self.client = client
+        self.cluster = cluster
+        self.operations = set(operations)
+        self.tracker = Tracker()
+        self.excluder = ProcessExcluder()
+        self.provider_cache = provider_cache or ProviderCache()
+        self.mutation_system = mutation_system or MutationSystem(
+            provider_cache=self.provider_cache)
+        self.expansion_system = expansion_system or ExpansionSystem(
+            mutation_system=self.mutation_system)
+        self.export_system = export_system
+        self.metrics = metrics
+        self.cache_manager = CacheManager(
+            client, cluster, excluder=self.excluder,
+            readiness_tracker=self.tracker, metrics=metrics,
+        )
+        self._constraint_watches: dict[str, callable] = {}  # kind -> cancel
+        self._lock = threading.RLock()
+        self._template_errors: dict[str, str] = {}
+
+    def is_assigned(self, op: str) -> bool:
+        """Reference: operations.IsAssigned (operations.go:92)."""
+        return op in self.operations or "*" in self.operations
+
+    # --- boot (reference: readiness tracker seeding, ready_tracker.go:326)
+    def start(self) -> "Manager":
+        for obj in self.cluster.list(TEMPLATES_GVK):
+            self.tracker.expect("templates", name_of(obj))
+        self.tracker.populated("templates")
+        for gvk, kind in ((CONFIG_GVK, "config"),
+                          (EXPANSION_GVK, "expansions"),
+                          (PROVIDER_GVK, "providers")):
+            for obj in self.cluster.list(gvk):
+                self.tracker.expect(kind, name_of(obj))
+            self.tracker.populated(kind)
+        for gvk in [TEMPLATES_GVK, CONFIG_GVK, SYNCSET_GVK, EXPANSION_GVK,
+                    PROVIDER_GVK, CONNECTION_GVK]:
+            self.cluster.subscribe(gvk, self._dispatch, replay=True)
+        for mkind in MUTATOR_KINDS:
+            for version in ("v1", "v1beta1", "v1alpha1"):
+                self.cluster.subscribe((MUTATIONS_GROUP, version, mkind),
+                                       self._dispatch, replay=True)
+        self.tracker.populated("mutators")
+        # constraints tracked once their kinds exist; mark populated for the
+        # boot snapshot (dynamic watches will observe them)
+        self.tracker.populated("constraints")
+        self.tracker.populated("data")
+        return self
+
+    # --- dispatch -------------------------------------------------------
+    def _dispatch(self, event: Event) -> None:
+        group, _version, kind = gvk_of(event.obj)
+        try:
+            if (group, kind) == (TEMPLATES_GVK[0], TEMPLATES_GVK[2]):
+                self._reconcile_template(event)
+            elif group == CONSTRAINTS_GROUP:
+                self._reconcile_constraint(event)
+            elif (group, kind) == (CONFIG_GVK[0], CONFIG_GVK[2]):
+                self._reconcile_config(event)
+            elif (group, kind) == (SYNCSET_GVK[0], SYNCSET_GVK[2]):
+                self._reconcile_syncset(event)
+            elif group == MUTATIONS_GROUP and kind in MUTATOR_KINDS:
+                self._reconcile_mutator(event)
+            elif (group, kind) == (EXPANSION_GVK[0], EXPANSION_GVK[2]):
+                self._reconcile_expansion(event)
+            elif (group, kind) == (PROVIDER_GVK[0], PROVIDER_GVK[2]):
+                self._reconcile_provider(event)
+            elif (group, kind) == (CONNECTION_GVK[0], CONNECTION_GVK[2]):
+                self._reconcile_connection(event)
+        except Exception as e:  # reconcile errors surface via status
+            self._set_status(event.obj, error=str(e))
+
+    # --- per-family reconcilers ----------------------------------------
+    def _reconcile_template(self, event: Event) -> None:
+        name = name_of(event.obj)
+        if event.type == DELETED:
+            kind = deep_get(event.obj,
+                            ("spec", "crd", "spec", "names", "kind"), "")
+            if kind:
+                self.client.remove_template(kind)
+                cancel = self._constraint_watches.pop(kind, None)
+                if cancel:
+                    cancel()
+            return
+        try:
+            crd = self.client.add_template(event.obj)
+        except Exception as e:
+            # compile failure: cancel the readiness expectation
+            # (constrainttemplate_controller.go:391,484)
+            self.tracker.try_cancel("templates", name)
+            self._template_errors[name] = str(e)
+            self._set_status(event.obj, error=str(e))
+            return
+        self._template_errors.pop(name, None)
+        self.tracker.observe("templates", name)
+        if self.metrics is not None:
+            self.metrics.set_gauge("constraint_templates",
+                                   len(self.client.templates()), {})
+        kind = crd["spec"]["names"]["kind"]
+        with self._lock:
+            if kind not in self._constraint_watches:
+                # dynamic watch for the constraint kind
+                # (constrainttemplate_controller.go:516)
+                self._constraint_watches[kind] = self.cluster.subscribe(
+                    (CONSTRAINTS_GROUP, "v1beta1", kind), self._dispatch,
+                    replay=True,
+                )
+        self._set_status(event.obj, created=True)
+
+    def _reconcile_constraint(self, event: Event) -> None:
+        if event.type == DELETED:
+            self.client.remove_constraint(event.obj)
+        else:
+            self.client.add_constraint(event.obj)
+            self.tracker.observe(
+                "constraints",
+                (event.obj.get("kind", ""), name_of(event.obj)))
+        if self.metrics is not None:
+            self.metrics.set_gauge("constraints",
+                                   len(self.client.constraints()), {})
+
+    def _reconcile_config(self, event: Event) -> None:
+        name = name_of(event.obj)
+        # reference enforces the singleton name "config" (policy.go:489-494)
+        if name != "config":
+            self._set_status(event.obj, error="config name must be 'config'")
+            return
+        if event.type == DELETED:
+            self.cache_manager.remove_source(("config", name))
+            self.excluder.replace(ProcessExcluder())
+            return
+        match_entries = deep_get(event.obj, ("spec", "match"), []) or []
+        self.cache_manager.replace_excluder(
+            ProcessExcluder.from_config_match(match_entries))
+        gvks = []
+        for e in deep_get(event.obj, ("spec", "sync", "syncOnly"), []) or []:
+            gvks.append((e.get("group", ""), e.get("version", ""),
+                        e.get("kind", "")))
+        self.cache_manager.upsert_source(("config", name), gvks)
+        self.tracker.observe("config", name)
+
+    def _reconcile_syncset(self, event: Event) -> None:
+        name = name_of(event.obj)
+        if event.type == DELETED:
+            self.cache_manager.remove_source(("syncset", name))
+            return
+        gvks = []
+        for e in deep_get(event.obj, ("spec", "gvks"), []) or []:
+            gvks.append((e.get("group", ""), e.get("version", ""),
+                        e.get("kind", "")))
+        self.cache_manager.upsert_source(("syncset", name), gvks)
+
+    def _reconcile_mutator(self, event: Event) -> None:
+        from gatekeeper_tpu.mutation.mutators import MutatorID
+
+        _g, _v, kind = gvk_of(event.obj)
+        if event.type == DELETED:
+            self.mutation_system.remove(
+                MutatorID(kind=kind, name=name_of(event.obj)))
+        else:
+            self.mutation_system.upsert_unstructured(event.obj)
+            if self.metrics is not None:
+                self.metrics.inc_counter(
+                    "mutator_ingestion_count", {"status": "active"})
+                self.metrics.set_gauge(
+                    "mutator_conflicting_count",
+                    len(self.mutation_system.conflicts()), {})
+
+    def _reconcile_expansion(self, event: Event) -> None:
+        if event.type == DELETED:
+            self.expansion_system.remove_template(name_of(event.obj))
+        else:
+            self.expansion_system.upsert_template(event.obj)
+            self.tracker.observe("expansions", name_of(event.obj))
+
+    def _reconcile_provider(self, event: Event) -> None:
+        if event.type == DELETED:
+            self.provider_cache.remove(name_of(event.obj))
+        else:
+            self.provider_cache.upsert(event.obj)
+            self.tracker.observe("providers", name_of(event.obj))
+
+    def _reconcile_connection(self, event: Event) -> None:
+        if self.export_system is None:
+            return
+        if event.type == DELETED:
+            self.export_system.remove_connection(name_of(event.obj))
+        else:
+            self.export_system.upsert_connection_cr(event.obj)
+
+    # --- status (reference: per-pod *PodStatus CRs folded by status
+    # controllers; single-process equivalent writes .status directly) ----
+    def _set_status(self, obj: dict, error: Optional[str] = None,
+                    created: bool = False) -> None:
+        status = obj.setdefault("status", {})
+        by_pod = status.setdefault("byPod", [{}])
+        entry = by_pod[0]
+        entry["id"] = "gatekeeper-tpu-0"
+        entry["observedGeneration"] = deep_get(
+            obj, ("metadata", "generation"), 1)
+        if error is not None:
+            entry["errors"] = [{"message": error}]
+        else:
+            entry.pop("errors", None)
+        if created:
+            status["created"] = True
+
+    def template_error(self, name: str) -> Optional[str]:
+        return self._template_errors.get(name)

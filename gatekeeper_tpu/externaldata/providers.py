@@ -1,0 +1,157 @@
+"""External data providers: cache, validation, batched resolution.
+
+Reference: the framework's externaldata package + Provider CRD
+(main.go:420-458); mutation placeholder resolution batches per-provider
+calls with mTLS and a 5s timeout (mutation/system_external_data.go:21-221);
+responses may be TTL-cached.  The transport is pluggable (``send_fn``) so
+tests and offline runs need no network; the default transport posts the
+ExternalData ProviderRequest JSON over HTTPS.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from gatekeeper_tpu.utils.unstructured import deep_get, gvk_of, name_of
+
+PROVIDER_GROUP = "externaldata.gatekeeper.sh"
+
+
+class ProviderError(Exception):
+    pass
+
+
+@dataclass
+class Provider:
+    name: str
+    url: str
+    timeout_s: float = 5.0
+    ca_bundle: str = ""
+    raw: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_unstructured(obj: dict) -> "Provider":
+        group, _, kind = gvk_of(obj)
+        if kind != "Provider" or group != PROVIDER_GROUP:
+            raise ProviderError(f"not a Provider: {group}/{kind}")
+        name = name_of(obj)
+        spec = obj.get("spec") or {}
+        url = spec.get("url", "")
+        if not url:
+            raise ProviderError(f"provider {name}: missing spec.url")
+        if not url.startswith("https://"):
+            # reference: provider URLs must use HTTPS (webhook validation of
+            # Provider resources, policy.go:564-580)
+            raise ProviderError(f"provider {name}: url must be https")
+        if not spec.get("caBundle"):
+            raise ProviderError(f"provider {name}: caBundle required")
+        return Provider(
+            name=name,
+            url=url,
+            timeout_s=float(spec.get("timeout", 5) or 5),
+            ca_bundle=spec.get("caBundle", ""),
+            raw=obj,
+        )
+
+
+def default_send(provider: Provider, keys: list) -> dict:
+    """POST an ExternalData ProviderRequest (reference request shape)."""
+    import ssl
+    import urllib.request
+
+    body = json.dumps({
+        "apiVersion": "externaldata.gatekeeper.sh/v1beta1",
+        "kind": "ProviderRequest",
+        "request": {"keys": keys},
+    }).encode()
+    ctx = ssl.create_default_context()
+    req = urllib.request.Request(
+        provider.url, data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=provider.timeout_s,
+                                context=ctx) as resp:
+        return json.loads(resp.read())
+
+
+class ProviderCache:
+    """Provider registry + response TTL cache + batched resolution."""
+
+    def __init__(self, send_fn: Optional[Callable] = None,
+                 response_ttl_s: float = 180.0):
+        self._providers: dict[str, Provider] = {}
+        self._responses: dict[tuple, tuple] = {}  # (provider, key) -> (t, val)
+        self.send_fn = send_fn or default_send
+        self.response_ttl_s = response_ttl_s
+        self._lock = threading.Lock()
+
+    def upsert(self, obj_or_provider) -> Provider:
+        p = (obj_or_provider if isinstance(obj_or_provider, Provider)
+             else Provider.from_unstructured(obj_or_provider))
+        with self._lock:
+            self._providers[p.name] = p
+        return p
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    def get(self, name: str) -> Optional[Provider]:
+        return self._providers.get(name)
+
+    # --- resolution (reference: system_external_data.go) ----------------
+    def fetch(self, provider_name: str, keys: list) -> dict:
+        """Returns key -> (value, error-string-or-None); TTL-cached."""
+        provider = self._providers.get(provider_name)
+        if provider is None:
+            raise ProviderError(f"provider {provider_name!r} not found")
+        now = time.monotonic()
+        out: dict = {}
+        missing = []
+        with self._lock:
+            for key in keys:
+                hit = self._responses.get((provider_name, key))
+                if hit and now - hit[0] < self.response_ttl_s:
+                    out[key] = hit[1]
+                else:
+                    missing.append(key)
+        if missing:
+            resp = self.send_fn(provider, missing)
+            items = deep_get(resp, ("response", "items"), []) or []
+            system_error = deep_get(resp, ("response", "systemError"), "")
+            if system_error:
+                raise ProviderError(
+                    f"provider {provider_name}: {system_error}")
+            got = {}
+            for item in items:
+                got[item.get("key")] = (item.get("value"),
+                                        item.get("error") or None)
+            with self._lock:
+                for key in missing:
+                    value = got.get(key, (None, "key not returned"))
+                    self._responses[(provider_name, key)] = (now, value)
+                    out[key] = value
+        return out
+
+    def resolve(self, placeholder) -> Any:
+        """Resolve one mutation placeholder (failure policy semantics:
+        Fail | Ignore | UseDefault)."""
+        # ValueAtLocation: key = the pre-mutation value at the location;
+        # Username: key = the admission username (caller sets original_value)
+        key = placeholder.original_value
+        try:
+            result = self.fetch(placeholder.provider, [key])
+            value, err = result[key]
+            if err:
+                raise ProviderError(err)
+            return value
+        except Exception as e:
+            policy = placeholder.failure_policy
+            if policy == "UseDefault":
+                return placeholder.default
+            if policy == "Ignore":
+                return placeholder.original_value
+            raise

@@ -101,7 +101,9 @@ def run_bench(objs, engine: str, iterations: int) -> BenchResult:
     latencies = []
     violations = 0
 
-    if engine == "tpu":
+    if not reviews:
+        total_reviews = 0
+    elif engine == "tpu":
         # batched lane: one latency sample per batch pass over all objects
         client.review_batch(reviews, enforcement_point=GATOR_EP)  # warmup
         t_all0 = time.perf_counter()
@@ -120,11 +122,13 @@ def run_bench(objs, engine: str, iterations: int) -> BenchResult:
             client.review(rv, enforcement_point=GATOR_EP)
         t_all0 = time.perf_counter()
         for _ in range(iterations):
+            pass_violations = 0
             for rv in reviews:
                 t0 = time.perf_counter()
                 resp = client.review(rv, enforcement_point=GATOR_EP)
                 latencies.append((time.perf_counter() - t0) * 1000)
-            violations = sum(1 for _ in resp.results())
+                pass_violations += len(resp.results())
+            violations = pass_violations
         r.total_eval_s = time.perf_counter() - t_all0
         total_reviews = iterations * len(reviews)
 

@@ -17,26 +17,6 @@ import yaml
 from gatekeeper_tpu.gator import reader
 
 
-def _honor_jax_platforms_env():
-    """Pin jax to the platform named in JAX_PLATFORMS.
-
-    Some accelerator plugins (e.g. the axon TPU plugin in this image) prepend
-    themselves to jax_platforms regardless of the env var; an unreachable
-    accelerator would then hang every CLI run that touches the TPU driver.
-    """
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS", "")
-    if not want:
-        return
-    try:
-        import jax
-
-        jax.config.update("jax_platforms", want)
-    except Exception:
-        pass
-
-
 def _enforceable_failure(result) -> bool:
     # Reference: cmd/gator/test/test.go:245-255.
     if result.enforcement_action == "deny":
@@ -172,7 +152,7 @@ COMMANDS = {
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    _honor_jax_platforms_env()
+    # JAX_PLATFORMS honored at package import (gatekeeper_tpu/__init__.py)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: gator {test|verify|expand|bench|sync} [options]")
         return 0

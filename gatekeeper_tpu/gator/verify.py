@@ -209,30 +209,32 @@ def _run_case(client: Client, case: dict, base: str, expander_objs):
         inventory.extend(load_yaml_file(os.path.join(base, inv_path)))
     for obj in inventory:
         client.add_data(obj)
-    # namespaces resolved gator-style from object+inventory+expansion set
-    expander = Expander([under_test, *inventory, *expander_objs])
-    ns = expander.namespace_for(under_test)
-    responses = client.review(
-        AugmentedUnstructured(object=under_test, namespace=ns,
-                              source=SOURCE_ORIGINAL),
-        enforcement_point=GATOR_EP,
-    )
-    for resultant in expander.expand(under_test):
-        r_resp = client.review(
-            AugmentedUnstructured(object=resultant.obj, namespace=ns,
-                                  source=SOURCE_GENERATED),
+    try:
+        # namespaces resolved gator-style from object+inventory+expansion set
+        expander = Expander([under_test, *inventory, *expander_objs])
+        ns = expander.namespace_for(under_test)
+        responses = client.review(
+            AugmentedUnstructured(object=under_test, namespace=ns,
+                                  source=SOURCE_ORIGINAL),
             enforcement_point=GATOR_EP,
         )
-        from gatekeeper_tpu.expansion import aggregate
+        for resultant in expander.expand(under_test):
+            r_resp = client.review(
+                AugmentedUnstructured(object=resultant.obj, namespace=ns,
+                                      source=SOURCE_GENERATED),
+                enforcement_point=GATOR_EP,
+            )
+            from gatekeeper_tpu.expansion import aggregate
 
-        aggregate.override_enforcement_action(
-            resultant.enforcement_action, r_resp)
-        aggregate.aggregate_responses(resultant.template_name, responses,
-                                      r_resp)
-    # data added per case must not leak to the next case
-    for obj in inventory:
-        client.remove_data(obj)
-    return responses.results()
+            aggregate.override_enforcement_action(
+                resultant.enforcement_action, r_resp)
+            aggregate.aggregate_responses(resultant.template_name, responses,
+                                          r_resp)
+        return responses.results()
+    finally:
+        # per-case data must not leak to the next case, even on errors
+        for obj in inventory:
+            client.remove_data(obj)
 
 
 def print_result(sr: SuiteResult, out=sys.stdout) -> None:

@@ -380,8 +380,8 @@ class _Lowerer:
             return None, None
         t1, e1 = _assign_parts(s1)
         t2, e2 = _assign_parts(s2)
-        if t1 is None or t2 is None or not isinstance(t1, ast.Var) \
-                or t2.name != head:
+        if (t1 is None or t2 is None or not isinstance(t1, ast.Var)
+                or not isinstance(t2, ast.Var) or t2.name != head):
             return OpaqueVal("array comprehension shape")
         cenv = dict(env)
         elem = self._abstract(e1, cenv)

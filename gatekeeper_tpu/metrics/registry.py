@@ -1,0 +1,118 @@
+"""Metrics registry with Prometheus text exposition.
+
+Reference: pkg/metrics (OTel registry + prometheus exporter) and the
+per-subsystem reporters (webhook request count/duration, audit
+last_run_time/violations, constraint counts, sync gauges — names per
+website/docs/metrics.md).  Here: a dependency-free registry producing the
+Prometheus exposition format, served by the webhook server or scraped via
+``render()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+PREFIX = "gatekeeper_"
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._counters: dict = defaultdict(float)
+        self._gauges: dict = {}
+        self._hist: dict = defaultdict(list)  # (name, labels) -> durations
+        self._lock = threading.Lock()
+
+    # --- instruments --------------------------------------------------
+    def inc_counter(self, name: str, labels: Optional[dict] = None,
+                    value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[(name, _labels_key(labels))] += value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._gauges[(name, _labels_key(labels))] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._hist[(name, _labels_key(labels))].append(value)
+
+    def timed(self, name: str, labels: Optional[dict] = None):
+        registry = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.observe(name, time.perf_counter() - self.t0, labels)
+
+        return _Timer()
+
+    # --- exposition ----------------------------------------------------
+    def render(self) -> str:
+        """Prometheus text format (the prometheus exporter equivalent)."""
+        lines = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {PREFIX}{name} counter")
+                lines.append(f"{PREFIX}{name}{_fmt(labels)} {_num(v)}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {PREFIX}{name} gauge")
+                lines.append(f"{PREFIX}{name}{_fmt(labels)} {_num(v)}")
+            for (name, labels), vals in sorted(self._hist.items()):
+                lines.append(f"# TYPE {PREFIX}{name} summary")
+                count = len(vals)
+                total = sum(vals)
+                lines.append(
+                    f"{PREFIX}{name}_count{_fmt(labels)} {count}")
+                lines.append(
+                    f"{PREFIX}{name}_sum{_fmt(labels)} {_num(total)}")
+                for q in (0.5, 0.9, 0.99):
+                    sv = sorted(vals)
+                    idx = min(int(q * count), count - 1)
+                    ql = labels + (("quantile", str(q)),)
+                    lines.append(f"{PREFIX}{name}{_fmt(ql)} {_num(sv[idx])}")
+        return "\n".join(lines) + "\n"
+
+    def get_counter(self, name: str, labels: Optional[dict] = None) -> float:
+        return self._counters.get((name, _labels_key(labels)), 0.0)
+
+    def get_gauge(self, name: str, labels: Optional[dict] = None):
+        return self._gauges.get((name, _labels_key(labels)))
+
+
+def _fmt(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{%s}" % inner
+
+
+def _num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+# canonical metric names (reference: website/docs/metrics.md)
+REQUEST_COUNT = "validation_request_count"
+REQUEST_DURATION = "validation_request_duration_seconds"
+MUTATION_REQUEST_COUNT = "mutation_request_count"
+VIOLATIONS = "violations"
+AUDIT_DURATION = "audit_duration_seconds"
+AUDIT_LAST_RUN = "audit_last_run_time"
+AUDIT_LAST_RUN_END = "audit_last_run_end_time"
+CONSTRAINT_TEMPLATES = "constraint_templates"
+CONSTRAINTS = "constraints"
+MUTATOR_INGESTION = "mutator_ingestion_count"
+MUTATOR_CONFLICTS = "mutator_conflicting_count"
+SYNC = "sync"
+WATCH_GVKS = "watch_manager_watched_gvk"

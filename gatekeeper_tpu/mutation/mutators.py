@@ -121,9 +121,11 @@ class BaseMutator:
 
 
 class _AssignSetter(Setter):
-    def __init__(self, value: Any, assign_if: dict):
+    def __init__(self, value: Any, assign_if: dict,
+                 placeholder_factory=None):
         self.value = value
         self.assign_if = assign_if or {}
+        self.placeholder_factory = placeholder_factory
 
     def _gate(self, current: Any, exists: bool) -> bool:
         in_list = self.assign_if.get("in")
@@ -139,6 +141,18 @@ class _AssignSetter(Setter):
     def set_value(self, parent, key, current, exists):
         if not self._gate(current, exists):
             return None, False
+        if self.placeholder_factory is not None:
+            from gatekeeper_tpu.externaldata.placeholders import (
+                ExternalDataPlaceholder,
+            )
+
+            if isinstance(current, ExternalDataPlaceholder):
+                # already placed this iteration round: fixed point
+                return None, False
+            # external data: the placeholder carries the CURRENT value — for
+            # dataSource ValueAtLocation it becomes the provider key
+            # (system_external_data.go)
+            return self.placeholder_factory(current), True
         return copy.deepcopy(self.value), True
 
 
@@ -186,19 +200,25 @@ class AssignMutator(BaseMutator):
                 raise MutateError(
                     f"unknown fromMetadata field {self.from_metadata!r}"
                 )
+        placeholder_factory = None
         if self.external is not None:
             from gatekeeper_tpu.externaldata.placeholders import (
                 ExternalDataPlaceholder,
             )
 
-            value = ExternalDataPlaceholder(
-                provider=self.external.get("provider", ""),
-                data_source=self.external.get("dataSource", "ValueAtLocation"),
-                default=self.external.get("default"),
-                failure_policy=self.external.get("failurePolicy", "Fail"),
-                location=self.location,
-            )
-        setter = _AssignSetter(value, self.assign_if)
+            ext = self.external
+
+            def placeholder_factory(current):
+                return ExternalDataPlaceholder(
+                    provider=ext.get("provider", ""),
+                    data_source=ext.get("dataSource", "ValueAtLocation"),
+                    default=ext.get("default"),
+                    failure_policy=ext.get("failurePolicy", "Fail"),
+                    location=self.location,
+                    original_value=current,
+                )
+
+        setter = _AssignSetter(value, self.assign_if, placeholder_factory)
         return mutate(obj, self.path, setter, self.tester)
 
 

@@ -1,0 +1,93 @@
+"""Readiness tracker: expectation-vs-observation gating.
+
+Reference: pkg/readiness/ready_tracker.go — at boot, each tracked kind's
+existing objects become *expectations*; controllers *observe* as they ingest;
+``/readyz`` fails until every expectation is observed (or cancelled), so a
+restarting pod takes no webhook traffic with a cold policy cache.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Hashable
+
+
+class ObjectTracker:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._expected: set = set()
+        self._observed: set = set()
+        self._cancelled: set = set()
+        self._populated = False
+        self._lock = threading.Lock()
+
+    def expect(self, key: Hashable) -> None:
+        with self._lock:
+            if key not in self._cancelled:
+                self._expected.add(key)
+
+    def observe(self, key: Hashable) -> None:
+        with self._lock:
+            self._observed.add(key)
+
+    def try_cancel(self, key: Hashable) -> None:
+        """Unsatisfiable expectation (e.g. a template that fails to compile)
+        must not wedge readiness (reference: TryCancelTemplate,
+        constrainttemplate_controller.go:391)."""
+        with self._lock:
+            self._cancelled.add(key)
+            self._expected.discard(key)
+
+    def expectations_done(self) -> None:
+        with self._lock:
+            self._populated = True
+
+    def satisfied(self) -> bool:
+        with self._lock:
+            if not self._populated:
+                return False
+            return self._expected <= (self._observed | self._cancelled)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "expected": len(self._expected),
+                "observed": len(self._observed),
+                "cancelled": len(self._cancelled),
+                "populated": self._populated,
+            }
+
+
+class Tracker:
+    """Per-kind trackers + overall satisfaction (ready_tracker.go:63-128)."""
+
+    KINDS = ("templates", "constraints", "config", "data", "mutators",
+             "expansions", "providers")
+
+    def __init__(self):
+        self._trackers = {k: ObjectTracker(k) for k in self.KINDS}
+
+    def for_kind(self, kind: str) -> ObjectTracker:
+        return self._trackers[kind]
+
+    def expect(self, kind: str, key) -> None:
+        self._trackers[kind].expect(key)
+
+    def observe(self, kind: str, key) -> None:
+        self._trackers[kind].observe(key)
+
+    def try_cancel(self, kind: str, key) -> None:
+        self._trackers[kind].try_cancel(key)
+
+    def populated(self, kind: str) -> None:
+        self._trackers[kind].expectations_done()
+
+    def all_populated(self) -> None:
+        for t in self._trackers.values():
+            t.expectations_done()
+
+    def satisfied(self) -> bool:
+        return all(t.satisfied() for t in self._trackers.values())
+
+    def stats(self) -> dict:
+        return {k: t.stats() for k, t in self._trackers.items()}
