@@ -31,6 +31,10 @@ def main(argv=None) -> int:
     p.add_argument("--audit-chunk-size", type=int, default=500)
     p.add_argument("--export-dir", default="",
                    help="enable disk export of audit violations")
+    p.add_argument("--log-denies", action="store_true",
+                   help="log structured deny events (reference --log-denies)")
+    p.add_argument("--certs-dir", default="",
+                   help="serve TLS using (or generating) certs in this dir")
     p.add_argument("--once", action="store_true",
                    help="run one audit sweep and exit (no servers)")
     args = p.parse_args(argv)
@@ -92,7 +96,8 @@ def main(argv=None) -> int:
                 chunk_size=args.audit_chunk_size,
             ),
             evaluator=evaluator,
-            export_system=export if args.export_dir else None,
+            export_system=export,  # Connection CRs register here too
+            log_violations=args.log_denies,
         )
 
     if args.once:
@@ -110,6 +115,15 @@ def main(argv=None) -> int:
     batcher = Batcher(client).start()
     server = None
     if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
+        certfile = keyfile = None
+        if args.certs_dir:
+            from gatekeeper_tpu.webhook.certs import generate_certs
+            import os
+
+            if not os.path.exists(os.path.join(args.certs_dir, "tls.crt")):
+                generate_certs(args.certs_dir)
+            certfile = os.path.join(args.certs_dir, "tls.crt")
+            keyfile = os.path.join(args.certs_dir, "tls.key")
         server = WebhookServer(
             validation_handler=ValidationHandler(
                 client,
@@ -118,6 +132,7 @@ def main(argv=None) -> int:
                 namespace_lookup=lambda name: cluster.get(
                     ("", "v1", "Namespace"), "", name),
                 batcher=batcher,
+                log_denies=args.log_denies,
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,
@@ -127,6 +142,8 @@ def main(argv=None) -> int:
             ) if mgr.is_assigned("mutation-webhook") else None,
             namespace_label_handler=NamespaceLabelHandler(),
             port=args.port,
+            certfile=certfile,
+            keyfile=keyfile,
             readiness_check=mgr.tracker.satisfied,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)

@@ -82,6 +82,7 @@ class AuditManager:
         status_writer: Optional[Callable] = None,
         export_system=None,
         event_sink: Optional[Callable] = None,
+        log_violations: bool = False,
     ):
         self.client = client
         self.lister = lister
@@ -90,6 +91,7 @@ class AuditManager:
         self.status_writer = status_writer
         self.export_system = export_system
         self.event_sink = event_sink
+        self.log_violations = log_violations
         self._stop = threading.Event()
 
     # --- loop (reference: auditManagerLoop, manager.go:831) -------------
@@ -324,6 +326,12 @@ class AuditManager:
                 for v in violations:
                     self.export_system.publish_violation(run.timestamp, v)
             self.export_system.publish_audit_ended(run.timestamp)
+        if self.log_violations:
+            from gatekeeper_tpu.utils.logging import log_audit_violation
+
+            for violations in run.kept.values():
+                for v in violations:
+                    log_audit_violation(v, run.timestamp)
         if self.event_sink is not None:
             self.event_sink(run)
 

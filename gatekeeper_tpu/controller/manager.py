@@ -157,6 +157,12 @@ class Manager:
             self.metrics.set_gauge("constraint_templates",
                                    len(self.client.templates()), {})
         kind = crd["spec"]["names"]["kind"]
+        try:
+            self._manage_vap(event.obj, kind)
+        except Exception as e:
+            # VAP generation failure is a status condition, never a reconcile
+            # abort (the template stays live and its constraints watched)
+            self._set_status(event.obj, error=f"vap generation: {e}")
         with self._lock:
             if kind not in self._constraint_watches:
                 # dynamic watch for the constraint kind
@@ -175,6 +181,7 @@ class Manager:
             self.tracker.observe(
                 "constraints",
                 (event.obj.get("kind", ""), name_of(event.obj)))
+            self._manage_vapb(event.obj)
         if self.metrics is not None:
             self.metrics.set_gauge("constraints",
                                    len(self.client.constraints()), {})
@@ -187,7 +194,8 @@ class Manager:
             return
         if event.type == DELETED:
             self.cache_manager.remove_source(("config", name))
-            self.excluder.replace(ProcessExcluder())
+            # excluder reset must wipe + replay like any excluder change
+            self.cache_manager.replace_excluder(ProcessExcluder())
             return
         match_entries = deep_get(event.obj, ("spec", "match"), []) or []
         self.cache_manager.replace_excluder(
@@ -267,3 +275,40 @@ class Manager:
 
     def template_error(self, name: str) -> Optional[str]:
         return self._template_errors.get(name)
+
+    # --- VAP generation (reference: manageVAP at constrainttemplate_
+    # controller.go:503-524 + manageVAPB at constraint_controller.go:375;
+    # gated by generateVAP in the CEL source) ---------------------------
+    def _cel_driver(self):
+        for d in self.client.drivers:
+            if hasattr(d, "template_to_vap"):
+                return d
+        return None
+
+    def _manage_vap(self, template_obj: dict, kind: str) -> None:
+        driver = self._cel_driver()
+        if driver is None:
+            return
+        compiled = getattr(driver, "_templates", {}).get(kind)
+        if compiled is None or not getattr(compiled, "generate_vap", False):
+            return
+        from gatekeeper_tpu.apis.templates import ConstraintTemplate
+
+        t = ConstraintTemplate.from_unstructured(template_obj)
+        self.cluster.apply(driver.template_to_vap(t))
+
+    def _manage_vapb(self, constraint_obj: dict) -> None:
+        driver = self._cel_driver()
+        if driver is None:
+            return
+        kind = constraint_obj.get("kind", "")
+        compiled = getattr(driver, "_templates", {}).get(kind)
+        if compiled is None or not getattr(compiled, "generate_vap", False):
+            return
+        from gatekeeper_tpu.apis.constraints import Constraint
+
+        template = self.client.get_template(kind)
+        if template is None:
+            return
+        con = Constraint.from_unstructured(constraint_obj)
+        self.cluster.apply(driver.constraint_to_vap_binding(con, template))

@@ -60,6 +60,7 @@ class Provider:
 
 def default_send(provider: Provider, keys: list) -> dict:
     """POST an ExternalData ProviderRequest (reference request shape)."""
+    import base64
     import ssl
     import urllib.request
 
@@ -68,7 +69,11 @@ def default_send(provider: Provider, keys: list) -> dict:
         "kind": "ProviderRequest",
         "request": {"keys": keys},
     }).encode()
-    ctx = ssl.create_default_context()
+    # the provider's private CA (spec.caBundle, required by validation) must
+    # anchor the TLS verification
+    ctx = ssl.create_default_context(
+        cadata=base64.b64decode(provider.ca_bundle).decode()
+    )
     req = urllib.request.Request(
         provider.url, data=body,
         headers={"Content-Type": "application/json"})

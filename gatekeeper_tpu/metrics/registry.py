@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Optional
+
+_HIST_WINDOW = 4096  # bounded reservoir per series (webhook hot path)
 
 PREFIX = "gatekeeper_"
 
@@ -26,7 +28,10 @@ class MetricsRegistry:
     def __init__(self):
         self._counters: dict = defaultdict(float)
         self._gauges: dict = {}
-        self._hist: dict = defaultdict(list)  # (name, labels) -> durations
+        self._hist: dict = defaultdict(
+            lambda: {"count": 0, "sum": 0.0,
+                     "window": deque(maxlen=_HIST_WINDOW)}
+        )
         self._lock = threading.Lock()
 
     # --- instruments --------------------------------------------------
@@ -43,7 +48,10 @@ class MetricsRegistry:
     def observe(self, name: str, value: float,
                 labels: Optional[dict] = None) -> None:
         with self._lock:
-            self._hist[(name, _labels_key(labels))].append(value)
+            h = self._hist[(name, _labels_key(labels))]
+            h["count"] += 1
+            h["sum"] += value
+            h["window"].append(value)
 
     def timed(self, name: str, labels: Optional[dict] = None):
         registry = self
@@ -69,19 +77,19 @@ class MetricsRegistry:
             for (name, labels), v in sorted(self._gauges.items()):
                 lines.append(f"# TYPE {PREFIX}{name} gauge")
                 lines.append(f"{PREFIX}{name}{_fmt(labels)} {_num(v)}")
-            for (name, labels), vals in sorted(self._hist.items()):
+            for (name, labels), h in sorted(self._hist.items()):
                 lines.append(f"# TYPE {PREFIX}{name} summary")
-                count = len(vals)
-                total = sum(vals)
                 lines.append(
-                    f"{PREFIX}{name}_count{_fmt(labels)} {count}")
+                    f"{PREFIX}{name}_count{_fmt(labels)} {h['count']}")
                 lines.append(
-                    f"{PREFIX}{name}_sum{_fmt(labels)} {_num(total)}")
-                for q in (0.5, 0.9, 0.99):
-                    sv = sorted(vals)
-                    idx = min(int(q * count), count - 1)
-                    ql = labels + (("quantile", str(q)),)
-                    lines.append(f"{PREFIX}{name}{_fmt(ql)} {_num(sv[idx])}")
+                    f"{PREFIX}{name}_sum{_fmt(labels)} {_num(h['sum'])}")
+                sv = sorted(h["window"])  # quantiles over the recent window
+                if sv:
+                    for q in (0.5, 0.9, 0.99):
+                        idx = min(int(q * len(sv)), len(sv) - 1)
+                        ql = labels + (("quantile", str(q)),)
+                        lines.append(
+                            f"{PREFIX}{name}{_fmt(ql)} {_num(sv[idx])}")
         return "\n".join(lines) + "\n"
 
     def get_counter(self, name: str, labels: Optional[dict] = None) -> float:

@@ -30,6 +30,7 @@ class CacheManager:
         self.metrics = metrics
         self.aggregator = GVKAggregator()
         self._cancels: dict[tuple, callable] = {}  # gvk -> unsubscribe
+        self._synced: set = set()  # keys of objects in the inventory
         self._lock = threading.RLock()
 
     # --- sources (reference: UpsertSource cachemanager.go:139) ----------
@@ -59,24 +60,27 @@ class CacheManager:
     def _on_event(self, event: Event) -> None:
         obj = event.obj
         ns = namespace_of(obj)
+        key = _obj_key(obj)
         if event.type == DELETED:
             self.client.remove_data(obj)
+            self._synced.discard(key)
         else:
             if ns and self.excluder.is_excluded("sync", ns):
                 # excluded namespaces never reach the eval-plane inventory
                 self.client.remove_data(obj)
+                self._synced.discard(key)
                 return
             self.client.add_data(obj)
+            self._synced.add(key)
             if self.readiness_tracker is not None:
-                self.readiness_tracker.observe("data", _obj_key(obj))
+                self.readiness_tracker.observe("data", key)
         if self.metrics is not None:
-            self.metrics.set_gauge(
-                "sync_objects", len(self.cluster.list()), {}
-            )
+            self.metrics.set_gauge("sync", len(self._synced), {})
 
     def _remove_gvk_data(self, gvk: tuple) -> None:
         for obj in self.cluster.list(gvk):
             self.client.remove_data(obj)
+            self._synced.discard(_obj_key(obj))
 
     # --- excluder swap (reference: wipeCacheIfNeeded + replay) ----------
     def replace_excluder(self, new_excluder: ProcessExcluder) -> None:

@@ -1,0 +1,79 @@
+"""Self-signed serving certificates for the webhook.
+
+Reference: the external ``open-policy-agent/cert-controller`` module
+(go.mod:17, wired at main.go:288-315) generates a CA + serving cert, stores
+them in a secret, and injects the CA bundle into webhook configurations.
+Here: openssl-based generation of a CA and a SAN'd serving cert; the CA PEM
+doubles as the ``caBundle`` for a ValidatingWebhookConfiguration.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import subprocess
+import tempfile
+
+
+class CertError(Exception):
+    pass
+
+
+def generate_certs(out_dir: str, service: str = "gatekeeper-webhook-service",
+                   namespace: str = "gatekeeper-system",
+                   days: int = 3650) -> dict:
+    """Returns paths: {ca, cert, key} plus the base64 caBundle."""
+    os.makedirs(out_dir, exist_ok=True)
+    ca_key = os.path.join(out_dir, "ca.key")
+    ca_crt = os.path.join(out_dir, "ca.crt")
+    srv_key = os.path.join(out_dir, "tls.key")
+    srv_csr = os.path.join(out_dir, "tls.csr")
+    srv_crt = os.path.join(out_dir, "tls.crt")
+    cn = f"{service}.{namespace}.svc"
+    san = (f"subjectAltName=DNS:{service},DNS:{service}.{namespace},"
+           f"DNS:{cn},DNS:{cn}.cluster.local,DNS:localhost,IP:127.0.0.1")
+
+    def run(*cmd):
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise CertError(f"{' '.join(cmd[:3])}...: {proc.stderr.strip()}")
+
+    run("openssl", "genrsa", "-out", ca_key, "2048")
+    run("openssl", "req", "-x509", "-new", "-nodes", "-key", ca_key,
+        "-subj", "/CN=gatekeeper-ca", "-days", str(days), "-out", ca_crt)
+    run("openssl", "genrsa", "-out", srv_key, "2048")
+    run("openssl", "req", "-new", "-key", srv_key, "-subj", f"/CN={cn}",
+        "-addext", san, "-out", srv_csr)
+    with tempfile.NamedTemporaryFile("w", suffix=".cnf", delete=False) as f:
+        f.write(san + "\n")
+        ext = f.name
+    try:
+        run("openssl", "x509", "-req", "-in", srv_csr, "-CA", ca_crt,
+            "-CAkey", ca_key, "-CAcreateserial", "-days", str(days),
+            "-extfile", ext, "-out", srv_crt)
+    finally:
+        os.unlink(ext)
+    with open(ca_crt, "rb") as f:
+        ca_bundle = base64.b64encode(f.read()).decode()
+    return {"ca": ca_crt, "cert": srv_crt, "key": srv_key,
+            "ca_bundle": ca_bundle}
+
+
+def webhook_configuration(ca_bundle: str, url: str) -> dict:
+    """A ValidatingWebhookConfiguration pointing at this server with the CA
+    injected (the cert-controller's CABundle injection equivalent)."""
+    return {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": "gatekeeper-validating-webhook-configuration"},
+        "webhooks": [{
+            "name": "validation.gatekeeper.sh",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "failurePolicy": "Ignore",
+            "clientConfig": {"url": url, "caBundle": ca_bundle},
+            "rules": [{"apiGroups": ["*"], "apiVersions": ["*"],
+                       "operations": ["CREATE", "UPDATE", "DELETE"],
+                       "resources": ["*"]}],
+        }],
+    }

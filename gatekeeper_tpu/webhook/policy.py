@@ -157,6 +157,15 @@ class ValidationHandler:
 
         denies, warns = self._partition(responses)
         warns = warns + expansion_warnings
+        if self.log_denies and denies:
+            from gatekeeper_tpu.utils.logging import log_deny
+
+            for result in responses.results():
+                actions = (result.scoped_enforcement_actions
+                           if result.enforcement_action == "scoped"
+                           else [result.enforcement_action])
+                if "deny" in actions:
+                    log_deny(result, req)
         if denies:
             msg = "\n".join(denies)
             resp = ValidationResponse(

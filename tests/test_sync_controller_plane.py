@@ -240,3 +240,57 @@ def test_provider_cache_and_placeholders():
     ph = ExternalDataPlaceholder(provider="p", failure_policy="UseDefault",
                                  default="dflt")
     assert cache2.resolve(ph) == "dflt"
+
+
+def test_vap_generation_through_manager():
+    """CEL templates with generateVAP produce VAP + VAPB objects in the
+    cluster (reference: manageVAP/manageVAPB controllers)."""
+    client, cluster, mgr = make_manager()
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+
+    client.drivers.append(CELDriver())
+    cluster.apply({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8svaptest"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sVapTest"}}},
+            "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                         "code": [{"engine": "K8sNativeValidation",
+                                   "source": {
+                                       "generateVAP": True,
+                                       "validations": [{
+                                           "expression": "object != null",
+                                           "message": "m"}],
+                                   }}]}],
+        },
+    })
+    cluster.apply({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sVapTest", "metadata": {"name": "vap-c"}, "spec": {},
+    })
+    vaps = cluster.list(("admissionregistration.k8s.io", "v1",
+                         "ValidatingAdmissionPolicy"))
+    vapbs = cluster.list(("admissionregistration.k8s.io", "v1",
+                          "ValidatingAdmissionPolicyBinding"))
+    assert len(vaps) == 1 and vaps[0]["metadata"]["name"] == \
+        "gatekeeper-k8svaptest"
+    assert len(vapbs) == 1 and vapbs[0]["spec"]["policyName"] == \
+        "gatekeeper-k8svaptest"
+
+
+def test_webhook_certs(tmp_path):
+    import ssl
+    import subprocess
+
+    from gatekeeper_tpu.webhook.certs import generate_certs
+
+    out = generate_certs(str(tmp_path))
+    assert out["ca_bundle"]
+    # the serving cert verifies against the CA
+    proc = subprocess.run(
+        ["openssl", "verify", "-CAfile", out["ca"], out["cert"]],
+        capture_output=True, text=True)
+    assert "OK" in proc.stdout
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(out["cert"], out["key"])  # loads without error
