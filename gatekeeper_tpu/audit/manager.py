@@ -125,6 +125,10 @@ class AuditManager:
         kept: dict = {(c.kind, c.name): [] for c in constraints}
         totals: dict = {(c.kind, c.name): 0 for c in constraints}
 
+        # pipelined chunking: while the device evaluates chunk N, the host
+        # lists + flattens + dispatches chunk N+1 (jit dispatch is async);
+        # the fetch for N happens only when N+1 is in flight
+        pending = None  # (submitted, objects)
         chunk: list[dict] = []
         for obj in self.lister():
             if kind_filter is not None:
@@ -134,10 +138,15 @@ class AuditManager:
             chunk.append(obj)
             run.total_objects += 1
             if len(chunk) >= self.config.chunk_size:
-                self._audit_chunk(chunk, constraints, kept, totals, limit)
+                pending = self._pipeline_step(
+                    pending, chunk, constraints, kept, totals, limit)
                 chunk = []
         if chunk:
-            self._audit_chunk(chunk, constraints, kept, totals, limit)
+            pending = self._pipeline_step(
+                pending, chunk, constraints, kept, totals, limit)
+        if pending is not None:
+            self._pipeline_step(pending, None, constraints, kept, totals,
+                                limit)
 
         run.total_violations = totals
         run.kept = kept
@@ -162,6 +171,35 @@ class AuditManager:
         return kinds
 
     # --- chunk evaluation ------------------------------------------------
+    def _pipeline_step(self, pending, next_chunk, constraints, kept, totals,
+                       limit):
+        """Submit ``next_chunk`` to the device, then process the previous
+        chunk's results (which overlapped with this submission).  Without an
+        evaluator, falls back to synchronous per-chunk processing."""
+        batch_driver = next(
+            (d for d in self.client.drivers if hasattr(d, "query_batch")),
+            None,
+        )
+        if self.evaluator is None or batch_driver is None:
+            # no device path: synchronous per-chunk interpreter processing
+            if next_chunk:
+                self._audit_chunk(next_chunk, constraints, kept, totals,
+                                  limit)
+            return None
+        submitted = None
+        if next_chunk:
+            submitted = (
+                self.evaluator.sweep_submit(
+                    constraints, next_chunk,
+                    return_bits=self.config.exact_totals),
+                next_chunk,
+            )
+        if pending is not None:
+            swept = self.evaluator.sweep_collect(pending[0])
+            self._process_swept(swept, pending[1], constraints, kept, totals,
+                                limit)
+        return submitted
+
     def _audit_chunk(self, objects, constraints, kept, totals, limit):
         target = self.client.target
         reviews = None
@@ -177,51 +215,12 @@ class AuditManager:
                 ]
             return reviews
 
-        driver = None
-        for d in self.client.drivers:
-            if hasattr(d, "query_batch"):
-                driver = d
-                break
-
-        if self.evaluator is not None and driver is not None:
-            exact = self.config.exact_totals
-            swept = self.evaluator.sweep(constraints, objects,
-                                         return_bits=exact)
-            n_obj = len(objects)
-            for kind, (cons, idx, valid, ccounts, bits) in swept.items():
-                for ci, con in enumerate(cons):
-                    key = con.key()
-                    if exact and bits is not None:
-                        hit_idx = np.nonzero(
-                            np.unpackbits(bits[ci], count=n_obj)
-                        )[0]
-                        for oi in hit_idx.tolist():
-                            totals[key] += self._render_kept(
-                                driver, con, objects[oi],
-                                get_reviews()[oi], kept[key], limit
-                            )
-                    else:
-                        totals[key] += int(ccounts[ci])
-                        for j in range(idx.shape[1]):
-                            if not valid[ci, j] or len(kept[key]) >= limit:
-                                continue
-                            oi = int(idx[ci, j])
-                            self._render_kept(
-                                driver, con, objects[oi], get_reviews()[oi],
-                                kept[key], limit
-                            )
-            # fallback kinds through the exact engine
-            fallback_cons = [
-                c for c in constraints
-                if c.kind in driver.fallback_kinds()
-            ]
-            if fallback_cons:
-                self._chunk_via_query_batch(
-                    driver, fallback_cons, objects, get_reviews(), kept,
-                    totals, limit
-                )
-            return
-
+        driver = next(
+            (d for d in self.client.drivers if hasattr(d, "query_batch")),
+            None,
+        )
+        # (the evaluator path goes through _pipeline_step/_process_swept;
+        # this method handles the no-evaluator fallbacks only)
         if driver is not None:
             self._chunk_via_query_batch(
                 driver, constraints, objects, get_reviews(), kept, totals,
@@ -245,6 +244,63 @@ class AuditManager:
                     if len(kept[key]) < limit:
                         kept[key].append(self._violation(con, obj, r.msg,
                                                          r.details))
+
+    def _process_swept(self, swept, objects, constraints, kept, totals,
+                       limit):
+        """Fold one chunk's device results into the run state and run the
+        fallback kinds through the exact engine."""
+        target = self.client.target
+        driver = next(
+            (d for d in self.client.drivers if hasattr(d, "query_batch")),
+            None,
+        )
+        reviews = None
+
+        def get_reviews():
+            nonlocal reviews
+            if reviews is None:
+                reviews = [
+                    target.handle_review(
+                        AugmentedUnstructured(object=o,
+                                              source=SOURCE_ORIGINAL)
+                    )
+                    for o in objects
+                ]
+            return reviews
+
+        exact = self.config.exact_totals
+        n_obj = len(objects)
+        for kind, (cons, idx, valid, ccounts, bits) in swept.items():
+            for ci, con in enumerate(cons):
+                key = con.key()
+                if exact and bits is not None:
+                    hit_idx = np.nonzero(
+                        np.unpackbits(bits[ci], count=n_obj)
+                    )[0]
+                    for oi in hit_idx.tolist():
+                        totals[key] += self._render_kept(
+                            driver, con, objects[oi],
+                            get_reviews()[oi], kept[key], limit
+                        )
+                else:
+                    totals[key] += int(ccounts[ci])
+                    for j in range(idx.shape[1]):
+                        if not valid[ci, j] or len(kept[key]) >= limit:
+                            continue
+                        oi = int(idx[ci, j])
+                        self._render_kept(
+                            driver, con, objects[oi], get_reviews()[oi],
+                            kept[key], limit
+                        )
+        fallback_cons = [
+            c for c in constraints
+            if c.kind in driver.fallback_kinds()
+        ]
+        if fallback_cons:
+            self._chunk_via_query_batch(
+                driver, fallback_cons, objects, get_reviews(), kept,
+                totals, limit
+            )
 
     def _chunk_via_query_batch(self, driver, constraints, objects, reviews,
                                kept, totals, limit):

@@ -103,6 +103,18 @@ def topk_violations(verdicts: jnp.ndarray, k: int) -> tuple:
     return top_idx, top_scores > 0
 
 
+class _PendingSweep:
+    __slots__ = ("result", "kinds", "offsets", "by_kind", "n", "return_bits")
+
+    def __init__(self, result, kinds, offsets, by_kind, n, return_bits):
+        self.result = result
+        self.kinds = kinds
+        self.offsets = offsets
+        self.by_kind = by_kind
+        self.n = n
+        self.return_bits = return_bits
+
+
 class ShardedEvaluator:
     """Runs a TpuDriver's compiled programs over a device mesh.
 
@@ -160,6 +172,15 @@ class ShardedEvaluator:
         None.  Fallback (non-lowered) kinds are handled by the caller via
         driver.query_batch; this path is the mass-scan for lowered kinds.
         """
+        return self.sweep_collect(
+            self.sweep_submit(constraints, objects, return_bits))
+
+    def sweep_submit(self, constraints: Sequence, objects: Sequence[dict],
+                     return_bits: bool = False):
+        """Flatten + dispatch without fetching: jit dispatch is async, so
+        the caller can flatten/submit the NEXT chunk while the device works
+        (the pipeline-parallel fix for the reference's fully-sequential
+        spill-review loop, SURVEY.md §2.9)."""
         by_kind: dict[str, list] = {}
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
@@ -221,24 +242,35 @@ class ShardedEvaluator:
         result = self._sweep_fn(kinds, k, return_bits)(
             tuple(tables), sharded_cols, mask_dev
         )
-        if return_bits:
-            packed_np = np.asarray(result[0])
-            bits_np = np.asarray(result[1])
+        return _PendingSweep(result, kinds, offsets, by_kind, n, return_bits)
+
+    def sweep_collect(self, pending):
+        """Fetch + unpack a submitted sweep (the single device->host
+        transfer)."""
+        if pending is None:
+            return {}
+        if isinstance(pending, dict):  # empty submit
+            return pending
+        if pending.return_bits:
+            packed_np = np.asarray(pending.result[0])
+            bits_np = np.asarray(pending.result[1])
         else:
-            packed_np = np.asarray(result)  # the single device->host fetch
+            packed_np = np.asarray(pending.result)
             bits_np = None
 
         # top_k clamps k to the padded batch width; recover the effective k
         # from the packed layout [idx(k') | valid(k') | count]
         k_eff = (packed_np.shape[1] - 1) // 2
+        n = pending.n
         out = {}
-        for kind in kinds:
-            lo, hi = offsets[kind]
+        for kind in pending.kinds:
+            lo, hi = pending.offsets[kind]
             idx_np = packed_np[lo:hi, :k_eff]
             valid_np = (packed_np[lo:hi, k_eff: 2 * k_eff] != 0) & (idx_np < n)
             counts_np = packed_np[lo:hi, 2 * k_eff]
             kb = bits_np[lo:hi] if bits_np is not None else None
-            out[kind] = (by_kind[kind], idx_np, valid_np, counts_np, kb)
+            out[kind] = (pending.by_kind[kind], idx_np, valid_np, counts_np,
+                         kb)
         return out
 
     def _pad(self, n: int) -> int:

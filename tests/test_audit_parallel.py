@@ -160,3 +160,54 @@ def test_kept_respects_limit_with_multi_result_objects():
     ).audit()
     assert run.total_violations[key] == 12  # all results counted
     assert len(run.kept[key]) == 5  # but kept hard-capped at the limit
+
+
+def test_pipelined_chunks_match_synchronous():
+    """The pipelined chunk loop (submit N+1 before collecting N) must
+    produce identical totals/kept as single-chunk processing."""
+    client, tpu = build_client()
+    pods = make_pods(500)
+    mesh = make_mesh(4)
+    run_small_chunks = AuditManager(
+        client, lister=lambda: iter(pods),
+        config=AuditConfig(chunk_size=64, violations_limit=7),
+        evaluator=ShardedEvaluator(tpu, mesh, violations_limit=7),
+    ).audit()
+    run_one_chunk = AuditManager(
+        client, lister=lambda: iter(pods),
+        config=AuditConfig(chunk_size=100000, violations_limit=7),
+        evaluator=ShardedEvaluator(tpu, mesh, violations_limit=7),
+    ).audit()
+    assert run_small_chunks.total_violations == run_one_chunk.total_violations
+    for key in run_one_chunk.kept:
+        assert (
+            [v.name for v in run_small_chunks.kept[key]]
+            == [v.name for v in run_one_chunk.kept[key]]
+        )
+
+
+def test_evaluator_without_batch_driver_falls_back():
+    """An evaluator without any query_batch-capable driver must fall back to
+    the interpreter loop instead of crashing."""
+    from gatekeeper_tpu.drivers.rego_driver import RegoDriver
+
+    client = Client(target=K8sValidationTarget(), drivers=[RegoDriver()],
+                    enforcement_points=["audit.gatekeeper.sh"])
+    client.add_template(_load(
+        "/root/reference/demo/basic/templates/"
+        "k8srequiredlabels_template.yaml"))
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": ["owner"]}},
+    })
+    tpu_elsewhere = TpuDriver()  # an evaluator whose driver isn't registered
+    mgr = AuditManager(
+        client, lister=lambda: iter(make_pods(20)),
+        config=AuditConfig(chunk_size=8),
+        evaluator=ShardedEvaluator(tpu_elsewhere, make_mesh(2)),
+    )
+    run = mgr.audit()
+    assert run.total_objects == 20
+    assert run.total_violations[("K8sRequiredLabels", "need-owner")] > 0
