@@ -133,6 +133,7 @@ def main(argv=None) -> int:
                     ("", "v1", "Namespace"), "", name),
                 batcher=batcher,
                 log_denies=args.log_denies,
+                metrics=metrics,
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,
@@ -145,6 +146,7 @@ def main(argv=None) -> int:
             certfile=certfile,
             keyfile=keyfile,
             readiness_check=mgr.tracker.satisfied,
+            metrics=metrics,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
 

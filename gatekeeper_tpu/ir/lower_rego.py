@@ -698,6 +698,16 @@ class _Lowerer:
                     if op == "neq":
                         pred = N.Not(pred)
                     return pred, paxis
+        def _is_feature(v):
+            return isinstance(v, (PathVal, ItemVal)) or (
+                isinstance(v, StrFnVal)
+                and isinstance(v.inner, (PathVal, ItemVal))
+            )
+
+        if _is_feature(lhs) and _is_feature(rhs):
+            # feature-to-feature: exact semantics would need lexical string
+            # order / composite comparison on device — interpreter fallback
+            raise LowerError("feature-to-feature comparison")
         str_side = self._is_stringy(lhs) or self._is_stringy(rhs)
         if str_side:
             if op not in ("equal", "neq"):

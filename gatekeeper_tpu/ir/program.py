@@ -37,6 +37,23 @@ from gatekeeper_tpu.ops.flatten import (
     round_up,
 )
 
+# Rego term-order rank per kind tag (value.py _TYPE_ORDER): null < bool <
+# number < string < composites.  Indexed by kind tag (absent -> -1
+# sentinel); numpy so importing this module never initializes a backend.
+_RANK_BY_KIND = np.asarray([-1, 1, 1, 2, 3, 6, 0], np.int8)
+
+
+def _py_rank(v) -> int:
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 1
+    if isinstance(v, (int, float)):
+        return 2
+    if isinstance(v, str):
+        return 3
+    return 6
+
 
 def col_key(spec) -> str:
     """Stable string key for a column spec (jit pytrees need sortable dict
@@ -207,6 +224,10 @@ def strtab_key(op: str, needle) -> str:
     return f"{base}__strtab_{op}{xf}"
 
 
+def p_has(params: dict, name: str) -> bool:
+    return name in params
+
+
 def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
     """Pack constraint parameters into arrays [C, ...] for vmap.
 
@@ -232,9 +253,16 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
             table[f"{spec.name}__num"] = jnp.asarray(
                 [float(v) if isinstance(v, (int, float)) and not isinstance(v, bool)
                  else 0.0 for v in vals], jnp.float32)
-            table[f"{spec.name}__present"] = jnp.asarray(
+            table[f"{spec.name}__isnum"] = jnp.asarray(
                 [isinstance(v, (int, float)) and not isinstance(v, bool)
                  for v in vals], jnp.bool_)
+            # parameters keep full term-order info: a string-valued "numeric"
+            # parameter still participates in Rego's total ordering
+            table[f"{spec.name}__present"] = jnp.asarray(
+                [p_has(params_by_con[i], spec.name) for i in range(c)],
+                jnp.bool_)
+            table[f"{spec.name}__rank"] = jnp.asarray(
+                [_py_rank(v) for v in vals], jnp.int8)
         elif spec.kind == "str":
             table[f"{spec.name}__sid"] = jnp.asarray(
                 [vocab.intern(v) if isinstance(v, str) else -2 for v in vals],
@@ -281,26 +309,39 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 dotted = ".".join(field)
                 if ftype == "num":
                     arr = np.zeros((c, k), np.float32)
-                    ok = np.zeros((c, k), bool)
                 else:
                     arr = np.full((c, k), -2, np.int32)
-                    ok = np.zeros((c, k), bool)
+                ok = np.zeros((c, k), bool)
+                rank = np.full((c, k), -1, np.int8)
+                fpresent = np.zeros((c, k), bool)
                 for i, xs in enumerate(lists):
                     for j, item in enumerate(xs):
                         cur = item
+                        found = isinstance(item, dict)
                         for part in field:
-                            cur = cur.get(part) if isinstance(cur, dict) \
-                                else None
-                        if ftype == "num" and isinstance(cur, (int, float)) \
-                                and not isinstance(cur, bool):
+                            if isinstance(cur, dict) and part in cur:
+                                cur = cur[part]
+                            else:
+                                cur, found = None, False
+                                break
+                        if found:
+                            fpresent[i, j] = True
+                            rank[i, j] = _py_rank(cur)
+                        if ftype == "num" and found and isinstance(
+                                cur, (int, float)) and not isinstance(
+                                cur, bool):
                             arr[i, j] = float(cur)
                             ok[i, j] = True
-                        elif ftype == "str" and isinstance(cur, str):
+                        elif ftype == "str" and found and isinstance(cur,
+                                                                     str):
                             arr[i, j] = vocab.intern(cur)
                             ok[i, j] = True
                 suffix = "__nums" if ftype == "num" else "__sids"
                 table[f"{spec.name}.{dotted}{suffix}"] = jnp.asarray(arr)
                 table[f"{spec.name}.{dotted}__ok"] = jnp.asarray(ok)
+                table[f"{spec.name}.{dotted}__rank"] = jnp.asarray(rank)
+                table[f"{spec.name}.{dotted}__fpresent"] = jnp.asarray(
+                    fpresent)
         else:
             raise LowerError(f"unknown param kind {spec.kind}")
 
@@ -466,59 +507,80 @@ def _expand_for_ctx(ctx: _Ctx, arr, is_ragged: bool):
     return arr
 
 
-def _eval_numlike(ctx: _Ctx, e: N.Expr):
-    """Returns (value_array, valid_array) broadcastable in the active shape."""
+def _eval_cmp_operand(ctx: _Ctx, e: N.Expr):
+    """(num, term_rank, is_num, present) for a comparison operand.
+
+    Rego's ordered comparisons are TOTAL across types (term order: null <
+    bool < number < string < composites, value.py compare()) — a policy like
+    ``hostPort > 9000`` is TRUE for hostPort "80" (string ranks above
+    number).  Ranks make the lowered comparisons honor that."""
     if isinstance(e, N.FeatNum):
         a = _feat_arrays(ctx, e.col)
         ragged = isinstance(e.col, RaggedCol)
+        kind = _expand_for_ctx(ctx, a["kind"], ragged)
         return (
             _expand_for_ctx(ctx, a["num"], ragged),
-            _expand_for_ctx(ctx, a["kind"] == K_NUM, ragged),
+            jnp.asarray(_RANK_BY_KIND)[kind],
+            kind == K_NUM,
+            kind > 0,
         )
     if isinstance(e, N.ParamNum):
-        return ctx.row[f"{e.name}__num"], ctx.row[f"{e.name}__present"]
+        return (ctx.row[f"{e.name}__num"],
+                ctx.row[f"{e.name}__rank"],
+                ctx.row[f"{e.name}__isnum"],
+                ctx.row[f"{e.name}__present"])
     if isinstance(e, N.ConstNum):
-        return jnp.float32(e.value), jnp.bool_(True)
+        return (jnp.float32(e.value), jnp.int8(2), jnp.bool_(True),
+                jnp.bool_(True))
     if isinstance(e, N.ParamElemFieldNum):
         if ctx.elem_k is None:
             raise LowerError("ParamElemFieldNum outside AnyParamList")
         dotted = ".".join(e.field)
         return (ctx.row[f"{e.param}.{dotted}__nums"],
-                ctx.row[f"{e.param}.{dotted}__ok"])
+                ctx.row[f"{e.param}.{dotted}__rank"],
+                ctx.row[f"{e.param}.{dotted}__ok"],
+                ctx.row[f"{e.param}.{dotted}__fpresent"])
     if isinstance(e, N.ParamFnNum):
-        return (ctx.row[f"{e.name}__fn_{e.fn}__num"],
-                ctx.row[f"{e.name}__fn_{e.fn}__ok"])
+        ok = ctx.row[f"{e.name}__fn_{e.fn}__ok"]
+        return ctx.row[f"{e.name}__fn_{e.fn}__num"], jnp.int8(2), ok, ok
     if isinstance(e, N.StrFnNum):
-        sid, sok = _eval_sidlike(ctx, e.operand)
+        sid, sok, spresent = _eval_sidlike(ctx, e.operand)
         num = ctx.cols[f"fn:{e.fn}:num"]
         ok = ctx.cols[f"fn:{e.fn}:ok"]
         safe = jnp.clip(sid, 0, num.shape[0] - 1)
-        return num[safe], sok & (sid >= 0) & ok[safe]
+        valid = sok & (sid >= 0) & ok[safe]
+        # units.parse of a non-string / unparseable string is UNDEFINED in
+        # Rego (builtin error), so validity gates the whole comparison
+        return num[safe], jnp.int8(2), valid, valid
     raise LowerError(f"not a numeric operand: {e}")
 
 
 def _eval_sidlike(ctx: _Ctx, e: N.Expr):
+    """(sid, is_string, present)."""
     if isinstance(e, N.FeatSid):
         a = _feat_arrays(ctx, e.col)
         ragged = isinstance(e.col, RaggedCol)
+        kind = _expand_for_ctx(ctx, a["kind"], ragged)
         return (
             _expand_for_ctx(ctx, a["sid"], ragged),
-            _expand_for_ctx(ctx, a["kind"] == K_STR, ragged),
+            kind == K_STR,
+            kind > 0,
         )
     if isinstance(e, N.ParamSid):
-        return ctx.row[f"{e.name}__sid"], ctx.row[f"{e.name}__present"]
+        ok = ctx.row[f"{e.name}__present"]
+        return ctx.row[f"{e.name}__sid"], ok, ok
     if isinstance(e, N.ConstSid):
-        return jnp.int32(e.sid), jnp.bool_(True)
+        return jnp.int32(e.sid), jnp.bool_(True), jnp.bool_(True)
     if isinstance(e, N.ParamElemSid):
         if ctx.elem_k is None:
             raise LowerError("ParamElemSid outside AnyParamList")
-        return ctx.elem_k, jnp.bool_(True)
+        return ctx.elem_k, jnp.bool_(True), jnp.bool_(True)
     if isinstance(e, N.ParamElemFieldSid):
         if ctx.elem_k is None:
             raise LowerError("ParamElemFieldSid outside AnyParamList")
         dotted = ".".join(e.field)
-        return (ctx.row[f"{e.param}.{dotted}__sids"],
-                ctx.row[f"{e.param}.{dotted}__ok"])
+        ok = ctx.row[f"{e.param}.{dotted}__ok"]
+        return ctx.row[f"{e.param}.{dotted}__sids"], ok, ok
     raise LowerError(f"not a string operand: {e}")
 
 
@@ -554,17 +616,29 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         ragged = isinstance(e.col, RaggedCol)
         return _expand_for_ctx(ctx, a["kind"] == e.kind, ragged)
     if isinstance(e, N.CmpNum):
-        lv, lok = _eval_numlike(ctx, e.lhs)
-        rv, rok = _eval_numlike(ctx, e.rhs)
-        return lok & rok & _CMP[e.op](lv, rv)
+        lv, lrank, lnum, lpres = _eval_cmp_operand(ctx, e.lhs)
+        rv, rrank, rnum, rpres = _eval_cmp_operand(ctx, e.rhs)
+        both_num = lnum & rnum
+        num_res = _CMP[e.op](lv, rv)
+        if e.op in ("eq",):
+            cross = jnp.bool_(False)  # different types are never equal
+        elif e.op in ("neq",):
+            cross = jnp.bool_(True)
+        else:
+            # total term order across types (value.py compare())
+            cross = _CMP[e.op](lrank.astype(jnp.int8),
+                               rrank.astype(jnp.int8))
+        return lpres & rpres & jnp.where(both_num, num_res, cross)
     if isinstance(e, N.EqStr):
-        lv, lok = _eval_sidlike(ctx, e.lhs)
-        rv, rok = _eval_sidlike(ctx, e.rhs)
-        eq = jnp.equal(lv, rv)
-        out = lok & rok & (jnp.logical_not(eq) if e.negate else eq)
-        return out
+        lv, lok, lpres = _eval_sidlike(ctx, e.lhs)
+        rv, rok, rpres = _eval_sidlike(ctx, e.rhs)
+        eq_true = lok & rok & jnp.equal(lv, rv)
+        if e.negate:
+            # Rego: 5 != "x" is TRUE (defined inequality across types)
+            return lpres & rpres & jnp.logical_not(eq_true)
+        return eq_true
     if isinstance(e, N.InStrList):
-        nv, nok = _eval_sidlike(ctx, e.needle)
+        nv, nok, _npres = _eval_sidlike(ctx, e.needle)
         sids = ctx.row[f"{e.param}__sids"]  # [K]
         cnt = ctx.row[f"{e.param}__count"]
         k = sids.shape[-1]
@@ -577,7 +651,7 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         col = ctx.cols.get(col_key(e.keyset))
         if col is None:
             raise LowerError(f"keyset column {e.keyset} not in batch")
-        nv, nok = _eval_sidlike(ctx, e.needle)
+        nv, nok, _npres = _eval_sidlike(ctx, e.needle)
         keys = col["sid"]  # [N, L]
         cnt = col["count"]  # [N]
         l = keys.shape[-1]
@@ -607,7 +681,7 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             saved_elem = ctx.elem_k
             ctx.elem_k = None
             try:
-                sid, sok = _eval_sidlike(ctx, e.subject)  # [N] or [N, M]
+                sid, sok, _sp = _eval_sidlike(ctx, e.subject)  # [N] / [N, M]
             finally:
                 ctx.elem_k = saved_elem
             safe = jnp.clip(sid, 0, matrix.shape[1] - 1)
@@ -615,7 +689,7 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             hit = jnp.moveaxis(rows[:, safe], 0, -1)  # [..., K]
             return hit & rok & ((sid >= 0) & sok)[..., None]
         if isinstance(needle, (N.ParamSid, N.ConstSid)):
-            sid, sok = _eval_sidlike(ctx, e.subject)
+            sid, sok, _sp = _eval_sidlike(ctx, e.subject)
             if isinstance(needle, N.ParamSid):
                 key = f"{needle.name}__strtab_{e.op}"
             else:

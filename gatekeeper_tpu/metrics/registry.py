@@ -70,15 +70,22 @@ class MetricsRegistry:
     def render(self) -> str:
         """Prometheus text format (the prometheus exporter equivalent)."""
         lines = []
+        typed: set = set()  # one # TYPE line per metric name
+
+        def type_line(name, kind):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {PREFIX}{name} {kind}")
+
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {PREFIX}{name} counter")
+                type_line(name, "counter")
                 lines.append(f"{PREFIX}{name}{_fmt(labels)} {_num(v)}")
             for (name, labels), v in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {PREFIX}{name} gauge")
+                type_line(name, "gauge")
                 lines.append(f"{PREFIX}{name}{_fmt(labels)} {_num(v)}")
             for (name, labels), h in sorted(self._hist.items()):
-                lines.append(f"# TYPE {PREFIX}{name} summary")
+                type_line(name, "summary")
                 lines.append(
                     f"{PREFIX}{name}_count{_fmt(labels)} {h['count']}")
                 lines.append(

@@ -25,8 +25,9 @@ from typing import Any, Iterable, Optional, Sequence
 
 import numpy as np
 
-# value-kind tags
-K_ABSENT, K_FALSE, K_TRUE, K_NUM, K_STR, K_OTHER = 0, 1, 2, 3, 4, 5
+# value-kind tags (K_NULL split from K_OTHER so device term-order ranks can
+# distinguish null(<numbers) from composites(>strings))
+K_ABSENT, K_FALSE, K_TRUE, K_NUM, K_STR, K_OTHER, K_NULL = 0, 1, 2, 3, 4, 5, 6
 
 
 class Vocab:
@@ -185,9 +186,9 @@ def _classify(v: Any, vocab: Vocab):
         return K_NUM, float(v), -1
     if isinstance(v, str):
         return K_STR, 0.0, vocab.intern(v)
-    if v is None or isinstance(v, (list, dict)):
-        return K_OTHER, 0.0, -1
-    return K_OTHER, 0.0, -1
+    if v is None:
+        return K_NULL, 0.0, -1
+    return K_OTHER, 0.0, -1  # list / dict
 
 
 def _walk(obj: Any, path: Sequence[str]):

@@ -82,6 +82,7 @@ class ValidationHandler:
         batcher: Optional["Batcher"] = None,
         log_denies: bool = False,
         event_sink=None,
+        metrics=None,
     ):
         self.client = client
         self.expansion_system = expansion_system
@@ -90,9 +91,25 @@ class ValidationHandler:
         self.batcher = batcher
         self.log_denies = log_denies
         self.event_sink = event_sink
+        self.metrics = metrics
 
     # --- the handler (reference: validationHandler.Handle, policy.go:139) -
     def handle(self, review_body: dict) -> ValidationResponse:
+        if self.metrics is None:
+            return self._handle(review_body)
+        from gatekeeper_tpu.metrics import registry as m
+
+        status = "error"  # count even when _handle raises (fail-open path)
+        try:
+            with self.metrics.timed(m.REQUEST_DURATION):
+                resp = self._handle(review_body)
+            status = "allow" if resp.allowed else "deny"
+            return resp
+        finally:
+            self.metrics.inc_counter(m.REQUEST_COUNT,
+                                     {"admission_status": status})
+
+    def _handle(self, review_body: dict) -> ValidationResponse:
         req = parse_admission_review(review_body)
         username = (req.user_info or {}).get("username", "")
 

@@ -20,6 +20,7 @@ ADMIT_PATH = "/v1/admit"
 MUTATE_PATH = "/v1/mutate"
 ADMIT_LABEL_PATH = "/v1/admitlabel"
 HEALTH_PATH = "/healthz"
+METRICS_PATH = "/metrics"
 
 
 def admission_response(uid: str, allowed: bool, message: str = "",
@@ -53,11 +54,13 @@ class WebhookServer:
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
         readiness_check=None,  # callable -> bool
+        metrics=None,  # MetricsRegistry for /metrics exposition
     ):
         self.validation_handler = validation_handler
         self.mutation_handler = mutation_handler
         self.namespace_label_handler = namespace_label_handler
         self.readiness_check = readiness_check
+        self.metrics = metrics
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -70,6 +73,14 @@ class WebhookServer:
                              or outer.readiness_check())
                     self._reply(200 if ready else 503,
                                 {"ready": bool(ready)})
+                elif self.path == METRICS_PATH and outer.metrics is not None:
+                    data = outer.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                 else:
                     self._reply(404, {"error": "not found"})
 

@@ -23,7 +23,7 @@
 
 /* value-kind tags (must match ops/flatten.py) */
 enum { K_ABSENT = 0, K_FALSE = 1, K_TRUE = 2, K_NUM = 3, K_STR = 4,
-       K_OTHER = 5 };
+       K_OTHER = 5, K_NULL = 6 };
 
 typedef struct {
     PyObject *to_id;  /* dict: str -> int */
@@ -88,8 +88,10 @@ classify(Vocab *vocab, PyObject *val, signed char *kind, float *num,
         if (id < 0 && PyErr_Occurred())
             return -1;
         *sid = (int)id;
+    } else if (val == Py_None) {
+        *kind = K_NULL;
     } else {
-        *kind = K_OTHER; /* None / list / dict */
+        *kind = K_OTHER; /* list / dict */
     }
     return 0;
 }

@@ -1,0 +1,149 @@
+"""Extended differential fuzzing: lowered programs vs the interpreter.
+
+Not part of the default pytest run (no test_ prefix) — invoke manually:
+
+    python tests/fuzz_differential.py [n_objects] [seeds...]
+
+Generates randomized object populations against every library policy and
+asserts verdict-set equality between TpuDriver.query_batch and the exact
+interpreter, printing a summary per seed.  Exit 1 on any divergence.
+"""
+
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+# assignment, not setdefault: the ambient env may say "axon" and the package
+# import hook honors JAX_PLATFORMS — a dead tunnel would hang the oracle
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from gatekeeper_tpu.apis.constraints import Constraint  # noqa: E402
+from gatekeeper_tpu.apis.templates import ConstraintTemplate  # noqa: E402
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver  # noqa: E402
+from gatekeeper_tpu.target.review import AugmentedUnstructured  # noqa: E402
+from gatekeeper_tpu.target.target import K8sValidationTarget  # noqa: E402
+from gatekeeper_tpu.utils.unstructured import load_yaml_file  # noqa: E402
+
+LIB = os.path.join(os.path.dirname(__file__), "..", "library", "general")
+TARGET = "admission.k8s.gatekeeper.sh"
+
+IMAGES = ["openpolicyagent/opa:0.9.2", "nginx", "nginx:latest", "a/b:v1",
+          "registry.corp:5000/x/y@sha256:ab", "", ":weird", "latest"]
+VALUES = [True, False, 0, 1, -1, 2.5, "", "x", None, [], {},
+          "user.agilebank.demo", "user"]
+
+
+def rand_value(rng, depth=0):
+    r = rng.random()
+    if depth > 2 or r < 0.6:
+        return rng.choice(VALUES)
+    if r < 0.8:
+        return [rand_value(rng, depth + 1) for _ in range(rng.randint(0, 3))]
+    return {f"k{i}": rand_value(rng, depth + 1)
+            for i in range(rng.randint(0, 3))}
+
+
+def rand_obj(rng, i):
+    kind = rng.choice(["Pod", "Deployment", "Service", "Namespace",
+                       "Ingress"])
+    group = {"Deployment": "apps", "Ingress": "networking.k8s.io"}.get(
+        kind, "")
+    meta = {"name": f"o{i}"}
+    if rng.random() < 0.7:
+        meta["namespace"] = rng.choice(["default", "prod", "kube-system"])
+    if rng.random() < 0.5:
+        meta["labels"] = {k: str(rand_value(rng))[:20] for k in rng.sample(
+            ["owner", "app", "team", "env"], rng.randint(1, 3))}
+    spec = {}
+    if rng.random() < 0.8:
+        containers = []
+        for j in range(rng.randint(0, 4)):
+            c = {}
+            if rng.random() < 0.9:
+                c["name"] = f"c{j}"
+            if rng.random() < 0.9:
+                c["image"] = rng.choice(IMAGES)
+            if rng.random() < 0.4:
+                c["resources"] = {"limits": {
+                    k: rng.choice(["100m", "1", "2Gi", "64Mi", "bogus", 3])
+                    for k in rng.sample(["cpu", "memory"],
+                                        rng.randint(1, 2))}}
+            if rng.random() < 0.3:
+                c["ports"] = [{"hostPort": rng.choice(
+                    [79, 80, 9000, 9001, "80"])}
+                    for _ in range(rng.randint(0, 2))]
+            if rng.random() < 0.2:
+                c[rng.choice(["readinessProbe", "livenessProbe"])] = {}
+            containers.append(c)
+        spec["containers"] = containers
+    for key in ("hostPID", "hostIPC", "hostNetwork"):
+        if rng.random() < 0.15:
+            spec[key] = rng.choice([True, False, "yes"])
+    if kind == "Deployment" and rng.random() < 0.7:
+        spec["replicas"] = rng.choice([0, 1, 3, 50, 51, "3"])
+    if kind == "Service":
+        spec["type"] = rng.choice(["ClusterIP", "NodePort", "LoadBalancer"])
+    if kind == "Ingress" and rng.random() < 0.8:
+        spec["rules"] = [{"host": rng.choice(
+            ["a.com", "b.com", ""])} for _ in range(rng.randint(0, 2))]
+    if rng.random() < 0.1:
+        spec["extra"] = rand_value(rng)
+    av = f"{group}/v1" if group else "v1"
+    return {"apiVersion": av, "kind": kind, "metadata": meta, "spec": spec}
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    seeds = [int(s) for s in sys.argv[2:]] or [0, 1, 2, 3, 4]
+
+    tpu = TpuDriver(batch_bucket=64)
+    constraints = []
+    for name in sorted(os.listdir(LIB)):
+        t = ConstraintTemplate.from_unstructured(
+            load_yaml_file(os.path.join(LIB, name, "template.yaml"))[0])
+        if not t.targets[0].rego:
+            continue
+        tpu.add_template(t)
+        constraints.append(Constraint.from_unstructured(load_yaml_file(
+            os.path.join(LIB, name, "samples", "constraint.yaml"))[0]))
+    print(f"templates: {len(constraints)} "
+          f"({len(tpu.lowered_kinds())} lowered)")
+
+    target = K8sValidationTarget()
+    failures = 0
+    for seed in seeds:
+        rng = random.Random(seed)
+        objs = [rand_obj(rng, i) for i in range(n)]
+        reviews = [target.handle_review(AugmentedUnstructured(object=o))
+                   for o in objs]
+        got = tpu.query_batch(TARGET, constraints, reviews)
+        mismatches = 0
+        for oi, review in enumerate(reviews):
+            expected = []
+            for con in constraints:
+                if not target.to_matcher(con.match).match(review):
+                    continue
+                expected.extend(
+                    tpu._interp.query(TARGET, [con], review).results)
+            key = lambda r: (r.constraint["metadata"]["name"], r.msg)
+            if sorted(map(key, got[oi].results)) != sorted(
+                    map(key, expected)):
+                mismatches += 1
+                if mismatches <= 3:
+                    print(f"  DIVERGENCE seed={seed} obj={oi}: {objs[oi]}")
+                    print(f"    got:  {sorted(map(key, got[oi].results))}")
+                    print(f"    want: {sorted(map(key, expected))}")
+        total = sum(len(g.results) for g in got)
+        status = "OK" if mismatches == 0 else f"{mismatches} MISMATCHES"
+        print(f"seed {seed}: {n} objects, {total} violations -> {status}")
+        failures += mismatches
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

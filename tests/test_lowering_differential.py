@@ -456,3 +456,50 @@ violation[{"msg": "sensitive volume"}] {
          "spec": {"volumes": {"data": {"emptyDir": {}}}}},
     ]
     assert _verdicts(tpu, con, pods) == [1, 1, 0]
+
+
+def test_cross_type_comparison_term_order():
+    """Rego ordered comparisons are total across types (term order: null <
+    bool < number < string < composites) — `hostPort > 9000` is TRUE for a
+    string-typed hostPort (fuzzer-found divergence)."""
+    tpu, con = _mini_driver("""
+package k8scmprank
+
+violation[{"msg": "port out of range"}] {
+  port := input.review.object.spec.containers[_].ports[_].hostPort
+  port > input.parameters.max
+}
+
+violation[{"msg": "neq mismatch"}] {
+  input.review.object.spec.replicas != input.parameters.max
+}
+""", "K8sCmpRank")
+    con.parameters = {"max": 9000}
+    con.raw["spec"]["parameters"] = {"max": 9000}
+    assert "K8sCmpRank" in tpu.lowered_kinds()
+    pods = [
+        # string port: ranks above any number -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"ports": [{"hostPort": "80"}]}],
+                  "replicas": 9000}},
+        # numeric port within range; replicas != max is false -> no violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"ports": [{"hostPort": 80}]}],
+                  "replicas": 9000}},
+        # bool port: bool < number -> not greater; replicas string != 9000 ->
+        # neq true (cross-type inequality is DEFINED in Rego)
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"containers": [{"ports": [{"hostPort": True}]}],
+                  "replicas": "9000"}},
+        # null port: null < number; missing replicas -> neq undefined
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "d"},
+         "spec": {"containers": [{"ports": [{"hostPort": None}]}]}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    # oracle agreement is the real assertion
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert (g > 0) == (want > 0), (pod, g, want)
+    assert got == [1, 0, 1, 0]

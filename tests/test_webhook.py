@@ -242,3 +242,32 @@ def test_batcher_coalesces_requests():
         assert all("gatekeeper" in r.message for r in results.values())
     finally:
         batcher.stop()
+
+
+def test_metrics_endpoint_and_request_counters():
+    import urllib.request
+
+    from gatekeeper_tpu.metrics.registry import MetricsRegistry
+
+    client = make_client()
+    metrics = MetricsRegistry()
+    srv = WebhookServer(
+        validation_handler=ValidationHandler(client, metrics=metrics),
+        port=0, metrics=metrics,
+    ).start()
+    try:
+        post(srv.port, "/v1/admit", admission_review(ns("nolabels")))
+        post(srv.port, "/v1/admit",
+             admission_review(ns("ok", {"gatekeeper": "x"})))
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ) as resp:
+            body = resp.read().decode()
+        assert ('gatekeeper_validation_request_count'
+                '{admission_status="deny"} 1') in body
+        assert ('gatekeeper_validation_request_count'
+                '{admission_status="allow"} 1') in body
+        assert "gatekeeper_validation_request_duration_seconds_count 2" \
+            in body
+    finally:
+        srv.stop()
