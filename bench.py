@@ -49,8 +49,50 @@ def build():
     return tpu, cons, evaluator
 
 
+def _probe_accelerator(timeout_s: float = 90.0) -> bool:
+    """Device init in a subprocess with a timeout: a dead TPU tunnel hangs
+    jax.devices() forever, which must not hang the benchmark harness."""
+    import subprocess
+
+    probe_src = (
+        "import os, jax\n"
+        "w = os.environ.get('JAX_PLATFORMS')\n"
+        "w and jax.config.update('jax_platforms', w)\n"
+        "jax.devices()\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe_src],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"device init timed out after {timeout_s:.0f}s")
+        return False
+    if proc.returncode != 0:
+        log("device init failed:\n" + (proc.stderr or "").strip()[-2000:])
+        return False
+    return True
+
+
 def main():
+    import os
+
+    cpu_fallback = False
+    # always probe (honoring any env pin — the ambient pin may itself name a
+    # dead accelerator); a cpu probe costs ~2s, a live TPU probe a few more
+    if not _probe_accelerator():
+        was = os.environ.get("JAX_PLATFORMS", "<default>")
+        log(f"accelerator unreachable (platform {was}); falling back to "
+            "CPU — the reported number is NOT a TPU result")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        cpu_fallback = was != "cpu"
+    import gatekeeper_tpu  # noqa: F401 — package hook pins JAX_PLATFORMS
     import jax
+
+    if cpu_fallback:
+        # the hook only pins from env; ensure the override sticks even if
+        # another import already touched jax config
+        jax.config.update("jax_platforms", "cpu")
 
     import __graft_entry__ as g
 
@@ -86,12 +128,17 @@ def main():
         f"constraint-evals/sec: {n * len(cons) / elapsed:,.0f}"
     )
 
-    print(json.dumps({
+    out = {
         "metric": "audit admission reviews/sec/chip",
         "value": round(reviews_per_s, 1),
         "unit": "reviews/s",
         "vs_baseline": round(reviews_per_s / 100_000, 4),
-    }))
+    }
+    if cpu_fallback:
+        # metric name stays stable for consumers; the flag marks the result
+        # as a CPU-fallback measurement (TPU unreachable)
+        out["cpu_fallback"] = True
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
