@@ -40,6 +40,7 @@ from gatekeeper_tpu.ops.flatten import (
     Axis,
     KeySetCol,
     RaggedCol,
+    RaggedKeySetCol,
     ScalarCol,
     Schema,
 )
@@ -91,6 +92,15 @@ class ParamElemFieldVal:
     name: str
     field: tuple
     instance: int = 0
+
+
+@dataclass(frozen=True)
+class DynFieldVal:
+    """Dynamic field access: item[param_elem] (container[probe]).  Only
+    presence/truthiness is expressible on device (via ragged key sets)."""
+
+    item: "ItemVal"
+    elem: Any  # ParamElemVal | ParamElemFieldVal
 
 
 @dataclass(frozen=True)
@@ -232,6 +242,31 @@ class _Lowerer:
                 raise LowerError("some..in")
             raise LowerError(f"statement {type(stmt).__name__}")
 
+        # dual-group predicates reduce their param axis first, then join
+        # the axis-level predicates of their shared axis instance.  A param
+        # instance is ONE existential: plain predicates on the same instance
+        # (probe == "x") must reduce inside the SAME AnyParamList as the
+        # dual predicates (c[probe]) — and an instance shared by two dual
+        # groups cannot be split at all.
+        dual_groups = [g for g in axis_preds if g[0] == "dual"]
+        pgroup_uses: dict = {}
+        for group in dual_groups:
+            pgroup_uses.setdefault(group[2], []).append(group)
+        for pgroup, users in pgroup_uses.items():
+            if len(users) > 1:
+                raise LowerError(
+                    "param element shared across multiple axis existentials"
+                )
+        for group in dual_groups:
+            _d, agroup, pgroup = group
+            preds = axis_preds.pop(group)
+            # absorb plain predicates bound to the same param instance
+            plain = axis_preds.pop(pgroup, None)
+            if plain:
+                preds = list(preds) + list(plain)
+            inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
+            axis_preds.setdefault(agroup, []).append(
+                N.AnyParamList(pgroup[1], inner))
         terms = list(obj_preds)
         for group, preds in axis_preds.items():
             inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
@@ -285,6 +320,10 @@ class _Lowerer:
             return [(N.ParamPresent(val.name), None)]
         if isinstance(val, (ConstVal, KeySetVal, ParamListSetVal, SetDiffVal)):
             return []
+        if isinstance(val, DynFieldVal):
+            # a false-valued key is DEFINED but outside the truthy keyset, so
+            # keyset-contains cannot express definedness — fall back
+            raise LowerError("definedness of dynamic field access")
         if isinstance(val, OpaqueVal):
             raise LowerError(f"definedness of opaque value: {val.why}")
         return []
@@ -443,6 +482,12 @@ class _Lowerer:
                                                 base.instance)
                 else:
                     return OpaqueVal(f"correlated index var {arg.name}")
+            elif isinstance(arg, ast.Var) and isinstance(
+                env.get(arg.name), (ParamElemVal, ParamElemFieldVal)
+            ) and isinstance(base, ItemVal):
+                # dynamic field access by a parameter element:
+                # container[probe] — presence-only on device
+                base = DynFieldVal(base, env[arg.name])
             else:
                 return OpaqueVal("computed ref index")
             if isinstance(base, OpaqueVal):
@@ -526,6 +571,22 @@ class _Lowerer:
         if negated:
             if group is None:
                 return N.Not(pred), None
+            if group[0] == "dual":
+                _d, agroup, pgroup = group
+                # close over any existential introduced inside the negation
+                if pgroup[2] > before:
+                    pred = N.AnyParamList(pgroup[1], pred)
+                    group = agroup
+                    if agroup[2] > before:
+                        return N.Not(N.AnyAxis(agroup[1], pred)), None
+                    return N.Not(pred), agroup
+                if agroup[2] > before:
+                    # axis fresh but param pre-bound: ∃p ¬∃c — not
+                    # expressible in this grid shape
+                    raise LowerError(
+                        "negation over fresh axis with bound param element"
+                    )
+                return N.Not(pred), group
             if group[2] > before:
                 # the existential was introduced INSIDE the negated term
                 # (e.g. `not containers[_].privileged`): negation closes over
@@ -561,6 +622,25 @@ class _Lowerer:
         if isinstance(val, ItemVal):
             col = self._ragged_col(val)
             return N.Truthy(col), ("axis", val.axis, val.instance)
+        if isinstance(val, DynFieldVal):
+            # keyset columns hold truthy keys only, so contains == statement
+            # truthiness of item[elem]
+            rks = RaggedKeySetCol(axis=val.item.axis,
+                                  subpath=val.item.subpath)
+            if rks not in self.schema.ragged_keysets:
+                self.schema.ragged_keysets.append(rks)
+            elem = val.elem
+            if isinstance(elem, ParamElemVal):
+                self._note_param(elem.name, "strlist")
+                needle = N.ParamElemSid()
+                pgroup = ("param", elem.name, elem.instance)
+            else:
+                self._note_param_field(elem.name, elem.field, "str")
+                needle = N.ParamElemFieldSid(elem.name, elem.field)
+                pgroup = ("param", elem.name, elem.instance)
+            agroup = ("axis", val.item.axis, val.item.instance)
+            return N.RaggedKeySetContains(rks, needle), (
+                "dual", agroup, pgroup)
         if isinstance(val, ParamVal):
             self._note_param(val.name, "bool")
             return N.ParamTruthy(val.name), None

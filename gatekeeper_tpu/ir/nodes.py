@@ -15,7 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Union
 
-from gatekeeper_tpu.ops.flatten import Axis, KeySetCol, RaggedCol, ScalarCol
+from gatekeeper_tpu.ops.flatten import Axis, KeySetCol, RaggedCol, ScalarCol  # noqa: F401
 
 FeatCol = Union[ScalarCol, RaggedCol]
 
@@ -165,6 +165,16 @@ class KeySetContains(Expr):
     """needle ∈ keys of map column (e.g. a label key in metadata.labels)."""
 
     keyset: KeySetCol
+    needle: Expr  # sid-valued
+
+
+@dataclass(frozen=True)
+class RaggedKeySetContains(Expr):
+    """needle ∈ keys of the current axis item's map (dynamic field
+    presence: container[probe]).  Evaluates inside AnyAxis (+ AnyParamList
+    when the needle is a param element)."""
+
+    keyset: "object"  # ops.flatten.RaggedKeySetCol
     needle: Expr  # sid-valued
 
 

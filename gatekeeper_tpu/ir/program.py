@@ -32,6 +32,7 @@ from gatekeeper_tpu.ops.flatten import (
     K_TRUE,
     KeySetCol,
     RaggedCol,
+    RaggedKeySetCol,
     ScalarCol,
     Vocab,
     round_up,
@@ -64,6 +65,8 @@ def col_key(spec) -> str:
         return "rg:" + spec.axis.key() + ":" + ".".join(spec.subpath)
     if isinstance(spec, KeySetCol):
         return "ks:" + ".".join(spec.path)
+    if isinstance(spec, RaggedKeySetCol):
+        return "rks:" + spec.axis.key() + ":" + ".".join(spec.subpath)
     raise LowerError(f"unknown column spec {spec}")
 
 
@@ -700,6 +703,27 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             safe = jnp.clip(sid, 0, matrix.shape[1] - 1)
             return row[safe] & rok & (sid >= 0) & sok
         raise LowerError(f"StrPred needle {needle}")
+    if isinstance(e, N.RaggedKeySetContains):
+        col = ctx.cols.get(col_key(e.keyset))
+        if col is None:
+            raise LowerError(f"ragged keyset {e.keyset} not in batch")
+        if ctx.axis is None:
+            raise LowerError("RaggedKeySetContains outside AnyAxis")
+        keys = col["sid"]  # [N, M, L]
+        cnt = col["count"]  # [N, M]
+        l = keys.shape[-1]
+        valid = jnp.arange(l) < cnt[..., None]  # [N, M, L]
+        nv, nok, _np_ = _eval_sidlike(ctx, e.needle)
+        if ctx.elem_k is not None:
+            # needle [K]: hit [N, M, K]
+            hit = jnp.any(
+                (keys[..., None, :] == nv[..., :, None])
+                & valid[..., None, :],
+                axis=-1,
+            )
+            return hit & nok
+        hit = jnp.any((keys == nv[..., None]) & valid, axis=-1)  # [N, M]
+        return hit & nok
     if isinstance(e, N.Not):
         return jnp.logical_not(eval_expr(ctx, e.inner))
     if isinstance(e, N.And):
@@ -796,6 +820,9 @@ class CompiledProgram:
         for axis, cnt in batch.axis_counts.items():
             cols[axis_key(axis)] = jnp.asarray(cnt)
         for spec, col in batch.keysets.items():
+            cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
+                                   "count": jnp.asarray(col.count)}
+        for spec, col in batch.ragged_keysets.items():
             cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
                                    "count": jnp.asarray(col.count)}
         if vocab is not None:

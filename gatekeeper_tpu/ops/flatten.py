@@ -98,11 +98,22 @@ class KeySetCol:
     path: tuple
 
 
+@dataclass(frozen=True)
+class RaggedKeySetCol:
+    """Per-axis-item key sets: the keys of the map at ``subpath`` under
+    each item (e.g. the field names of every container — backs dynamic
+    field-presence checks like ``container[probe]``)."""
+
+    axis: Axis
+    subpath: tuple
+
+
 @dataclass
 class Schema:
     scalars: list = field(default_factory=list)
     raggeds: list = field(default_factory=list)
     keysets: list = field(default_factory=list)
+    ragged_keysets: list = field(default_factory=list)
 
     def merge(self, other: "Schema") -> None:
         for s in other.scalars:
@@ -114,12 +125,18 @@ class Schema:
         for k in other.keysets:
             if k not in self.keysets:
                 self.keysets.append(k)
+        for rk in getattr(other, "ragged_keysets", []):
+            if rk not in self.ragged_keysets:
+                self.ragged_keysets.append(rk)
 
     def axes(self) -> list:
         out = []
         for r in self.raggeds:
             if r.axis not in out:
                 out.append(r.axis)
+        for rk in self.ragged_keysets:
+            if rk.axis not in out:
+                out.append(rk.axis)
         return out
 
 
@@ -147,12 +164,19 @@ class KeySetColumn:
 
 
 @dataclass
+class RaggedKeySetColumn:
+    sid: np.ndarray  # [N, M, L] int32, -1 padded
+    count: np.ndarray  # [N, M] int32
+
+
+@dataclass
 class ColumnBatch:
     n: int
     scalars: dict  # ScalarCol -> ScalarColumn
     raggeds: dict  # RaggedCol -> RaggedColumn
     axis_counts: dict  # Axis -> np.ndarray [N] int32
     keysets: dict  # KeySetCol -> KeySetColumn
+    ragged_keysets: dict = field(default_factory=dict)
     # identity columns for match masks
     group_sid: np.ndarray = None
     kind_sid: np.ndarray = None
@@ -259,13 +283,18 @@ class Flattener:
         objects when not supplied (the audit path)."""
         review_cols = [c for c in self.schema.scalars
                        if c.path[:1] == ("__review__",)]
+        ragged_keysets = list(getattr(self.schema, "ragged_keysets", []))
         schema = self.schema
-        if review_cols:
+        if review_cols or ragged_keysets:
             schema = Schema()
             schema.scalars = [c for c in self.schema.scalars
                               if c.path[:1] != ("__review__",)]
             schema.raggeds = list(self.schema.raggeds)
             schema.keysets = list(self.schema.keysets)
+            # ragged_keysets stay on the inner schema so axes() materializes
+            # their axis counts; the key extraction itself happens below
+            # (python-side; native ragged keysets are a ROADMAP item)
+            schema.ragged_keysets = list(ragged_keysets)
         inner = Flattener(schema, self.vocab, self.use_native)
         if inner.use_native:
             from gatekeeper_tpu.ops import native
@@ -289,6 +318,33 @@ class Flattener:
                     if ok:
                         kind[i], num[i], sid[i] = _classify(val, self.vocab)
                 batch.scalars[spec] = ScalarColumn(kind, num, sid)
+        for rk in ragged_keysets:
+            n = batch.n
+            m = round_up(int(batch.axis_counts[rk.axis].max(initial=0)))
+            per_obj = [_axis_items(o, rk.axis) for o in objects]
+            key_lists = []
+            maxl = 0
+            for items in per_obj:
+                row = []
+                for item in items[:m]:
+                    val, ok = (_walk(item, rk.subpath) if rk.subpath
+                               else (item, True))
+                    # truthy-key semantics (see flat keysets above)
+                    keys = (sorted(k for k, v in val.items()
+                                   if v is not False)
+                            if ok and isinstance(val, dict) else [])
+                    row.append(keys)
+                    maxl = max(maxl, len(keys))
+                key_lists.append(row)
+            l = round_up(maxl)
+            sid = np.full((n, m, l), -1, np.int32)
+            count = np.zeros((n, m), np.int32)
+            for i, row in enumerate(key_lists):
+                for j, keys in enumerate(row):
+                    count[i, j] = len(keys)
+                    for q, k in enumerate(keys):
+                        sid[i, j, q] = self.vocab.intern(k)
+            batch.ragged_keysets[rk] = RaggedKeySetColumn(sid, count)
         return batch
 
     def _flatten_native(self, mod, objects: Sequence[dict],
@@ -388,7 +444,10 @@ class Flattener:
             per_obj_keys = []
             for obj in objects:
                 val, ok = _walk(obj, spec.path)
-                keys = sorted(val.keys()) if ok and isinstance(val, dict) else []
+                # truthy-key semantics: {k | m[k]} in Rego excludes keys whose
+                # value is false (statement truthiness)
+                keys = (sorted(k for k, v in val.items() if v is not False)
+                        if ok and isinstance(val, dict) else [])
                 per_obj_keys.append(keys)
             per_obj_keys += [[] for _ in range(n - n_real)]
             l = round_up(max((len(k) for k in per_obj_keys), default=0))

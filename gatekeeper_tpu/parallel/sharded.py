@@ -209,6 +209,8 @@ class ShardedEvaluator:
             cols[axis_key(axis)] = cnt
         for spec, col in batch.keysets.items():
             cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
+        for spec, col in batch.ragged_keysets.items():
+            cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
 
         kinds = tuple(sorted(lowered))
         k = self.violations_limit

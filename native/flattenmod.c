@@ -440,7 +440,15 @@ flatten_batch(PyObject *self, PyObject *args)
             for (Py_ssize_t i = 0; i < n_real; i++) {
                 PyObject *val = walk(PyList_GET_ITEM(objects, i), path);
                 if (val != NULL && PyDict_Check(val)) {
-                    Py_ssize_t c = PyDict_Size(val);
+                    /* truthy keys only — must match pass 2's filter so the
+                     * bucketed width equals the Python flattener's */
+                    Py_ssize_t c = 0;
+                    PyObject *kk2, *vv2;
+                    Py_ssize_t pos2 = 0;
+                    while (PyDict_Next(val, &pos2, &kk2, &vv2)) {
+                        if (vv2 != Py_False)
+                            c++;
+                    }
                     if (c > maxc)
                         maxc = c;
                 }
@@ -461,10 +469,27 @@ flatten_batch(PyObject *self, PyObject *args)
                 PyObject *val = walk(PyList_GET_ITEM(objects, i), path);
                 if (val == NULL || !PyDict_Check(val))
                     continue;
-                /* sorted keys to match the Python flattener exactly */
-                PyObject *keys = PyDict_Keys(val);
-                if (keys == NULL || PyList_Sort(keys) < 0) {
-                    Py_XDECREF(keys); Py_DECREF(out);
+                /* truthy keys only (Rego {k | m[k]} excludes false
+                 * values), sorted to match the Python flattener exactly */
+                PyObject *keys = PyList_New(0);
+                if (keys == NULL) {
+                    Py_DECREF(out);
+                    goto fail;
+                }
+                {
+                    PyObject *kk2, *vv2;
+                    Py_ssize_t pos2 = 0;
+                    while (PyDict_Next(val, &pos2, &kk2, &vv2)) {
+                        if (vv2 == Py_False)
+                            continue;
+                        if (PyList_Append(keys, kk2) < 0) {
+                            Py_DECREF(keys); Py_DECREF(out);
+                            goto fail;
+                        }
+                    }
+                }
+                if (PyList_Sort(keys) < 0) {
+                    Py_DECREF(keys); Py_DECREF(out);
                     goto fail;
                 }
                 Py_ssize_t c = PyList_GET_SIZE(keys);

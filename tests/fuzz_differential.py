@@ -57,8 +57,10 @@ def rand_obj(rng, i):
     if rng.random() < 0.7:
         meta["namespace"] = rng.choice(["default", "prod", "kube-system"])
     if rng.random() < 0.5:
-        meta["labels"] = {k: str(rand_value(rng))[:20] for k in rng.sample(
-            ["owner", "app", "team", "env"], rng.randint(1, 3))}
+        meta["labels"] = {
+            k: rng.choice([str(rand_value(rng))[:20], False, None, 1])
+            for k in rng.sample(["owner", "app", "team", "env"],
+                                rng.randint(1, 3))}
     spec = {}
     if rng.random() < 0.8:
         containers = []
@@ -77,8 +79,10 @@ def rand_obj(rng, i):
                 c["ports"] = [{"hostPort": rng.choice(
                     [79, 80, 9000, 9001, "80"])}
                     for _ in range(rng.randint(0, 2))]
-            if rng.random() < 0.2:
-                c[rng.choice(["readinessProbe", "livenessProbe"])] = {}
+            if rng.random() < 0.3:
+                # False-valued probes stress truthy-key semantics
+                c[rng.choice(["readinessProbe", "livenessProbe"])] = \
+                    rng.choice([{}, {"httpGet": {}}, False, None])
             containers.append(c)
         spec["containers"] = containers
     for key in ("hostPID", "hostIPC", "hostNetwork"):

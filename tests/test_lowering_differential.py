@@ -503,3 +503,41 @@ violation[{"msg": "neq mismatch"}] {
         want = len(tpu._interp.query(TARGET, [con], review).results)
         assert (g > 0) == (want > 0), (pod, g, want)
     assert got == [1, 0, 1, 0]
+
+
+def test_dynamic_field_access_and_shared_param_instance():
+    """container[probe] lowers via ragged key sets; a param element shared
+    between a guard (probe == "x") and the dynamic access is ONE existential
+    (reduced in a single AnyParamList)."""
+    tpu, con = _mini_driver("""
+package k8ssharedelem
+
+violation[{"msg": "missing gated probe"}] {
+  probe := input.parameters.probes[_]
+  probe == "livenessProbe"
+  c := input.review.object.spec.containers[_]
+  not c[probe]
+}
+""", "K8sSharedElem")
+    con.parameters = {"probes": ["livenessProbe", "readinessProbe"]}
+    con.raw["spec"]["parameters"] = con.parameters
+    assert "K8sSharedElem" in tpu.lowered_kinds()
+    pods = [
+        # livenessProbe present -> no violation (guard selects it)
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"name": "c", "livenessProbe": {"x": 1}}]}},
+        # only readinessProbe -> livenessProbe missing -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"name": "c", "readinessProbe": {"x": 1}}]}},
+        # FALSE-valued livenessProbe: defined-but-false -> statement truthy
+        # fails -> violation (truthy-key semantics)
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"containers": [{"name": "c", "livenessProbe": False}]}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert (g > 0) == (want > 0), (pod, g, want)
+    assert got == [0, 1, 1]
