@@ -35,10 +35,12 @@ from typing import Any, Optional, Union
 from gatekeeper_tpu.ir import nodes as N
 from gatekeeper_tpu.ir.program import LowerError
 from gatekeeper_tpu.lang.rego import ast
+from gatekeeper_tpu.lang.rego.builtins import REGISTRY as _BUILTINS
 from gatekeeper_tpu.lang.rego.parser import WithWrapped
 from gatekeeper_tpu.ops.flatten import (
     Axis,
     KeySetCol,
+    MapKeyCol,
     RaggedCol,
     RaggedKeySetCol,
     ScalarCol,
@@ -92,6 +94,32 @@ class ParamElemFieldVal:
     name: str
     field: tuple
     instance: int = 0
+
+
+@dataclass(frozen=True)
+class DefinedOpaqueVal:
+    """Opaque value whose definedness has already been charged to the
+    clause (e.g. msg := sprintf(...) — a total builtin over args whose
+    Present-predicates were emitted at the assignment)."""
+
+    why: str
+
+
+# builtins total over defined arguments: defined for ANY defined args,
+# regardless of type (lower/trim/count etc. are NOT — they are undefined on
+# mistyped args, so marking them defined would fabricate violations)
+_TOTAL_FNS = {"sprintf", "json.marshal"}
+
+
+@dataclass(frozen=True)
+class MapKeyVal:
+    """The iteration key of a map-value axis (labels[key]): usable in string
+    (in)equality and string predicates.  List-backed items carry an integer
+    index as their key — present but non-string, so == against a string is
+    defined-false and != defined-true, matching the interpreter."""
+
+    axis: Any
+    instance: int
 
 
 @dataclass(frozen=True)
@@ -225,13 +253,26 @@ class _Lowerer:
                 term = stmt.term if isinstance(stmt, ast.AssignStmt) else stmt.rhs
                 if not isinstance(target, ast.Var):
                     raise LowerError("destructuring assignment")
-                env[target.name] = self._abstract(term, env)
+                bound = self._abstract(term, env)
                 # an assignment in Rego fails when its RHS is undefined; even
                 # message-only assignments gate the clause, so emit their
                 # definedness predicates (e.g. msg := sprintf(..., [c.name])
                 # requires c.name defined)
                 for pred, axis_inst in self._definedness_preds(term, env):
                     add_pred(pred, axis_inst)
+                if isinstance(bound, OpaqueVal) and isinstance(term, ast.Call):
+                    if term.op in _TOTAL_FNS:
+                        # total builtin: defined now that its args are charged
+                        bound = DefinedOpaqueVal(bound.why)
+                    elif term.op in _BUILTINS:
+                        # a partial builtin (undefined on mistyped args)
+                        # gates the clause in a way we can't express — even
+                        # if the result is only used in the message head
+                        raise LowerError(
+                            f"assignment through partial builtin {term.op}")
+                    # else: user-defined function — definedness charged via
+                    # its args (library functions like get_message are total)
+                env[target.name] = bound
                 continue
             if isinstance(stmt, ast.ExprStmt):
                 pred, axis = self._lower_pred(stmt.term, env, stmt.negated)
@@ -324,6 +365,8 @@ class _Lowerer:
             # a false-valued key is DEFINED but outside the truthy keyset, so
             # keyset-contains cannot express definedness — fall back
             raise LowerError("definedness of dynamic field access")
+        if isinstance(val, DefinedOpaqueVal):
+            return []  # charged at its assignment
         if isinstance(val, OpaqueVal):
             raise LowerError(f"definedness of opaque value: {val.why}")
         return []
@@ -336,7 +379,9 @@ class _Lowerer:
             if term.name in env:
                 v = env[term.name]
                 if isinstance(v, IterBinding):
-                    # the iteration KEY itself (maps) is not columnized
+                    if isinstance(v.axis, Axis):
+                        # the iteration KEY of a (possibly-map) axis
+                        return MapKeyVal(v.axis, v.instance)
                     return OpaqueVal(f"iteration key {term.name} as value")
                 return v
             if term.name == "input":
@@ -695,6 +740,9 @@ class _Lowerer:
         elif isinstance(subject, ItemVal):
             subj = N.FeatSid(self._ragged_col(subject))
             group = ("axis", subject.axis, subject.instance)
+        elif isinstance(subject, MapKeyVal):
+            subj = self._sid_operand(subject)
+            group = ("axis", subject.axis, subject.instance)
         else:
             raise LowerError(
                 f"string-pred subject {type(subject).__name__}"
@@ -733,9 +781,10 @@ class _Lowerer:
             return pred, sgroup
         if sgroup is None:
             return pred, pgroup
-        # both existentials: reduce the param element axis here, leaving an
-        # axis-level predicate ([N, M, K] -> any over K)
-        return N.AnyParamList(pgroup[1], pred), sgroup
+        # both existentials: a dual group — the clause assembly nests the
+        # param reduction under the axis reduction, merging predicates that
+        # share either instance
+        return pred, ("dual", sgroup, pgroup)
 
     def _lower_cmp(self, op: str, args, env: dict):
         lhs_t, rhs_t = args
@@ -748,7 +797,7 @@ class _Lowerer:
         axis = None
         for v in (lhs, rhs):
             g = None
-            if isinstance(v, ItemVal):
+            if isinstance(v, (ItemVal, MapKeyVal)):
                 g = ("axis", v.axis, v.instance)
             elif isinstance(v, (ParamElemVal, ParamElemFieldVal)):
                 g = ("param", v.name, v.instance)
@@ -761,15 +810,17 @@ class _Lowerer:
                      else ("param", iv.name, iv.instance))
             if g is not None:
                 if axis is not None and g != axis:
-                    if axis[0] == "axis" and g[0] == "param":
-                        # feature × param-element: the param existential wins
-                        # the group; the feature axis must be object-level
-                        raise LowerError(
-                            "ragged feature compared to param element"
-                        )
-                    # two independent existentials can't fuse elementwise
-                    raise LowerError("cross-instance comparison")
-                axis = g
+                    if {axis[0], g[0]} == {"axis", "param"}:
+                        # feature × param-element: one predicate under BOTH
+                        # existentials — a dual group the clause assembly
+                        # nests as AnyAxis(... AnyParamList(...))
+                        agroup = axis if axis[0] == "axis" else g
+                        pgroup = g if g[0] == "param" else axis
+                        axis = ("dual", agroup, pgroup)
+                    else:
+                        # two independent existentials can't fuse elementwise
+                        raise LowerError("cross-instance comparison")
+                axis = g if axis is None else axis
         # equality against a boolean constant: x == true / x == false
         if op in ("equal", "neq"):
             for a, b in ((lhs, rhs), (rhs, lhs)):
@@ -895,6 +946,8 @@ class _Lowerer:
 
     # --- operand helpers ----------------------------------------------------
     def _is_stringy(self, val) -> bool:
+        if isinstance(val, MapKeyVal):
+            return True
         if isinstance(val, ConstVal):
             return isinstance(val.value, str)
         if isinstance(val, ParamVal):
@@ -919,6 +972,8 @@ class _Lowerer:
         if isinstance(val, ParamElemFieldVal):
             self._note_param_field(val.name, val.field, "num")
             return N.ParamElemFieldNum(val.name, val.field)
+        if isinstance(val, MapKeyVal):
+            raise LowerError("map iteration key used numerically")
         if isinstance(val, StrFnVal):
             inner = val.inner
             if isinstance(inner, PathVal):
@@ -940,6 +995,7 @@ class _Lowerer:
             self._note_param(val.name, "str")
             return N.ParamSid(val.name)
         if isinstance(val, ParamElemVal):
+            self._note_param(val.name, "strlist")
             return N.ParamElemSid()
         if isinstance(val, ParamElemFieldVal):
             self._note_param_field(val.name, val.field, "str")
@@ -948,6 +1004,11 @@ class _Lowerer:
             return N.FeatSid(self._scalar_col(val))
         if isinstance(val, ItemVal):
             return N.FeatSid(self._ragged_col(val))
+        if isinstance(val, MapKeyVal):
+            col = MapKeyCol(axis=val.axis)
+            if col not in self.schema.map_keys:
+                self.schema.map_keys.append(col)
+            return N.MapKeySid(col)
         raise LowerError(f"string operand {type(val).__name__}")
 
     def _intern_const(self, s: str) -> int:

@@ -169,6 +169,15 @@ class KeySetContains(Expr):
 
 
 @dataclass(frozen=True)
+class MapKeySid(Expr):
+    """The map key of the current axis item (labels[key] iteration);
+    sid -1 for list-backed items (whose Rego key is an int index — string
+    equality against it is false on both engines)."""
+
+    col: "object"  # ops.flatten.MapKeyCol
+
+
+@dataclass(frozen=True)
 class RaggedKeySetContains(Expr):
     """needle ∈ keys of the current axis item's map (dynamic field
     presence: container[probe]).  Evaluates inside AnyAxis (+ AnyParamList

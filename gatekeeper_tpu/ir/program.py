@@ -31,6 +31,7 @@ from gatekeeper_tpu.ops.flatten import (
     K_STR,
     K_TRUE,
     KeySetCol,
+    MapKeyCol,
     RaggedCol,
     RaggedKeySetCol,
     ScalarCol,
@@ -67,6 +68,8 @@ def col_key(spec) -> str:
         return "ks:" + ".".join(spec.path)
     if isinstance(spec, RaggedKeySetCol):
         return "rks:" + spec.axis.key() + ":" + ".".join(spec.subpath)
+    if isinstance(spec, MapKeyCol):
+        return "mk:" + spec.axis.key()
     raise LowerError(f"unknown column spec {spec}")
 
 
@@ -584,6 +587,16 @@ def _eval_sidlike(ctx: _Ctx, e: N.Expr):
         dotted = ".".join(e.field)
         ok = ctx.row[f"{e.param}.{dotted}__ok"]
         return ctx.row[f"{e.param}.{dotted}__sids"], ok, ok
+    if isinstance(e, N.MapKeySid):
+        a = ctx.cols.get(col_key(e.col))
+        if a is None:
+            raise LowerError(f"map-key column {e.col} not in batch")
+        sid = _expand_for_ctx(ctx, a["sid"], True)  # [N, M] ragged-shaped
+        # list-backed items carry sid -1: their Rego key is an int index —
+        # PRESENT (neq against it is defined-true) but not a string.
+        # Padding rows are masked by the enclosing AnyAxis count.
+        is_str = sid >= 0
+        return sid, is_str, jnp.ones_like(is_str)
     raise LowerError(f"not a string operand: {e}")
 
 
@@ -825,6 +838,8 @@ class CompiledProgram:
         for spec, col in batch.ragged_keysets.items():
             cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
                                    "count": jnp.asarray(col.count)}
+        for spec, col in batch.map_keys.items():
+            cols[col_key(spec)] = {"sid": jnp.asarray(col.sid)}
         if vocab is not None:
             for k, v in vocab_tables(self.program, vocab).items():
                 cols[k] = jnp.asarray(v)

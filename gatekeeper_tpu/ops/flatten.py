@@ -99,6 +99,15 @@ class KeySetCol:
 
 
 @dataclass(frozen=True)
+class MapKeyCol:
+    """The map KEY each axis item came from (items of dict-backed axes);
+    list-backed items get sid -1.  Aligned with the axis's value items so
+    ``labels[key]`` iterations can bind both key and value columns."""
+
+    axis: Axis
+
+
+@dataclass(frozen=True)
 class RaggedKeySetCol:
     """Per-axis-item key sets: the keys of the map at ``subpath`` under
     each item (e.g. the field names of every container — backs dynamic
@@ -114,6 +123,7 @@ class Schema:
     raggeds: list = field(default_factory=list)
     keysets: list = field(default_factory=list)
     ragged_keysets: list = field(default_factory=list)
+    map_keys: list = field(default_factory=list)
 
     def merge(self, other: "Schema") -> None:
         for s in other.scalars:
@@ -128,6 +138,9 @@ class Schema:
         for rk in getattr(other, "ragged_keysets", []):
             if rk not in self.ragged_keysets:
                 self.ragged_keysets.append(rk)
+        for mk in getattr(other, "map_keys", []):
+            if mk not in self.map_keys:
+                self.map_keys.append(mk)
 
     def axes(self) -> list:
         out = []
@@ -137,6 +150,9 @@ class Schema:
         for rk in self.ragged_keysets:
             if rk.axis not in out:
                 out.append(rk.axis)
+        for mk in self.map_keys:
+            if mk.axis not in out:
+                out.append(mk.axis)
         return out
 
 
@@ -170,6 +186,11 @@ class RaggedKeySetColumn:
 
 
 @dataclass
+class MapKeyColumn:
+    sid: np.ndarray  # [N, M] int32, -1 for list-backed items
+
+
+@dataclass
 class ColumnBatch:
     n: int
     scalars: dict  # ScalarCol -> ScalarColumn
@@ -177,6 +198,7 @@ class ColumnBatch:
     axis_counts: dict  # Axis -> np.ndarray [N] int32
     keysets: dict  # KeySetCol -> KeySetColumn
     ragged_keysets: dict = field(default_factory=dict)
+    map_keys: dict = field(default_factory=dict)
     # identity columns for match masks
     group_sid: np.ndarray = None
     kind_sid: np.ndarray = None
@@ -227,22 +249,30 @@ def _walk(obj: Any, path: Sequence[str]):
     return cur, True
 
 
-def _axis_items(obj: dict, axis: Axis) -> list:
+def _axis_items_keyed(obj: dict, axis: Axis) -> list:
+    """[(key_or_None, item)] — key set for items produced by map-value
+    iteration at the FINAL part of a segment."""
     items: list = []
     for seg in axis.segments:
-        level = [obj]
+        level = [(None, obj)]
         for part in seg:
             nxt = []
-            for node in level:
+            for _k, node in level:
                 val, ok = _walk(node, part)
                 if ok and isinstance(val, list):
-                    nxt.extend(val)
+                    nxt.extend((None, v) for v in val)
                 elif ok and isinstance(val, dict):
-                    # Rego xs[_] iterates map VALUES too (interp semantics)
-                    nxt.extend(val.values())
+                    nxt.extend(val.items())
             level = nxt
-        items.append(level)
-    return [x for level in items for x in level]
+        items.extend(level)
+    return items
+
+
+def _axis_items(obj: dict, axis: Axis) -> list:
+    # Rego xs[_] iterates map VALUES too; derived from the keyed walk so
+    # MapKeyColumn sids stay aligned with ragged value columns by
+    # construction
+    return [v for _k, v in _axis_items_keyed(obj, axis)]
 
 
 def _synth_review(obj: dict) -> dict:
@@ -284,17 +314,19 @@ class Flattener:
         review_cols = [c for c in self.schema.scalars
                        if c.path[:1] == ("__review__",)]
         ragged_keysets = list(getattr(self.schema, "ragged_keysets", []))
+        map_key_cols = list(getattr(self.schema, "map_keys", []))
         schema = self.schema
-        if review_cols or ragged_keysets:
+        if review_cols or ragged_keysets or map_key_cols:
             schema = Schema()
             schema.scalars = [c for c in self.schema.scalars
                               if c.path[:1] != ("__review__",)]
             schema.raggeds = list(self.schema.raggeds)
             schema.keysets = list(self.schema.keysets)
-            # ragged_keysets stay on the inner schema so axes() materializes
-            # their axis counts; the key extraction itself happens below
-            # (python-side; native ragged keysets are a ROADMAP item)
+            # ragged_keysets/map_keys stay on the inner schema so axes()
+            # materializes their axis counts; the extraction itself happens
+            # below (python-side; native support is a ROADMAP item)
             schema.ragged_keysets = list(ragged_keysets)
+            schema.map_keys = list(map_key_cols)
         inner = Flattener(schema, self.vocab, self.use_native)
         if inner.use_native:
             from gatekeeper_tpu.ops import native
@@ -318,6 +350,17 @@ class Flattener:
                     if ok:
                         kind[i], num[i], sid[i] = _classify(val, self.vocab)
                 batch.scalars[spec] = ScalarColumn(kind, num, sid)
+        for mk in getattr(self.schema, "map_keys", []):
+            n = batch.n
+            m = round_up(int(batch.axis_counts[mk.axis].max(initial=0)))
+            sid = np.full((n, m), -1, np.int32)
+            for i, obj in enumerate(objects):
+                for j, (key, _item) in enumerate(
+                    _axis_items_keyed(obj, mk.axis)[:m]
+                ):
+                    if isinstance(key, str):
+                        sid[i, j] = self.vocab.intern(key)
+            batch.map_keys[mk] = MapKeyColumn(sid)
         for rk in ragged_keysets:
             n = batch.n
             m = round_up(int(batch.axis_counts[rk.axis].max(initial=0)))

@@ -211,6 +211,8 @@ class ShardedEvaluator:
             cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
         for spec, col in batch.ragged_keysets.items():
             cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
+        for spec, col in batch.map_keys.items():
+            cols[col_key(spec)] = {"sid": col.sid}
 
         kinds = tuple(sorted(lowered))
         k = self.violations_limit

@@ -56,6 +56,12 @@ def rand_obj(rng, i):
     meta = {"name": f"o{i}"}
     if rng.random() < 0.7:
         meta["namespace"] = rng.choice(["default", "prod", "kube-system"])
+    if rng.random() < 0.4:
+        # stresses map key+value iteration (requiredannotations clause 2)
+        meta["annotations"] = {
+            k: rng.choice(["x", "", "a-b", 0, False, None, ["x"]])
+            for k in rng.sample(["a8r.io/owner", "a-2", "owner"],
+                                rng.randint(1, 2))}
     if rng.random() < 0.5:
         meta["labels"] = {
             k: rng.choice([str(rand_value(rng))[:20], False, None, 1])

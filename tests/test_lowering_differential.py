@@ -541,3 +541,174 @@ violation[{"msg": "missing gated probe"}] {
         want = len(tpu._interp.query(TARGET, [con], review).results)
         assert (g > 0) == (want > 0), (pod, g, want)
     assert got == [0, 1, 1]
+
+
+def test_map_key_iteration_as_value():
+    """labels[key] with the bound key used as a VALUE (the required-labels /
+    required-annotations clause-2 pattern): map keys columnize to a MapKeyCol;
+    the param-element × axis-item equality lowers to a dual existential
+    (reference library/general/requiredlabels template clause 2)."""
+    tpu, con = _mini_driver("""
+package k8skeyval
+
+violation[{"msg": msg}] {
+  value := input.review.object.metadata.labels[key]
+  expected := input.parameters.labels[_]
+  expected.key == key
+  not re_match(expected.allowedRegex, value)
+  msg := sprintf("<%v: %v> fails %v", [key, value, expected.allowedRegex])
+}
+""", "K8sKeyVal")
+    con.parameters = {"labels": [{"key": "owner", "allowedRegex": "^team-"}]}
+    con.raw["spec"]["parameters"] = dict(con.parameters)
+    assert "K8sKeyVal" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # matching key, regex holds -> no violation
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "a", "labels": {"owner": "team-a"}}},
+        # matching key, regex fails -> violation
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "b", "labels": {"owner": "alice"}}},
+        # key absent -> clause can't bind -> no violation
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "c", "labels": {"app": "x"}}},
+        # non-string value: re_match errors -> undefined -> not ... is TRUE
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "d", "labels": {"owner": False}}},
+        # no labels at all
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "e"}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    # oracle agreement first, then the expected pattern
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [0, 1, 0, 1, 0]
+
+
+def test_list_axis_iteration_key_is_not_a_string():
+    """Iterating a LIST binds the key var to an integer index; string
+    equality against it is false on both engines (MapKeyCol sid -1)."""
+    tpu, con = _mini_driver("""
+package k8slistkey
+
+violation[{"msg": "named index"}] {
+  c := input.review.object.spec.containers[key]
+  expected := input.parameters.names[_]
+  expected == key
+}
+""", "K8sListKey")
+    con.parameters = {"names": ["0", "c0"]}
+    con.raw["spec"]["parameters"] = dict(con.parameters)
+    assert "K8sListKey" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"name": "c0"}]}},
+        # map-shaped containers: key "c0" IS a string -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": {"c0": {"image": "x"}}}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [0, 1]
+
+
+def test_shared_param_instance_across_dual_and_plain():
+    """expected := params.xs[_] used in BOTH a dual (axis×param) predicate
+    and a plain param predicate must reduce in ONE AnyParamList."""
+    tpu, con = _mini_driver("""
+package k8ssharedelem
+
+violation[{"msg": "match"}] {
+  value := input.review.object.metadata.labels[key]
+  expected := input.parameters.xs[_]
+  expected.key == key
+  expected.mode == "enforce"
+  not startswith(value, expected.prefix)
+}
+""", "K8sSharedElem")
+    con.parameters = {"xs": [
+        {"key": "owner", "mode": "enforce", "prefix": "team-"},
+        {"key": "app", "mode": "audit", "prefix": "svc-"},
+    ]}
+    con.raw["spec"]["parameters"] = dict(con.parameters)
+    assert "K8sSharedElem" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # owner enforced and bad prefix -> violation; app is audit-mode (its
+        # elem fails mode check, so bad app prefix alone must NOT violate)
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "a",
+                      "labels": {"owner": "alice", "app": "bad"}}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "b",
+                      "labels": {"owner": "team-a", "app": "bad"}}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [1, 0]
+
+
+def test_neq_against_list_iteration_key():
+    """`expected != key` over a LIST axis: Rego binds key to an int index and
+    cross-type inequality is defined-TRUE — the device must not mask the
+    map-key slot as absent (review-found divergence)."""
+    tpu, con = _mini_driver("""
+package k8slistkeyneq
+
+violation[{"msg": "index neq"}] {
+  c := input.review.object.spec.containers[key]
+  expected := input.parameters.names[_]
+  expected != key
+}
+""", "K8sListKeyNeq")
+    con.parameters = {"names": ["c0"]}
+    con.raw["spec"]["parameters"] = dict(con.parameters)
+    assert "K8sListKeyNeq" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # list axis: key=0, "c0" != 0 is defined-true -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"name": "c0"}]}},
+        # map axis with the exact key: "c0" != "c0" false -> no violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": {"c0": {"image": "x"}}}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"containers": {"other": {"image": "x"}}}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [1, 0, 1]
+
+
+def test_partial_builtin_assignment_falls_back():
+    """lower() is undefined on a number, so a message assignment through it
+    gates the clause in a way the device can't express -> the template must
+    FALL BACK, not fabricate violations (review-found regression guard)."""
+    tpu, con = _mini_driver("""
+package k8spartialfn
+
+violation[{"msg": m}] {
+  input.review.object.spec.replicas > 0
+  m := lower(input.review.object.spec.replicas)
+}
+""", "K8sPartialFn")
+    assert "K8sPartialFn" in tpu.fallback_kinds(), tpu.lowered_kinds()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"replicas": 3}},
+    ]
+    # lower(3) undefined -> clause undefined -> NO violation
+    assert _verdicts(tpu, con, pods) == [0]
