@@ -209,6 +209,10 @@ class _Lowerer:
         self.depth = 0
         self._instances = 0
         self.param_fields: dict[str, dict] = {}
+        # (child_axis, child_instance) -> (parent_axis, parent_instance):
+        # recorded when iterating a bound item's sublist (c.ports[_]) so the
+        # clause assembly can detect correlated parent/child existentials
+        self._axis_parent: dict = {}
 
     def _fresh_instance(self) -> int:
         self._instances += 1
@@ -230,6 +234,17 @@ class _Lowerer:
 
     # --- body lowering ----------------------------------------------------
     def _lower_body(self, body, env: dict) -> N.Expr:
+        terms, open_groups = self._lower_body_parts(body, env, None)
+        assert not open_groups  # open_upto=None closes everything
+        if not terms:
+            raise LowerError("clause lowered to no predicates")
+        return N.And(tuple(terms)) if len(terms) > 1 else terms[0]
+
+    def _lower_body_parts(self, body, env: dict, open_upto):
+        """Lower a conjunction.  Groups whose every existential instance was
+        created at or before ``open_upto`` (caller bindings of an inlined
+        function) are returned OPEN for the caller's assembly to merge;
+        everything else closes here.  Returns (closed_terms, open_groups)."""
         env = dict(env)
         obj_preds: list[N.Expr] = []
         # group key: ("axis", Axis, inst) | ("param", name, inst)
@@ -275,13 +290,48 @@ class _Lowerer:
                 env[target.name] = bound
                 continue
             if isinstance(stmt, ast.ExprStmt):
-                pred, axis = self._lower_pred(stmt.term, env, stmt.negated)
-                if pred is not None:
+                for pred, axis in self._lower_pred(stmt.term, env,
+                                                   stmt.negated):
                     add_pred(pred, axis)
                 continue
             if isinstance(stmt, ast.SomeIn):
                 raise LowerError("some..in")
             raise LowerError(f"statement {type(stmt).__name__}")
+
+        # partition: groups living entirely on caller-created instances
+        # stay open; an existential spanning the call boundary (one
+        # component inside, one outside) is not expressible in this grid
+        open_groups: dict = {}
+        if open_upto is not None:
+            for group in list(axis_preds):
+                comps = ([group] if group[0] != "dual"
+                         else [group[1], group[2]])
+                outer = [c[2] <= open_upto for c in comps]
+                if all(outer):
+                    open_groups[group] = axis_preds.pop(group)
+                elif any(outer):
+                    raise LowerError(
+                        "existential spans inlined call boundary")
+        # correlated parent/child axes: an axis descending from a bound
+        # item (c.drop[_] with c bound) must not reduce independently of
+        # predicates on its parent instance — the flattened pair axis loses
+        # which parent each pair belongs to
+        comps_present = set()
+        for group in axis_preds:
+            for c in ([group] if group[0] != "dual"
+                      else [group[1], group[2]]):
+                if c[0] == "axis":
+                    comps_present.add((c[1], c[2]))
+        for a, i in comps_present:
+            pa = self._axis_parent.get((a, i))
+            while pa is not None:
+                if pa in comps_present:
+                    raise LowerError(
+                        "correlated parent/child axis existentials")
+                if open_upto is not None and pa[1] <= open_upto:
+                    raise LowerError(
+                        "nested iteration under caller-bound item")
+                pa = self._axis_parent.get(pa)
 
         # dual-group predicates reduce their param axis first, then join
         # the axis-level predicates of their shared axis instance.  A param
@@ -315,9 +365,9 @@ class _Lowerer:
                 terms.append(N.AnyAxis(group[1], inner))
             else:  # param-element existential
                 terms.append(N.AnyParamList(group[1], inner))
-        if not terms:
+        if not terms and not open_groups:
             raise LowerError("clause lowered to no predicates")
-        return N.And(tuple(terms)) if len(terms) > 1 else terms[0]
+        return terms, open_groups
 
     def _definedness_preds(self, term, env: dict) -> list:
         """Present-predicates implied by evaluating ``term`` (undefined refs
@@ -474,6 +524,15 @@ class _Lowerer:
         cenv[t1.name] = elem
         if not isinstance(e2, ast.Call):
             return OpaqueVal("comprehension predicate not a call")
+        if e2.op in ("equal", "neq") and len(e2.args) == 2:
+            # equality comprehension: ok = (feat == elem) — reuse the full
+            # rank-aware comparison lowering; its group tells us which
+            # existentials the predicate spans
+            try:
+                pred, group = self._lower_cmp(e2.op, e2.args, cenv)
+            except LowerError as err:
+                return OpaqueVal(str(err))
+            return self._compr_from_group(elem, pred, group)
         if e2.op not in self._STR_PREDS or len(e2.args) != 2:
             return OpaqueVal("comprehension predicate not a string pred")
         table_op, si, ni = self._STR_PREDS[e2.op]
@@ -486,8 +545,31 @@ class _Lowerer:
             return OpaqueVal(str(err))
         if pgroup is not None and pgroup[1] != elem.name:
             return OpaqueVal("comprehension over foreign existential")
-        self._note_param(elem.name, "strlist")
+        if not isinstance(needle, ParamElemFieldVal) and not (
+            isinstance(needle, XformElemVal)
+            and isinstance(needle.inner, ParamElemFieldVal)
+        ):
+            # objlist elems (allowed.pathPrefix) register via the field
+            # access; a bare strlist note would conflict
+            self._note_param(elem.name, "strlist")
         return BoolComprVal(elem.name, pred, sgroup)
+
+    def _compr_from_group(self, elem, pred, group):
+        """Map a lowered predicate's group onto BoolComprVal's
+        (param, axis_inst) shape; reject foreign existentials."""
+        if group is None:
+            return BoolComprVal(elem.name, pred, None)
+        if group[0] == "param":
+            if group[1] != elem.name or group[2] != elem.instance:
+                return OpaqueVal("comprehension over foreign existential")
+            return BoolComprVal(elem.name, pred, None)
+        if group[0] == "dual":
+            _d, agroup, pgroup = group
+            if pgroup[1] != elem.name or pgroup[2] != elem.instance:
+                return OpaqueVal("comprehension over foreign existential")
+            return BoolComprVal(elem.name, pred, agroup)
+        # a plain axis group means the elem never constrained the predicate
+        return OpaqueVal("comprehension predicate ignores the element")
 
     def _abstract_ref(self, term: ast.Ref, env: dict):
         base = self._abstract(term.head, env)
@@ -567,7 +649,10 @@ class _Lowerer:
         if isinstance(base, ItemVal):
             # nested list: extend every segment with the subpath as a part
             segs = tuple(seg + (base.subpath,) for seg in base.axis.segments)
-            return ItemVal(Axis(segs), (), self._fresh_instance())
+            child = ItemVal(Axis(segs), (), self._fresh_instance())
+            self._axis_parent[(child.axis, child.instance)] = (
+                base.axis, base.instance)
+            return child
         if isinstance(base, ParamVal):
             return ParamElemVal(base.name, self._fresh_instance())
         if isinstance(base, OpaqueVal):
@@ -605,45 +690,66 @@ class _Lowerer:
 
     # --- predicates ---------------------------------------------------------
     def _lower_pred(self, term, env: dict, negated: bool):
-        """Returns (expr|None, (axis, instance)|None); None expr = skip.
+        """Returns a list of (expr, group) parts ([] = skip; inlined calls
+        may contribute several groups).
 
         Negation closes over the wildcard existential:  ``not p(x[_])`` is
         ¬∃i.p(x[i]), an object-level predicate — never ∃i.¬p(x[i])."""
         before = self._instances
-        pred, group = self._lower_pred_inner(term, env)
-        if pred is None:
-            return None, None
-        if negated:
-            if group is None:
-                return N.Not(pred), None
-            if group[0] == "dual":
-                _d, agroup, pgroup = group
-                # close over any existential introduced inside the negation
-                if pgroup[2] > before:
-                    pred = N.AnyParamList(pgroup[1], pred)
-                    group = agroup
-                    if agroup[2] > before:
-                        return N.Not(N.AnyAxis(agroup[1], pred)), None
-                    return N.Not(pred), agroup
-                if agroup[2] > before:
-                    # axis fresh but param pre-bound: ∃p ¬∃c — not
-                    # expressible in this grid shape
+        result = self._lower_pred_inner(term, env)
+        parts = result if isinstance(result, list) else [result]
+        parts = [(p, g) for p, g in parts if p is not None]
+        if not parts:
+            return []
+        if not negated:
+            return parts
+        if len(parts) > 1:
+            # ¬(A(g1) ∧ B(g2)) does not distribute over groups
+            raise LowerError("negated call spans multiple groups")
+        pred, group = parts[0]
+        if group is None:
+            return [(N.Not(pred), None)]
+
+        def _check_uncorrelated(axis, inst):
+            # closing ¬∃ over a nested child axis whose parent item was
+            # bound BEFORE the negation would range over ALL parents' pairs
+            # instead of the bound one's
+            pa = self._axis_parent.get((axis, inst))
+            while pa is not None:
+                if pa[1] <= before:
                     raise LowerError(
-                        "negation over fresh axis with bound param element"
-                    )
-                return N.Not(pred), group
-            if group[2] > before:
-                # the existential was introduced INSIDE the negated term
-                # (e.g. `not containers[_].privileged`): negation closes over
-                # it — ¬∃
-                if group[0] == "axis":
-                    return N.Not(N.AnyAxis(group[1], pred)), None
-                return N.Not(N.AnyParamList(group[1], pred)), None
-            # the variable was bound before the negation
-            # (`c := containers[_]; not c.privileged`): per-item negation
-            # under the clause's shared existential — ∃c.¬
-            return N.Not(pred), group
-        return pred, group
+                        "negation over axis nested under a bound item")
+                pa = self._axis_parent.get(pa)
+
+        if group[0] == "dual":
+            _d, agroup, pgroup = group
+            # close over any existential introduced inside the negation
+            if pgroup[2] > before:
+                pred = N.AnyParamList(pgroup[1], pred)
+                group = agroup
+                if agroup[2] > before:
+                    _check_uncorrelated(agroup[1], agroup[2])
+                    return [(N.Not(N.AnyAxis(agroup[1], pred)), None)]
+                return [(N.Not(pred), agroup)]
+            if agroup[2] > before:
+                # axis fresh but param pre-bound: ∃p ¬∃c — not
+                # expressible in this grid shape
+                raise LowerError(
+                    "negation over fresh axis with bound param element"
+                )
+            return [(N.Not(pred), group)]
+        if group[2] > before:
+            # the existential was introduced INSIDE the negated term
+            # (e.g. `not containers[_].privileged`): negation closes over
+            # it — ¬∃
+            if group[0] == "axis":
+                _check_uncorrelated(group[1], group[2])
+                return [(N.Not(N.AnyAxis(group[1], pred)), None)]
+            return [(N.Not(N.AnyParamList(group[1], pred)), None)]
+        # the variable was bound before the negation
+        # (`c := containers[_]; not c.privileged`): per-item negation
+        # under the clause's shared existential — ∃c.¬
+        return [(N.Not(pred), group)]
 
     def _lower_pred_inner(self, term, env: dict):
         if isinstance(term, ast.Var) and term.name not in env:
@@ -908,6 +1014,11 @@ class _Lowerer:
         raise LowerError(f"count comparison {op} {n}")
 
     def _inline_rule(self, rule: ast.Rule, args, env: dict):
+        """Inline a call.  Predicates on CALLER-bound existentials (an item
+        argument like read_only(c)) return open, grouped under the caller's
+        instance, so the clause assembly merges them into the shared
+        AnyAxis; body-internal existentials close here.  Returns a list of
+        (pred, group) parts."""
         self.depth += 1
         if self.depth > 16:
             raise LowerError("function inlining too deep")
@@ -915,7 +1026,8 @@ class _Lowerer:
             if rule.kind not in ("function", "complete"):
                 raise LowerError(f"call of {rule.kind} rule")
             arg_vals = [self._abstract(a, env) for a in args]
-            clause_exprs = []
+            snapshot = self._instances
+            clause_parts = []
             for clause in rule.clauses:
                 if clause.els is not None:
                     raise LowerError("else in inlined function")
@@ -932,15 +1044,31 @@ class _Lowerer:
                     if not isinstance(p, ast.Var):
                         raise LowerError("pattern parameter")
                     fenv[p.name] = v
-                clause_exprs.append(self._lower_body(clause.body, fenv))
-            if not clause_exprs:
+                terms, open_groups = self._lower_body_parts(
+                    clause.body, fenv, snapshot)
+                parts = []
+                if terms:
+                    parts.append((N.And(tuple(terms)) if len(terms) > 1
+                                  else terms[0], None))
+                for g, preds in open_groups.items():
+                    parts.append((N.And(tuple(preds)) if len(preds) > 1
+                                  else preds[0], g))
+                if not parts:
+                    raise LowerError("empty inlined clause")
+                clause_parts.append(parts)
+            if not clause_parts:
                 raise LowerError("empty function")
-            expr = (
-                N.Or(tuple(clause_exprs))
-                if len(clause_exprs) > 1
-                else clause_exprs[0]
-            )
-            return expr, None
+            if len(clause_parts) == 1:
+                return clause_parts[0]
+            # multi-clause OR: only mergeable when every clause is a single
+            # part under the same group
+            groups = {parts[0][1] if len(parts) == 1 else ...
+                      for parts in clause_parts}
+            if len(groups) != 1 or ... in groups:
+                raise LowerError(
+                    "OR of inlined clauses across existential groups")
+            return [(N.Or(tuple(parts[0][0] for parts in clause_parts)),
+                     groups.pop())]
         finally:
             self.depth -= 1
 

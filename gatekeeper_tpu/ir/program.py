@@ -469,6 +469,27 @@ class _ElemListSid(N.Expr):
 _ELEM_OF = _ElemListSid
 
 
+def pack_batch_cols(batch: ColumnBatch) -> dict:
+    """cols dict (numpy) from a ColumnBatch — the single packing shared by
+    CompiledProgram.run, the sharded sweep, and the driver entry points."""
+    cols: dict = {}
+    for spec, col in batch.scalars.items():
+        cols[col_key(spec)] = {"kind": col.kind, "num": col.num,
+                               "sid": col.sid}
+    for spec, col in batch.raggeds.items():
+        cols[col_key(spec)] = {"kind": col.kind, "num": col.num,
+                               "sid": col.sid}
+    for axis, cnt in batch.axis_counts.items():
+        cols[axis_key(axis)] = cnt
+    for spec, col in batch.keysets.items():
+        cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
+    for spec, col in batch.ragged_keysets.items():
+        cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
+    for spec, col in batch.map_keys.items():
+        cols[col_key(spec)] = {"sid": col.sid}
+    return cols
+
+
 def vocab_tables(program: N.Program, vocab: Vocab) -> dict:
     """Shared (non-vmapped) vocab-derived arrays for the cols dict."""
     out = {}
@@ -821,25 +842,7 @@ class CompiledProgram:
     def run(self, batch: ColumnBatch, param_table: dict,
             vocab: Optional[Vocab] = None) -> np.ndarray:
         """Returns verdicts [C, N] (numpy bool)."""
-        cols: dict = {}
-        for spec, col in batch.scalars.items():
-            cols[col_key(spec)] = {"kind": jnp.asarray(col.kind),
-                                   "num": jnp.asarray(col.num),
-                                   "sid": jnp.asarray(col.sid)}
-        for spec, col in batch.raggeds.items():
-            cols[col_key(spec)] = {"kind": jnp.asarray(col.kind),
-                                   "num": jnp.asarray(col.num),
-                                   "sid": jnp.asarray(col.sid)}
-        for axis, cnt in batch.axis_counts.items():
-            cols[axis_key(axis)] = jnp.asarray(cnt)
-        for spec, col in batch.keysets.items():
-            cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
-                                   "count": jnp.asarray(col.count)}
-        for spec, col in batch.ragged_keysets.items():
-            cols[col_key(spec)] = {"sid": jnp.asarray(col.sid),
-                                   "count": jnp.asarray(col.count)}
-        for spec, col in batch.map_keys.items():
-            cols[col_key(spec)] = {"sid": jnp.asarray(col.sid)}
+        cols = jax.tree.map(jnp.asarray, pack_batch_cols(batch))
         if vocab is not None:
             for k, v in vocab_tables(self.program, vocab).items():
                 cols[k] = jnp.asarray(v)

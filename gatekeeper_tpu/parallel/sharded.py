@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gatekeeper_tpu.ir.program import build_param_table, vocab_tables
+from gatekeeper_tpu.ir.program import (build_param_table, pack_batch_cols,
+                                        vocab_tables)
 from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
 
 
@@ -198,21 +199,7 @@ class ShardedEvaluator:
         from gatekeeper_tpu.ir import masks as masks_mod
         from gatekeeper_tpu.ir.program import col_key, axis_key
 
-        cols: dict = {}
-        for spec, col in batch.scalars.items():
-            cols[col_key(spec)] = {"kind": col.kind, "num": col.num,
-                                   "sid": col.sid}
-        for spec, col in batch.raggeds.items():
-            cols[col_key(spec)] = {"kind": col.kind, "num": col.num,
-                                   "sid": col.sid}
-        for axis, cnt in batch.axis_counts.items():
-            cols[axis_key(axis)] = cnt
-        for spec, col in batch.keysets.items():
-            cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
-        for spec, col in batch.ragged_keysets.items():
-            cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
-        for spec, col in batch.map_keys.items():
-            cols[col_key(spec)] = {"sid": col.sid}
+        cols = pack_batch_cols(batch)
 
         kinds = tuple(sorted(lowered))
         k = self.violations_limit

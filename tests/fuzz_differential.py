@@ -33,7 +33,8 @@ LIB = os.path.join(os.path.dirname(__file__), "..", "library", "general")
 TARGET = "admission.k8s.gatekeeper.sh"
 
 IMAGES = ["openpolicyagent/opa:0.9.2", "nginx", "nginx:latest", "a/b:v1",
-          "registry.corp:5000/x/y@sha256:ab", "", ":weird", "latest"]
+          "registry.corp:5000/x/y@sha256:ab", "", ":weird", "latest",
+          "openpolicyagent/opa@sha256:" + "1" * 64]
 VALUES = [True, False, 0, 1, -1, 2.5, "", "x", None, [], {},
           "user.agilebank.demo", "user"]
 
@@ -89,6 +90,19 @@ def rand_obj(rng, i):
                 # False-valued probes stress truthy-key semantics
                 c[rng.choice(["readinessProbe", "livenessProbe"])] = \
                     rng.choice([{}, {"httpGet": {}}, False, None])
+            if rng.random() < 0.4:
+                sc = {}
+                if rng.random() < 0.6:
+                    sc["readOnlyRootFilesystem"] = rng.choice(
+                        [True, False, "true", None])
+                if rng.random() < 0.6:
+                    sc["capabilities"] = {
+                        k: rng.sample(["NET_BIND_SERVICE", "SYS_ADMIN",
+                                       "NET_RAW", "ALL", "*"],
+                                      rng.randint(0, 3))
+                        for k in rng.sample(["add", "drop"],
+                                            rng.randint(1, 2))}
+                c["securityContext"] = sc
             containers.append(c)
         spec["containers"] = containers
     for key in ("hostPID", "hostIPC", "hostNetwork"):
@@ -98,6 +112,31 @@ def rand_obj(rng, i):
         spec["replicas"] = rng.choice([0, 1, 3, 50, 51, "3"])
     if kind == "Service":
         spec["type"] = rng.choice(["ClusterIP", "NodePort", "LoadBalancer"])
+        if rng.random() < 0.5:
+            spec["externalIPs"] = [
+                rng.choice(["203.0.113.0", "10.0.0.1", "", 8, None])
+                for _ in range(rng.randint(1, 2))]
+    if kind == "Pod" and rng.random() < 0.25:
+        spec["securityContext"] = {"sysctls": rng.choice([
+            [{"name": "kernel.msgmax", "value": "1"}],
+            [{"name": "net.core.somaxconn"}],
+            [{"name": "net.ipv4.tcp_syncookies", "value": "1"},
+             {"name": "kernel.shm_rmid_forced"}],
+            [{"name": 5}], [{}], "oops",
+        ])}
+    if rng.random() < 0.3:
+        spec["volumes"] = [
+            rng.choice([{"hostPath": {"path": p}},
+                        {"hostPath": {}}, {"emptyDir": {}}, {}])
+            for p in rng.sample(["/var/log/app", "/etc", "/var", ""],
+                                rng.randint(1, 2))]
+    if kind == "Ingress":
+        if rng.random() < 0.4:
+            spec["tls"] = rng.choice([[], [{"hosts": ["a.com"]}], "bad"])
+        if rng.random() < 0.4:
+            meta.setdefault("annotations", {})[
+                "kubernetes.io/ingress.allow-http"] = rng.choice(
+                ["false", "true", False, ""])
     if kind == "Ingress" and rng.random() < 0.8:
         spec["rules"] = [{"host": rng.choice(
             ["a.com", "b.com", ""])} for _ in range(rng.randint(0, 2))]

@@ -712,3 +712,130 @@ violation[{"msg": m}] {
     ]
     # lower(3) undefined -> clause undefined -> NO violation
     assert _verdicts(tpu, con, pods) == [0]
+
+
+def test_inlined_function_shares_caller_existential():
+    """not f(c) with c bound: the inlined body's predicates must merge into
+    the CALLER's AnyAxis (∃c: name ∧ ¬f(c)), not close their own
+    object-level existential (fuzzer-found divergence: a single compliant
+    container masked violations by its siblings)."""
+    tpu, con = _mini_driver("""
+package k8sinlineshare
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  not read_only(c)
+  msg := sprintf("container <%v>", [c.name])
+}
+
+read_only(c) {
+  c.securityContext.readOnlyRootFilesystem == true
+}
+""", "K8sInlineShare")
+    assert "K8sInlineShare" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # one compliant + one violating container: must still violate
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [
+             {"name": "good",
+              "securityContext": {"readOnlyRootFilesystem": True}},
+             {"name": "bad"}]}},
+        # all compliant
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [
+             {"name": "good",
+              "securityContext": {"readOnlyRootFilesystem": True}}]}},
+        # string-typed true is NOT boolean true
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"containers": [
+             {"name": "strtrue",
+              "securityContext": {"readOnlyRootFilesystem": "true"}}]}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [1, 0, 1]
+
+
+def test_correlated_nested_axes_fall_back():
+    """Predicates on a parent item AND a nested sub-list (c.name with
+    c.caps.drop[_]) lose their correlation in the flattened pair axis —
+    the clause must fall back to the interpreter, not evaluate the two
+    existentials independently (fuzzer-found divergence)."""
+    tpu, con = _mini_driver("""
+package k8scorrelated
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  d := c.securityContext.capabilities.drop[_]
+  d == "ALL"
+  msg := sprintf("container <%v> drops ALL", [c.name])
+}
+""", "K8sCorrelated")
+    assert "K8sCorrelated" in tpu.fallback_kinds(), tpu.lowered_kinds()
+    pods = [
+        # the dropping container has no name: interpreter yields NO
+        # violation (msg undefined); independent existentials would
+        # wrongly combine c0's name with c1's drop
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [
+             {"name": "c0"},
+             {"securityContext": {"capabilities": {"drop": ["ALL"]}}}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [
+             {"name": "c0",
+              "securityContext": {"capabilities": {"drop": ["ALL"]}}}]}},
+    ]
+    assert _verdicts(tpu, con, pods) == [0, 1]
+
+
+def test_uncorrelated_nested_axis_still_lowers():
+    """Nested iteration WITHOUT parent-item predicates (the
+    hostnetworkingports shape) keeps its single flattened pair axis."""
+    tpu, con = _mini_driver("""
+package k8spairax
+
+violation[{"msg": "big port"}] {
+  input.review.object.spec.containers[_].ports[_].hostPort > 9000
+}
+""", "K8sPairAx")
+    assert "K8sPairAx" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"ports": [{"hostPort": 80}]},
+                                 {"ports": [{"hostPort": 9001}]}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"ports": [{"hostPort": 80}]}]}},
+    ]
+    assert _verdicts(tpu, con, pods) == [1, 0]
+
+
+def test_negated_nested_axis_under_bound_item_falls_back():
+    """`c := containers[_]; not c.ports[_].hostPort` — the ¬∃ would close
+    over ALL containers' flattened pairs, not just c's; must fall back
+    (review-found divergence)."""
+    tpu, con = _mini_driver("""
+package k8snegnested
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  not c.ports[_].hostPort
+  msg := sprintf("container <%v> has no hostPort", [c.name])
+}
+""", "K8sNegNested")
+    assert "K8sNegNested" in tpu.fallback_kinds(), tpu.lowered_kinds()
+    pods = [
+        # c0 has no ports: interpreter violates; independent ¬∃ over all
+        # pairs would see c1's port and say no violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [
+             {"name": "c0"},
+             {"name": "c1", "ports": [{"hostPort": 80}]}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"name": "c0",
+                                  "ports": [{"hostPort": 80}]}]}},
+    ]
+    assert _verdicts(tpu, con, pods) == [1, 0]
