@@ -133,12 +133,15 @@ class DynFieldVal:
 
 @dataclass(frozen=True)
 class XformElemVal:
-    """String transform of a param element: prefix + elem + suffix
+    """Static string transform of a param element: strips applied first
+    (trim_prefix/trim_suffix, no-op when absent), then prefix + s + suffix
     (the concat(":", ["", tag]) idiom)."""
 
     inner: Any  # ParamElemVal | ParamElemFieldVal
     prefix: str = ""
     suffix: str = ""
+    strip_prefix: str = ""
+    strip_suffix: str = ""
 
 
 @dataclass(frozen=True)
@@ -298,18 +301,20 @@ class _Lowerer:
                 raise LowerError("some..in")
             raise LowerError(f"statement {type(stmt).__name__}")
 
-        # partition: groups living entirely on caller-created instances
-        # stay open; an existential spanning the call boundary (one
-        # component inside, one outside) is not expressible in this grid
+        # partition duals: both components caller-created → return whole
+        # dual open; outer axis × inner param flows through the dual
+        # closure below (the param reduces into AnyParamList, landing on
+        # the outer axis group, which the plain partition then opens);
+        # inner axis × outer param is not expressible in this grid
         open_groups: dict = {}
         if open_upto is not None:
-            for group in list(axis_preds):
-                comps = ([group] if group[0] != "dual"
-                         else [group[1], group[2]])
-                outer = [c[2] <= open_upto for c in comps]
-                if all(outer):
+            for group in [g for g in list(axis_preds) if g[0] == "dual"]:
+                agroup, pgroup = group[1], group[2]
+                a_out = agroup[2] <= open_upto
+                p_out = pgroup[2] <= open_upto
+                if a_out and p_out:
                     open_groups[group] = axis_preds.pop(group)
-                elif any(outer):
+                elif p_out and not a_out:
                     raise LowerError(
                         "existential spans inlined call boundary")
         # correlated parent/child axes: an axis descending from a bound
@@ -358,6 +363,12 @@ class _Lowerer:
             inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
             axis_preds.setdefault(agroup, []).append(
                 N.AnyParamList(pgroup[1], inner))
+        # plain groups on caller-created instances return open (including
+        # axis groups just fed by the dual closure above)
+        if open_upto is not None:
+            for group in list(axis_preds):
+                if group[2] <= open_upto:
+                    open_groups[group] = axis_preds.pop(group)
         terms = list(obj_preds)
         for group, preds in axis_preds.items():
             inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
@@ -464,6 +475,19 @@ class _Lowerer:
                 term.args[1], ast.ArrayTerm
             ):
                 return self._abstract_concat(term, env)
+            if term.op in ("trim_prefix", "trim_suffix") and (
+                len(term.args) == 2
+            ):
+                inner = self._abstract(term.args[0], env)
+                affix = self._abstract(term.args[1], env)
+                if isinstance(inner, (ParamElemVal, ParamElemFieldVal)) \
+                        and isinstance(affix, ConstVal) \
+                        and isinstance(affix.value, str):
+                    if term.op == "trim_prefix":
+                        return XformElemVal(inner,
+                                            strip_prefix=affix.value)
+                    return XformElemVal(inner, strip_suffix=affix.value)
+                return OpaqueVal(f"call {term.op}")
             return OpaqueVal(f"call {term.op}")
         if isinstance(term, ast.ArrayCompr):
             return self._abstract_bool_compr(term, env)
@@ -849,13 +873,20 @@ class _Lowerer:
         elif isinstance(subject, MapKeyVal):
             subj = self._sid_operand(subject)
             group = ("axis", subject.axis, subject.instance)
+        elif isinstance(subject, (ParamElemVal, ParamElemFieldVal)):
+            # the subject itself iterates a param list
+            # (endswith(forbidden, "*")): elem sids index the pred matrix
+            subj = self._sid_operand(subject)
+            group = ("param", subject.name, subject.instance)
         else:
             raise LowerError(
                 f"string-pred subject {type(subject).__name__}"
             )
-        prefix = suffix = ""
+        prefix = suffix = strip_p = strip_s = ""
         if isinstance(needle, XformElemVal):
             prefix, suffix = needle.prefix, needle.suffix
+            strip_p = needle.strip_prefix
+            strip_s = needle.strip_suffix
             needle = needle.inner
         if isinstance(needle, ConstVal) and isinstance(needle.value, str):
             ndl = N.ConstSid(self._intern_const(
@@ -869,13 +900,14 @@ class _Lowerer:
                 group, None
         if isinstance(needle, ParamElemVal):
             self._note_param(needle.name, "strlist")
-            ndl = _ElemListSid(needle.name, prefix, suffix)
+            ndl = _ElemListSid(needle.name, prefix, suffix,
+                               strip_p, strip_s)
             return N.StrPred(table_op, subj, ndl), group, (
                 "param", needle.name, needle.instance)
         if isinstance(needle, ParamElemFieldVal):
             self._note_param_field(needle.name, needle.field, "str")
             ndl = N.ParamElemFieldSid(needle.name, needle.field, prefix,
-                                      suffix)
+                                      suffix, strip_p, strip_s)
             return N.StrPred(table_op, subj, ndl), group, (
                 "param", needle.name, needle.instance)
         raise LowerError(f"string-pred needle {type(needle).__name__}")
@@ -984,8 +1016,22 @@ class _Lowerer:
         # truthy but != true), so test the kind tag, not truthiness
         return N.KindIs(col, 2 if want else 1), axis
 
+    _CMPNUM_OP = {"lt": "lt", "lte": "lte", "gt": "gt", "gte": "gte",
+                  "equal": "eq", "neq": "neq"}
+
     def _lower_count_cmp(self, op: str, set_term, n, env: dict):
         val = self._abstract(set_term, env)
+        if isinstance(val, PathVal):
+            # count(obj.spec.tls) OP n: composite item count / string length
+            if val.path[:2] != OBJECT_ROOT:
+                raise LowerError("count() outside review object")
+            col = self._scalar_col(val)
+            axis = Axis(((val.path[2:],),))
+            # a ragged col on the axis materializes its item counts
+            self._ragged_col(ItemVal(axis, (), 0))
+            cmp = N.CmpNum(N.CountNum(col, axis), self._CMPNUM_OP[op],
+                           N.ConstNum(float(n)))
+            return cmp, None
         if not isinstance(val, SetDiffVal):
             raise LowerError("count() of non set-diff pattern")
         if val.required.field:

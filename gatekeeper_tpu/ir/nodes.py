@@ -95,6 +95,8 @@ class ParamElemFieldSid(Expr):
     field: tuple
     prefix: str = ""
     suffix: str = ""
+    strip_prefix: str = ""
+    strip_suffix: str = ""
 
 
 @dataclass(frozen=True)
@@ -121,6 +123,16 @@ class ParamFnNum(Expr):
 
     fn: str
     name: str
+
+
+@dataclass(frozen=True)
+class CountNum(Expr):
+    """Rego count() of the value at a scalar path: item count of the
+    derived axis for composites, string length (vocab 'count' table) for
+    strings; undefined for other kinds (validity gates the comparison)."""
+
+    col: FeatCol  # ScalarCol at the path (kind/sid)
+    axis: Axis  # materializes the composite item count
 
 
 @dataclass(frozen=True)

@@ -28,6 +28,7 @@ from gatekeeper_tpu.ir import nodes as N
 from gatekeeper_tpu.ops.flatten import (
     ColumnBatch,
     K_NUM,
+    K_OTHER,
     K_STR,
     K_TRUE,
     KeySetCol,
@@ -218,16 +219,31 @@ def pred_matrix(vocab: Vocab, op: str):
     return mat
 
 
+def _needle_xform(needle, s: str) -> str:
+    """Static needle transform: strips first (trim_prefix/trim_suffix
+    no-op when the affix is absent), then concatenation."""
+    sp = getattr(needle, "strip_prefix", "")
+    ss = getattr(needle, "strip_suffix", "")
+    if sp and s.startswith(sp):
+        s = s[len(sp):]
+    if ss and s.endswith(ss):
+        s = s[: len(s) - len(ss)]
+    return needle.prefix + s + needle.suffix
+
+
+def _xf_tag(needle) -> str:
+    parts = (needle.prefix, needle.suffix,
+             getattr(needle, "strip_prefix", ""),
+             getattr(needle, "strip_suffix", ""))
+    return "|" + "|".join(parts) if any(parts) else ""
+
+
 def strtab_key(op: str, needle) -> str:
     if isinstance(needle, N.ParamElemFieldSid):
         base = f"{needle.param}.{'.'.join(needle.field)}"
-        xf = f"|{needle.prefix}|{needle.suffix}" if (
-            needle.prefix or needle.suffix) else ""
-        return f"{base}__strtab_{op}{xf}"
+        return f"{base}__strtab_{op}{_xf_tag(needle)}"
     base = needle.param
-    xf = f"|{needle.prefix}|{needle.suffix}" if (
-        needle.prefix or needle.suffix) else ""
-    return f"{base}__strtab_{op}{xf}"
+    return f"{base}__strtab_{op}{_xf_tag(needle)}"
 
 
 def p_has(params: dict, name: str) -> bool:
@@ -394,8 +410,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                                 else None
                         if isinstance(cur, str):
                             rowidx[i, j] = pred_table_row(
-                                vocab, node.op,
-                                needle.prefix + cur + needle.suffix)
+                                vocab, node.op, _needle_xform(needle, cur))
                             ok[i, j] = True
                 table[key] = jnp.asarray(rowidx)
                 table[key + "__ok"] = jnp.asarray(ok)
@@ -416,8 +431,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 for i, xs in enumerate(lists):
                     for j, x in enumerate(xs):
                         rowidx[i, j] = pred_table_row(
-                            vocab, node.op,
-                            needle.prefix + x + needle.suffix)
+                            vocab, node.op, _needle_xform(needle, x))
                         ok[i, j] = True
                 table[key] = jnp.asarray(rowidx)
                 table[key + "__ok"] = jnp.asarray(ok)
@@ -448,22 +462,31 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
 
 class _ElemListSid(N.Expr):
     """Marker: StrPred needle iterating a plain string-list param, with an
-    optional static prefix/suffix transform (concat idiom)."""
+    optional static transform: strip_prefix/strip_suffix (trim_prefix /
+    trim_suffix — no-op when absent, Rego semantics) applied first, then
+    prefix/suffix concatenation (concat idiom)."""
 
-    __slots__ = ("param", "prefix", "suffix")
+    __slots__ = ("param", "prefix", "suffix", "strip_prefix",
+                 "strip_suffix")
 
-    def __init__(self, param: str, prefix: str = "", suffix: str = ""):
+    def __init__(self, param: str, prefix: str = "", suffix: str = "",
+                 strip_prefix: str = "", strip_suffix: str = ""):
         self.param = param
         self.prefix = prefix
         self.suffix = suffix
+        self.strip_prefix = strip_prefix
+        self.strip_suffix = strip_suffix
+
+    def _key(self):
+        return (self.param, self.prefix, self.suffix, self.strip_prefix,
+                self.strip_suffix)
 
     def __hash__(self):
-        return hash(("_ElemListSid", self.param, self.prefix, self.suffix))
+        return hash(("_ElemListSid",) + self._key())
 
     def __eq__(self, other):
         return (isinstance(other, _ElemListSid)
-                and (other.param, other.prefix, other.suffix)
-                == (self.param, self.prefix, self.suffix))
+                and other._key() == self._key())
 
 
 _ELEM_OF = _ElemListSid
@@ -500,6 +523,10 @@ def vocab_tables(program: N.Program, vocab: Vocab) -> dict:
             out[f"fn:{node.fn}:ok"] = valid
         elif isinstance(node, N.StrPred):
             out[f"st:{node.op}"] = pred_matrix(vocab, node.op)
+        elif isinstance(node, N.CountNum):
+            num, valid = fn_table(vocab, "count")
+            out["fn:count:num"] = num
+            out["fn:count:ok"] = valid
     return out
 
 
@@ -579,6 +606,18 @@ def _eval_cmp_operand(ctx: _Ctx, e: N.Expr):
         # units.parse of a non-string / unparseable string is UNDEFINED in
         # Rego (builtin error), so validity gates the whole comparison
         return num[safe], jnp.int8(2), valid, valid
+    if isinstance(e, N.CountNum):
+        a = _feat_arrays(ctx, e.col)
+        kind = _expand_for_ctx(ctx, a["kind"], False)
+        sid = _expand_for_ctx(ctx, a["sid"], False)
+        cnt = _expand_for_ctx(ctx, ctx.cols[axis_key(e.axis)], False)
+        strlen = ctx.cols["fn:count:num"]
+        safe = jnp.clip(sid, 0, strlen.shape[0] - 1)
+        num = jnp.where(kind == K_STR, strlen[safe],
+                        cnt.astype(jnp.float32))
+        # count() is defined for strings and composites only
+        valid = (kind == K_STR) | (kind == K_OTHER)
+        return num, jnp.int8(2), valid, valid
     raise LowerError(f"not a numeric operand: {e}")
 
 

@@ -839,3 +839,91 @@ violation[{"msg": msg}] {
                                   "ports": [{"hostPort": 80}]}]}},
     ]
     assert _verdicts(tpu, con, pods) == [1, 0]
+
+
+def test_count_of_path_value():
+    """count(obj.spec.tls) OP n on device: composite item count, string
+    LENGTH for strings, undefined for scalars/null (CountNum node)."""
+    tpu, con = _mini_driver("""
+package k8scountpath
+
+violation[{"msg": "too few tls"}] {
+  count(input.review.object.spec.tls) == 0
+}
+
+violation[{"msg": "big name"}] {
+  count(input.review.object.metadata.nick) > 3
+}
+""", "K8sCountPath")
+    assert "K8sCountPath" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # empty list: count 0 -> violation 1
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "a", "nick": "ab"}, "spec": {"tls": []}},
+        # non-empty map counts entries; nick len 5 > 3 -> violation 2
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "b", "nick": "abcde"},
+         "spec": {"tls": {"x": 1}}},
+        # tls missing -> count undefined -> no violation; no nick
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {}},
+        # tls is a NUMBER: count undefined (not a collection/string)
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "d"},
+         "spec": {"tls": 7}},
+        # tls is a string: count = length 3 != 0 -> no violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "e"},
+         "spec": {"tls": "abc"}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [1, 1, 0, 0, 0]
+
+
+def test_param_elem_subject_and_trim_suffix():
+    """The forbiddensysctls shape: the param ELEMENT is the string-pred
+    subject (endswith(forbidden, "*")) and the needle is
+    trim_suffix(forbidden, "*") — wildcard-prefix matching on device."""
+    tpu, con = _mini_driver("""
+package k8strimsfx
+
+violation[{"msg": msg}] {
+  name := input.review.object.spec.sysctls[_].name
+  bad(name)
+  msg := sprintf("forbidden <%v>", [name])
+}
+
+bad(name) {
+  input.parameters.forbidden[_] == name
+}
+
+bad(name) {
+  f := input.parameters.forbidden[_]
+  endswith(f, "*")
+  startswith(name, trim_suffix(f, "*"))
+}
+""", "K8sTrimSfx")
+    con.parameters = {"forbidden": ["kernel.*", "net.core.somaxconn"]}
+    con.raw["spec"]["parameters"] = dict(con.parameters)
+    assert "K8sTrimSfx" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"sysctls": [{"name": "kernel.msgmax"}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"sysctls": [{"name": "net.core.somaxconn"}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"sysctls": [{"name": "net.ipv4.ip_forward"}]}},
+        # exact-match clause must NOT wildcard: "kernel." prefix only via *
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "d"},
+         "spec": {"sysctls": [{"name": "net.core.somaxconn2"}]}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [1, 1, 0, 0]
