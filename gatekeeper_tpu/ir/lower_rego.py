@@ -41,6 +41,7 @@ from gatekeeper_tpu.ops.flatten import (
     Axis,
     KeySetCol,
     MapKeyCol,
+    ParentIdxCol,
     RaggedCol,
     RaggedKeySetCol,
     ScalarCol,
@@ -301,12 +302,31 @@ class _Lowerer:
                 raise LowerError("some..in")
             raise LowerError(f"statement {type(stmt).__name__}")
 
-        # partition duals: both components caller-created → return whole
-        # dual open; outer axis × inner param flows through the dual
-        # closure below (the param reduces into AnyParamList, landing on
-        # the outer axis group, which the plain partition then opens);
-        # inner axis × outer param is not expressible in this grid
         open_groups: dict = {}
+
+        # (A) callee pre-pass: a dual on a FRESH child axis with a CALLER
+        # param element closes the child per-parent (absorbing plain preds
+        # on the same child instance), re-keying as (parent, param) — which
+        # the partition below returns open for the caller to merge
+        if open_upto is not None:
+            for group in [g for g in list(axis_preds) if g[0] == "dual"]:
+                agroup, pgroup = group[1], group[2]
+                if agroup[2] > open_upto and pgroup[2] <= open_upto:
+                    parent = self._axis_parent.get((agroup[1], agroup[2]))
+                    if parent is None or parent[1] > open_upto:
+                        raise LowerError(
+                            "existential spans inlined call boundary")
+                    preds = axis_preds.pop(group)
+                    plain = axis_preds.pop(
+                        ("axis", agroup[1], agroup[2]), None)
+                    if plain:
+                        preds = list(preds) + list(plain)
+                    node = self._nested_any(agroup[1], parent[0], preds)
+                    axis_preds.setdefault(
+                        ("dual", ("axis",) + parent, pgroup),
+                        []).append(node)
+
+        # (B) partition duals: both components caller-created → open whole
         if open_upto is not None:
             for group in [g for g in list(axis_preds) if g[0] == "dual"]:
                 agroup, pgroup = group[1], group[2]
@@ -317,33 +337,13 @@ class _Lowerer:
                 elif p_out and not a_out:
                     raise LowerError(
                         "existential spans inlined call boundary")
-        # correlated parent/child axes: an axis descending from a bound
-        # item (c.drop[_] with c bound) must not reduce independently of
-        # predicates on its parent instance — the flattened pair axis loses
-        # which parent each pair belongs to
-        comps_present = set()
-        for group in axis_preds:
-            for c in ([group] if group[0] != "dual"
-                      else [group[1], group[2]]):
-                if c[0] == "axis":
-                    comps_present.add((c[1], c[2]))
-        for a, i in comps_present:
-            pa = self._axis_parent.get((a, i))
-            while pa is not None:
-                if pa in comps_present:
-                    raise LowerError(
-                        "correlated parent/child axis existentials")
-                if open_upto is not None and pa[1] <= open_upto:
-                    raise LowerError(
-                        "nested iteration under caller-bound item")
-                pa = self._axis_parent.get(pa)
 
-        # dual-group predicates reduce their param axis first, then join
-        # the axis-level predicates of their shared axis instance.  A param
-        # instance is ONE existential: plain predicates on the same instance
-        # (probe == "x") must reduce inside the SAME AnyParamList as the
-        # dual predicates (c[probe]) — and an instance shared by two dual
-        # groups cannot be split at all.
+        # (C) dual-group predicates reduce their param axis first, then
+        # join the axis-level predicates of their shared axis instance.  A
+        # param instance is ONE existential: plain predicates on the same
+        # instance (probe == "x") must reduce inside the SAME AnyParamList
+        # as the dual predicates (c[probe]) — and an instance shared by two
+        # dual groups cannot be split at all.
         dual_groups = [g for g in axis_preds if g[0] == "dual"]
         pgroup_uses: dict = {}
         for group in dual_groups:
@@ -363,8 +363,46 @@ class _Lowerer:
             inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
             axis_preds.setdefault(agroup, []).append(
                 N.AnyParamList(pgroup[1], inner))
-        # plain groups on caller-created instances return open (including
-        # axis groups just fed by the dual closure above)
+
+        # (D) close child axes into per-parent NestedAny reductions WHERE
+        # correlation demands it: the parent instance carries its own
+        # predicates, two child groups share one parent binding, or the
+        # parent is caller-bound (its predicates live across the call
+        # boundary).  Otherwise the flat pair axis is equivalent (∃pair ≡
+        # ∃parent ∃child) and cheaper.  Caller-bound child instances are
+        # never closed here — they return open below.
+        changed = True
+        while changed:
+            changed = False
+            by_parent: dict = {}
+            for g in axis_preds:
+                if g[0] != "axis":
+                    continue
+                pa = self._axis_parent.get((g[1], g[2]))
+                if pa is not None:
+                    by_parent.setdefault(pa, []).append(g)
+            for group in list(axis_preds):
+                if group[0] != "axis":
+                    continue
+                if open_upto is not None and group[2] <= open_upto:
+                    continue  # caller's binding: returned open
+                parent = self._axis_parent.get((group[1], group[2]))
+                if parent is None:
+                    continue
+                pkey = ("axis",) + parent
+                need = (pkey in axis_preds
+                        or len(by_parent.get(parent, [])) > 1
+                        or (open_upto is not None
+                            and parent[1] <= open_upto))
+                if not need:
+                    continue
+                preds = axis_preds.pop(group)
+                node = self._nested_any(group[1], parent[0], preds)
+                axis_preds.setdefault(pkey, []).append(node)
+                changed = True
+                break
+
+        # (E) plain groups on caller-created instances return open
         if open_upto is not None:
             for group in list(axis_preds):
                 if group[2] <= open_upto:
@@ -734,16 +772,22 @@ class _Lowerer:
         if group is None:
             return [(N.Not(pred), None)]
 
-        def _check_uncorrelated(axis, inst):
-            # closing ¬∃ over a nested child axis whose parent item was
-            # bound BEFORE the negation would range over ALL parents' pairs
-            # instead of the bound one's
+        def _close_fresh_axis(axis, inst, inner):
+            """Close ∃ over a fresh axis inside a negation.  A child axis
+            whose DIRECT parent item was bound before the negation closes
+            per-parent (NestedAny) and stays grouped under the parent;
+            otherwise closes object-level (AnyAxis)."""
             pa = self._axis_parent.get((axis, inst))
-            while pa is not None:
-                if pa[1] <= before:
+            if pa is not None and pa[1] <= before:
+                return (self._nested_any(axis, pa[0], [inner]),
+                        ("axis",) + pa)
+            pa2 = pa
+            while pa2 is not None:
+                if pa2[1] <= before:
                     raise LowerError(
-                        "negation over axis nested under a bound item")
-                pa = self._axis_parent.get(pa)
+                        "negation over deeply nested bound axes")
+                pa2 = self._axis_parent.get(pa2)
+            return N.AnyAxis(axis, inner), None
 
         if group[0] == "dual":
             _d, agroup, pgroup = group
@@ -752,12 +796,19 @@ class _Lowerer:
                 pred = N.AnyParamList(pgroup[1], pred)
                 group = agroup
                 if agroup[2] > before:
-                    _check_uncorrelated(agroup[1], agroup[2])
-                    return [(N.Not(N.AnyAxis(agroup[1], pred)), None)]
+                    closed, g = _close_fresh_axis(agroup[1], agroup[2],
+                                                  pred)
+                    return [(N.Not(closed), g)]
                 return [(N.Not(pred), agroup)]
             if agroup[2] > before:
-                # axis fresh but param pre-bound: ∃p ¬∃c — not
-                # expressible in this grid shape
+                # axis fresh but param pre-bound: per-parent closure keeps
+                # the (parent, param) dual; without a bound parent the
+                # shape ∃p ¬∃c is not expressible in this grid
+                pa = self._axis_parent.get((agroup[1], agroup[2]))
+                if pa is not None and pa[1] <= before:
+                    nested = self._nested_any(agroup[1], pa[0], [pred])
+                    return [(N.Not(nested),
+                             ("dual", ("axis",) + pa, pgroup))]
                 raise LowerError(
                     "negation over fresh axis with bound param element"
                 )
@@ -767,8 +818,8 @@ class _Lowerer:
             # (e.g. `not containers[_].privileged`): negation closes over
             # it — ¬∃
             if group[0] == "axis":
-                _check_uncorrelated(group[1], group[2])
-                return [(N.Not(N.AnyAxis(group[1], pred)), None)]
+                closed, g = _close_fresh_axis(group[1], group[2], pred)
+                return [(N.Not(closed), g)]
             return [(N.Not(N.AnyParamList(group[1], pred)), None)]
         # the variable was bound before the negation
         # (`c := containers[_]; not c.privileged`): per-item negation
@@ -1019,6 +1070,14 @@ class _Lowerer:
     _CMPNUM_OP = {"lt": "lt", "lte": "lte", "gt": "gt", "gte": "gte",
                   "equal": "eq", "neq": "neq"}
 
+    def _nested_any(self, child_axis, parent_axis, preds) -> "N.Expr":
+        picol = ParentIdxCol(axis=child_axis, parent=parent_axis)
+        if picol not in self.schema.parent_idx:
+            self.schema.parent_idx.append(picol)
+        parent_col = self._ragged_col(ItemVal(parent_axis, (), 0))
+        inner = N.And(tuple(preds)) if len(preds) > 1 else preds[0]
+        return N.NestedAny(picol, parent_col, inner)
+
     def _lower_count_cmp(self, op: str, set_term, n, env: dict):
         val = self._abstract(set_term, env)
         if isinstance(val, PathVal):
@@ -1106,15 +1165,26 @@ class _Lowerer:
                 raise LowerError("empty function")
             if len(clause_parts) == 1:
                 return clause_parts[0]
-            # multi-clause OR: only mergeable when every clause is a single
-            # part under the same group
-            groups = {parts[0][1] if len(parts) == 1 else ...
-                      for parts in clause_parts}
-            if len(groups) != 1 or ... in groups:
+            # multi-clause OR: mergeable when every clause is a single part
+            # and the groups share one axis component; a plain axis part
+            # broadcasts over the param element dim of a sibling dual
+            if any(len(parts) != 1 for parts in clause_parts):
                 raise LowerError(
                     "OR of inlined clauses across existential groups")
-            return [(N.Or(tuple(parts[0][0] for parts in clause_parts)),
-                     groups.pop())]
+            groups = [parts[0][1] for parts in clause_parts]
+            uniq = set(groups)
+            if len(uniq) == 1:
+                return [(N.Or(tuple(p[0][0] for p in clause_parts)),
+                         groups[0])]
+            axis_of = {g[1] if g is not None and g[0] == "dual" else g
+                       for g in groups}
+            duals = {g for g in uniq if g is not None and g[0] == "dual"}
+            if len(axis_of) == 1 and len(duals) == 1:
+                # same axis everywhere, one dual: merge under it
+                return [(N.Or(tuple(p[0][0] for p in clause_parts)),
+                         duals.pop())]
+            raise LowerError(
+                "OR of inlined clauses across existential groups")
         finally:
             self.depth -= 1
 

@@ -227,6 +227,19 @@ class AnyAxis(Expr):
 
 
 @dataclass(frozen=True)
+class NestedAny(Expr):
+    """Per-parent-item ∃ over a nested pair axis: inside the parent's
+    AnyAxis, true for parent slot p iff some pair j with parent_idx[j]==p
+    satisfies inner (evaluated in the CHILD's ragged context).  Expresses
+    correlated iteration like `c := containers[_]; c.caps.drop[_] == x`
+    without losing which container each pair belongs to."""
+
+    col: "object"  # ops.flatten.ParentIdxCol
+    parent_col: "object"  # RaggedCol on the parent axis (shape source)
+    inner: Expr
+
+
+@dataclass(frozen=True)
 class AnyParamList(Expr):
     """∃ element of a list parameter satisfying inner (inner uses
     ParamElemSid / ParamElemField*) — e.g. required-labels: any required

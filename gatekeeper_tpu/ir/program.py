@@ -33,6 +33,7 @@ from gatekeeper_tpu.ops.flatten import (
     K_TRUE,
     KeySetCol,
     MapKeyCol,
+    ParentIdxCol,
     RaggedCol,
     RaggedKeySetCol,
     ScalarCol,
@@ -71,6 +72,8 @@ def col_key(spec) -> str:
         return "rks:" + spec.axis.key() + ":" + ".".join(spec.subpath)
     if isinstance(spec, MapKeyCol):
         return "mk:" + spec.axis.key()
+    if isinstance(spec, ParentIdxCol):
+        return "pi:" + spec.axis.key() + "|" + spec.parent.key()
     raise LowerError(f"unknown column spec {spec}")
 
 
@@ -510,6 +513,8 @@ def pack_batch_cols(batch: ColumnBatch) -> dict:
         cols[col_key(spec)] = {"sid": col.sid, "count": col.count}
     for spec, col in batch.map_keys.items():
         cols[col_key(spec)] = {"sid": col.sid}
+    for spec, col in batch.parent_idx.items():
+        cols[col_key(spec)] = {"idx": col.idx}
     return cols
 
 
@@ -825,6 +830,28 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         if inner.ndim == 3:
             valid = valid[..., None]
         return jnp.any(inner & valid, axis=1)
+    if isinstance(e, N.NestedAny):
+        if ctx.axis is None:
+            raise LowerError("NestedAny outside a parent AnyAxis")
+        a = ctx.cols.get(col_key(e.col))
+        if a is None:
+            raise LowerError(f"parent-idx column {e.col} not in batch")
+        pi = a["idx"]  # [N, Mc]
+        child_counts = ctx.cols[axis_key(e.col.axis)]  # [N]
+        pshape = _feat_arrays(ctx, e.parent_col)["kind"].shape[1]  # P
+        prev = ctx.axis
+        ctx.axis = e.col.axis
+        try:
+            inner = eval_expr(ctx, e.inner)  # [N, Mc] (+K)
+        finally:
+            ctx.axis = prev
+        mc = pi.shape[1]
+        cvalid = jnp.arange(mc) < child_counts[:, None]  # [N, Mc]
+        mask = (pi[:, None, :] == jnp.arange(pshape)[None, :, None]) \
+            & cvalid[:, None, :]  # [N, P, Mc]
+        if inner.ndim == 3:  # elem ctx: [N, Mc, K]
+            return jnp.any(mask[..., None] & inner[:, None, :, :], axis=2)
+        return jnp.any(mask & inner[:, None, :], axis=2)  # [N, P]
     if isinstance(e, N.AnyParamList):
         if ctx.elem_k is not None:
             raise LowerError("nested AnyParamList unsupported")

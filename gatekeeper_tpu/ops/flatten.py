@@ -108,6 +108,23 @@ class MapKeyCol:
 
 
 @dataclass(frozen=True)
+class ParentIdxCol:
+    """For a nested pair axis (containers[_].caps.drop[_]): the ordinal of
+    each pair's PARENT item in the parent axis's enumeration (-1 padding).
+    Backs per-parent reductions (NestedAny) — segment-aligned by
+    construction: child segments are parent segments each extended by one
+    subpath part."""
+
+    axis: Axis  # the child (pair) axis
+    parent: Axis
+
+
+@dataclass(frozen=True)
+class ParentIdxColumn:
+    idx: "np.ndarray"  # [N, M] int32, -1 padding
+
+
+@dataclass(frozen=True)
 class RaggedKeySetCol:
     """Per-axis-item key sets: the keys of the map at ``subpath`` under
     each item (e.g. the field names of every container — backs dynamic
@@ -124,6 +141,7 @@ class Schema:
     keysets: list = field(default_factory=list)
     ragged_keysets: list = field(default_factory=list)
     map_keys: list = field(default_factory=list)
+    parent_idx: list = field(default_factory=list)
 
     def merge(self, other: "Schema") -> None:
         for s in other.scalars:
@@ -141,6 +159,9 @@ class Schema:
         for mk in getattr(other, "map_keys", []):
             if mk not in self.map_keys:
                 self.map_keys.append(mk)
+        for pi in getattr(other, "parent_idx", []):
+            if pi not in self.parent_idx:
+                self.parent_idx.append(pi)
 
     def axes(self) -> list:
         out = []
@@ -153,6 +174,10 @@ class Schema:
         for mk in self.map_keys:
             if mk.axis not in out:
                 out.append(mk.axis)
+        for pi in self.parent_idx:
+            for a in (pi.axis, pi.parent):
+                if a not in out:
+                    out.append(a)
         return out
 
 
@@ -199,6 +224,7 @@ class ColumnBatch:
     keysets: dict  # KeySetCol -> KeySetColumn
     ragged_keysets: dict = field(default_factory=dict)
     map_keys: dict = field(default_factory=dict)
+    parent_idx: dict = field(default_factory=dict)
     # identity columns for match masks
     group_sid: np.ndarray = None
     kind_sid: np.ndarray = None
@@ -268,6 +294,25 @@ def _axis_items_keyed(obj: dict, axis: Axis) -> list:
     return items
 
 
+def _axis_items_with_parent(obj: dict, child: Axis, parent: Axis) -> list:
+    """[(parent_ordinal, item)] for a child axis whose segments extend the
+    parent's one-for-one; the parent ordinal is the item's index in
+    _axis_items(obj, parent)."""
+    out = []
+    base = 0
+    for pseg, cseg in zip(parent.segments, child.segments):
+        sub = cseg[-1]
+        parents = _axis_items(obj, Axis((pseg,)))
+        for k, pit in enumerate(parents):
+            val, ok = _walk(pit, sub)
+            if ok and isinstance(val, list):
+                out.extend((base + k, v) for v in val)
+            elif ok and isinstance(val, dict):
+                out.extend((base + k, v) for v in val.values())
+        base += len(parents)
+    return out
+
+
 def _axis_items(obj: dict, axis: Axis) -> list:
     # Rego xs[_] iterates map VALUES too; derived from the keyed walk so
     # MapKeyColumn sids stay aligned with ragged value columns by
@@ -315,8 +360,9 @@ class Flattener:
                        if c.path[:1] == ("__review__",)]
         ragged_keysets = list(getattr(self.schema, "ragged_keysets", []))
         map_key_cols = list(getattr(self.schema, "map_keys", []))
+        parent_idx_cols = list(getattr(self.schema, "parent_idx", []))
         schema = self.schema
-        if review_cols or ragged_keysets or map_key_cols:
+        if review_cols or ragged_keysets or map_key_cols or parent_idx_cols:
             schema = Schema()
             schema.scalars = [c for c in self.schema.scalars
                               if c.path[:1] != ("__review__",)]
@@ -327,6 +373,7 @@ class Flattener:
             # below (python-side; native support is a ROADMAP item)
             schema.ragged_keysets = list(ragged_keysets)
             schema.map_keys = list(map_key_cols)
+            schema.parent_idx = list(parent_idx_cols)
         inner = Flattener(schema, self.vocab, self.use_native)
         if inner.use_native:
             from gatekeeper_tpu.ops import native
@@ -361,6 +408,15 @@ class Flattener:
                     if isinstance(key, str):
                         sid[i, j] = self.vocab.intern(key)
             batch.map_keys[mk] = MapKeyColumn(sid)
+        for pic in parent_idx_cols:
+            n = batch.n
+            m = round_up(int(batch.axis_counts[pic.axis].max(initial=0)))
+            idx = np.full((n, m), -1, np.int32)
+            for i, obj in enumerate(objects):
+                pairs = _axis_items_with_parent(obj, pic.axis, pic.parent)
+                for j, (pk, _item) in enumerate(pairs[:m]):
+                    idx[i, j] = pk
+            batch.parent_idx[pic] = ParentIdxColumn(idx)
         for rk in ragged_keysets:
             n = batch.n
             m = round_up(int(batch.axis_counts[rk.axis].max(initial=0)))

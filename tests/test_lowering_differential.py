@@ -760,11 +760,11 @@ read_only(c) {
     assert got == [1, 0, 1]
 
 
-def test_correlated_nested_axes_fall_back():
+def test_correlated_nested_axes_lower_per_parent():
     """Predicates on a parent item AND a nested sub-list (c.name with
-    c.caps.drop[_]) lose their correlation in the flattened pair axis —
-    the clause must fall back to the interpreter, not evaluate the two
-    existentials independently (fuzzer-found divergence)."""
+    c.caps.drop[_]) must evaluate per-parent (NestedAny), never as two
+    independent existentials (fuzzer-found divergence, now lowered via the
+    parent-index column)."""
     tpu, con = _mini_driver("""
 package k8scorrelated
 
@@ -775,7 +775,7 @@ violation[{"msg": msg}] {
   msg := sprintf("container <%v> drops ALL", [c.name])
 }
 """, "K8sCorrelated")
-    assert "K8sCorrelated" in tpu.fallback_kinds(), tpu.lowered_kinds()
+    assert "K8sCorrelated" in tpu.lowered_kinds(), tpu.fallback_kinds()
     pods = [
         # the dropping container has no name: interpreter yields NO
         # violation (msg undefined); independent existentials would
@@ -813,9 +813,9 @@ violation[{"msg": "big port"}] {
     assert _verdicts(tpu, con, pods) == [1, 0]
 
 
-def test_negated_nested_axis_under_bound_item_falls_back():
-    """`c := containers[_]; not c.ports[_].hostPort` — the ¬∃ would close
-    over ALL containers' flattened pairs, not just c's; must fall back
+def test_negated_nested_axis_under_bound_item():
+    """`c := containers[_]; not c.ports[_].hostPort` — the ¬∃ must close
+    over c's OWN pairs (per-parent NestedAny), not all containers'
     (review-found divergence)."""
     tpu, con = _mini_driver("""
 package k8snegnested
@@ -826,7 +826,7 @@ violation[{"msg": msg}] {
   msg := sprintf("container <%v> has no hostPort", [c.name])
 }
 """, "K8sNegNested")
-    assert "K8sNegNested" in tpu.fallback_kinds(), tpu.lowered_kinds()
+    assert "K8sNegNested" in tpu.lowered_kinds(), tpu.fallback_kinds()
     pods = [
         # c0 has no ports: interpreter violates; independent ¬∃ over all
         # pairs would see c1's port and say no violation
@@ -927,3 +927,86 @@ bad(name) {
         want = len(tpu._interp.query(TARGET, [con], review).results)
         assert g == want, (pod, g, want)
     assert got == [1, 1, 0, 0]
+
+
+def test_callee_preds_on_caller_bound_child_axis():
+    """big(p) with p a caller-bound PAIR item: the callee's predicates must
+    merge into the caller's pair existential, then close per-parent as ONE
+    NestedAny — never two independent reductions (review-found
+    divergence)."""
+    tpu, con = _mini_driver("""
+package k8scalleechild
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  p := c.ports[_]
+  big(p)
+  p.hostPort < 200
+  msg := sprintf("container <%v>", [c.name])
+}
+
+big(p) {
+  p.hostPort > 100
+}
+""", "K8sCalleeChild")
+    assert "K8sCalleeChild" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # no single port in (100, 200): ports 300 and 50 -> NO violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [
+             {"name": "c0",
+              "ports": [{"hostPort": 300}, {"hostPort": 50}]}]}},
+        # port 150 satisfies both -> violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"name": "c0",
+                                  "ports": [{"hostPort": 150}]}]}},
+        # 150 in one container, name in the other: per-container NestedAny
+        # still violates via c1 (both preds on the same pair)
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"containers": [
+             {"name": "c0", "ports": [{"hostPort": 300}]},
+             {"name": "c1", "ports": [{"hostPort": 150}]}]}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [0, 1, 1]
+
+
+def test_plain_and_dual_preds_share_pair_binding():
+    """p.name == params.names[_] AND p.hostPort > 100 on the same bound
+    pair p: one conjunction over one existential, not two decorrelated
+    reductions (review-found divergence)."""
+    tpu, con = _mini_driver("""
+package k8spairshare
+
+violation[{"msg": "match"}] {
+  c := input.review.object.spec.containers[_]
+  p := c.ports[_]
+  p.name == input.parameters.names[_]
+  p.hostPort > 100
+}
+""", "K8sPairShare")
+    con.parameters = {"names": ["web"]}
+    con.raw["spec"]["parameters"] = dict(con.parameters)
+    assert "K8sPairShare" in tpu.lowered_kinds(), tpu.fallback_kinds()
+    pods = [
+        # no single port is both named "web" AND > 100 -> NO violation
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"ports": [
+             {"name": "web", "hostPort": 50},
+             {"name": "x", "hostPort": 200}]}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"ports": [
+             {"name": "web", "hostPort": 200}]}]}},
+    ]
+    got = _verdicts(tpu, con, pods)
+    target = K8sValidationTarget()
+    for pod, g in zip(pods, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [0, 1]
