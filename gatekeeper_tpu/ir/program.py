@@ -495,6 +495,51 @@ class _ElemListSid(N.Expr):
 _ELEM_OF = _ElemListSid
 
 
+def needed_fields(program: N.Program) -> dict:
+    """col_key -> set of array fields the program's evaluator actually
+    reads.  Drives transfer slimming: the flattener materializes kind/num/
+    sid for every column, but e.g. a Truthy-only column never needs its num
+    or sid array on device."""
+    need: dict = {}
+
+    def add(spec, *fields):
+        need.setdefault(col_key(spec), set()).update(fields)
+
+    for node in expr_nodes(program):
+        if isinstance(node, (N.Truthy, N.Present, N.KindIs)):
+            add(node.col, "kind")
+        elif isinstance(node, N.FeatNum):
+            add(node.col, "kind", "num")
+        elif isinstance(node, N.FeatSid):
+            add(node.col, "kind", "sid")
+        elif isinstance(node, N.CountNum):
+            add(node.col, "kind", "sid")
+        elif isinstance(node, (N.KeySetContains, N.RaggedKeySetContains)):
+            add(node.keyset, "sid", "count")
+        elif isinstance(node, N.MapKeySid):
+            add(node.col, "sid")
+        elif isinstance(node, N.NestedAny):
+            add(node.col, "idx")
+            add(node.parent_col, "kind")
+    return need
+
+
+def slim_cols(cols: dict, needs: dict) -> dict:
+    """Drop per-column arrays no program reads (axis counts and vocab
+    tables always ship — they are tiny or shared)."""
+    out = {}
+    for key, val in cols.items():
+        if not isinstance(val, dict):
+            out[key] = val  # axis counts / vocab tables
+            continue
+        want = needs.get(key)
+        if want is None:
+            out[key] = val  # unknown consumer: keep everything
+        else:
+            out[key] = {k: v for k, v in val.items() if k in want}
+    return out
+
+
 def pack_batch_cols(batch: ColumnBatch) -> dict:
     """cols dict (numpy) from a ColumnBatch — the single packing shared by
     CompiledProgram.run, the sharded sweep, and the driver entry points."""
@@ -908,7 +953,9 @@ class CompiledProgram:
     def run(self, batch: ColumnBatch, param_table: dict,
             vocab: Optional[Vocab] = None) -> np.ndarray:
         """Returns verdicts [C, N] (numpy bool)."""
-        cols = jax.tree.map(jnp.asarray, pack_batch_cols(batch))
+        cols = jax.tree.map(
+            jnp.asarray,
+            slim_cols(pack_batch_cols(batch), needed_fields(self.program)))
         if vocab is not None:
             for k, v in vocab_tables(self.program, vocab).items():
                 cols[k] = jnp.asarray(v)

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gatekeeper_tpu.ir.program import (build_param_table, pack_batch_cols,
+from gatekeeper_tpu.ir.program import (build_param_table, needed_fields,
+                                        pack_batch_cols, slim_cols,
                                         vocab_tables)
 from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
 
@@ -200,6 +201,13 @@ class ShardedEvaluator:
         from gatekeeper_tpu.ir.program import col_key, axis_key
 
         cols = pack_batch_cols(batch)
+        # transfer slimming: ship only the array fields some program reads
+        needs: dict = {}
+        for kind in sorted(lowered):
+            for ck, fields in needed_fields(
+                    self.driver._programs[kind].program).items():
+                needs.setdefault(ck, set()).update(fields)
+        cols = slim_cols(cols, needs)
 
         kinds = tuple(sorted(lowered))
         k = self.violations_limit
