@@ -35,6 +35,18 @@ def main(argv=None) -> int:
                    help="log structured deny events (reference --log-denies)")
     p.add_argument("--certs-dir", default="",
                    help="serve TLS using (or generating) certs in this dir")
+    p.add_argument("--client-ca-file", default="",
+                   help="require and verify client certificates against "
+                        "this CA (reference --client-ca-name)")
+    p.add_argument("--tls-min-version", default="1.3",
+                   choices=["1.2", "1.3"])
+    p.add_argument("--shutdown-delay", type=float, default=0.0,
+                   help="seconds to keep serving after SIGTERM before "
+                        "shutting down (reference --shutdown-delay)")
+    p.add_argument("--enable-profile", action="store_true",
+                   help="serve /debug/profile?seconds=N (pprof equivalent)")
+    p.add_argument("--cert-rotation-check-s", type=float, default=3600.0,
+                   help="cert expiry check interval for the rotation loop")
     p.add_argument("--once", action="store_true",
                    help="run one audit sweep and exit (no servers)")
     args = p.parse_args(argv)
@@ -125,6 +137,9 @@ def main(argv=None) -> int:
             certfile = os.path.join(args.certs_dir, "tls.crt")
             keyfile = os.path.join(args.certs_dir, "tls.key")
         server = WebhookServer(
+            client_ca_file=args.client_ca_file or None,
+            tls_min_version=args.tls_min_version,
+            enable_profile=args.enable_profile,
             validation_handler=ValidationHandler(
                 client,
                 expansion_system=mgr.expansion_system,
@@ -149,13 +164,44 @@ def main(argv=None) -> int:
             metrics=metrics,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
+        if args.certs_dir:
+            import threading
+
+            from gatekeeper_tpu.webhook.certs import rotation_loop
+
+            rot_stop = threading.Event()
+            threading.Thread(
+                target=rotation_loop,
+                args=(args.certs_dir, server, rot_stop,
+                      args.cert_rotation_check_s),
+                daemon=True,
+            ).start()
+
+    # graceful shutdown: on SIGTERM keep serving --shutdown-delay seconds
+    # (reference main.go manages this so the LB deregisters the pod first)
+    import signal
+    import threading
+
+    stopping = threading.Event()
+
+    def _on_term(signum, frame):
+        print(f"signal {signum}: shutting down"
+              + (f" after {args.shutdown_delay:.0f}s drain"
+                 if args.shutdown_delay else ""), file=sys.stderr)
+        if args.shutdown_delay:
+            time.sleep(args.shutdown_delay)
+        stopping.set()
+        if audit_mgr is not None:
+            audit_mgr.stop()
+
+    signal.signal(signal.SIGTERM, _on_term)
 
     try:
         if audit_mgr is not None:
             audit_mgr.run_forever()
         else:
-            while True:
-                time.sleep(3600)
+            while not stopping.wait(1.0):
+                pass
     except KeyboardInterrupt:
         pass
     finally:

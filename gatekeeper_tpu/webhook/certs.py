@@ -77,3 +77,35 @@ def webhook_configuration(ca_bundle: str, url: str) -> dict:
                        "resources": ["*"]}],
         }],
     }
+
+
+def cert_expires_within(cert_path: str, seconds: float) -> bool:
+    """True if the certificate at ``cert_path`` expires within ``seconds``
+    (or can't be read) — drives the rotation loop."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["openssl", "x509", "-checkend", str(int(seconds)),
+             "-noout", "-in", cert_path],
+            capture_output=True, timeout=10,
+        )
+    except Exception:
+        return True
+    return proc.returncode != 0
+
+
+def rotation_loop(certs_dir: str, server, stop_event,
+                  check_interval_s: float = 3600.0,
+                  renew_before_s: float = 90 * 24 * 3600.0):
+    """Background cert rotation (reference: open-policy-agent/cert-controller
+    rotator.go wired at main.go:342): regenerate the chain when it nears
+    expiry and hot-reload the serving context."""
+    import os
+
+    crt = os.path.join(certs_dir, "tls.crt")
+    while not stop_event.wait(check_interval_s):
+        if cert_expires_within(crt, renew_before_s):
+            generate_certs(certs_dir)
+            if server is not None:
+                server.reload_certs()

@@ -21,6 +21,7 @@ MUTATE_PATH = "/v1/mutate"
 ADMIT_LABEL_PATH = "/v1/admitlabel"
 HEALTH_PATH = "/healthz"
 METRICS_PATH = "/metrics"
+PROFILE_PATH = "/debug/profile"
 
 
 def admission_response(uid: str, allowed: bool, message: str = "",
@@ -55,12 +56,16 @@ class WebhookServer:
         keyfile: Optional[str] = None,
         readiness_check=None,  # callable -> bool
         metrics=None,  # MetricsRegistry for /metrics exposition
+        client_ca_file: Optional[str] = None,  # mTLS: require client certs
+        tls_min_version: str = "1.3",  # reference --webhook-tls-min-version
+        enable_profile: bool = False,  # pprof-equivalent /debug/profile
     ):
         self.validation_handler = validation_handler
         self.mutation_handler = mutation_handler
         self.namespace_label_handler = namespace_label_handler
         self.readiness_check = readiness_check
         self.metrics = metrics
+        self.enable_profile = enable_profile
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,6 +78,9 @@ class WebhookServer:
                              or outer.readiness_check())
                     self._reply(200 if ready else 503,
                                 {"ready": bool(ready)})
+                elif self.path.startswith(PROFILE_PATH) and \
+                        outer.enable_profile:
+                    self._profile()
                 elif self.path == METRICS_PATH and outer.metrics is not None:
                     data = outer.metrics.render().encode()
                     self.send_response(200)
@@ -83,6 +91,31 @@ class WebhookServer:
                     self.wfile.write(data)
                 else:
                     self._reply(404, {"error": "not found"})
+
+            def _profile(self):
+                # pprof-equivalent: profile this process for ?seconds=N
+                # (default 2) and return cProfile stats text
+                import cProfile
+                import io
+                import pstats
+                import time as _t
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                secs = min(float(q.get("seconds", ["2"])[0]), 30.0)
+                prof = cProfile.Profile()
+                prof.enable()
+                _t.sleep(secs)
+                prof.disable()
+                buf = io.StringIO()
+                pstats.Stats(prof, stream=buf).sort_stats(
+                    "cumulative").print_stats(50)
+                data = buf.getvalue().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
@@ -147,14 +180,31 @@ class WebhookServer:
                 self.wfile.write(data)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        self._certfile, self._keyfile = certfile, keyfile
+        self._ssl_ctx = None
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile, keyfile)
-            ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+            ctx.minimum_version = {
+                "1.2": ssl.TLSVersion.TLSv1_2,
+                "1.3": ssl.TLSVersion.TLSv1_3,
+            }.get(tls_min_version, ssl.TLSVersion.TLSv1_3)
+            if client_ca_file:
+                # reference --client-ca-name: verify the apiserver's client
+                # certificate against this CA
+                ctx.load_verify_locations(client_ca_file)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            self._ssl_ctx = ctx
             self._server.socket = ctx.wrap_socket(
                 self._server.socket, server_side=True
             )
         self._thread: Optional[threading.Thread] = None
+
+    def reload_certs(self):
+        """Hot-reload the certificate chain (rotation loop); new
+        connections pick up the refreshed chain."""
+        if self._ssl_ctx is not None and self._certfile:
+            self._ssl_ctx.load_cert_chain(self._certfile, self._keyfile)
 
     @property
     def port(self) -> int:
