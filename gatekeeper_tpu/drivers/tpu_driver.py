@@ -48,6 +48,9 @@ class TpuDriver:
         self.vocab = Vocab()
         self._programs: dict[str, CompiledProgram] = {}  # kind -> compiled
         self._lower_errors: dict[str, str] = {}  # kind -> why fallback
+        self._data_version = 0
+        self._data_kind_versions: dict = {}  # inventory kind -> version
+        self._inv_cache: dict = {}  # kind -> (versions, cols, exact)
         self.batch_bucket = batch_bucket
 
     # --- Driver protocol (delegating lifecycle to the exact engine) ------
@@ -73,11 +76,13 @@ class TpuDriver:
         except LowerError as e:
             self._programs.pop(template.kind, None)
             self._lower_errors[template.kind] = str(e)
+        self._inv_cache.pop(template.kind, None)
 
     def remove_template(self, template_kind: str) -> None:
         self._interp.remove_template(template_kind)
         self._programs.pop(template_kind, None)
         self._lower_errors.pop(template_kind, None)
+        self._inv_cache.pop(template_kind, None)
 
     def add_constraint(self, constraint: Constraint) -> None:
         self._interp.add_constraint(constraint)
@@ -85,14 +90,66 @@ class TpuDriver:
     def remove_constraint(self, constraint: Constraint) -> None:
         self._interp.remove_constraint(constraint)
 
+    def _bump_data(self, path) -> None:
+        self._data_version += 1
+        # namespace-scope paths name the object kind at [3]: scope writes
+        # only dirty that kind's referential tables
+        if (len(path) >= 4 and path[0] == "namespace"):
+            self._data_kind_versions[path[3]] = self._data_version
+        else:
+            self._data_kind_versions.clear()  # unknown shape: dirty all
+
     def add_data(self, target: str, path: Sequence[str], data: Any) -> None:
         self._interp.add_data(target, path, data)
+        self._bump_data(path)
 
     def remove_data(self, target: str, path: Sequence[str]) -> None:
         self._interp.remove_data(target, path)
+        self._bump_data(path)
 
     def wipe_data(self) -> None:
         self._interp.wipe_data()
+        self._data_version += 1
+        self._data_kind_versions.clear()
+
+    # --- referential (data.inventory) join tables ----------------------
+    def inventory_cols(self, kind: str):
+        """(cols, exact) for a lowered referential template; ({}, True)
+        when the program has no inventory joins.  Cached per data version;
+        out-of-vocab sids are definite misses so vocab growth alone never
+        invalidates (see InventoryUniqueJoin eval)."""
+        from gatekeeper_tpu.ir.program import build_inventory_tables
+
+        from gatekeeper_tpu.ir import nodes as _N
+        from gatekeeper_tpu.ir.program import expr_nodes
+
+        prog = self._programs.get(kind)
+        if prog is None:
+            return {}, True
+        inv_kinds = tuple(sorted({
+            n.spec.kind for n in expr_nodes(prog.program)
+            if isinstance(n, _N.InventoryUniqueJoin)}))
+        if not inv_kinds:
+            return {}, True
+        # per-inventory-kind versions: unrelated data writes don't force a
+        # rebuild; a cleared map (wipe / odd path) falls back to the global
+        versions = tuple(
+            self._data_kind_versions.get(k, self._data_version)
+            if self._data_kind_versions else self._data_version
+            for k in inv_kinds)
+        cached = self._inv_cache.get(kind)
+        if cached is not None and cached[0] == versions:
+            return cached[1], cached[2]
+        cols, exact = build_inventory_tables(
+            prog.program, self._interp._data, self.vocab)
+        self._inv_cache[kind] = (versions, cols, exact)
+        return cols, exact
+
+    def inventory_exact(self, kind: str) -> bool:
+        """False when the kind's referential tables can't represent the
+        current inventory exactly (non-string join values): callers must
+        route the kind through the interpreter for this data version."""
+        return self.inventory_cols(kind)[1]
 
     def query(self, target, constraints, review, cfg=None) -> QueryResponse:
         return self._interp.query(target, constraints, review, cfg)
@@ -143,8 +200,9 @@ class TpuDriver:
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
 
-        lowered_kinds = [k for k in by_kind if k in self._programs]
-        fallback_kinds = [k for k in by_kind if k not in self._programs]
+        lowered_kinds = [k for k in by_kind
+                         if k in self._programs and self.inventory_exact(k)]
+        fallback_kinds = [k for k in by_kind if k not in lowered_kinds]
 
         t0 = time.perf_counter_ns()
         verdicts: dict[str, np.ndarray] = {}
@@ -178,7 +236,8 @@ class TpuDriver:
             prog = self._programs[kind]
             cons = by_kind[kind]
             table = build_param_table(prog.program, cons, self.vocab)
-            grid = prog.run(batch, table, vocab=self.vocab)  # [C, pad_n]
+            grid = prog.run(batch, table, vocab=self.vocab,
+                            extra_cols=self.inventory_cols(kind)[0])
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )

@@ -186,10 +186,59 @@ class SetDiffVal:
 
 
 @dataclass(frozen=True)
+class InventoryObjVal:
+    """An entry iterated from data.inventory.namespace[_][apiver][Kind][_]
+    (referential policies).  Joins against it lower to host-built
+    owner-count tables (N.InventoryUniqueJoin)."""
+
+    kind: str
+    instance: int
+    apiver_var: str = ""  # named apiVersion var (regex-filterable)
+
+
+@dataclass(frozen=True)
+class InventoryFeatVal:
+    """A (possibly wildcard-iterated) path under an inventory entry:
+    other.spec.rules[_].host -> ("spec", "rules", "*", "host")."""
+
+    inv: InventoryObjVal
+    path: tuple
+
+
+@dataclass(frozen=True)
+class InventoryMetaVal:
+    """A named variable bound by the inventory ref itself (ns / apiver /
+    name slots) — only filterable (re_match) or message-renderable."""
+
+    inv: InventoryObjVal
+    slot: str  # "ns" | "apiver" | "name"
+
+
+@dataclass(frozen=True)
 class OpaqueVal:
     """Value we can't lower; poisonous only if used in a predicate."""
 
     why: str
+
+
+class _InvFilterSignal(Exception):
+    """re_match(const, <inventory apiVersion var>): an apiVersion filter
+    applied at table build time."""
+
+    def __init__(self, inv, regex):
+        self.inv = inv
+        self.regex = regex
+
+
+class _InvJoinSignal(Exception):
+    """Raised by _lower_cmp when one side is an inventory feature; the
+    clause body loop catches it and records the join for fused emission at
+    assembly."""
+
+    def __init__(self, inv, feat_path, subject_val):
+        self.inv = inv
+        self.feat_path = feat_path
+        self.subject_val = subject_val
 
 
 @dataclass(frozen=True)
@@ -253,6 +302,8 @@ class _Lowerer:
         obj_preds: list[N.Expr] = []
         # group key: ("axis", Axis, inst) | ("param", name, inst)
         axis_preds: dict[tuple, list] = {}
+        # inventory instance -> {"join": (path, subject), "exclude": bool}
+        inv_records: dict[int, dict] = {}
 
         def add_pred(p: N.Expr, group):
             if group is None:
@@ -294,13 +345,59 @@ class _Lowerer:
                 env[target.name] = bound
                 continue
             if isinstance(stmt, ast.ExprStmt):
-                for pred, axis in self._lower_pred(stmt.term, env,
-                                                   stmt.negated):
+                inv = self._inventory_exclusion(stmt, env)
+                if inv is not None:
+                    inv_records.setdefault(inv.instance,
+                                           {})["exclude"] = True
+                    continue
+                try:
+                    parts = self._lower_pred(stmt.term, env, stmt.negated)
+                except _InvJoinSignal as sig:
+                    if stmt.negated:
+                        raise LowerError("negated inventory join")
+                    rec = inv_records.setdefault(sig.inv.instance, {})
+                    if "join" in rec:
+                        raise LowerError("multiple inventory joins")
+                    rec["join"] = (sig.inv, sig.feat_path, sig.subject_val)
+                    continue
+                except _InvFilterSignal as sig:
+                    if stmt.negated:
+                        raise LowerError("negated inventory filter")
+                    rec = inv_records.setdefault(sig.inv.instance, {})
+                    if "apiver_regex" in rec:
+                        raise LowerError("multiple apiVersion filters")
+                    rec["apiver_regex"] = sig.regex
+                    continue
+                for pred, axis in parts:
                     add_pred(pred, axis)
                 continue
             if isinstance(stmt, ast.SomeIn):
                 raise LowerError("some..in")
             raise LowerError(f"statement {type(stmt).__name__}")
+
+        # fused referential joins: each inventory entry iterated by this
+        # clause must have produced exactly one join equality (plus an
+        # optional identical() self-exclusion) — emit the table-lookup node
+        # under the join subject's group
+        for rec in inv_records.values():
+            if "join" not in rec:
+                raise LowerError("inventory entry without a join predicate")
+            inv, feat_path, subject = rec["join"]
+            subj = self._sid_operand(subject)
+            group = None
+            if isinstance(subject, (ItemVal, MapKeyVal)):
+                group = ("axis", subject.axis, subject.instance)
+            ns_col = self._scalar_col(
+                PathVal(OBJECT_ROOT + ("metadata", "namespace")))
+            name_col = self._scalar_col(
+                PathVal(OBJECT_ROOT + ("metadata", "name")))
+            spec = N.InvTableSpec(inv.kind, feat_path,
+                                  rec.get("apiver_regex", ""))
+            add_pred(
+                N.InventoryUniqueJoin(spec, subj, ns_col, name_col,
+                                      exclude_self=rec.get("exclude",
+                                                           False)),
+                group)
 
         open_groups: dict = {}
 
@@ -427,6 +524,14 @@ class _Lowerer:
         if isinstance(term, (ast.SetCompr, ast.ArrayCompr, ast.ObjectCompr)):
             return []  # comprehensions are total (empty on no solutions)
         if isinstance(term, (ast.Var, ast.Ref)):
+            if (isinstance(term, ast.Ref)
+                    and isinstance(term.head, ast.Var)
+                    and term.head.name == "data"
+                    and term.head.name not in env):
+                # inventory refs carry their definedness inside the fused
+                # join (∃ entry); re-abstracting here would double-bind the
+                # ref's named slot vars
+                return []
             val = self._abstract(term, env)
             return self._definedness_of_val(val)
         if isinstance(term, ast.ArrayTerm):
@@ -634,6 +739,9 @@ class _Lowerer:
         return OpaqueVal("comprehension predicate ignores the element")
 
     def _abstract_ref(self, term: ast.Ref, env: dict):
+        if (isinstance(term.head, ast.Var) and term.head.name == "data"
+                and term.head.name not in env):
+            return self._abstract_inventory_ref(term, env)
         base = self._abstract(term.head, env)
         for arg in term.args:
             if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
@@ -683,6 +791,46 @@ class _Lowerer:
                 return base
         return base
 
+    def _abstract_inventory_ref(self, term: ast.Ref, env: dict):
+        args = term.args
+        if (len(args) < 6 or not isinstance(args[0], ast.Scalar)
+                or args[0].value != "inventory"
+                or not isinstance(args[1], ast.Scalar)
+                or args[1].value != "namespace"):
+            return OpaqueVal("unbound var data")
+        # data.inventory.namespace[ns][apiver][Kind][name]
+        ns_a, av_a, kind_a, name_a = args[2:6]
+        if not (isinstance(kind_a, ast.Scalar)
+                and isinstance(kind_a.value, str)):
+            return OpaqueVal("inventory ref without a literal kind")
+
+        def slot_var(a):
+            if isinstance(a, ast.Var):
+                return a.name
+            return None
+
+        for a in (ns_a, av_a, name_a):
+            if slot_var(a) is None:
+                return OpaqueVal("inventory ref with non-var slot")
+        inv = InventoryObjVal(kind_a.value, self._fresh_instance(),
+                              apiver_var=(""
+                                          if av_a.name.startswith("$w")
+                                          else av_a.name))
+        for a, slot in ((ns_a, "ns"), (av_a, "apiver"), (name_a, "name")):
+            if not a.name.startswith("$w"):
+                if a.name in env:
+                    return OpaqueVal("inventory slot var already bound")
+                env[a.name] = InventoryMetaVal(inv, slot)
+        base = InventoryFeatVal(inv, ())
+        for arg in args[6:]:
+            if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
+                base = InventoryFeatVal(inv, base.path + (arg.value,))
+            elif isinstance(arg, ast.Var) and arg.name.startswith("$w"):
+                base = InventoryFeatVal(inv, base.path + ("*",))
+            else:
+                return OpaqueVal("inventory ref index")
+        return base if base.path else inv
+
     def _step(self, base, key: str):
         if isinstance(base, PathVal):
             if base.path == ("parameters",):
@@ -692,6 +840,10 @@ class _Lowerer:
             return ItemVal(base.axis, base.subpath + (key,), base.instance)
         if isinstance(base, ParamElemVal):
             return ParamElemFieldVal(base.name, (key,), base.instance)
+        if isinstance(base, InventoryObjVal):
+            return InventoryFeatVal(base, (key,))
+        if isinstance(base, InventoryFeatVal):
+            return InventoryFeatVal(base.inv, base.path + (key,))
         if isinstance(base, ParamElemFieldVal):
             return ParamElemFieldVal(base.name, base.field + (key,),
                                      base.instance)
@@ -717,6 +869,10 @@ class _Lowerer:
             return child
         if isinstance(base, ParamVal):
             return ParamElemVal(base.name, self._fresh_instance())
+        if isinstance(base, InventoryFeatVal):
+            # iteration within an inventory entry: the host-side table
+            # build flattens it ('*' path step)
+            return InventoryFeatVal(base.inv, base.path + ("*",))
         if isinstance(base, OpaqueVal):
             return base
         return OpaqueVal(f"iterate {type(base).__name__}")
@@ -894,6 +1050,13 @@ class _Lowerer:
             table_op, si, ni = self._STR_PREDS[op]
             subject = self._abstract(term.args[si], env)
             needle = self._abstract(term.args[ni], env)
+            if op == "re_match" and isinstance(subject, InventoryMetaVal):
+                # NB: re_match(pattern, value) — 'subject' is the VALUE arg
+                if (subject.slot == "apiver"
+                        and isinstance(needle, ConstVal)
+                        and isinstance(needle.value, str)):
+                    raise _InvFilterSignal(subject.inv, needle.value)
+                raise LowerError("unsupported inventory filter")
             return self._lower_str_pred(table_op, subject, needle)
         if op in ("any", "all") and len(term.args) == 1:
             val = self._abstract(term.args[0], env)
@@ -983,6 +1146,14 @@ class _Lowerer:
             return self._lower_count_cmp(op, lhs_t.args[0], rhs_t.value, env)
         lhs = self._abstract(lhs_t, env)
         rhs = self._abstract(rhs_t, env)
+        for a, b in ((lhs, rhs), (rhs, lhs)):
+            if isinstance(a, InventoryFeatVal):
+                if op != "equal":
+                    raise LowerError("non-equality inventory comparison")
+                if isinstance(b, (InventoryFeatVal, InventoryObjVal,
+                                  InventoryMetaVal)):
+                    raise LowerError("inventory-to-inventory comparison")
+                raise _InvJoinSignal(a.inv, a.path, b)
         axis = None
         for v in (lhs, rhs):
             g = None
@@ -1117,6 +1288,56 @@ class _Lowerer:
         if op in ("equal", "lte") and n == 0:
             return N.Not(missing_any), None
         raise LowerError(f"count comparison {op} {n}")
+
+    def _inventory_exclusion(self, stmt, env: dict):
+        """Recognize `not identical(other, input.review)` where ``other``
+        is an inventory entry and ``identical`` tests metadata namespace +
+        name equality — the self-exclusion of referential uniqueness
+        policies.  Returns the InventoryObjVal or None."""
+        if not stmt.negated or not isinstance(stmt.term, ast.Call):
+            return None
+        call = stmt.term
+        rule = self.entry_mod.rules.get(call.op)
+        if rule is None or len(call.args) != 2:
+            return None
+        inv = env.get(getattr(call.args[0], "name", None))
+        if not isinstance(inv, InventoryObjVal):
+            return None
+        second = self._abstract(call.args[1], dict(env))
+        if not (isinstance(second, PathVal) and second.path == ("review",)):
+            raise LowerError(
+                "inventory exclusion must compare against input.review")
+        if len(rule.clauses) != 1 or rule.clauses[0].value is not None:
+            raise LowerError("unrecognized inventory exclusion function")
+        clause = rule.clauses[0]
+        params = clause.args or ()
+        if len(params) != 2 or not all(isinstance(pr, ast.Var)
+                                       for pr in params):
+            raise LowerError("unrecognized inventory exclusion function")
+        fenv = {params[0].name: inv,
+                params[1].name: PathVal(("review",))}
+        needed = {("metadata", "namespace"), ("metadata", "name")}
+        seen = set()
+        for st in clause.body:
+            if not (isinstance(st, ast.ExprStmt) and not st.negated
+                    and isinstance(st.term, ast.Call)
+                    and st.term.op == "equal"
+                    and len(st.term.args) == 2):
+                raise LowerError("unrecognized inventory exclusion function")
+        for st in clause.body:
+            a = self._abstract(st.term.args[0], dict(fenv))
+            b = self._abstract(st.term.args[1], dict(fenv))
+            if isinstance(b, InventoryFeatVal):
+                a, b = b, a
+            if not (isinstance(a, InventoryFeatVal) and a.inv == inv
+                    and isinstance(b, PathVal)
+                    and b.path == OBJECT_ROOT + a.path
+                    and a.path in needed):
+                raise LowerError("unrecognized inventory exclusion function")
+            seen.add(a.path)
+        if seen != needed:
+            raise LowerError("unrecognized inventory exclusion function")
+        return inv
 
     def _inline_rule(self, rule: ast.Rule, args, env: dict):
         """Inline a call.  Predicates on CALLER-bound existentials (an item
@@ -1253,6 +1474,9 @@ class _Lowerer:
             if col not in self.schema.map_keys:
                 self.schema.map_keys.append(col)
             return N.MapKeySid(col)
+        if isinstance(val, (InventoryFeatVal, InventoryObjVal,
+                            InventoryMetaVal)):
+            raise LowerError("inventory value outside a join")
         raise LowerError(f"string operand {type(val).__name__}")
 
     def _intern_const(self, s: str) -> int:

@@ -126,6 +126,37 @@ class ParamFnNum(Expr):
 
 
 @dataclass(frozen=True)
+class InvTableSpec:
+    """Host-built inventory join table: for every object of ``kind`` in
+    data.inventory.namespace[*][apiver][kind][*], the values at
+    ``join_path`` ('*' = iterate), deduped per owner.  Device arrays
+    (vocab-padded [V]): cnt (distinct owners per value sid), ons/onm (the
+    sole owner's metadata ns/name sids when cnt==1, sentinel -2 when that
+    owner lacks the field)."""
+
+    kind: str
+    join_path: tuple  # e.g. ("spec", "rules", "*", "host")
+    apiver_regex: str = ""  # "" = any apiVersion
+
+    def key(self) -> str:
+        return f"{self.kind}|{'.'.join(self.join_path)}|{self.apiver_regex}"
+
+
+@dataclass(frozen=True)
+class InventoryUniqueJoin(Expr):
+    """∃ inventory entry (of spec.kind) whose join value equals
+    ``subject`` and whose owner differs from the review object's
+    metadata ns/name (identical() exclusion).  With exclude_self False,
+    any owner counts."""
+
+    spec: InvTableSpec
+    subject: Expr  # sid-valued
+    ns_col: "object"  # ScalarCol at metadata.namespace
+    name_col: "object"  # ScalarCol at metadata.name
+    exclude_self: bool = True
+
+
+@dataclass(frozen=True)
 class CountNum(Expr):
     """Rego count() of the value at a scalar path: item count of the
     derived axis for composites, string length (vocab 'count' table) for

@@ -521,6 +521,9 @@ def needed_fields(program: N.Program) -> dict:
         elif isinstance(node, N.NestedAny):
             add(node.col, "idx")
             add(node.parent_col, "kind")
+        elif isinstance(node, N.InventoryUniqueJoin):
+            add(node.ns_col, "sid")
+            add(node.name_col, "sid")
     return need
 
 
@@ -561,6 +564,91 @@ def pack_batch_cols(batch: ColumnBatch) -> dict:
     for spec, col in batch.parent_idx.items():
         cols[col_key(spec)] = {"idx": col.idx}
     return cols
+
+
+def build_inventory_tables(program: N.Program, data_tree: dict,
+                           vocab: Vocab) -> tuple:
+    """(cols dict, exact: bool) for the program's InvTableSpecs from the
+    interpreter's data tree.  exact=False when the inventory contains
+    non-string join values (the sid join can't represent them: the caller
+    must fall back to the interpreter for this template)."""
+    import re as _re
+
+    out: dict = {}
+    exact = True
+    inv = (data_tree or {}).get("inventory", {})
+    for node in expr_nodes(program):
+        if not isinstance(node, N.InventoryUniqueJoin):
+            continue
+        spec = node.spec
+        key = spec.key()
+        if f"inv:{key}:cnt" in out:
+            continue
+        owners_by_sid: dict = {}
+        rx = _re.compile(spec.apiver_regex) if spec.apiver_regex else None
+        for ns, by_apiver in (inv.get("namespace", {}) or {}).items():
+            if not isinstance(by_apiver, dict):
+                continue
+            for apiver, by_kind in by_apiver.items():
+                if rx is not None and not rx.search(str(apiver)):
+                    continue
+                if not isinstance(by_kind, dict):
+                    continue
+                objs = by_kind.get(spec.kind)
+                if not isinstance(objs, dict):
+                    continue
+                for _name, obj in objs.items():
+                    meta = obj.get("metadata", {}) if isinstance(
+                        obj, dict) else {}
+                    ons = meta.get("namespace") if isinstance(
+                        meta, dict) else None
+                    onm = meta.get("name") if isinstance(meta, dict) \
+                        else None
+                    # ABSENT owner fields make identical() undefined (the
+                    # entry always counts): sentinel -2 never matches an
+                    # object sid.  A PRESENT non-string field — including
+                    # null, since null == null is defined-true in Rego —
+                    # could still satisfy the equality -> inexact.
+                    for f in ("namespace", "name"):
+                        if isinstance(meta, dict) and f in meta \
+                                and not isinstance(meta[f], str):
+                            exact = False
+                    owner = (
+                        vocab.intern(ons) if isinstance(ons, str) else -2,
+                        vocab.intern(onm) if isinstance(onm, str) else -2,
+                    )
+                    vals: list = [obj]
+                    for part in spec.join_path:
+                        nxt = []
+                        for v in vals:
+                            if part == "*":
+                                if isinstance(v, list):
+                                    nxt.extend(v)
+                                elif isinstance(v, dict):
+                                    nxt.extend(v.values())
+                            elif isinstance(v, dict) and part in v:
+                                nxt.append(v[part])
+                        vals = nxt
+                    for v in vals:
+                        if isinstance(v, str):
+                            owners_by_sid.setdefault(
+                                vocab.intern(v), set()).add(owner)
+                        else:
+                            # a non-string join value can satisfy the Rego
+                            # equality against an equal non-string subject
+                            exact = False
+        vp = _vpad(len(vocab))
+        cnt = np.zeros(vp, np.int32)
+        ons_arr = np.full(vp, -3, np.int32)
+        onm_arr = np.full(vp, -3, np.int32)
+        for sid, owners in owners_by_sid.items():
+            cnt[sid] = len(owners)
+            if len(owners) == 1:
+                ons_arr[sid], onm_arr[sid] = next(iter(owners))
+        out[f"inv:{key}:cnt"] = cnt
+        out[f"inv:{key}:ons"] = ons_arr
+        out[f"inv:{key}:onm"] = onm_arr
+    return out, exact
 
 
 def vocab_tables(program: N.Program, vocab: Vocab) -> dict:
@@ -897,6 +985,28 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         if inner.ndim == 3:  # elem ctx: [N, Mc, K]
             return jnp.any(mask[..., None] & inner[:, None, :, :], axis=2)
         return jnp.any(mask & inner[:, None, :], axis=2)  # [N, P]
+    if isinstance(e, N.InventoryUniqueJoin):
+        sid, sok, _spres = _eval_sidlike(ctx, e.subject)
+        key = e.spec.key()
+        cnt = ctx.cols.get(f"inv:{key}:cnt")
+        if cnt is None:
+            raise LowerError(f"inventory table {key} not in batch")
+        ons = ctx.cols[f"inv:{key}:ons"]
+        onm = ctx.cols[f"inv:{key}:onm"]
+        safe = jnp.clip(sid, 0, cnt.shape[0] - 1)
+        c = cnt[safe]
+        # sids interned AFTER the table build (by later batch flattening)
+        # cannot be in the inventory: out-of-range is a definite miss, so
+        # stale-pad tables stay exact until the data version changes
+        hit = sok & (sid >= 0) & (sid < cnt.shape[0]) & (c >= 1)
+        if not e.exclude_self:
+            return hit
+        obj_ns = _expand_for_ctx(
+            ctx, _feat_arrays(ctx, e.ns_col)["sid"], False)
+        obj_nm = _expand_for_ctx(
+            ctx, _feat_arrays(ctx, e.name_col)["sid"], False)
+        sole_is_self = (ons[safe] == obj_ns) & (onm[safe] == obj_nm)
+        return hit & ((c >= 2) | jnp.logical_not(sole_is_self))
     if isinstance(e, N.AnyParamList):
         if ctx.elem_k is not None:
             raise LowerError("nested AnyParamList unsupported")
@@ -951,13 +1061,17 @@ class CompiledProgram:
         return batch_fn
 
     def run(self, batch: ColumnBatch, param_table: dict,
-            vocab: Optional[Vocab] = None) -> np.ndarray:
-        """Returns verdicts [C, N] (numpy bool)."""
+            vocab: Optional[Vocab] = None,
+            extra_cols: Optional[dict] = None) -> np.ndarray:
+        """Returns verdicts [C, N] (numpy bool).  ``extra_cols``: shared
+        non-batch arrays (inventory join tables)."""
         cols = jax.tree.map(
             jnp.asarray,
             slim_cols(pack_batch_cols(batch), needed_fields(self.program)))
         if vocab is not None:
             for k, v in vocab_tables(self.program, vocab).items():
                 cols[k] = jnp.asarray(v)
+        for k, v in (extra_cols or {}).items():
+            cols[k] = jnp.asarray(v)
         out = self._fn(param_table, cols)
         return np.asarray(out)

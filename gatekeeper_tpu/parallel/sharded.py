@@ -55,7 +55,7 @@ def shard_batch_arrays(cols: dict, mesh: Mesh) -> dict:
     """
     out = {}
     for key, val in cols.items():
-        if key.startswith(("fn:", "st:")):
+        if key.startswith(("fn:", "st:", "inv:")):
             # vocab-derived tables are shared lookup state: replicate
             out[key] = jax.device_put(
                 val, NamedSharding(mesh, P(*([None] * val.ndim)))
@@ -186,7 +186,9 @@ class ShardedEvaluator:
         by_kind: dict[str, list] = {}
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
-        lowered = [k for k in by_kind if k in self.driver._programs]
+        lowered = [k for k in by_kind
+                   if k in self.driver._programs
+                   and self.driver.inventory_exact(k)]
         if not lowered:
             return {}
 
@@ -232,6 +234,8 @@ class ShardedEvaluator:
             for tk, tv in vocab_tables(
                 self.driver._programs[kind].program, self.driver.vocab
             ).items():
+                cols[tk] = tv
+            for tk, tv in self.driver.inventory_cols(kind)[0].items():
                 cols[tk] = tv
         sharded_cols = shard_batch_arrays(cols, self.mesh)
         mask = np.concatenate(mask_rows, axis=0)

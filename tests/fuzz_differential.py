@@ -160,6 +160,20 @@ def main():
         tpu.add_template(t)
         constraints.append(Constraint.from_unstructured(load_yaml_file(
             os.path.join(LIB, name, "samples", "constraint.yaml"))[0]))
+    # referential coverage: seed the inventory with ingresses sharing
+    # hosts/names/namespaces with the generated review objects
+    inv_rng = random.Random(991)
+    for i in range(25):
+        ns = inv_rng.choice(["default", "prod", "kube-system"])
+        name = inv_rng.choice([f"o{j}" for j in range(40)] + ["inv-only"])
+        hosts = [inv_rng.choice(["a.com", "b.com", "", "inv.com"])
+                 for _ in range(inv_rng.randint(0, 2))]
+        tpu.add_data(
+            TARGET, ["namespace", ns, "networking.k8s.io/v1", "Ingress",
+                     f"{name}-{i}" if inv_rng.random() < 0.5 else name],
+            {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+             "metadata": {"name": name, "namespace": ns},
+             "spec": {"rules": [{"host": h} for h in hosts]}})
     print(f"templates: {len(constraints)} "
           f"({len(tpu.lowered_kinds())} lowered)")
 

@@ -1010,3 +1010,134 @@ violation[{"msg": "match"}] {
         want = len(tpu._interp.query(TARGET, [con], review).results)
         assert g == want, (pod, g, want)
     assert got == [0, 1]
+
+
+def test_referential_unique_ingress_host():
+    """data.inventory join on device (InventoryUniqueJoin): host-built
+    owner-count tables with identical() self-exclusion — the
+    uniqueingresshost policy (reference: referential policies over synced
+    inventory)."""
+    import os
+
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    lib = os.path.join(os.path.dirname(__file__), "..", "library",
+                       "general", "uniqueingresshost")
+    tpu = TpuDriver(batch_bucket=8)
+    from gatekeeper_tpu.apis.templates import ConstraintTemplate
+
+    tpu.add_template(ConstraintTemplate.from_unstructured(
+        load_yaml_file(os.path.join(lib, "template.yaml"))[0]))
+    assert "K8sUniqueIngressHost" in tpu.lowered_kinds(), \
+        tpu.fallback_kinds()
+    con = Constraint.from_unstructured(load_yaml_file(
+        os.path.join(lib, "samples", "constraint.yaml"))[0])
+    tpu.add_constraint(con)
+
+    def ing(name, ns, hosts):
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"rules": [{"host": h} for h in hosts]}}
+
+    # inventory: two ingresses; one shares a host with the review object
+    for obj in [ing("a", "default", ["a.com", "shared.com"]),
+                ing("b", "prod", ["b.com"])]:
+        tpu.add_data("admission.k8s.gatekeeper.sh",
+                     ["namespace", obj["metadata"]["namespace"],
+                      "networking.k8s.io/v1", "Ingress",
+                      obj["metadata"]["name"]], obj)
+
+    reviews_objs = [
+        # conflicts with inventory ingress a
+        ing("new", "default", ["shared.com"]),
+        # no conflict
+        ing("new2", "default", ["unique.com"]),
+        # IS inventory ingress a (self): its own hosts don't conflict,
+        # b's don't match -> no violation
+        ing("a", "default", ["a.com", "shared.com"]),
+        # same name, DIFFERENT namespace: not identical -> conflict
+        ing("a", "prod", ["a.com"]),
+        # conflicts with b
+        ing("x", "default", ["b.com"]),
+        # no rules at all
+        {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+         "metadata": {"name": "y", "namespace": "default"}, "spec": {}},
+    ]
+    got = _verdicts(tpu, con, reviews_objs)
+    target = K8sValidationTarget()
+    for pod, g in zip(reviews_objs, got):
+        review = target.handle_review(AugmentedUnstructured(object=pod))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (pod, g, want)
+    assert got == [1, 0, 0, 1, 1, 0]
+
+    # data mutation invalidates the cache: removing ingress a clears the
+    # shared.com conflict
+    tpu.remove_data("admission.k8s.gatekeeper.sh",
+                    ["namespace", "default", "networking.k8s.io/v1",
+                     "Ingress", "a"])
+    assert _verdicts(tpu, con, [reviews_objs[0]]) == [0]
+
+    # non-string join value in inventory -> runtime fallback (exactness)
+    tpu.add_data("admission.k8s.gatekeeper.sh",
+                 ["namespace", "default", "networking.k8s.io/v1",
+                  "Ingress", "weird"],
+                 {"metadata": {"name": "weird", "namespace": "default"},
+                  "spec": {"rules": [{"host": 5}]}})
+    assert not tpu.inventory_exact("K8sUniqueIngressHost")
+    # verdicts still exact via the interpreter route
+    rv = {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+          "metadata": {"name": "n", "namespace": "default"},
+          "spec": {"rules": [{"host": 5}]}}
+    got = _verdicts(tpu, con, [rv])
+    review = target.handle_review(AugmentedUnstructured(object=rv))
+    want = len(tpu._interp.query(TARGET, [con], review).results)
+    assert got == [want] == [1]  # 5 == 5 cross-entry conflict
+
+
+def test_referential_upstream_template_shape():
+    """The upstream uniqueingresshost form: NAMED inventory slot vars, a
+    re_match apiVersion filter, and slot vars in the message — still one
+    fused device join (reference library shape)."""
+    tpu, con = _mini_driver("""
+package k8srefupstream
+
+identical(obj, review) {
+  obj.metadata.namespace == review.object.metadata.namespace
+  obj.metadata.name == review.object.metadata.name
+}
+
+violation[{"msg": msg}] {
+  input.review.kind.kind == "Ingress"
+  host := input.review.object.spec.rules[_].host
+  other := data.inventory.namespace[ns][otherapiversion]["Ingress"][name]
+  re_match("^(extensions|networking.k8s.io)/", otherapiversion)
+  not identical(other, input.review)
+  other.spec.rules[_].host == host
+  msg := sprintf("host <%v> taken by %v/%v", [host, ns, name])
+}
+""", "K8sRefUpstream")
+    assert "K8sRefUpstream" in tpu.lowered_kinds(), tpu.fallback_kinds()
+
+    def ing(name, ns, hosts):
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"rules": [{"host": h} for h in hosts]}}
+
+    tpu.add_data(TARGET, ["namespace", "default", "networking.k8s.io/v1",
+                          "Ingress", "a"], ing("a", "default", ["x.com"]))
+    # an entry under a NON-matching apiVersion key: filtered out
+    tpu.add_data(TARGET, ["namespace", "default", "fake.io/v1",
+                          "Ingress", "b"], ing("b", "default", ["y.com"]))
+    objs = [
+        ing("new", "default", ["x.com"]),   # conflict via a
+        ing("new2", "default", ["y.com"]),  # b filtered by apiver regex
+        ing("a", "default", ["x.com"]),     # self
+    ]
+    got = _verdicts(tpu, con, objs)
+    target = K8sValidationTarget()
+    for o, g in zip(objs, got):
+        review = target.handle_review(AugmentedUnstructured(object=o))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (o, g, want)
+    assert got == [1, 0, 0]
