@@ -139,6 +139,7 @@ cmd_verify = _delegate("verify")
 cmd_expand = _delegate("expand_cmd")
 cmd_bench = _delegate("bench")
 cmd_sync = _delegate("sync_cmd")
+cmd_policy = _delegate("policy_cmd")
 
 
 COMMANDS = {
@@ -147,6 +148,7 @@ COMMANDS = {
     "expand": cmd_expand,
     "bench": cmd_bench,
     "sync": cmd_sync,
+    "policy": cmd_policy,
 }
 
 
@@ -154,7 +156,7 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # JAX_PLATFORMS honored at package import (gatekeeper_tpu/__init__.py)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: gator {test|verify|expand|bench|sync} [options]")
+        print("usage: gator {test|verify|expand|bench|sync|policy} [options]")
         return 0
     cmd = argv[0]
     fn = COMMANDS.get(cmd)

@@ -1,0 +1,117 @@
+"""gator policy: local catalog + OCI-image-layout bundle manager
+(reference: pkg/gator/policy + pkg/oci)."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tarfile
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.gator import policy_cmd
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+LIB = os.path.join(REPO, "library", "general")
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    bundles = tmp_path / "bundles"
+    bundles.mkdir()
+    tgz = bundles / "requiredlabels-1.1.2.tar.gz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        tf.add(os.path.join(LIB, "requiredlabels"), arcname="requiredlabels")
+
+    # OCI image layout whose single layer is a tar.gz bundle
+    oci = bundles / "allowedrepos-oci"
+    (oci / "blobs" / "sha256").mkdir(parents=True)
+    layer = tmp_path / "layer.tgz"
+    with tarfile.open(layer, "w:gz") as tf:
+        tf.add(os.path.join(LIB, "allowedrepos"), arcname="allowedrepos")
+    lb = layer.read_bytes()
+    ld = hashlib.sha256(lb).hexdigest()
+    (oci / "blobs" / "sha256" / ld).write_bytes(lb)
+    manifest = json.dumps({"schemaVersion": 2, "layers": [
+        {"mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+         "digest": f"sha256:{ld}"}]}).encode()
+    md = hashlib.sha256(manifest).hexdigest()
+    (oci / "blobs" / "sha256" / md).write_bytes(manifest)
+    (oci / "index.json").write_text(json.dumps(
+        {"schemaVersion": 2, "manifests": [{"digest": f"sha256:{md}"}]}))
+    (oci / "oci-layout").write_text('{"imageLayoutVersion": "1.0.0"}')
+
+    cat = tmp_path / "catalog.yaml"
+    cat.write_text(yaml.safe_dump({"policies": [
+        {"name": "requiredlabels",
+         "description": "Requires resources to contain specified labels.",
+         "versions": [
+             {"version": "1.1.1", "ref": "bundles/requiredlabels-1.1.2.tar.gz"},
+             {"version": "1.1.2", "ref": "bundles/requiredlabels-1.1.2.tar.gz"},
+         ]},
+        {"name": "allowedrepos",
+         "description": "Allowed repos (OCI layout bundle).",
+         "versions": [{"version": "2.0.0",
+                       "ref": "bundles/allowedrepos-oci"}]},
+    ]}))
+    return str(cat)
+
+
+def test_search(catalog):
+    rows = policy_cmd.search(catalog, "labels")
+    assert rows == [("requiredlabels", "1.1.2",
+                     "Requires resources to contain specified labels.")]
+    assert len(policy_cmd.search(catalog)) == 2
+
+
+def test_install_upgrade_remove_roundtrip(catalog, tmp_path):
+    target = str(tmp_path / "lib")
+    out = policy_cmd.install(catalog, "requiredlabels", target,
+                             version="1.1.1")
+    assert "installed 1.1.1" in out
+    assert os.path.exists(os.path.join(target, "requiredlabels",
+                                       "template.yaml"))
+    # double install refused; upgrade moves to latest
+    with pytest.raises(policy_cmd.PolicyError):
+        policy_cmd.install(catalog, "requiredlabels", target)
+    out = policy_cmd.install(catalog, "requiredlabels", target,
+                             upgrade=True)
+    assert "upgraded to 1.1.2" in out
+    assert policy_cmd.list_installed(target) == [("requiredlabels",
+                                                  "1.1.2")]
+    assert "removed" in policy_cmd.remove(target, "requiredlabels")
+    assert policy_cmd.list_installed(target) == []
+    assert not os.path.exists(os.path.join(target, "requiredlabels"))
+
+
+def test_oci_layout_install_verifies(catalog, tmp_path):
+    target = str(tmp_path / "lib")
+    policy_cmd.install(catalog, "allowedrepos", target)
+    assert os.path.exists(os.path.join(target, "allowedrepos",
+                                       "suite.yaml"))
+    # the installed bundle passes gator verify end-to-end
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "gatekeeper_tpu.gator", "verify", target],
+        capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "--- ok: allowed-repos" in proc.stdout
+
+
+def test_remote_refs_refused(catalog, tmp_path):
+    with pytest.raises(policy_cmd.PolicyError, match="no network egress"):
+        policy_cmd.load_catalog("oci://example.com/cat")
+    with pytest.raises(policy_cmd.PolicyError, match="no network egress"):
+        policy_cmd.fetch_bundle("https://x/y.tgz", ".", str(tmp_path / "d"))
+
+
+def test_traversal_bundle_refused(tmp_path):
+    evil = tmp_path / "evil.tar"
+    with tarfile.open(evil, "w") as tf:
+        info = tarfile.TarInfo("../../escape.txt")
+        info.size = 0
+        tf.addfile(info, fileobj=None)
+    with pytest.raises(policy_cmd.PolicyError, match="unsafe path"):
+        policy_cmd.fetch_bundle(str(evil), ".", str(tmp_path / "dest"))
