@@ -41,6 +41,8 @@ SYNCSET_GVK = ("syncset.gatekeeper.sh", "v1alpha1", "SyncSet")
 EXPANSION_GVK = (EXPANSION_GROUP, "v1alpha1", "ExpansionTemplate")
 PROVIDER_GVK = (PROVIDER_GROUP, "v1beta1", "Provider")
 CONNECTION_GVK = ("connection.gatekeeper.sh", "v1alpha1", "Connection")
+WEBHOOKCONFIG_GVK = ("admissionregistration.k8s.io", "v1",
+                     "ValidatingWebhookConfiguration")
 
 ALL_OPERATIONS = ("audit", "webhook", "mutation-webhook",
                   "mutation-controller", "status", "generate")
@@ -63,6 +65,7 @@ class Manager:
         self.operations = set(operations)
         self.tracker = Tracker()
         self.excluder = ProcessExcluder()
+        self.webhookconfig_cache = None  # validating webhook match scope
         self.provider_cache = provider_cache or ProviderCache()
         self.mutation_system = mutation_system or MutationSystem(
             provider_cache=self.provider_cache)
@@ -94,7 +97,7 @@ class Manager:
                 self.tracker.expect(kind, name_of(obj))
             self.tracker.populated(kind)
         for gvk in [TEMPLATES_GVK, CONFIG_GVK, SYNCSET_GVK, EXPANSION_GVK,
-                    PROVIDER_GVK, CONNECTION_GVK]:
+                    PROVIDER_GVK, CONNECTION_GVK, WEBHOOKCONFIG_GVK]:
             self.cluster.subscribe(gvk, self._dispatch, replay=True)
         for mkind in MUTATOR_KINDS:
             for version in ("v1", "v1beta1", "v1alpha1"):
@@ -127,6 +130,9 @@ class Manager:
                 self._reconcile_provider(event)
             elif (group, kind) == (CONNECTION_GVK[0], CONNECTION_GVK[2]):
                 self._reconcile_connection(event)
+            elif (group, kind) == (WEBHOOKCONFIG_GVK[0],
+                                   WEBHOOKCONFIG_GVK[2]):
+                self._reconcile_webhookconfig(event)
         except Exception as e:  # reconcile errors surface via status
             self._set_status(event.obj, error=str(e))
 
@@ -285,6 +291,32 @@ class Manager:
                 return d
         return None
 
+    def _reconcile_webhookconfig(self, event: Event) -> None:
+        """webhookconfig cache (reference: webhookconfig_controller.go:293
+        + webhookconfigcache/): cache the validating webhook's match scope
+        so generated VAPs mirror it, then refresh every generated VAP."""
+        if event.type == "DELETED":
+            self.webhookconfig_cache = None
+        else:
+            hooks = event.obj.get("webhooks") or []
+            scope = {}
+            for h in hooks:
+                if "validation" not in h.get("name", ""):
+                    continue
+                scope = {
+                    "namespaceSelector": h.get("namespaceSelector"),
+                    "objectSelector": h.get("objectSelector"),
+                    "rules": h.get("rules"),
+                }
+                break
+            self.webhookconfig_cache = scope or None
+        # re-emit VAPs for every CEL template under the new scope
+        for tobj in self.cluster.list(TEMPLATES_GVK):
+            kind = (((tobj.get("spec") or {}).get("crd") or {})
+                    .get("spec") or {}).get("names", {}).get("kind")
+            if kind:
+                self._manage_vap(tobj, kind)
+
     def _manage_vap(self, template_obj: dict, kind: str) -> None:
         driver = self._cel_driver()
         if driver is None:
@@ -295,7 +327,8 @@ class Manager:
         from gatekeeper_tpu.apis.templates import ConstraintTemplate
 
         t = ConstraintTemplate.from_unstructured(template_obj)
-        self.cluster.apply(driver.template_to_vap(t))
+        self.cluster.apply(driver.template_to_vap(
+            t, webhook_scope=self.webhookconfig_cache))
 
     def _manage_vapb(self, constraint_obj: dict) -> None:
         driver = self._cel_driver()

@@ -72,6 +72,27 @@ def parse_source(template: ConstraintTemplate) -> Optional[dict]:
     return template.targets[0].source_for(ENGINE_CEL)
 
 
+def _vap_match_constraints(webhook_scope: Optional[dict]) -> dict:
+    """matchConstraints for a generated VAP: the webhook's cached rules /
+    selectors when known, else match-everything."""
+    rules = (webhook_scope or {}).get("rules") or []
+    resource_rules = [
+        {"apiGroups": r.get("apiGroups", ["*"]),
+         "apiVersions": r.get("apiVersions", ["*"]),
+         "operations": r.get("operations", ["CREATE", "UPDATE"]),
+         "resources": r.get("resources", ["*"])}
+        for r in rules
+    ] or [{
+        "apiGroups": ["*"], "apiVersions": ["*"],
+        "operations": ["CREATE", "UPDATE"], "resources": ["*"],
+    }]
+    out: dict = {"resourceRules": resource_rules}
+    for sel in ("namespaceSelector", "objectSelector"):
+        if (webhook_scope or {}).get(sel):
+            out[sel] = webhook_scope[sel]
+    return out
+
+
 class CELDriver:
     def __init__(self, gather_stats: bool = False):
         self._templates: dict[str, _CompiledCELTemplate] = {}
@@ -256,8 +277,13 @@ class CELDriver:
         }.get(stat_name, "unknown stat")
 
     # --- VAP codegen (reference: k8scel/transform/make_vap_objects.go) --
-    def template_to_vap(self, template: ConstraintTemplate) -> dict:
-        """Lower a CEL template to a native ValidatingAdmissionPolicy."""
+    def template_to_vap(self, template: ConstraintTemplate,
+                        webhook_scope: Optional[dict] = None) -> dict:
+        """Lower a CEL template to a native ValidatingAdmissionPolicy.
+        ``webhook_scope`` (from the webhookconfig cache) mirrors the
+        validating webhook's match scope into matchConstraints so the VAP
+        enforces exactly where the webhook would (reference:
+        webhookconfig_controller.go:293 scope sync)."""
         compiled = self._templates.get(template.kind)
         source = compiled.source if compiled else parse_source(template)
         if source is None:
@@ -286,14 +312,7 @@ class CELDriver:
                     "apiVersion": "constraints.gatekeeper.sh/v1beta1",
                     "kind": template.kind,
                 },
-                "matchConstraints": {
-                    "resourceRules": [{
-                        "apiGroups": ["*"],
-                        "apiVersions": ["*"],
-                        "operations": ["CREATE", "UPDATE"],
-                        "resources": ["*"],
-                    }]
-                },
+                "matchConstraints": _vap_match_constraints(webhook_scope),
                 "matchConditions": [
                     {"name": mc.get("name", ""),
                      "expression": mc.get("expression", "")}

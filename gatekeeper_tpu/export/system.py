@@ -3,8 +3,9 @@
 Reference: pkg/export — ``System`` maps Connection CRs to pluggable drivers;
 the audit publishes audit_started / violation / audit_ended messages
 (audit/manager.go:267-295,931-936).  Drivers here: **disk** (rotating
-audit-run files, reference disk/disk.go) and **stdout**; the dapr pub-sub
-driver's slot exists for parity but requires a sidecar (stubbed).
+audit-run files, reference disk/disk.go), **stdout**, and **dapr**
+(pub-sub publish through the localhost sidecar HTTP API, reference
+export/dapr/dapr.go:93).
 """
 
 from __future__ import annotations
@@ -65,7 +66,43 @@ class StdoutDriver:
         print("export:", json.dumps(msg), flush=True)
 
 
-DRIVERS = {"disk": DiskDriver, "stdout": StdoutDriver}
+class DaprDriver:
+    """dapr pub-sub export (reference: export/dapr/dapr.go): publishes
+    each message to the local sidecar's HTTP API,
+    POST http://127.0.0.1:<port>/v1.0/publish/<component>/<topic>.  The
+    sidecar port follows the DAPR_HTTP_PORT convention."""
+
+    def __init__(self, component: str = "pubsub",
+                 topic: str = "audit-channel",
+                 port: Optional[int] = None,
+                 timeout_s: float = 5.0):
+        self.component = component
+        self.topic = topic
+        self.port = port if port is not None else int(
+            os.environ.get("DAPR_HTTP_PORT", "3500"))
+        self.timeout_s = timeout_s
+
+    def publish(self, msg: dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/v1.0/publish/"
+            f"{self.component}/{self.topic}",
+            data=json.dumps(msg).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                if r.status >= 300:
+                    raise ExportError(
+                        f"dapr sidecar returned {r.status}")
+        except ExportError:
+            raise
+        except Exception as e:
+            raise ExportError(f"dapr publish failed: {e}") from e
+
+
+DRIVERS = {"disk": DiskDriver, "stdout": StdoutDriver, "dapr": DaprDriver}
 
 
 class ExportSystem:
@@ -84,6 +121,13 @@ class ExportSystem:
                 self._connections[name] = cls(
                     config.get("path", "/tmp/gatekeeper-exports"),
                     int(config.get("maxAuditResults", 3)),
+                )
+            elif driver == "dapr":
+                self._connections[name] = cls(
+                    component=config.get("component", "pubsub"),
+                    topic=config.get("topic", "audit-channel"),
+                    port=(int(config["port"]) if "port" in config
+                          else None),
                 )
             else:
                 self._connections[name] = cls()

@@ -294,3 +294,115 @@ def test_webhook_certs(tmp_path):
     assert "OK" in proc.stdout
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(out["cert"], out["key"])  # loads without error
+
+
+def test_dapr_export_driver_publishes_to_sidecar():
+    """dapr driver POSTs messages to the sidecar pub-sub HTTP API
+    (reference export/dapr/dapr.go; a local HTTP server stands in)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from gatekeeper_tpu.export.system import ExportSystem
+
+    received = []
+
+    class Sidecar(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, _json.loads(body)))
+            self.send_response(204)
+            self.end_headers()
+
+    srv = HTTPServer(("127.0.0.1", 0), Sidecar)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        sys_ = ExportSystem()
+        sys_.upsert_connection_cr({
+            "metadata": {"name": "audit"},
+            "spec": {"driver": "dapr",
+                     "config": {"component": "pubsub",
+                                "topic": "audit-channel",
+                                "port": srv.server_address[1]}},
+        })
+        assert sys_.publish_audit_started("id-1") == []
+        assert sys_.publish({"event": "violation", "x": 1}) == []
+        path, body = received[0]
+        assert path == "/v1.0/publish/pubsub/audit-channel"
+        assert body["event"] == "audit_started"
+        assert received[1][1] == {"event": "violation", "x": 1}
+    finally:
+        srv.shutdown()
+
+    # sidecar down: publish surfaces a per-connection error (fed back to
+    # the Connection CR status in the reference)
+    sys2 = ExportSystem()
+    sys2.upsert_connection("audit", "dapr",
+                           {"port": srv.server_address[1]})
+    errs = sys2.publish({"event": "violation"})
+    assert errs and errs[0][0] == "audit"
+
+
+def test_webhookconfig_cache_mirrors_scope_into_vap():
+    """A ValidatingWebhookConfiguration's match scope is cached and
+    mirrored into generated VAPs (reference webhookconfig controller +
+    cache)."""
+    from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.controller.manager import Manager
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.sync.source import FakeCluster
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+
+    client = Client(target=K8sValidationTarget(),
+                    drivers=[TpuDriver(), CELDriver()],
+                    enforcement_points=[WEBHOOK_EP])
+    cluster = FakeCluster()
+    mgr = Manager(client, cluster).start()
+    cluster.apply({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8scelscope"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sCelScope"}}},
+            "targets": [{
+                "target": "admission.k8s.gatekeeper.sh",
+                "code": [{"engine": "K8sNativeValidation", "source": {
+                    "generateVAP": True,
+                    "validations": [{"expression": "1 == 1",
+                                     "message": "x"}],
+                }}],
+            }],
+        },
+    })
+    vap_key = ("admissionregistration.k8s.io", "v1",
+               "ValidatingAdmissionPolicy")
+    vaps = list(cluster.list(vap_key))
+    assert vaps, "VAP not generated"
+    mc = vaps[0]["spec"]["matchConstraints"]
+    assert mc["resourceRules"][0]["apiGroups"] == ["*"]
+    assert "namespaceSelector" not in mc
+
+    cluster.apply({
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": "gatekeeper-validating-webhook-configuration"},
+        "webhooks": [{
+            "name": "validation.gatekeeper.sh",
+            "namespaceSelector": {"matchExpressions": [{
+                "key": "admission.gatekeeper.sh/ignore",
+                "operator": "DoesNotExist"}]},
+            "rules": [{"apiGroups": [""], "apiVersions": ["v1"],
+                       "operations": ["CREATE", "UPDATE"],
+                       "resources": ["pods"]}],
+        }],
+    })
+    vaps = list(cluster.list(vap_key))
+    mc = vaps[0]["spec"]["matchConstraints"]
+    assert mc["resourceRules"][0]["resources"] == ["pods"]
+    assert mc["namespaceSelector"]["matchExpressions"][0]["key"] == \
+        "admission.gatekeeper.sh/ignore"
