@@ -47,9 +47,22 @@ def main(argv=None) -> int:
                    help="serve /debug/profile?seconds=N (pprof equivalent)")
     p.add_argument("--cert-rotation-check-s", type=float, default=3600.0,
                    help="cert expiry check interval for the rotation loop")
+    p.add_argument("--coordinator", default="",
+                   help="multi-host: coordinator address host:port "
+                        "(joins a global JAX mesh across processes)")
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
     p.add_argument("--once", action="store_true",
                    help="run one audit sweep and exit (no servers)")
     args = p.parse_args(argv)
+
+    if args.coordinator:
+        from gatekeeper_tpu.parallel.distributed import init_distributed
+
+        init_distributed(args.coordinator, args.num_processes,
+                         args.process_id)
+        print(f"joined global mesh: process {args.process_id}/"
+              f"{args.num_processes}", file=sys.stderr)
 
     from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager

@@ -1,0 +1,45 @@
+"""Worker for the two-process multi-host test (not a pytest module).
+
+Usage: python tests/multihost_worker.py <process_id> <coordinator_port>
+
+Joins a 2-process JAX runtime (4 virtual CPU devices each -> one global
+8-device mesh), runs a full ShardedEvaluator sweep over the global mesh,
+and prints one line: MH_RESULT <pid> <n_global_devices> <total_violations>.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+from gatekeeper_tpu.parallel.distributed import (  # noqa: E402
+    init_distributed,
+    process_info,
+)
+
+init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+                 local_device_count=4)
+
+import __graft_entry__ as g  # noqa: E402
+from gatekeeper_tpu.parallel.sharded import (  # noqa: E402
+    ShardedEvaluator,
+    make_mesh,
+)
+
+_, nproc, local, global_ = process_info()
+assert nproc == 2 and local == 4 and global_ == 8, (nproc, local, global_)
+
+tpu = g._build_driver([g._PRIV_TEMPLATE, g._REQ_LABELS_TEMPLATE,
+                       g._HOST_NS_TEMPLATE])
+cons = g._constraints(n_labels=4)
+mesh = make_mesh()  # all GLOBAL devices: the mesh spans both processes
+assert mesh.shape["data"] * mesh.shape.get("model", 1) == 8, dict(mesh.shape)
+evaluator = ShardedEvaluator(tpu, mesh, violations_limit=5)
+# every process feeds the same full batch; the 'data' axis shards globally
+pods = g._make_pods(64)
+swept = evaluator.sweep(cons, pods)
+total = sum(int(c[3].sum()) for c in swept.values())
+print(f"MH_RESULT {pid} {global_} {total}", flush=True)

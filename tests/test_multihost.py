@@ -1,0 +1,51 @@
+"""Two-process multi-host (DCN-shaped) mesh test: the sharded evaluation
+plane spans processes via jax.distributed + Gloo CPU collectives
+(tests/multihost_worker.py; reference scale-out: sharded audit pods)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_global_mesh_sweep():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    worker = os.path.join(REPO, "tests", "multihost_worker.py")
+    procs = [
+        subprocess.Popen([sys.executable, worker, str(i), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO, env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-2000:]
+        outs.append(out)
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("MH_RESULT"):
+                _tag, pid, ndev, total = line.split()
+                results[int(pid)] = (int(ndev), int(total))
+    assert set(results) == {0, 1}, outs
+    # both processes saw the 8-device global mesh and agree on the verdict
+    assert results[0] == results[1]
+    assert results[0][0] == 8
+    assert results[0][1] > 0
