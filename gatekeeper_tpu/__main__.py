@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                    help="serve /debug/profile?seconds=N (pprof equivalent)")
     p.add_argument("--cert-rotation-check-s", type=float, default=3600.0,
                    help="cert expiry check interval for the rotation loop")
+    p.add_argument("--management-manifests", default="",
+                   help="remote-cluster mode: status/secret state routes to "
+                        "a separate management cluster seeded from this "
+                        "directory (reference --enable-remote-cluster)")
     p.add_argument("--coordinator", default="",
                    help="multi-host: coordinator address host:port "
                         "(joins a global JAX mesh across processes)")
@@ -87,6 +91,12 @@ def main(argv=None) -> int:
                     drivers=[tpu, CELDriver()],
                     enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
     cluster = FakeCluster()
+    if args.management_manifests:
+        from gatekeeper_tpu.sync.routing import RoutingCluster
+
+        mgmt = FakeCluster()
+        FileSource(args.management_manifests).populate(mgmt)
+        cluster = RoutingCluster(mgmt, cluster)
     export = ExportSystem()
     if args.export_dir:
         export.upsert_connection("disk", "disk", {"path": args.export_dir})

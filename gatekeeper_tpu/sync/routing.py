@@ -1,0 +1,59 @@
+"""Remote-cluster routing.
+
+Reference: pkg/routing (cache.go:19-40, wired main.go:664-716,
+--enable-remote-cluster): gatekeeper runs against a TARGET cluster while
+keeping its own operational state — everything in the
+``status.gatekeeper.sh`` group plus local Secrets (webhook certs) — on the
+MANAGEMENT cluster it is deployed in.  ``RoutingCluster`` implements the
+same split over the ObjectSource seam: reads/writes/watches route per-GVK,
+so the controllers and audit run unmodified against either shape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from gatekeeper_tpu.sync.source import Event, gvk_of
+
+STATUS_GROUP = "status.gatekeeper.sh"
+
+
+def _routes_to_management(gvk: tuple) -> bool:
+    group, _version, kind = gvk
+    if group == STATUS_GROUP:
+        return True
+    # local Secrets hold the webhook serving certs (cert rotation writes
+    # them where the pod runs)
+    return (group, kind) == ("", "Secret")
+
+
+class RoutingCluster:
+    """Routes object traffic between a management and a target cluster
+    (same interface as FakeCluster / any ObjectSource)."""
+
+    def __init__(self, management, target):
+        self.management = management
+        self.target = target
+
+    def _for(self, gvk: tuple):
+        return self.management if _routes_to_management(gvk) else self.target
+
+    def apply(self, obj: dict) -> None:
+        self._for(gvk_of(obj)).apply(obj)
+
+    def delete(self, obj: dict) -> None:
+        self._for(gvk_of(obj)).delete(obj)
+
+    def get(self, gvk: tuple, namespace: str, name: str) -> Optional[dict]:
+        return self._for(gvk).get(gvk, namespace, name)
+
+    def list(self, gvk: Optional[tuple] = None) -> list:
+        if gvk is not None:
+            return self._for(gvk).list(gvk)
+        # unfiltered list spans both clusters (management state is
+        # gatekeeper-internal and comes last)
+        return list(self.target.list()) + list(self.management.list())
+
+    def subscribe(self, gvk: tuple, callback: Callable[[Event], None],
+                  replay: bool = False):
+        return self._for(gvk).subscribe(gvk, callback, replay=replay)

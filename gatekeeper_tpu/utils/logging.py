@@ -42,10 +42,44 @@ if not _logger.handlers:
     _logger.setLevel(logging.INFO)
 
 
+class _WarnSampler:
+    """WARN+ rate limit: at most ``rate`` warning/error lines per second
+    (reference: main.go zap sampling, WARN+ sampled 100/s); dropped-line
+    counts surface on the next emitted record."""
+
+    def __init__(self, rate: int = 100):
+        self.rate = rate
+        self._window = 0.0
+        self._count = 0
+        self._dropped = 0
+
+    def admit(self) -> tuple:
+        """(emit: bool, dropped_since_last_emit: int)"""
+        now = time.monotonic()
+        if now - self._window >= 1.0:
+            self._window = now
+            self._count = 0
+        if self._count >= self.rate:
+            self._dropped += 1
+            return False, 0
+        self._count += 1
+        dropped, self._dropped = self._dropped, 0
+        return True, dropped
+
+
+_warn_sampler = _WarnSampler()
+
+
 def log_event(level: str, msg: str, **fields) -> None:
-    """zapr-style JSON line with canonical keys."""
+    """zapr-style JSON line with canonical keys; WARN+ is sampled."""
     record = {"level": level, "ts": time.time(), "msg": msg}
     record.update({k: v for k, v in fields.items() if v is not None})
+    if level in ("warning", "error"):
+        emit, dropped = _warn_sampler.admit()
+        if not emit:
+            return
+        if dropped:
+            record["sampled_dropped"] = dropped
     line = json.dumps(record, default=str)
     if level == "error":
         _logger.error(line)

@@ -406,3 +406,94 @@ def test_webhookconfig_cache_mirrors_scope_into_vap():
     assert mc["resourceRules"][0]["resources"] == ["pods"]
     assert mc["namespaceSelector"]["matchExpressions"][0]["key"] == \
         "admission.gatekeeper.sh/ignore"
+
+
+def test_routing_cluster_splits_management_and_target():
+    """Remote-cluster routing (reference pkg/routing): status group +
+    Secrets go to the management cluster, workload traffic to the
+    target."""
+    from gatekeeper_tpu.sync.routing import RoutingCluster
+    from gatekeeper_tpu.sync.source import FakeCluster
+
+    mgmt, target = FakeCluster(), FakeCluster()
+    rc = RoutingCluster(mgmt, target)
+
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "default"}}
+    status = {"apiVersion": "status.gatekeeper.sh/v1beta1",
+              "kind": "ConstraintPodStatus",
+              "metadata": {"name": "s", "namespace": "gatekeeper-system"}}
+    secret = {"apiVersion": "v1", "kind": "Secret",
+              "metadata": {"name": "gatekeeper-webhook-server-cert",
+                           "namespace": "gatekeeper-system"}}
+    rc.apply(pod)
+    rc.apply(status)
+    rc.apply(secret)
+    assert target.list(("", "v1", "Pod")) == [pod]
+    assert mgmt.list(("", "v1", "Pod")) == []
+    assert mgmt.list(("status.gatekeeper.sh", "v1beta1",
+                      "ConstraintPodStatus")) == [status]
+    assert mgmt.list(("", "v1", "Secret")) == [secret]
+    assert target.list(("", "v1", "Secret")) == []
+    # reads and watches route the same way
+    assert rc.get(("", "v1", "Pod"), "default", "p") == pod
+    seen = []
+    rc.subscribe(("", "v1", "Pod"), lambda e: seen.append(e.obj),
+                 replay=True)
+    assert seen == [pod]
+    # the manager runs unmodified on a RoutingCluster
+    from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.controller.manager import Manager
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+
+    client = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                    enforcement_points=[WEBHOOK_EP])
+    mgr = Manager(client, rc).start()
+    rc.apply({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sroutedemo"},
+        "spec": {"crd": {"spec": {"names": {"kind": "K8sRouteDemo"}}},
+                 "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                              "rego": "package k8sroutedemo\n\n"
+                                      "violation[{\"msg\": \"x\"}] "
+                                      "{ input.review.object.spec.bad }"}]},
+    })
+    assert "K8sRouteDemo" in [t.kind for t in client.templates()]
+
+
+def test_warn_log_sampling():
+    """WARN+ lines rate-limit at 100/s; drop counts surface on the next
+    emitted record (reference: zap sampling in main.go)."""
+    import io
+    import json as _json
+    import logging as _logging
+
+    from gatekeeper_tpu.utils import logging as gklog
+
+    buf = io.StringIO()
+    handler = _logging.StreamHandler(buf)
+    gklog._logger.addHandler(handler)
+    sampler = gklog._WarnSampler(rate=100)
+    old = gklog._warn_sampler
+    gklog._warn_sampler = sampler
+    try:
+        for i in range(250):
+            gklog.log_event("warning", f"w{i}")
+        lines = [ln for ln in buf.getvalue().splitlines() if ln]
+        assert len(lines) == 100  # one 1s window admits the rate cap
+        # info is never sampled
+        gklog.log_event("info", "always")
+        assert "always" in buf.getvalue()
+        # force the window forward: drops surface on the next warn
+        sampler._window -= 2.0
+        sampler._count = 0
+        buf.truncate(0), buf.seek(0)
+        gklog.log_event("warning", "after-window")
+        rec = _json.loads(buf.getvalue().splitlines()[-1])
+        assert rec["sampled_dropped"] == 150
+    finally:
+        gklog._warn_sampler = old
+        gklog._logger.removeHandler(handler)
