@@ -111,6 +111,8 @@ class CELDriver:
             raise CELCompileError(
                 f"template {template.name}: no K8sNativeValidation source"
             )
+        from gatekeeper_tpu.lang.cel.checker import check as cel_check
+
         try:
             validations = []
             for v in source.get("validations") or []:
@@ -118,6 +120,11 @@ class CELDriver:
                 if not expr:
                     raise CELCompileError("validation with no expression")
                 msg_expr = v.get("messageExpression")
+                # static check (reference: cel-go type checker at
+                # AddTemplate): unknown functions/idents fail admission
+                cel_check(expr)
+                if msg_expr:
+                    cel_check(msg_expr)
                 validations.append(_CompiledValidation(
                     Program(expr),
                     v.get("message", "") or "",
@@ -133,12 +140,14 @@ class CELDriver:
                         f"variable {vname!r} uses the reserved prefix "
                         f"{RESERVED_PREFIX!r}"
                     )
+                cel_check(var.get("expression", ""))
                 variables[vname] = Program(var.get("expression", "")).ast
-            match_conditions = [
-                (mc.get("name", ""), Program(mc.get("expression", "")))
-                for mc in source.get("matchCondition")
-                or source.get("matchConditions") or []
-            ]
+            match_conditions = []
+            for mc in (source.get("matchCondition")
+                       or source.get("matchConditions") or []):
+                cel_check(mc.get("expression", ""))
+                match_conditions.append(
+                    (mc.get("name", ""), Program(mc.get("expression", ""))))
             failure_policy = source.get("failurePolicy") or "Fail"
         except CelParseError as e:
             raise CELCompileError(

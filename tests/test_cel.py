@@ -291,3 +291,38 @@ def test_vap_codegen():
         "metadata": {"name": "c1"}, "spec": {}})
     vapb = driver.constraint_to_vap_binding(con, t)
     assert vapb["spec"]["policyName"] == "gatekeeper-k8scelrequiredlabels"
+
+
+def test_static_checker_rejects_bad_templates_at_add():
+    """Unknown functions / undeclared identifiers fail at AddTemplate
+    (reference: cel-go type checking in the k8scel driver), not at eval."""
+    import pytest
+
+    from gatekeeper_tpu.drivers.cel_driver import CELCompileError, CELDriver
+
+    def tmpl(expr):
+        return ConstraintTemplate.from_unstructured({
+            "apiVersion": "templates.gatekeeper.sh/v1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8scelbad"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "K8sCelBad"}}},
+                "targets": [{
+                    "target": "admission.k8s.gatekeeper.sh",
+                    "code": [{"engine": "K8sNativeValidation",
+                              "source": {"validations": [
+                                  {"expression": expr, "message": "m"}]}}],
+                }],
+            },
+        })
+
+    d = CELDriver()
+    for bad in ("frobnicate(object)",
+                "object.metadata.name.fliptwist()",
+                "unknownvar.spec.x == 1",
+                "size(object, params) > 0"):
+        with pytest.raises(CELCompileError):
+            d.add_template(tmpl(bad))
+    # good templates still admit
+    d.add_template(tmpl("object.metadata.name == params.name"))
+    assert "K8sCelBad" in [k for k in d._templates]
