@@ -644,6 +644,13 @@ def _call(ast: Call, env: Env) -> Any:
     return _method(target, name, args)
 
 
+def _as_quantity(v):
+    q = _parse_quantity(v)
+    if q is None:
+        raise CelError(f"invalid quantity {v!r}")
+    return q
+
+
 def _global_fn(name: str, args: list) -> Any:
     if name == "size" and len(args) == 1:
         v = args[0]
@@ -696,10 +703,194 @@ def _global_fn(name: str, args: list) -> Any:
         return args[0]
     if name == "type" and len(args) == 1:
         return _type_name(args[0])
+    # Kubernetes CEL extension libraries (reference: the k8scel driver's
+    # cel-go env includes the k8s quantity / ip / cidr / url libs)
+    if name == "quantity" and len(args) == 1:
+        q = _parse_quantity(args[0])
+        if q is None:
+            raise CelError(f"invalid quantity {args[0]!r}")
+        return q
+    if name == "isQuantity" and len(args) == 1:
+        return _parse_quantity(args[0]) is not None
+    if name == "ip" and len(args) == 1:
+        a = _parse_ip(args[0])
+        if a is None:
+            raise CelError(f"invalid IP {args[0]!r}")
+        return a
+    if name == "isIP" and len(args) == 1:
+        return _parse_ip(args[0]) is not None
+    if name == "cidr" and len(args) == 1:
+        c = _parse_cidr(args[0])
+        if c is None:
+            raise CelError(f"invalid CIDR {args[0]!r}")
+        return c
+    if name == "isCIDR" and len(args) == 1:
+        return _parse_cidr(args[0]) is not None
+    if name == "url" and len(args) == 1:
+        u = _parse_url(args[0])
+        if u is None:
+            raise CelError(f"invalid URL {args[0]!r}")
+        return u
+    if name == "isURL" and len(args) == 1:
+        return _parse_url(args[0]) is not None
     raise CelError(f"unknown function {name}")
 
 
+# --- k8s extension value types --------------------------------------------
+
+_QUANTITY_SUFFIX = {
+    "": 1, "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15,
+    "E": 10**18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "Ei": 2**60,
+}
+
+_QUANTITY_RE = re.compile(
+    r"^([+-]?[0-9]+(?:\.[0-9]*)?(?:[eE][+-]?[0-9]+)?)"
+    r"(n|u|m|k|M|G|T|P|E|Ki|Mi|Gi|Ti|Pi|Ei)?$")
+
+
+class _Quantity:
+    __slots__ = ("value", "text")
+
+    def __init__(self, value: float, text: str):
+        self.value = value
+        self.text = text
+
+    def __repr__(self):
+        return f"quantity({self.text!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, _Quantity) and other.value == self.value
+
+    def __hash__(self):
+        return hash(("quantity", self.value))
+
+
+def _parse_quantity(s):
+    if isinstance(s, _Quantity):
+        return s
+    if not isinstance(s, str):
+        return None
+    m = _QUANTITY_RE.match(s.strip())
+    if not m:
+        return None
+    num, suffix = m.groups()
+    try:
+        return _Quantity(float(num) * _QUANTITY_SUFFIX[suffix or ""], s)
+    except (ValueError, KeyError):
+        return None
+
+
+def _parse_ip(s):
+    import ipaddress
+
+    if not isinstance(s, str):
+        return None
+    try:
+        return ipaddress.ip_address(s)
+    except ValueError:
+        return None
+
+
+def _parse_cidr(s):
+    import ipaddress
+
+    if not isinstance(s, str):
+        return None
+    try:
+        return ipaddress.ip_network(s, strict=False)
+    except ValueError:
+        return None
+
+
+def _parse_url(s):
+    from urllib.parse import urlparse
+
+    if not isinstance(s, str):
+        return None
+    try:
+        u = urlparse(s)
+    except ValueError:
+        return None
+    if not u.scheme:
+        return None
+    return u
+
+
 def _method(target: Any, name: str, args: list) -> Any:
+    if isinstance(target, _Quantity):
+        v = target.value
+        if name == "isGreaterThan" and len(args) == 1:
+            return v > _as_quantity(args[0]).value
+        if name == "isLessThan" and len(args) == 1:
+            return v < _as_quantity(args[0]).value
+        if name == "compareTo" and len(args) == 1:
+            o = _as_quantity(args[0]).value
+            return -1 if v < o else (1 if v > o else 0)
+        if name == "add" and len(args) == 1:
+            o = _as_quantity(args[0]).value
+            return _Quantity(v + o, f"{v + o}")
+        if name == "sub" and len(args) == 1:
+            o = _as_quantity(args[0]).value
+            return _Quantity(v - o, f"{v - o}")
+        if name == "asApproximateFloat" and not args:
+            return float(v)
+        if name == "asInteger" and not args:
+            if v != int(v):
+                raise CelError(f"quantity {target.text!r} is not an integer")
+            return int(v)
+        if name == "isInteger" and not args:
+            return v == int(v)
+        if name == "sign" and not args:
+            return -1 if v < 0 else (1 if v > 0 else 0)
+        raise CelError(f"unknown quantity method {name}")
+    import ipaddress as _ipa
+
+    if isinstance(target, (_ipa.IPv4Address, _ipa.IPv6Address)):
+        if name == "family" and not args:
+            return target.version
+        if name == "isLoopback" and not args:
+            return target.is_loopback
+        if name == "isGlobalUnicast" and not args:
+            return target.is_global and not target.is_multicast
+        if name == "isUnspecified" and not args:
+            return target.is_unspecified
+        raise CelError(f"unknown ip method {name}")
+    if isinstance(target, (_ipa.IPv4Network, _ipa.IPv6Network)):
+        if name == "containsIP" and len(args) == 1:
+            a = _parse_ip(args[0]) if not isinstance(
+                args[0], (_ipa.IPv4Address, _ipa.IPv6Address)) else args[0]
+            if a is None:
+                raise CelError(f"invalid IP {args[0]!r}")
+            return a in target
+        if name == "containsCIDR" and len(args) == 1:
+            c = _parse_cidr(args[0]) if isinstance(args[0], str) else args[0]
+            if c is None:
+                raise CelError(f"invalid CIDR {args[0]!r}")
+            return c.subnet_of(target)
+        if name == "prefixLength" and not args:
+            return target.prefixlen
+        raise CelError(f"unknown cidr method {name}")
+    from urllib.parse import ParseResult
+
+    if isinstance(target, ParseResult):
+        if name == "getScheme" and not args:
+            return target.scheme
+        if name == "getHost" and not args:
+            return target.netloc
+        if name == "getHostname" and not args:
+            return target.hostname or ""
+        if name == "getPort" and not args:
+            return str(target.port) if target.port else ""
+        if name == "getEscapedPath" and not args:
+            return target.path
+        if name == "getQuery" and not args:
+            from urllib.parse import parse_qs
+
+            return parse_qs(target.query)
+        raise CelError(f"unknown url method {name}")
     if isinstance(target, str):
         if name == "contains":
             return args[0] in target

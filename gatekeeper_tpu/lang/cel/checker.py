@@ -37,6 +37,11 @@ GLOBAL_FNS = {
     "bool": (1,),
     "dyn": (1,),
     "type": (1,),
+    # k8s extension libraries
+    "quantity": (1,), "isQuantity": (1,),
+    "ip": (1,), "isIP": (1,),
+    "cidr": (1,), "isCIDR": (1,),
+    "url": (1,), "isURL": (1,),
 }
 
 # method calls: name -> allowed arg counts
@@ -55,6 +60,15 @@ METHOD_FNS = {
     "substring": (1, 2),
     "join": (0, 1),
     "isSorted": (0,),
+    # quantity / ip / cidr / url methods
+    "isGreaterThan": (1,), "isLessThan": (1,), "compareTo": (1,),
+    "add": (1,), "sub": (1,), "asApproximateFloat": (0,),
+    "asInteger": (0,), "isInteger": (0,), "sign": (0,),
+    "family": (0,), "isLoopback": (0,), "isGlobalUnicast": (0,),
+    "isUnspecified": (0,),
+    "containsIP": (1,), "containsCIDR": (1,), "prefixLength": (0,),
+    "getScheme": (0,), "getHost": (0,), "getHostname": (0,),
+    "getPort": (0,), "getEscapedPath": (0,), "getQuery": (0,),
 }
 
 MACROS = {"all", "exists", "exists_one", "filter", "map"}

@@ -326,3 +326,44 @@ def test_static_checker_rejects_bad_templates_at_add():
     # good templates still admit
     d.add_template(tmpl("object.metadata.name == params.name"))
     assert "K8sCelBad" in [k for k in d._templates]
+
+
+def test_k8s_extension_libraries():
+    """quantity / ip / cidr / url extension functions (reference: the
+    cel-go k8s libraries in the k8scel driver env)."""
+    from gatekeeper_tpu.lang.cel.cel import CelError, Env, Program
+
+    def ev(expr, **vars_):
+        return Program(expr).eval(Env(vars_))
+
+    assert ev('quantity("1Gi").isGreaterThan(quantity("900Mi"))') is True
+    assert ev('quantity("100m").asApproximateFloat()') == 0.1
+    assert ev('quantity("2Ki").asInteger()') == 2048
+    assert ev('quantity("1.5").isInteger()') is False
+    assert ev('quantity("-3").sign()') == -1
+    assert ev('quantity("1Gi").compareTo(quantity("1024Mi"))') == 0
+    assert ev('quantity("1Gi").add(quantity("1Gi")).asInteger()') == 2**31
+    assert ev('isQuantity("10Wi")') is False
+    assert ev('isQuantity("150Mi")') is True
+
+    assert ev('ip("127.0.0.1").isLoopback()') is True
+    assert ev('ip("::1").family()') == 6
+    assert ev('isIP("999.1.1.1")') is False
+    assert ev('cidr("10.0.0.0/8").containsIP("10.1.2.3")') is True
+    assert ev('cidr("10.0.0.0/8").containsIP(ip("11.1.2.3"))') is False
+    assert ev('cidr("10.0.0.0/8").containsCIDR("10.2.0.0/16")') is True
+    assert ev('cidr("10.0.0.0/8").prefixLength()') == 8
+    assert ev('isCIDR("10.0.0.0/33")') is False
+
+    assert ev('url("https://example.com:8443/x").getScheme()') == "https"
+    assert ev('url("https://example.com:8443/x").getPort()') == "8443"
+    assert ev('url("https://example.com:8443/x").getHostname()') == \
+        "example.com"
+    assert ev('isURL("not a url")') is False
+
+    # errors are CelErrors (absorbed by || / failurePolicy like any other)
+    import pytest
+    with pytest.raises(CelError):
+        ev('quantity("10Wi")')
+    with pytest.raises(CelError):
+        ev('quantity("100m").asInteger() == 1')  # 0.1 is not integral
