@@ -398,6 +398,8 @@ class Flattener:
                         kind[i], num[i], sid[i] = _classify(val, self.vocab)
                 batch.scalars[spec] = ScalarColumn(kind, num, sid)
         for mk in getattr(self.schema, "map_keys", []):
+            if mk in batch.map_keys:
+                continue  # the native flattener already extracted it
             n = batch.n
             m = round_up(int(batch.axis_counts[mk.axis].max(initial=0)))
             sid = np.full((n, m), -1, np.int32)
@@ -454,12 +456,14 @@ class Flattener:
         schema = self.schema
         axes = schema.axes()
         axis_index = {a: i for i, a in enumerate(axes)}
+        map_key_specs = list(getattr(schema, "map_keys", []))
         out = mod.flatten_batch(
             list(objects),
             [tuple(s.path) for s in schema.scalars],
             [a.segments for a in axes],
             [(axis_index[r.axis], tuple(r.subpath)) for r in schema.raggeds],
             [tuple(k.path) for k in schema.keysets],
+            [axis_index[mk.axis] for mk in map_key_specs],
             self.vocab._to_id,
             self.vocab._to_str,
             int(pad_n or len(objects)),
@@ -479,6 +483,8 @@ class Flattener:
             batch.raggeds[spec] = RaggedColumn(kind, num, sid)
         for spec, (sid, cnt) in zip(schema.keysets, out["keysets"]):
             batch.keysets[spec] = KeySetColumn(sid, cnt)
+        for spec, sid in zip(map_key_specs, out.get("map_keys", [])):
+            batch.map_keys[spec] = MapKeyColumn(sid)
         return batch
 
     def _flatten_py(self, objects: Sequence[dict],

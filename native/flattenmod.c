@@ -112,24 +112,36 @@ new_array(int nd, npy_intp *dims, int typenum, int fill_minus1)
     return a;
 }
 
-/* append items of a (possibly nested) list path into out (PyList) */
+/* append items of a (possibly nested) list path into out (PyList).
+ * When keys_out is non-NULL, also append each item's map KEY (dict-backed
+ * items) or Py_None (list-backed) — the MapKeyCol source, order-aligned
+ * with the items by construction. */
 static int
-collect_segment(PyObject *obj, PyObject *segment /* tuple of path tuples */,
-                PyObject *out)
+collect_segment_keyed(PyObject *obj,
+                      PyObject *segment /* tuple of path tuples */,
+                      PyObject *out, PyObject *keys_out)
 {
     PyObject *level = PyList_New(0);
-    if (level == NULL)
+    PyObject *level_keys = keys_out ? PyList_New(0) : NULL;
+    if (level == NULL || (keys_out && level_keys == NULL)) {
+        Py_XDECREF(level);
+        Py_XDECREF(level_keys);
         return -1;
-    if (PyList_Append(level, obj) < 0) {
+    }
+    if (PyList_Append(level, obj) < 0 ||
+        (keys_out && PyList_Append(level_keys, Py_None) < 0)) {
         Py_DECREF(level);
+        Py_XDECREF(level_keys);
         return -1;
     }
     Py_ssize_t nparts = PyTuple_GET_SIZE(segment);
     for (Py_ssize_t p = 0; p < nparts; p++) {
         PyObject *part = PyTuple_GET_ITEM(segment, p);
         PyObject *next = PyList_New(0);
-        if (next == NULL) {
-            Py_DECREF(level);
+        PyObject *next_keys = keys_out ? PyList_New(0) : NULL;
+        if (next == NULL || (keys_out && next_keys == NULL)) {
+            Py_DECREF(level); Py_XDECREF(level_keys);
+            Py_XDECREF(next); Py_XDECREF(next_keys);
             return -1;
         }
         Py_ssize_t nl = PyList_GET_SIZE(level);
@@ -139,9 +151,11 @@ collect_segment(PyObject *obj, PyObject *segment /* tuple of path tuples */,
             if (val != NULL && PyList_Check(val)) {
                 Py_ssize_t ni = PyList_GET_SIZE(val);
                 for (Py_ssize_t j = 0; j < ni; j++) {
-                    if (PyList_Append(next, PyList_GET_ITEM(val, j)) < 0) {
-                        Py_DECREF(level);
-                        Py_DECREF(next);
+                    if (PyList_Append(next, PyList_GET_ITEM(val, j)) < 0 ||
+                        (keys_out &&
+                         PyList_Append(next_keys, Py_None) < 0)) {
+                        Py_DECREF(level); Py_XDECREF(level_keys);
+                        Py_DECREF(next); Py_XDECREF(next_keys);
                         return -1;
                     }
                 }
@@ -150,29 +164,43 @@ collect_segment(PyObject *obj, PyObject *segment /* tuple of path tuples */,
                 PyObject *k2, *v2;
                 Py_ssize_t pos = 0;
                 while (PyDict_Next(val, &pos, &k2, &v2)) {
-                    if (PyList_Append(next, v2) < 0) {
-                        Py_DECREF(level);
-                        Py_DECREF(next);
+                    if (PyList_Append(next, v2) < 0 ||
+                        (keys_out && PyList_Append(next_keys, k2) < 0)) {
+                        Py_DECREF(level); Py_XDECREF(level_keys);
+                        Py_DECREF(next); Py_XDECREF(next_keys);
                         return -1;
                     }
                 }
             }
         }
         Py_DECREF(level);
+        Py_XDECREF(level_keys);
         level = next;
+        level_keys = next_keys;
     }
     Py_ssize_t nl = PyList_GET_SIZE(level);
     for (Py_ssize_t i = 0; i < nl; i++) {
-        if (PyList_Append(out, PyList_GET_ITEM(level, i)) < 0) {
+        if (PyList_Append(out, PyList_GET_ITEM(level, i)) < 0 ||
+            (keys_out &&
+             PyList_Append(keys_out, PyList_GET_ITEM(level_keys, i)) < 0)) {
             Py_DECREF(level);
+            Py_XDECREF(level_keys);
             return -1;
         }
     }
     Py_DECREF(level);
+    Py_XDECREF(level_keys);
     return 0;
 }
 
-/* flatten_batch(objects, scalars, axes, raggeds, keysets, to_id, to_str,
+static int
+collect_segment(PyObject *obj, PyObject *segment, PyObject *out)
+{
+    return collect_segment_keyed(obj, segment, out, NULL);
+}
+
+/* flatten_batch(objects, scalars, axes, raggeds, keysets, map_key_axes,
+ *               to_id, to_str,
  *               pad_n, ragged_bucket)
  *
  *   objects: list[dict]
@@ -192,12 +220,13 @@ collect_segment(PyObject *obj, PyObject *segment /* tuple of path tuples */,
 static PyObject *
 flatten_batch(PyObject *self, PyObject *args)
 {
-    PyObject *objects, *scalars, *axes, *raggeds, *keysets;
+    PyObject *objects, *scalars, *axes, *raggeds, *keysets, *map_key_axes;
     PyObject *to_id, *to_str;
     Py_ssize_t pad_n;
     long ragged_bucket;
-    if (!PyArg_ParseTuple(args, "OOOOOOOnl", &objects, &scalars, &axes,
-                          &raggeds, &keysets, &to_id, &to_str, &pad_n,
+    if (!PyArg_ParseTuple(args, "OOOOOOOOnl", &objects, &scalars, &axes,
+                          &raggeds, &keysets, &map_key_axes, &to_id,
+                          &to_str, &pad_n,
                           &ragged_bucket))
         return NULL;
     if (!PyList_Check(objects)) {
@@ -311,6 +340,7 @@ flatten_batch(PyObject *self, PyObject *args)
     /* --- axes: collect items + counts --------------------------------- */
     Py_ssize_t n_axes = PyList_GET_SIZE(axes);
     PyObject *axis_items = PyList_New(n_axes); /* per axis: list per object */
+    PyObject *axis_keys = NULL; /* axis idx -> per-object key lists */
     if (axis_items == NULL)
         goto fail;
     {
@@ -319,12 +349,23 @@ flatten_batch(PyObject *self, PyObject *args)
             Py_DECREF(axis_items);
             goto fail;
         }
+        /* axes needing a map-key column collect keys alongside items */
+        char *want_keys = (char *)calloc((size_t)(n_axes ? n_axes : 1), 1);
+        Py_ssize_t n_mk = PyList_GET_SIZE(map_key_axes);
+        for (Py_ssize_t q = 0; q < n_mk; q++) {
+            long ai = PyLong_AsLong(PyList_GET_ITEM(map_key_axes, q));
+            if (ai >= 0 && ai < n_axes)
+                want_keys[ai] = 1;
+        }
         for (Py_ssize_t a = 0; a < n_axes; a++) {
             PyObject *segments = PyList_GET_ITEM(axes, a);
             PyArrayObject *cnt = new_array(1, dims1, NPY_INT32, 0);
             PyObject *per_obj = PyList_New(n_real);
-            if (!cnt || !per_obj) {
+            PyObject *per_obj_keys =
+                want_keys[a] ? PyList_New(n_real) : NULL;
+            if (!cnt || !per_obj || (want_keys[a] && !per_obj_keys)) {
                 Py_XDECREF((PyObject *)cnt); Py_XDECREF(per_obj);
+                Py_XDECREF(per_obj_keys); free(want_keys);
                 Py_DECREF(axis_items); Py_DECREF(counts_out);
                 goto fail;
             }
@@ -332,27 +373,54 @@ flatten_batch(PyObject *self, PyObject *args)
             Py_ssize_t nseg = PyTuple_GET_SIZE(segments);
             for (Py_ssize_t i = 0; i < n_real; i++) {
                 PyObject *items = PyList_New(0);
-                if (items == NULL) {
+                PyObject *keys = want_keys[a] ? PyList_New(0) : NULL;
+                if (items == NULL || (want_keys[a] && keys == NULL)) {
+                    Py_XDECREF(items); Py_XDECREF(keys);
                     Py_DECREF((PyObject *)cnt); Py_DECREF(per_obj);
+                    Py_XDECREF(per_obj_keys); free(want_keys);
                     Py_DECREF(axis_items); Py_DECREF(counts_out);
                     goto fail;
                 }
                 for (Py_ssize_t g = 0; g < nseg; g++) {
-                    if (collect_segment(PyList_GET_ITEM(objects, i),
-                                        PyTuple_GET_ITEM(segments, g),
-                                        items) < 0) {
-                        Py_DECREF(items); Py_DECREF((PyObject *)cnt);
-                        Py_DECREF(per_obj); Py_DECREF(axis_items);
-                        Py_DECREF(counts_out);
+                    if (collect_segment_keyed(PyList_GET_ITEM(objects, i),
+                                              PyTuple_GET_ITEM(segments, g),
+                                              items, keys) < 0) {
+                        Py_DECREF(items); Py_XDECREF(keys);
+                        Py_DECREF((PyObject *)cnt);
+                        Py_DECREF(per_obj); Py_XDECREF(per_obj_keys);
+                        free(want_keys);
+                        Py_DECREF(axis_items); Py_DECREF(counts_out);
                         goto fail;
                     }
                 }
                 dc[i] = (int)PyList_GET_SIZE(items);
                 PyList_SET_ITEM(per_obj, i, items);
+                if (want_keys[a])
+                    PyList_SET_ITEM(per_obj_keys, i, keys);
             }
             PyList_SET_ITEM(axis_items, a, per_obj);
             PyList_SET_ITEM(counts_out, a, (PyObject *)cnt);
+            if (want_keys[a]) {
+                if (axis_keys == NULL) {
+                    axis_keys = PyDict_New();
+                    if (axis_keys == NULL) {
+                        free(want_keys);
+                        Py_DECREF(axis_items); Py_DECREF(counts_out);
+                        goto fail;
+                    }
+                }
+                PyObject *akey = PyLong_FromSsize_t(a);
+                int rc = PyDict_SetItem(axis_keys, akey, per_obj_keys);
+                Py_XDECREF(akey);
+                Py_DECREF(per_obj_keys);
+                if (rc < 0) {
+                    free(want_keys);
+                    Py_DECREF(axis_items); Py_DECREF(counts_out);
+                    goto fail;
+                }
+            }
         }
+        free(want_keys);
         if (PyDict_SetItemString(result, "axes", counts_out) < 0) {
             Py_DECREF(counts_out); Py_DECREF(axis_items);
             goto fail;
@@ -424,6 +492,71 @@ flatten_batch(PyObject *self, PyObject *args)
         }
         Py_DECREF(out);
     }
+    /* --- map-key columns (sid of each item's dict key, -1 list/pad) --- */
+    {
+        Py_ssize_t n_mk = PyList_GET_SIZE(map_key_axes);
+        PyObject *out = PyList_New(n_mk);
+        if (out == NULL) {
+            Py_XDECREF(axis_keys);
+            Py_DECREF(axis_items);
+            goto fail;
+        }
+        for (Py_ssize_t q = 0; q < n_mk; q++) {
+            long ai = PyLong_AsLong(PyList_GET_ITEM(map_key_axes, q));
+            PyObject *akey = PyLong_FromLong(ai);
+            PyObject *per_obj_keys =
+                axis_keys ? PyDict_GetItem(axis_keys, akey) : NULL;
+            Py_XDECREF(akey);
+            Py_ssize_t maxc = 0;
+            if (per_obj_keys != NULL) {
+                for (Py_ssize_t i = 0; i < n_real; i++) {
+                    Py_ssize_t c = PyList_GET_SIZE(
+                        PyList_GET_ITEM(per_obj_keys, i));
+                    if (c > maxc)
+                        maxc = c;
+                }
+            }
+            Py_ssize_t m = ragged_bucket; /* round_up(): min one bucket */
+            while (m < maxc)
+                m += ragged_bucket;
+            npy_intp dims2[2] = {(npy_intp)n, (npy_intp)m};
+            PyArrayObject *a_sid = new_array(2, dims2, NPY_INT32, 1);
+            if (a_sid == NULL) {
+                Py_DECREF(out); Py_XDECREF(axis_keys);
+                Py_DECREF(axis_items);
+                goto fail;
+            }
+            int *ds = (int *)PyArray_DATA(a_sid);
+            if (per_obj_keys != NULL) {
+                for (Py_ssize_t i = 0; i < n_real; i++) {
+                    PyObject *keys = PyList_GET_ITEM(per_obj_keys, i);
+                    Py_ssize_t c = PyList_GET_SIZE(keys);
+                    for (Py_ssize_t j = 0; j < c && j < m; j++) {
+                        PyObject *kk = PyList_GET_ITEM(keys, j);
+                        if (kk != Py_None && PyUnicode_Check(kk)) {
+                            long sid = vocab_intern(&vocab, kk);
+                            if (sid < 0) {
+                                Py_DECREF((PyObject *)a_sid);
+                                Py_DECREF(out); Py_XDECREF(axis_keys);
+                                Py_DECREF(axis_items);
+                                goto fail;
+                            }
+                            ds[i * m + j] = (int)sid;
+                        }
+                    }
+                }
+            }
+            PyList_SET_ITEM(out, q, (PyObject *)a_sid);
+        }
+        if (PyDict_SetItemString(result, "map_keys", out) < 0) {
+            Py_DECREF(out); Py_XDECREF(axis_keys);
+            Py_DECREF(axis_items);
+            goto fail;
+        }
+        Py_DECREF(out);
+    }
+    Py_XDECREF(axis_keys);
+    axis_keys = NULL;
     Py_DECREF(axis_items);
     axis_items = NULL;
 
