@@ -29,6 +29,7 @@ def constraint_masks(
     objects: Sequence[dict],
     namespaces: Optional[Sequence[Optional[dict]]] = None,
     sources: Optional[Sequence[str]] = None,
+    any_generate_name: Optional[bool] = None,
 ) -> np.ndarray:
     """[C, N] bool: does constraint c match object n."""
     c, n = len(constraints), batch.n
@@ -44,9 +45,10 @@ def constraint_masks(
         group_ids == vocab.lookup("")
     )
     name_ids = batch.name_sid[:n_real]
-    any_generate_name = any(
-        "generateName" in (o.get("metadata") or {}) for o in objects
-    )
+    if any_generate_name is None:  # callers sweeping per kind hoist this
+        any_generate_name = any(
+            "generateName" in (o.get("metadata") or {}) for o in objects
+        )
     # constraint-independent namespace context, hoisted out of the loop
     eff_ns = np.where(is_namespace_obj, name_ids, ns_ids)
     has_ns = eff_ns != vocab.lookup("")

@@ -211,6 +211,8 @@ class ShardedEvaluator:
                 needs.setdefault(ck, set()).update(fields)
         cols = slim_cols(cols, needs)
 
+        any_gen = any(
+            "generateName" in (o.get("metadata") or {}) for o in objects)
         kinds = tuple(sorted(lowered))
         k = self.violations_limit
         tables = []
@@ -226,7 +228,8 @@ class ShardedEvaluator:
             tables.append(shard_param_table(table, self.mesh,
                                             shard_constraints=False))
             mask_rows.append(masks_mod.constraint_masks(
-                cons, batch, self.driver.vocab, objects
+                cons, batch, self.driver.vocab, objects,
+                any_generate_name=any_gen,
             ))
             offsets[kind] = (c_off, c_off + len(cons))
             c_off += len(cons)
