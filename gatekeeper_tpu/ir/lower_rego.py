@@ -1241,6 +1241,17 @@ class _Lowerer:
     _CMPNUM_OP = {"lt": "lt", "lte": "lte", "gt": "gt", "gte": "gte",
                   "equal": "eq", "neq": "neq"}
 
+    def _eq_const_pred(self, lit: str, val):
+        """(pred, group): abstract value == string literal."""
+        subj = self._sid_operand(val)
+        pred = N.EqStr(subj, N.ConstSid(self._intern_const(lit)))
+        group = None
+        if isinstance(val, (ItemVal, MapKeyVal)):
+            group = ("axis", val.axis, val.instance)
+        elif isinstance(val, (ParamElemVal, ParamElemFieldVal)):
+            group = ("param", val.name, val.instance)
+        return pred, group
+
     def _nested_any(self, child_axis, parent_axis, preds) -> "N.Expr":
         picol = ParentIdxCol(axis=child_axis, parent=parent_axis)
         if picol not in self.schema.parent_idx:
@@ -1363,15 +1374,31 @@ class _Lowerer:
                 ):
                     raise LowerError("non-boolean function result")
                 fenv: dict = {}
+                pattern_parts = []
                 params = clause.args or ()
                 if len(params) != len(arg_vals):
                     raise LowerError("arity mismatch in inlined call")
                 for p, v in zip(params, arg_vals):
-                    if not isinstance(p, ast.Var):
+                    if isinstance(p, ast.Var):
+                        fenv[p.name] = v
+                    elif isinstance(p, ast.Scalar) and isinstance(
+                            p.value, str):
+                        # literal pattern parameter: the clause applies only
+                        # when the argument equals it (forbidden("x") { .. })
+                        pattern_parts.append(self._eq_const_pred(p.value, v))
+                    else:
                         raise LowerError("pattern parameter")
-                    fenv[p.name] = v
                 terms, open_groups = self._lower_body_parts(
                     clause.body, fenv, snapshot)
+                for pred, group in pattern_parts:
+                    if group is None:
+                        terms = list(terms) + [pred]
+                    else:
+                        open_groups.setdefault(group, []).append(pred)
+                # drop vacuous truths (forbidden("x") { true } bodies):
+                # true ∧ X = X, and a lone grouped part OR-merges cleanly
+                terms = [t for t in terms
+                         if not (isinstance(t, N.ConstBool) and t.value)]
                 parts = []
                 if terms:
                     parts.append((N.And(tuple(terms)) if len(terms) > 1

@@ -51,9 +51,9 @@ def rand_value(rng, depth=0):
 
 def rand_obj(rng, i):
     kind = rng.choice(["Pod", "Deployment", "Service", "Namespace",
-                       "Ingress"])
-    group = {"Deployment": "apps", "Ingress": "networking.k8s.io"}.get(
-        kind, "")
+                       "Ingress", "RoleBinding"])
+    group = {"Deployment": "apps", "Ingress": "networking.k8s.io",
+             "RoleBinding": "rbac.authorization.k8s.io"}.get(kind, "")
     meta = {"name": f"o{i}"}
     if rng.random() < 0.7:
         meta["namespace"] = rng.choice(["default", "prod", "kube-system"])
@@ -105,6 +105,17 @@ def rand_obj(rng, i):
                 c["securityContext"] = sc
             containers.append(c)
         spec["containers"] = containers
+    if kind == "Pod" and rng.random() < 0.4:
+        spec["automountServiceAccountToken"] = rng.choice(
+            [True, False, "false", None])
+    if kind == "RoleBinding" and rng.random() < 0.8:
+        return {"apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "RoleBinding", "metadata": meta,
+                "subjects": [
+                    {"kind": "User",
+                     "name": rng.choice(["system:anonymous", "alice",
+                                         "system:unauthenticated", 7])}
+                    for _ in range(rng.randint(0, 2))]}
     for key in ("hostPID", "hostIPC", "hostNetwork"):
         if rng.random() < 0.15:
             spec[key] = rng.choice([True, False, "yes"])
