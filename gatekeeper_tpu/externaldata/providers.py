@@ -141,6 +141,34 @@ class ProviderCache:
                     out[key] = value
         return out
 
+    def prefetch(self, pairs) -> None:
+        """Concurrently warm the response cache for (provider, key) pairs
+        (reference: async batch joins — the dispatcher overlaps provider
+        RTTs instead of fetching serially).  Errors are swallowed here and
+        surface through resolve()'s failure-policy semantics."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        by_provider: dict = {}
+        for provider, key in pairs:
+            by_provider.setdefault(provider, set()).add(key)
+        if len(by_provider) <= 1:
+            for provider, keys in by_provider.items():
+                try:
+                    self.fetch(provider, sorted(keys, key=repr))
+                except Exception:
+                    pass
+            return
+        with ThreadPoolExecutor(max_workers=min(8, len(by_provider))) as ex:
+            futures = [
+                ex.submit(self.fetch, provider, sorted(keys, key=repr))
+                for provider, keys in by_provider.items()
+            ]
+            for f in futures:
+                try:
+                    f.result()
+                except Exception:
+                    pass
+
     def resolve(self, placeholder) -> Any:
         """Resolve one mutation placeholder (failure policy semantics:
         Fail | Ignore | UseDefault)."""

@@ -128,6 +128,30 @@ class MutationSystem:
             ExternalDataPlaceholder,
         )
 
+        # pass 1: collect every placeholder, then warm the cache with ONE
+        # concurrent multi-provider prefetch (async batch join) so pass 2's
+        # per-placeholder resolve() hits cache instead of serial RTTs
+        pending = []
+
+        def collect(node):
+            if isinstance(node, dict):
+                for v in node.values():
+                    if isinstance(v, ExternalDataPlaceholder):
+                        pending.append(v)
+                    else:
+                        collect(v)
+            elif isinstance(node, list):
+                for v in node:
+                    if isinstance(v, ExternalDataPlaceholder):
+                        pending.append(v)
+                    else:
+                        collect(v)
+
+        collect(obj)
+        if self.provider_cache is not None and len(pending) > 1:
+            self.provider_cache.prefetch(
+                (ph.provider, ph.original_value) for ph in pending)
+
         def walk(node):
             if isinstance(node, dict):
                 for k, v in list(node.items()):

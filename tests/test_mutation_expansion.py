@@ -303,3 +303,39 @@ def test_expand_missing_ns_no_error():
     # reference bats: exit 0, no output assertions (empty golden)
     got = _expand_fixture("expand-with-missing-ns")
     assert isinstance(got, list)
+
+
+def test_external_data_prefetch_overlaps_providers():
+    """Multiple providers' fetches overlap (async batch join): two slow
+    providers resolve in ~one RTT, not two, and per-key values land
+    correctly."""
+    import threading
+    import time as _time
+
+    from gatekeeper_tpu.externaldata.providers import (
+        Provider,
+        ProviderCache,
+    )
+
+    calls = []
+
+    def slow_send(provider, keys):
+        calls.append((provider.name, tuple(keys)))
+        _time.sleep(0.3)
+        return {"response": {"items": [
+            {"key": k, "value": f"{provider.name}:{k}"} for k in keys]}}
+
+    cache = ProviderCache(send_fn=slow_send)
+    for name in ("p1", "p2"):
+        cache.upsert(Provider(name=name, url=f"https://{name}/v1"))
+
+    t0 = _time.perf_counter()
+    cache.prefetch([("p1", "a"), ("p2", "b"), ("p1", "c")])
+    elapsed = _time.perf_counter() - t0
+    assert elapsed < 0.55, f"providers fetched serially ({elapsed:.2f}s)"
+    assert len(calls) == 2  # one batched call per provider
+    # resolves are now cache hits
+    n_calls = len(calls)
+    assert cache.fetch("p1", ["a"])["a"] == ("p1:a", None)
+    assert cache.fetch("p2", ["b"])["b"] == ("p2:b", None)
+    assert len(calls) == n_calls
