@@ -45,6 +45,19 @@ def main(argv=None) -> int:
                         "shutting down (reference --shutdown-delay)")
     p.add_argument("--enable-profile", action="store_true",
                    help="serve /debug/profile?seconds=N (pprof equivalent)")
+    p.add_argument("--fail-open-on-error", action="store_true",
+                   help="admit (with a warning) when the review path raises "
+                        "internally, instead of the reference's Errored "
+                        "allowed=false code-500 response")
+    p.add_argument("--exempt-namespace", action="append", default=[],
+                   help="namespace allowed to set the ignore label "
+                        "(repeatable; reference --exempt-namespace)")
+    p.add_argument("--exempt-namespace-prefix", action="append", default=[],
+                   help="namespace name prefix allowed to set the ignore "
+                        "label (repeatable)")
+    p.add_argument("--exempt-namespace-suffix", action="append", default=[],
+                   help="namespace name suffix allowed to set the ignore "
+                        "label (repeatable)")
     p.add_argument("--cert-rotation-check-s", type=float, default=3600.0,
                    help="cert expiry check interval for the rotation loop")
     p.add_argument("--management-manifests", default="",
@@ -172,6 +185,7 @@ def main(argv=None) -> int:
                 batcher=batcher,
                 log_denies=args.log_denies,
                 metrics=metrics,
+                fail_open=args.fail_open_on_error,
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,
@@ -179,7 +193,11 @@ def main(argv=None) -> int:
                     ("", "v1", "Namespace"), "", name),
                 process_excluder=mgr.excluder,
             ) if mgr.is_assigned("mutation-webhook") else None,
-            namespace_label_handler=NamespaceLabelHandler(),
+            namespace_label_handler=NamespaceLabelHandler(
+                exempt_namespaces=args.exempt_namespace,
+                exempt_prefixes=args.exempt_namespace_prefix,
+                exempt_suffixes=args.exempt_namespace_suffix,
+            ),
             port=args.port,
             certfile=certfile,
             keyfile=keyfile,

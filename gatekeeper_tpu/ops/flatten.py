@@ -255,7 +255,10 @@ def _classify(v: Any, vocab: Vocab):
     if isinstance(v, bool):
         return (K_TRUE if v else K_FALSE), 0.0, -1
     if isinstance(v, (int, float)):
-        return K_NUM, float(v), -1
+        try:
+            return K_NUM, float(v), -1
+        except OverflowError:  # int beyond double range: saturate with sign
+            return K_NUM, float("inf") if v > 0 else float("-inf"), -1
     if isinstance(v, str):
         return K_STR, 0.0, vocab.intern(v)
     if v is None:

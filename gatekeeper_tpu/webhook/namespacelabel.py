@@ -1,8 +1,9 @@
 """Namespace-label webhook (reference: pkg/webhook/namespacelabel.go).
 
-Blocks unprivileged requests from self-exempting namespaces with the
-``admission.gatekeeper.sh/ignore`` label; service accounts on the exemption
-list may (namespacelabel.go:21-41).
+Blocks namespaces from self-exempting with the
+``admission.gatekeeper.sh/ignore`` label; namespaces whose NAME is on the
+exemption lists (--exempt-namespace / -prefix / -suffix) may
+(namespacelabel.go:28-30,63-66).
 """
 
 from __future__ import annotations
@@ -24,10 +25,10 @@ class LabelResponse:
 
 
 class NamespaceLabelHandler:
-    def __init__(self, exempt_users: Iterable[str] = (),
+    def __init__(self, exempt_namespaces: Iterable[str] = (),
                  exempt_prefixes: Iterable[str] = (),
                  exempt_suffixes: Iterable[str] = ()):
-        self.exempt_users = set(exempt_users)
+        self.exempt_namespaces = set(exempt_namespaces)
         self.exempt_prefixes = tuple(exempt_prefixes)
         self.exempt_suffixes = tuple(exempt_suffixes)
 
@@ -35,22 +36,24 @@ class NamespaceLabelHandler:
         req = parse_admission_review(review_body)
         if req.operation == "DELETE":
             return LabelResponse(allowed=True, uid=req.uid)
-        username = (req.user_info or {}).get("username", "")
-        if (
-            username in self.exempt_users
-            or any(username.startswith(p) for p in self.exempt_prefixes)
-            or any(username.endswith(s) for s in self.exempt_suffixes)
-        ):
+        kind = req.kind or {}
+        if kind.get("group", "") or kind.get("kind", "") != "Namespace":
             return LabelResponse(allowed=True, uid=req.uid)
         obj = req.object or {}
+        name = (obj.get("metadata") or {}).get("name", "")
+        if (
+            name in self.exempt_namespaces
+            or any(name.startswith(p) for p in self.exempt_prefixes)
+            or any(name.endswith(s) for s in self.exempt_suffixes)
+        ):
+            return LabelResponse(allowed=True, uid=req.uid)
         labels = (obj.get("metadata") or {}).get("labels") or {}
         if IGNORE_LABEL in labels:
             return LabelResponse(
                 allowed=False,
                 code=403,
                 message=(
-                    f"only exempt users can add the {IGNORE_LABEL} label to "
-                    "a namespace"
+                    f"Only exempt namespace can have the {IGNORE_LABEL} label"
                 ),
                 uid=req.uid,
             )

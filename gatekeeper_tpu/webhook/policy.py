@@ -83,6 +83,7 @@ class ValidationHandler:
         log_denies: bool = False,
         event_sink=None,
         metrics=None,
+        fail_open: bool = False,
     ):
         self.client = client
         self.expansion_system = expansion_system
@@ -92,6 +93,7 @@ class ValidationHandler:
         self.log_denies = log_denies
         self.event_sink = event_sink
         self.metrics = metrics
+        self.fail_open = fail_open
 
     # --- the handler (reference: validationHandler.Handle, policy.go:139) -
     def handle(self, review_body: dict) -> ValidationResponse:
@@ -99,11 +101,14 @@ class ValidationHandler:
             return self._handle(review_body)
         from gatekeeper_tpu.metrics import registry as m
 
-        status = "error"  # count even when _handle raises (fail-open path)
+        status = "error"  # count even when _handle itself raises
         try:
             with self.metrics.timed(m.REQUEST_DURATION):
                 resp = self._handle(review_body)
-            status = "allow" if resp.allowed else "deny"
+            if not resp.allowed and resp.code == 500:
+                status = "error"  # internal error surfaced as Errored deny
+            else:
+                status = "allow" if resp.allowed else "deny"
             return resp
         finally:
             self.metrics.inc_counter(m.REQUEST_COUNT,
@@ -137,11 +142,19 @@ class ValidationHandler:
         try:
             responses = self._review(augmented)
         except Exception as e:
-            # review errors fail open with a warning (webhook failurePolicy
-            # ignore, policy.go:83 marker); real deploys choose fail-closed
+            # admission.Errored equivalent (policy.go:664-668): a well-formed
+            # allowed=false code-500 response — an authoritative deny, like
+            # the reference; the fail_open flag (--fail-open-on-error) keeps
+            # the old hard-coded allow for deployments that prefer admitting
+            # on webhook bugs
+            if self.fail_open:
+                return ValidationResponse(
+                    allowed=True, uid=req.uid,
+                    warnings=[f"review failed: {e}"],
+                )
             return ValidationResponse(
-                allowed=True, uid=req.uid,
-                warnings=[f"review failed: {e}"],
+                allowed=False, uid=req.uid, code=500,
+                message=f"review failed: {e}",
             )
 
         expansion_warnings: list = []
@@ -257,10 +270,9 @@ class ValidationHandler:
 
 
 def _constraint_label(result) -> str:
+    # reference formats "[<constraint metadata.name>] msg" (policy.go:346)
     c = result.constraint or {}
-    kind = c.get("kind", "")
-    name = (c.get("metadata") or {}).get("name", "")
-    return f"{kind}] [{name}"
+    return (c.get("metadata") or {}).get("name", "")
 
 
 class Batcher:

@@ -135,10 +135,12 @@ class WebhookServer:
                         self._admit_label(body, uid)
                     else:
                         self._reply(404, {"error": "not found"})
-                except Exception as e:  # handler bug: fail open like the
-                    # reference's Errored response + failurePolicy
+                except Exception as e:
+                    # handler bug: admission.Errored equivalent — a
+                    # well-formed allowed=false code-500 response, matching
+                    # the reference (which never hard-codes allow here)
                     self._reply(200, admission_response(
-                        uid, True, warnings=[f"webhook error: {e}"]
+                        uid, False, message=f"webhook error: {e}", code=500
                     ))
 
             def _admit(self, body, uid):

@@ -17,6 +17,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <math.h>
 
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #include <numpy/arrayobject.h>
@@ -78,7 +79,22 @@ classify(Vocab *vocab, PyObject *val, signed char *kind, float *num,
         *kind = K_FALSE;
     } else if (PyLong_Check(val)) {
         *kind = K_NUM;
-        *num = (float)PyLong_AsDouble(val);
+        double d = PyLong_AsDouble(val);
+        if (d == -1.0 && PyErr_Occurred()) {
+            /* int beyond double range: saturate with the right sign so
+             * comparisons still order correctly instead of leaving a
+             * pending OverflowError to surface at an unrelated call */
+            PyErr_Clear();
+            PyObject *zero = PyLong_FromLong(0);
+            if (zero == NULL)
+                return -1;
+            int neg = PyObject_RichCompareBool(val, zero, Py_LT);
+            Py_DECREF(zero);
+            if (neg < 0)
+                return -1;
+            d = neg ? -HUGE_VAL : HUGE_VAL;
+        }
+        *num = (float)d;
     } else if (PyFloat_Check(val)) {
         *kind = K_NUM;
         *num = (float)PyFloat_AS_DOUBLE(val);
@@ -269,12 +285,18 @@ flatten_batch(PyObject *self, PyObject *args)
                 group = PyUnicode_Substring(av, 0, slash); /* new ref */
         }
         PyObject *g = group ? group : empty_str;
-        ((int *)PyArray_DATA(gid))[i] = (int)vocab_intern(&vocab, g);
+        long gval = vocab_intern(&vocab, g);
         Py_XDECREF(group);
+        if (gval < 0)
+            goto fail;
+        ((int *)PyArray_DATA(gid))[i] = (int)gval;
 
         PyObject *kv = PyDict_GetItem(obj, kind_key);
-        ((int *)PyArray_DATA(kid))[i] = (int)vocab_intern(
+        long kval = vocab_intern(
             &vocab, (kv && PyUnicode_Check(kv)) ? kv : empty_str);
+        if (kval < 0)
+            goto fail;
+        ((int *)PyArray_DATA(kid))[i] = (int)kval;
 
         PyObject *meta = PyDict_GetItem(obj, metadata_key);
         PyObject *nm = NULL, *ns = NULL;
@@ -282,10 +304,16 @@ flatten_batch(PyObject *self, PyObject *args)
             nm = PyDict_GetItem(meta, name_key);
             ns = PyDict_GetItem(meta, namespace_key);
         }
-        ((int *)PyArray_DATA(nsid))[i] = (int)vocab_intern(
+        long nsval = vocab_intern(
             &vocab, (ns && PyUnicode_Check(ns)) ? ns : empty_str);
-        ((int *)PyArray_DATA(nmid))[i] = (int)vocab_intern(
+        if (nsval < 0)
+            goto fail;
+        ((int *)PyArray_DATA(nsid))[i] = (int)nsval;
+        long nmval = vocab_intern(
             &vocab, (nm && PyUnicode_Check(nm)) ? nm : empty_str);
+        if (nmval < 0)
+            goto fail;
+        ((int *)PyArray_DATA(nmid))[i] = (int)nmval;
     }
     {
         PyObject *identity = Py_BuildValue("(NNNN)", gid, kid, nsid, nmid);
@@ -606,6 +634,8 @@ flatten_batch(PyObject *self, PyObject *args)
                  * values), sorted to match the Python flattener exactly */
                 PyObject *keys = PyList_New(0);
                 if (keys == NULL) {
+                    Py_DECREF((PyObject *)a_sid);
+                    Py_DECREF((PyObject *)a_cnt);
                     Py_DECREF(out);
                     goto fail;
                 }
@@ -616,21 +646,36 @@ flatten_batch(PyObject *self, PyObject *args)
                         if (vv2 == Py_False)
                             continue;
                         if (PyList_Append(keys, kk2) < 0) {
-                            Py_DECREF(keys); Py_DECREF(out);
+                            Py_DECREF(keys);
+                            Py_DECREF((PyObject *)a_sid);
+                            Py_DECREF((PyObject *)a_cnt);
+                            Py_DECREF(out);
                             goto fail;
                         }
                     }
                 }
                 if (PyList_Sort(keys) < 0) {
-                    Py_DECREF(keys); Py_DECREF(out);
+                    Py_DECREF(keys);
+                    Py_DECREF((PyObject *)a_sid);
+                    Py_DECREF((PyObject *)a_cnt);
+                    Py_DECREF(out);
                     goto fail;
                 }
                 Py_ssize_t c = PyList_GET_SIZE(keys);
                 dc[i] = (int)c;
                 for (Py_ssize_t j = 0; j < c && j < l; j++) {
                     PyObject *kk = PyList_GET_ITEM(keys, j);
-                    if (PyUnicode_Check(kk))
-                        ds[i * l + j] = (int)vocab_intern(&vocab, kk);
+                    if (PyUnicode_Check(kk)) {
+                        long sid = vocab_intern(&vocab, kk);
+                        if (sid < 0) {
+                            Py_DECREF(keys);
+                            Py_DECREF((PyObject *)a_sid);
+                            Py_DECREF((PyObject *)a_cnt);
+                            Py_DECREF(out);
+                            goto fail;
+                        }
+                        ds[i * l + j] = (int)sid;
+                    }
                 }
                 Py_DECREF(keys);
             }

@@ -125,3 +125,26 @@ def test_native_empty_and_weird_inputs():
         for spec in schema.scalars:
             np.testing.assert_array_equal(py.scalars[spec].kind,
                                           nat.scalars[spec].kind)
+
+
+@pytest.mark.skipif(native.load() is None, reason="native build unavailable")
+def test_native_huge_int_saturates_no_pending_exception():
+    # ADVICE r1: PyLong_AsDouble overflow must not leave a pending exception;
+    # both flatteners saturate to +/-inf with the right sign
+    schema = make_schema()
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "big"},
+             "spec": {"priority": 10 ** 400}},
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "neg"},
+             "spec": {"priority": -(10 ** 400)}}]
+    v1, v2 = Vocab(), Vocab()
+    py = Flattener(schema, v1, use_native=False).flatten(objs, pad_n=4)
+    nat = Flattener(schema, v2, use_native=True)._flatten_native(
+        native.load(), objs, 4)
+    spec = schema.scalars[1]  # spec.priority
+    np.testing.assert_array_equal(py.scalars[spec].num, nat.scalars[spec].num)
+    assert np.isposinf(nat.scalars[spec].num[0])
+    assert np.isneginf(nat.scalars[spec].num[1])
+    # no pending exception corrupts the next unrelated call
+    assert 1 + 1 == 2

@@ -103,7 +103,8 @@ def server():
         validation_handler=handler,
         mutation_handler=MutationHandler(mut_system),
         namespace_label_handler=NamespaceLabelHandler(
-            exempt_users=["system:serviceaccount:kube-system:admin"]),
+            exempt_namespaces=["gatekeeper-system"],
+            exempt_prefixes=["kube-"]),
         port=0,
         readiness_check=lambda: True,
     ).start()
@@ -195,13 +196,27 @@ def test_mutate_delete_passthrough(server):
 
 
 def test_namespace_label_guard(server):
+    # exemption is by the NAMESPACE's name (namespacelabel.go:63-66), not by
+    # the requesting user
     labeled = ns("sneaky", {"admission.gatekeeper.sh/ignore": "true"})
     out = post(server.port, "/v1/admitlabel", admission_review(labeled))
     assert out["response"]["allowed"] is False
     out = post(server.port, "/v1/admitlabel", admission_review(
         labeled, username="system:serviceaccount:kube-system:admin"))
+    assert out["response"]["allowed"] is False
+    exempt = ns("gatekeeper-system",
+                {"admission.gatekeeper.sh/ignore": "true"})
+    out = post(server.port, "/v1/admitlabel", admission_review(exempt))
+    assert out["response"]["allowed"] is True
+    prefixed = ns("kube-public", {"admission.gatekeeper.sh/ignore": "true"})
+    out = post(server.port, "/v1/admitlabel", admission_review(prefixed))
     assert out["response"]["allowed"] is True
     out = post(server.port, "/v1/admitlabel", admission_review(ns("plain")))
+    assert out["response"]["allowed"] is True
+    # non-namespace objects pass through
+    pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {
+        "name": "p", "labels": {"admission.gatekeeper.sh/ignore": "x"}}}
+    out = post(server.port, "/v1/admitlabel", admission_review(pod))
     assert out["response"]["allowed"] is True
 
 
