@@ -201,49 +201,55 @@ class AuditManager:
         return submitted
 
     def _audit_chunk(self, objects, constraints, kept, totals, limit):
+        """No-evaluator path: every constraint goes through its template's
+        own driver (batched where the driver supports it)."""
         target = self.client.target
-        reviews = None
-
-        def get_reviews():
-            nonlocal reviews
-            if reviews is None:
-                reviews = [
-                    target.handle_review(
-                        AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
-                    )
-                    for o in objects
-                ]
-            return reviews
-
-        driver = next(
-            (d for d in self.client.drivers if hasattr(d, "query_batch")),
-            None,
-        )
-        # (the evaluator path goes through _pipeline_step/_process_swept;
-        # this method handles the no-evaluator fallbacks only)
-        if driver is not None:
-            self._chunk_via_query_batch(
-                driver, constraints, objects, get_reviews(), kept, totals,
-                limit
+        reviews = [
+            target.handle_review(
+                AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
             )
-            return
+            for o in objects
+        ]
+        self._eval_via_drivers(constraints, objects, reviews, kept, totals,
+                               limit)
 
-        # pure interpreter path (no batch-capable driver registered)
-        for oi, obj in enumerate(objects):
-            review = get_reviews()[oi]
-            for con in constraints:
-                if not target.to_matcher(con.match).match(review):
-                    continue
-                qr = self.client._template_driver[con.kind].query(
-                    target.name, [con], review, ReviewCfg(
-                        enforcement_point=AUDIT_EP)
-                )
-                key = con.key()
-                totals[key] += len(qr.results)
-                for r in qr.results:
-                    if len(kept[key]) < limit:
-                        kept[key].append(self._violation(con, obj, r.msg,
-                                                         r.details))
+    def _eval_via_drivers(self, constraints, objects, reviews, kept, totals,
+                          limit):
+        """Evaluate constraints through their own template's driver: the
+        batch path for batch-capable drivers, a matcher-prefiltered per-object
+        query loop otherwise.  This is the lane for every constraint the
+        device sweep did not cover — non-lowered Rego templates, CEL
+        templates (owned by a different driver), and referential templates
+        whose inventory tables are inexact for the current data version."""
+        if not constraints:
+            return
+        target = self.client.target
+        by_driver: dict[int, tuple] = {}
+        for con in constraints:
+            d = self.client._template_driver.get(con.kind)
+            if d is None:
+                continue  # no template: constraint cannot be evaluated
+            by_driver.setdefault(id(d), (d, []))[1].append(con)
+        for d, cons in by_driver.values():
+            if hasattr(d, "query_batch"):
+                self._chunk_via_query_batch(d, cons, objects, reviews, kept,
+                                            totals, limit)
+                continue
+            for oi, obj in enumerate(objects):
+                review = reviews[oi]
+                for con in cons:
+                    if not target.to_matcher(con.match).match(review):
+                        continue
+                    qr = d.query(
+                        target.name, [con], review,
+                        ReviewCfg(enforcement_point=AUDIT_EP)
+                    )
+                    key = con.key()
+                    totals[key] += len(qr.results)
+                    for r in qr.results:
+                        if len(kept[key]) < limit:
+                            kept[key].append(
+                                self._violation(con, obj, r.msg, r.details))
 
     def _process_swept(self, swept, objects, constraints, kept, totals,
                        limit):
@@ -292,15 +298,13 @@ class AuditManager:
                             driver, con, objects[oi], get_reviews()[oi],
                             kept[key], limit
                         )
-        fallback_cons = [
-            c for c in constraints
-            if c.kind in driver.fallback_kinds()
-        ]
-        if fallback_cons:
-            self._chunk_via_query_batch(
-                driver, fallback_cons, objects, get_reviews(), kept,
-                totals, limit
-            )
+        # everything the device sweep did not cover (non-lowered kinds, CEL
+        # templates owned by another driver, inventory-inexact referential
+        # kinds) goes through its own driver's exact path
+        rest = [c for c in constraints if c.kind not in swept]
+        if rest:
+            self._eval_via_drivers(rest, objects, get_reviews(), kept,
+                                   totals, limit)
 
     def _chunk_via_query_batch(self, driver, constraints, objects, reviews,
                                kept, totals, limit):
@@ -326,10 +330,14 @@ class AuditManager:
         """Render one hit through the exact engine; append to ``out_list``
         up to ``limit`` (the reference's LimitQueue cap applies to *results*,
         audit/manager.go:161-202).  Returns the number of results."""
-        qr = driver._interp.query(
-            self.client.target.name, [con], review,
-            ReviewCfg(enforcement_point=AUDIT_EP),
-        )
+        cfg = ReviewCfg(enforcement_point=AUDIT_EP)
+        if hasattr(driver, "render_query"):
+            qr = driver.render_query(self.client.target.name, con, review,
+                                     cfg)
+        else:
+            qr = driver._interp.query(
+                self.client.target.name, [con], review, cfg,
+            )
         for r in qr.results:
             if len(out_list) < limit:
                 out_list.append(self._violation(con, obj, r.msg, r.details))

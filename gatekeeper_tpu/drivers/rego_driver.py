@@ -123,7 +123,10 @@ class RegoDriver:
         constraints: Sequence[Constraint],
         review: GkReview,
         cfg: Optional[ReviewCfg] = None,
+        data_override: Optional[dict] = None,
     ) -> QueryResponse:
+        """``data_override`` substitutes the data document for this query
+        (the TPU driver's restricted-inventory render path)."""
         cfg = cfg or ReviewCfg()
         resp = QueryResponse()
         trace_lines: list[str] = [] if (cfg.tracing or self._trace_enabled) else None
@@ -138,7 +141,10 @@ class RegoDriver:
                 if constraint.parameters is not None
                 else {},
             }
-            interp = Interpreter(compiled.modules, data=self._data)
+            interp = Interpreter(
+                compiled.modules,
+                data=self._data if data_override is None else data_override,
+            )
             t0 = time.perf_counter_ns()
             violations = interp.query_set_rule(
                 compiled.package, "violation", input_doc

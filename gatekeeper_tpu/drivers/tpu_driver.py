@@ -33,11 +33,43 @@ from gatekeeper_tpu.drivers.base import ReviewCfg
 from gatekeeper_tpu.drivers.rego_driver import RegoDriver
 from gatekeeper_tpu.ir import masks as masks_mod
 from gatekeeper_tpu.ir.lower_rego import lower_template
-from gatekeeper_tpu.ir.program import CompiledProgram, LowerError, build_param_table
+from gatekeeper_tpu.ir.program import (CompiledProgram, LowerError,
+                                        build_param_table, walk_join_values)
 from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab, round_up
 from gatekeeper_tpu.target.review import GkReview
 
 DRIVER_NAME = "TPU"
+
+
+def _col_restrictable(col) -> bool:
+    """True when ``_col_values`` can reproduce the column read on the raw
+    object — object-rooted ScalarCol/RaggedCol only (review-level
+    ``__review__`` columns have no object path to walk)."""
+    from gatekeeper_tpu.ops.flatten import RaggedCol, ScalarCol
+
+    if isinstance(col, ScalarCol):
+        return col.path[:1] != ("__review__",)
+    return isinstance(col, RaggedCol)
+
+
+def _col_values(obj, col):
+    """String values of a ScalarCol/RaggedCol read on the raw object —
+    built on the flattener's own walk helpers so the restriction sees
+    exactly the values the device columns held."""
+    from gatekeeper_tpu.ops.flatten import (RaggedCol, ScalarCol,
+                                            _axis_items, _walk)
+
+    if isinstance(col, ScalarCol):
+        val, ok = _walk(obj, col.path)
+        return [val] if ok and isinstance(val, str) else []
+    if isinstance(col, RaggedCol):
+        out = []
+        for item in _axis_items(obj, col.axis):
+            val, ok = _walk(item, col.subpath)
+            if ok and isinstance(val, str):
+                out.append(val)
+        return out
+    return []
 
 
 class TpuDriver:
@@ -51,6 +83,8 @@ class TpuDriver:
         self._data_version = 0
         self._data_kind_versions: dict = {}  # inventory kind -> version
         self._inv_cache: dict = {}  # kind -> (versions, cols, exact)
+        self._render_specs: dict = {}  # kind -> Optional[list[(spec, col)]]
+        self._render_idx: dict = {}  # spec.key() -> (version, value -> entries)
         self.batch_bucket = batch_bucket
 
     # --- Driver protocol (delegating lifecycle to the exact engine) ------
@@ -77,12 +111,14 @@ class TpuDriver:
             self._programs.pop(template.kind, None)
             self._lower_errors[template.kind] = str(e)
         self._inv_cache.pop(template.kind, None)
+        self._render_specs.pop(template.kind, None)
 
     def remove_template(self, template_kind: str) -> None:
         self._interp.remove_template(template_kind)
         self._programs.pop(template_kind, None)
         self._lower_errors.pop(template_kind, None)
         self._inv_cache.pop(template_kind, None)
+        self._render_specs.pop(template_kind, None)
 
     def add_constraint(self, constraint: Constraint) -> None:
         self._interp.add_constraint(constraint)
@@ -153,6 +189,93 @@ class TpuDriver:
 
     def query(self, target, constraints, review, cfg=None) -> QueryResponse:
         return self._interp.query(target, constraints, review, cfg)
+
+    # --- restricted-inventory hit rendering ------------------------------
+    # Rendering a device-detected hit re-runs the interpreter; for
+    # referential templates that naively rescans the WHOLE inventory per hit
+    # (O(inventory) per render).  A lowered program only reaches inventory
+    # through its InventoryUniqueJoin equality, so entries whose join value
+    # differs from the review object's subject values cannot satisfy any
+    # clause (either polarity) — the interpreter may run against just the
+    # join-key-matching candidates, exactly.
+    def render_query(self, target, constraint, review,
+                     cfg=None) -> QueryResponse:
+        """Interpreter query for message rendering of a device hit, with the
+        inventory restricted to join candidates where provably safe."""
+        specs = self._render_restrict_specs(constraint.kind)
+        if not specs or not (self._interp._data or {}).get("inventory"):
+            return self._interp.query(target, [constraint], review, cfg)
+        obj = review.request.object or {}
+        ns_tree: dict = {}
+        for spec, col in specs:
+            index = self._render_index(spec)
+            for val in _col_values(obj, col):
+                for ns, apiver, name, entry in index.get(val, ()):
+                    ns_tree.setdefault(ns, {}).setdefault(
+                        apiver, {}).setdefault(spec.kind, {})[name] = entry
+        return self._interp.query(
+            target, [constraint], review, cfg,
+            data_override={"inventory": {"namespace": ns_tree}},
+        )
+
+    def _render_restrict_specs(self, kind):
+        """List of (InvTableSpec, subject column) when every inventory
+        access of the kind's program is a join with a plain column-read
+        subject; None when restriction would be unsafe (or no program)."""
+        if kind in self._render_specs:
+            return self._render_specs[kind]
+        from gatekeeper_tpu.ir import nodes as _N
+        from gatekeeper_tpu.ir.program import expr_nodes
+
+        prog = self._programs.get(kind)
+        specs: Optional[list] = []
+        if prog is None:
+            specs = None
+        else:
+            for node in expr_nodes(prog.program):
+                if not isinstance(node, _N.InventoryUniqueJoin):
+                    continue
+                if isinstance(node.subject, _N.FeatSid) and \
+                        _col_restrictable(node.subject.col):
+                    specs.append((node.spec, node.subject.col))
+                else:
+                    # transformed or review-level subject: the object walk
+                    # can't reproduce it — render with the full inventory
+                    specs = None
+                    break
+        self._render_specs[kind] = specs
+        return specs
+
+    def _render_index(self, spec):
+        """value -> [(ns, apiver, name, obj)] for one InvTableSpec, cached
+        per data version."""
+        import re as _re
+
+        key = spec.key()
+        cached = self._render_idx.get(key)
+        if cached is not None and cached[0] == self._data_version:
+            return cached[1]
+        index: dict = {}
+        rx = _re.compile(spec.apiver_regex) if spec.apiver_regex else None
+        inv = (self._interp._data or {}).get("inventory", {})
+        for ns, by_apiver in (inv.get("namespace", {}) or {}).items():
+            if not isinstance(by_apiver, dict):
+                continue
+            for apiver, by_kind in by_apiver.items():
+                if rx is not None and not rx.search(str(apiver)):
+                    continue
+                if not isinstance(by_kind, dict):
+                    continue
+                objs = by_kind.get(spec.kind)
+                if not isinstance(objs, dict):
+                    continue
+                for name, entry in objs.items():
+                    for val in walk_join_values(entry, spec.join_path):
+                        if isinstance(val, str):
+                            index.setdefault(val, []).append(
+                                (ns, apiver, name, entry))
+        self._render_idx[key] = (self._data_version, index)
+        return index
 
     def dump(self) -> dict:
         d = self._interp.dump()
@@ -252,8 +375,8 @@ class TpuDriver:
                 hit_idx = np.nonzero(grid[ci, :n])[0]
                 for oi in hit_idx.tolist():
                     if render_messages:
-                        qr = self._interp.query(
-                            target, [con], reviews[oi], cfg
+                        qr = self.render_query(
+                            target, con, reviews[oi], cfg
                         )
                         responses[oi].results.extend(qr.results)
                         if qr.trace:

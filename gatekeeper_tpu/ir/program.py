@@ -566,6 +566,26 @@ def pack_batch_cols(batch: ColumnBatch) -> dict:
     return cols
 
 
+def walk_join_values(obj, join_path) -> list:
+    """Values at ``join_path`` under ``obj``, fanning out at '*' (lists and
+    map values) — the single definition of the inventory-join walk, shared
+    by the device table builder and the TPU driver's render-time
+    candidate index (they must agree exactly)."""
+    vals: list = [obj]
+    for part in join_path:
+        nxt: list = []
+        for v in vals:
+            if part == "*":
+                if isinstance(v, list):
+                    nxt.extend(v)
+                elif isinstance(v, dict):
+                    nxt.extend(v.values())
+            elif isinstance(v, dict) and part in v:
+                nxt.append(v[part])
+        vals = nxt
+    return vals
+
+
 def build_inventory_tables(program: N.Program, data_tree: dict,
                            vocab: Vocab) -> tuple:
     """(cols dict, exact: bool) for the program's InvTableSpecs from the
@@ -617,19 +637,7 @@ def build_inventory_tables(program: N.Program, data_tree: dict,
                         vocab.intern(ons) if isinstance(ons, str) else -2,
                         vocab.intern(onm) if isinstance(onm, str) else -2,
                     )
-                    vals: list = [obj]
-                    for part in spec.join_path:
-                        nxt = []
-                        for v in vals:
-                            if part == "*":
-                                if isinstance(v, list):
-                                    nxt.extend(v)
-                                elif isinstance(v, dict):
-                                    nxt.extend(v.values())
-                            elif isinstance(v, dict) and part in v:
-                                nxt.append(v[part])
-                        vals = nxt
-                    for v in vals:
+                    for v in walk_join_values(obj, spec.join_path):
                         if isinstance(v, str):
                             owners_by_sid.setdefault(
                                 vocab.intern(v), set()).add(owner)

@@ -209,9 +209,14 @@ class _Ctx:
         return UNDEFINED
 
     def call_function(self, mod: ast.Module, rule: ast.Rule, args: list) -> Any:
-        memo_key = (mod.package, rule.name, freeze(tuple(args)))
-        if memo_key in self.fn_memo:
-            return self.fn_memo[memo_key]
+        # memoize scalar-arg calls only: freezing container args (e.g. whole
+        # inventory objects in referential policies) costs far more than
+        # re-evaluating the function body
+        memo_key = None
+        if not any(isinstance(a, (dict, list, tuple, RegoSet)) for a in args):
+            memo_key = (mod.package, rule.name, freeze(tuple(args)))
+            if memo_key in self.fn_memo:
+                return self.fn_memo[memo_key]
         self.depth += 1
         if self.depth > MAX_DEPTH:
             raise RegoError("max evaluation depth exceeded")
@@ -228,7 +233,8 @@ class _Ctx:
                 result = v
         finally:
             self.depth -= 1
-        self.fn_memo[memo_key] = result
+        if memo_key is not None:
+            self.fn_memo[memo_key] = result
         return result
 
     def _eval_fn_clause_chain(self, mod, clause: ast.Clause, args: list) -> Any:

@@ -211,3 +211,85 @@ def test_evaluator_without_batch_driver_falls_back():
     run = mgr.audit()
     assert run.total_objects == 20
     assert run.total_violations[("K8sRequiredLabels", "need-owner")] > 0
+
+
+def test_cel_constraints_not_dropped_by_evaluator_path():
+    """Round-2 regression: constraints owned by a non-batch driver (CEL
+    templates) must still be evaluated when the device evaluator handles
+    the lowered kinds (the old code only routed TpuDriver fallback kinds)."""
+    import os
+
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    lib = os.path.join(os.path.dirname(__file__), "..", "library",
+                       "general", "containerlimitscel")
+    tpu = TpuDriver(batch_bucket=16)
+    client = Client(target=K8sValidationTarget(),
+                    drivers=[tpu, CELDriver()],
+                    enforcement_points=["audit.gatekeeper.sh"])
+    client.add_template(load_yaml_file(f"{lib}/template.yaml")[0])
+    client.add_constraint(load_yaml_file(f"{lib}/samples/constraint.yaml")[0])
+    bad = load_yaml_file(f"{lib}/samples/example_disallowed.yaml")[0]
+    mgr = AuditManager(
+        client, lister=lambda: iter([bad]),
+        evaluator=ShardedEvaluator(tpu, make_mesh(2)),
+    )
+    run = mgr.audit()
+    assert sum(run.total_violations.values()) == 1
+
+
+def test_restricted_inventory_rendering_matches_full():
+    """TPU-driver render_query with join-candidate-restricted inventory must
+    produce bit-identical messages to the full-inventory interpreter."""
+    import os
+
+    from gatekeeper_tpu.drivers.base import ReviewCfg
+    from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+    from gatekeeper_tpu.target.review import AugmentedUnstructured
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    lib = os.path.join(os.path.dirname(__file__), "..", "library",
+                       "general", "uniqueingresshost")
+    tpu = TpuDriver(batch_bucket=16)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu],
+                    enforcement_points=["audit.gatekeeper.sh"])
+    client.add_template(load_yaml_file(f"{lib}/template.yaml")[0])
+    con = client.add_constraint(
+        load_yaml_file(f"{lib}/samples/constraint.yaml")[0])
+
+    def ing(i, host, ns="default"):
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+                "metadata": {"name": f"ing-{i}", "namespace": ns},
+                "spec": {"rules": [{"host": host}]}}
+
+    ingresses = [ing(0, "dup.example.com"), ing(1, "dup.example.com", "ns2"),
+                 ing(2, "solo.example.com"), ing(3, "other.example.com")]
+    for o in ingresses:
+        client.add_data(o)
+    target = client.target
+    cfg = ReviewCfg(enforcement_point="audit.gatekeeper.sh")
+    specs = tpu._render_restrict_specs(con.kind)
+    assert specs, "uniqueingresshost join subject should be restrictable"
+    for o in ingresses:
+        review = target.handle_review(
+            AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL))
+        full = tpu._interp.query(target.name, [con], review, cfg)
+        res = tpu.render_query(target.name, con, review, cfg)
+        assert sorted(r.msg for r in full.results) == \
+            sorted(r.msg for r in res.results), o["metadata"]
+    # the duplicated-host pair violates; the solo hosts do not
+    review = target.handle_review(AugmentedUnstructured(
+        object=ingresses[0], source=SOURCE_ORIGINAL))
+    assert tpu.render_query(target.name, con, review, cfg).results
+
+
+def test_render_restrict_rejects_unwalkable_subjects():
+    """A join whose subject the object walk can't reproduce (review-level
+    or transformed) must disable restriction, not restrict to nothing."""
+    from gatekeeper_tpu.ir import nodes as N
+    from gatekeeper_tpu.drivers.tpu_driver import _col_restrictable
+    from gatekeeper_tpu.ops.flatten import ScalarCol
+
+    assert _col_restrictable(ScalarCol(("spec", "host")))
+    assert not _col_restrictable(ScalarCol(("__review__", "namespace")))
