@@ -87,9 +87,10 @@ def build_client():
     from gatekeeper_tpu.target.target import K8sValidationTarget
     from gatekeeper_tpu.utils.synthetic import load_library
 
-    tpu = TpuDriver()
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
     client = Client(target=K8sValidationTarget(),
-                    drivers=[tpu, CELDriver()],
+                    drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, AUDIT_EP])
     nt, nc = load_library(client)
     fb = tpu.fallback_kinds()

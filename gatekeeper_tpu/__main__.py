@@ -99,9 +99,10 @@ def main(argv=None) -> int:
 
     operations = args.operation or list(ALL_OPERATIONS)
     metrics = MetricsRegistry()
-    tpu = TpuDriver()
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
     client = Client(target=K8sValidationTarget(),
-                    drivers=[tpu, CELDriver()],
+                    drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
     cluster = FakeCluster()
     if args.management_manifests:

@@ -73,10 +73,18 @@ def _col_values(obj, col):
 
 
 class TpuDriver:
-    """Implements the Driver protocol + the batched device path."""
+    """Implements the Driver protocol + the batched device path.
 
-    def __init__(self, batch_bucket: int = 256):
+    With a ``cel_driver``, CEL (K8sNativeValidation) templates are accepted
+    too: their validations lower onto the same predicate IR
+    (ir/lower_cel.py) and join the fused verdict sweep; the CEL evaluator
+    remains the exact oracle and message renderer for those kinds — the
+    same compile-or-fallback split the Rego path uses."""
+
+    def __init__(self, batch_bucket: int = 256, cel_driver=None):
         self._interp = RegoDriver()
+        self._cel = cel_driver  # optional CELDriver
+        self._cel_kinds: set = set()  # kinds owned by the CEL engine
         self.vocab = Vocab()
         self._programs: dict[str, CompiledProgram] = {}  # kind -> compiled
         self._lower_errors: dict[str, str] = {}  # kind -> why fallback
@@ -92,10 +100,17 @@ class TpuDriver:
         return DRIVER_NAME
 
     def has_source_for(self, template: ConstraintTemplate) -> bool:
-        return self._interp.has_source_for(template)
+        if self._interp.has_source_for(template):
+            return True
+        return self._cel is not None and self._cel.has_source_for(template)
 
     def add_template(self, template: ConstraintTemplate) -> None:
+        if not self._interp.has_source_for(template) and \
+                self._cel is not None and self._cel.has_source_for(template):
+            self._add_cel_template(template)
+            return
         self._interp.add_template(template)
+        self._cel_kinds.discard(template.kind)
         compiled = self._interp._templates[template.kind]
         try:
             program = lower_template(
@@ -113,18 +128,47 @@ class TpuDriver:
         self._inv_cache.pop(template.kind, None)
         self._render_specs.pop(template.kind, None)
 
+    def _add_cel_template(self, template: ConstraintTemplate) -> None:
+        from gatekeeper_tpu.ir.lower_cel import lower_cel_template
+
+        self._cel.add_template(template)
+        self._cel_kinds.add(template.kind)
+        compiled = self._cel._templates[template.kind]
+        try:
+            program = lower_cel_template(
+                compiled, template.kind, self.vocab,
+                schema_hint=template.parameters_schema,
+            )
+            self._programs[template.kind] = CompiledProgram(program)
+            self._lower_errors.pop(template.kind, None)
+        except LowerError as e:
+            self._programs.pop(template.kind, None)
+            self._lower_errors[template.kind] = str(e)
+        self._inv_cache.pop(template.kind, None)
+        self._render_specs.pop(template.kind, None)
+
     def remove_template(self, template_kind: str) -> None:
-        self._interp.remove_template(template_kind)
+        if template_kind in self._cel_kinds:
+            self._cel.remove_template(template_kind)
+            self._cel_kinds.discard(template_kind)
+        else:
+            self._interp.remove_template(template_kind)
         self._programs.pop(template_kind, None)
         self._lower_errors.pop(template_kind, None)
         self._inv_cache.pop(template_kind, None)
         self._render_specs.pop(template_kind, None)
 
     def add_constraint(self, constraint: Constraint) -> None:
-        self._interp.add_constraint(constraint)
+        if constraint.kind in self._cel_kinds:
+            self._cel.add_constraint(constraint)
+        else:
+            self._interp.add_constraint(constraint)
 
     def remove_constraint(self, constraint: Constraint) -> None:
-        self._interp.remove_constraint(constraint)
+        if constraint.kind in self._cel_kinds:
+            self._cel.remove_constraint(constraint)
+        else:
+            self._interp.remove_constraint(constraint)
 
     def _bump_data(self, path) -> None:
         self._data_version += 1
@@ -188,7 +232,19 @@ class TpuDriver:
         return self.inventory_cols(kind)[1]
 
     def query(self, target, constraints, review, cfg=None) -> QueryResponse:
-        return self._interp.query(target, constraints, review, cfg)
+        cel_cons = [c for c in constraints if c.kind in self._cel_kinds]
+        rego_cons = [c for c in constraints if c.kind not in self._cel_kinds]
+        if not cel_cons:
+            return self._interp.query(target, constraints, review, cfg)
+        resp = self._cel.query(target, cel_cons, review, cfg)
+        if rego_cons:
+            r2 = self._interp.query(target, rego_cons, review, cfg)
+            resp.results.extend(r2.results)
+            resp.stats_entries.extend(r2.stats_entries)
+            if r2.trace:
+                resp.trace = (resp.trace + "\n" + r2.trace
+                              if resp.trace else r2.trace)
+        return resp
 
     # --- restricted-inventory hit rendering ------------------------------
     # Rendering a device-detected hit re-runs the interpreter; for
@@ -202,6 +258,8 @@ class TpuDriver:
                      cfg=None) -> QueryResponse:
         """Interpreter query for message rendering of a device hit, with the
         inventory restricted to join candidates where provably safe."""
+        if constraint.kind in self._cel_kinds:
+            return self._cel.query(target, [constraint], review, cfg)
         specs = self._render_restrict_specs(constraint.kind)
         if not specs or not (self._interp._data or {}).get("inventory"):
             return self._interp.query(target, [constraint], review, cfg)
@@ -248,12 +306,16 @@ class TpuDriver:
 
     def _render_index(self, spec):
         """value -> [(ns, apiver, name, obj)] for one InvTableSpec, cached
-        per data version."""
+        per inventory-kind data version (mirrors inventory_cols: unrelated
+        kinds' writes must not force an O(inventory) rebuild)."""
         import re as _re
 
         key = spec.key()
+        version = (self._data_kind_versions.get(spec.kind,
+                                                self._data_version)
+                   if self._data_kind_versions else self._data_version)
         cached = self._render_idx.get(key)
-        if cached is not None and cached[0] == self._data_version:
+        if cached is not None and cached[0] == version:
             return cached[1]
         index: dict = {}
         rx = _re.compile(spec.apiver_regex) if spec.apiver_regex else None
@@ -274,7 +336,7 @@ class TpuDriver:
                         if isinstance(val, str):
                             index.setdefault(val, []).append(
                                 (ns, apiver, name, entry))
-        self._render_idx[key] = (self._data_version, index)
+        self._render_idx[key] = (version, index)
         return index
 
     def dump(self) -> dict:
@@ -328,6 +390,14 @@ class TpuDriver:
         fallback_kinds = [k for k in by_kind if k not in lowered_kinds]
 
         t0 = time.perf_counter_ns()
+        # DELETE reviews diverge for CEL kinds (object unset, anyObject =
+        # oldObject — driver.go:184-186) while the flattened columns carry
+        # the copied object: route those (constraint, review) pairs through
+        # the CEL evaluator instead of the grid
+        cel_delete_idx = [
+            oi for oi, r in enumerate(reviews)
+            if r.request.operation == "DELETE"
+        ] if self._cel_kinds else []
         verdicts: dict[str, np.ndarray] = {}
         # flatten once with the union schema (identity columns always needed
         # for match masks, even when every kind falls back)
@@ -364,7 +434,16 @@ class TpuDriver:
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )
-            verdicts[kind] = grid[:, : batch.n] & mask
+            grid = grid[:, : batch.n] & mask
+            if kind in self._cel_kinds and cel_delete_idx:
+                for ci, con in enumerate(cons):
+                    for oi in cel_delete_idx:
+                        if mask[ci, oi]:
+                            qr = self._cel.query(target, [con], reviews[oi],
+                                                 cfg)
+                            responses[oi].results.extend(qr.results)
+                    grid[ci, cel_delete_idx] = False
+            verdicts[kind] = grid
         eval_ns = time.perf_counter_ns() - te
 
         # render hits through the exact engine
@@ -392,12 +471,14 @@ class TpuDriver:
         # fallback kinds: exact engine on match-filtered pairs
         for kind in fallback_kinds:
             cons = by_kind[kind]
+            engine = (self._cel.query if kind in self._cel_kinds
+                      else self._interp.query)
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )
             for ci, con in enumerate(cons):
                 for oi in np.nonzero(mask[ci, :n])[0].tolist():
-                    qr = self._interp.query(target, [con], reviews[oi], cfg)
+                    qr = engine(target, [con], reviews[oi], cfg)
                     responses[oi].results.extend(qr.results)
 
         if cfg.stats:

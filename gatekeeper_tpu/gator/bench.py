@@ -54,7 +54,7 @@ def _drivers_for(engine: str):
     if engine == "cel":
         return [CELDriver()]
     if engine == "tpu":
-        return [TpuDriver()]
+        return [TpuDriver(cel_driver=CELDriver())]
     return [RegoDriver(), CELDriver()]  # all
 
 

@@ -1,0 +1,855 @@
+"""CEL (K8sNativeValidation) → predicate-IR lowering.
+
+The reference evaluates CEL templates with a per-(constraint, review)
+cel-go program loop (pkg/drivers/k8scel/driver.go:162-251).  Here the same
+vectorizable fragment that ir/lower_rego.py covers for Rego lowers CEL
+validations onto the SAME device IR (ir/nodes.py), so CEL constraints join
+the fused [C, N] verdict sweep instead of running a per-object Python
+evaluator.
+
+Exact semantics being lowered (drivers/cel_driver.py query loop):
+a validation VIOLATES iff its expression does NOT evaluate to exactly
+``true`` — evaluating to false, to a non-bool, or erroring (under
+``failurePolicy: Fail``) all violate.  The lowerer therefore tracks DUAL
+polarity for every boolean subexpression:
+
+    t(E): device expr that is true  iff E evaluates to exactly true
+    f(E): device expr that is true  iff E evaluates to exactly false
+
+and the violation expression is ``Not(t(E))`` — which correctly includes
+CEL's error outcomes because every primitive's t/f forms are definedness-
+gated (absent fields, non-string operands to string predicates, and
+unparseable quantities make both polarities false).
+
+CEL's error-absorbing && / || map exactly onto this dual form:
+    t(a && b) = t(a) ∧ t(b)        f(a && b) = f(a) ∨ f(b)
+    t(a || b) = t(a) ∨ t(b)        f(a || b) = f(a) ∧ f(b)
+    t(!a) = f(a)                   f(!a) = t(a)
+macros:
+    t(L.all(x, P))    = ¬∃item ¬t(P)      f = ∃item f(P)
+    t(L.exists(x, P)) = ∃item t(P)        f = ¬∃item ¬f(P)
+    t(size(L.filter(x, P)) == 0) = ¬∃item ¬f(P)   (all items exactly false)
+
+Fragment boundaries (anything else raises LowerError → interpreter
+fallback behind the same Driver seam):
+- failurePolicy must be Fail (Ignore absorbs errors differently);
+- no matchConditions;
+- comparisons on quantities (isQuantity/quantity().isGreaterThan/...),
+  booleans, strings, and literal numbers;
+- list sources: object paths, ``a + b`` concatenation, the
+  ``!has(p) ? [] : p`` guard idiom, string-list params;
+- no oldObject / request / namespaceObject access.
+
+Messages are NOT lowered: hits render through the CEL evaluator
+(messageExpression semantics preserved).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from gatekeeper_tpu.ir import nodes as N
+from gatekeeper_tpu.ir.program import LowerError, _ElemListSid
+from gatekeeper_tpu.lang.cel import cel as C
+from gatekeeper_tpu.ops.flatten import (Axis, K_FALSE, K_MAP, K_NUM, K_OTHER,
+                                        K_STR, K_TRUE, RaggedCol, ScalarCol,
+                                        Schema)
+
+QUANTITY_FN = "cel.quantity"
+
+_STR_METHODS = {"startsWith": "startswith", "endsWith": "endswith",
+                "contains": "contains", "matches": "re_match"}
+_QTY_CMP = {"isGreaterThan": ("gt", "lte"), "isLessThan": ("lt", "gte")}
+
+
+# --- symbolic values ------------------------------------------------------
+
+
+class SVal:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class SObj(SVal):
+    """Value at a path under the review object root."""
+
+    path: tuple
+
+
+@dataclass(frozen=True)
+class SItem(SVal):
+    """Field of the current macro item on a ragged axis."""
+
+    axis: Axis
+    subpath: tuple
+
+
+@dataclass(frozen=True)
+class ListPart(SVal):
+    """One source of a (possibly concatenated) list value.
+
+    ``empty_guards``: exprs under which the source evaluates to a DEFINED
+    empty list via the ``!has(p) ? [] : p`` idiom (each is the exactly-
+    false form of the corresponding has()).  ``path`` locates the value
+    for list/map kind gating (object-rooted)."""
+
+    path: tuple
+    empty_guards: tuple = ()
+
+
+@dataclass(frozen=True)
+class SList(SVal):
+    """A list value backed by a ragged axis over one or more parts.
+
+    CEL outcome model per part: ERROR (base chain broken / unguarded
+    absent / non-list value), EMPTY (a guard fired), LIST (items).  Maps
+    are NOT lists: a macro over a non-empty map iterates KEYS (which this
+    axis cannot represent) and a concat over a map errors — both gate to
+    the error outcome, which is exact as long as the macro body derefs
+    the loop variable (enforced by the bare-variable check)."""
+
+    axis: Axis
+    parts: tuple  # tuple[ListPart]
+
+
+@dataclass(frozen=True)
+class SFiltered(SVal):
+    """``L.filter(var, body)`` — lowered lazily at the size() comparison."""
+
+    source: "SList"
+    var: str
+    body: Any
+    env: tuple  # frozen env items
+
+
+@dataclass(frozen=True)
+class SParam(SVal):
+    path: tuple  # under params root
+
+
+@dataclass(frozen=True)
+class SParamList(SVal):
+    name: str
+
+
+@dataclass(frozen=True)
+class SParamElem(SVal):
+    name: str
+
+
+@dataclass(frozen=True)
+class SLit(SVal):
+    value: Any
+
+
+@dataclass(frozen=True)
+class SQuantity(SVal):
+    arg: SVal
+
+
+class _VariablesMarker(SVal):
+    __slots__ = ()
+
+
+def _check_no_bare_var(ast, var: str) -> None:
+    """CEL macros iterate map KEYS; the ragged axis holds VALUES.  The
+    _list_ok gates emit the ERROR outcome for macros over non-empty maps,
+    which is exact only if the body genuinely errors on every string key.
+    Three conditions enforce that statically:
+
+    - the variable is never used BARE (a value use like ``k == "x"`` is
+      key-sensitive and evaluates fine on strings);
+    - BOTH outcomes of the body require a successful dereference of the
+      variable (CEL's absorbing && / || can otherwise decide the body
+      without touching the var: ``has(c.x) || true`` is TRUE over keys,
+      ``has(c.x) && false`` is FALSE over keys — either would diverge)."""
+    t_req, f_req = _deref_req(ast, var)
+    if not (t_req and f_req):
+        raise LowerError(
+            f"macro body can decide without dereferencing {var}")
+
+
+def _deref_req(ast, var: str) -> tuple:
+    """(t_req, f_req): whether the body's exactly-true / exactly-false
+    outcome entails a successful deref of ``var`` (vacuous outcomes count
+    as requiring).  Raises on bare uses."""
+    if isinstance(ast, C.Lit):
+        if ast.value is True:
+            return False, True
+        if ast.value is False:
+            return True, False
+        return True, True  # non-bool literal can't decide a bool body
+    if isinstance(ast, C.Unary) and ast.op == "!":
+        t, f = _deref_req(ast.operand, var)
+        return f, t
+    if isinstance(ast, C.Binary) and ast.op in ("&&", "||"):
+        lt, lf = _deref_req(ast.lhs, var)
+        rt, rf = _deref_req(ast.rhs, var)
+        if ast.op == "&&":
+            return (lt or rt), (lf and rf)
+        return (lt and rt), (lf or rf)
+    if isinstance(ast, C.Ternary):
+        ct, cf = _deref_req(ast.cond, var)
+        at, af = _deref_req(ast.then, var)
+        bt, bf = _deref_req(ast.other, var)
+        return ((ct or at) and (cf or bt)), ((ct or af) and (cf or bf))
+    if isinstance(ast, C.Macro):
+        # nested macro (e.g. over a param list): true needs a true element
+        # (body true), false is reachable with an empty source (no deref)
+        bt, bf = _deref_req(ast.body, var)
+        tgt = _count_var_derefs(ast.target, var, False) > 0
+        if ast.name == "exists":
+            return (tgt or bt), tgt
+        if ast.name == "all":
+            return tgt, (tgt or bf)
+        return False, False  # filter/map: analyzed at their comparison
+    # leaf predicate (comparison, method, has, in): both outcomes imply its
+    # operands evaluated — derefs under nested macro BODIES don't count
+    # (an empty source decides without evaluating the body)
+    d = _count_var_derefs(ast, var, False, skip_macro_bodies=True) > 0
+    return d, d
+
+
+def _count_var_derefs(ast, var: str, safe: bool,
+                      skip_macro_bodies: bool = False) -> int:
+    count = 0
+    if isinstance(ast, C.Ident):
+        if ast.name == var:
+            if not safe:
+                raise LowerError(f"macro variable {var} used bare")
+            return 1
+        return 0
+    if isinstance(ast, C.Select):
+        return _count_var_derefs(ast.base, var, True, skip_macro_bodies)
+    if isinstance(ast, C.Index):
+        return (_count_var_derefs(ast.base, var, True, skip_macro_bodies)
+                + _count_var_derefs(ast.index, var, False,
+                                    skip_macro_bodies))
+    if isinstance(ast, C.Call):
+        # only Select/Index BASE positions deref; a method target or call
+        # argument uses the value itself (string ops on a map key work)
+        if ast.target is not None:
+            count += _count_var_derefs(ast.target, var, False,
+                                       skip_macro_bodies)
+        for a in ast.args:
+            count += _count_var_derefs(a, var, False, skip_macro_bodies)
+        return count
+    if isinstance(ast, C.Macro) and skip_macro_bodies:
+        return _count_var_derefs(ast.target, var, False, skip_macro_bodies)
+    for f in getattr(ast, "__dataclass_fields__", {}):
+        v = getattr(ast, f)
+        if isinstance(v, (C.Lit, C.Ident, C.Select, C.Index, C.Call,
+                          C.Unary, C.Binary, C.Ternary, C.ListLit,
+                          C.MapLit, C.Macro)):
+            count += _count_var_derefs(v, var, False, skip_macro_bodies)
+        elif isinstance(v, tuple):
+            count += sum(_count_var_derefs(item, var, False,
+                                           skip_macro_bodies)
+                         for item in v)
+    return count
+
+
+_VARIABLES = _VariablesMarker()
+_TRUE = N.ConstBool(True)
+_FALSE = N.ConstBool(False)
+
+
+def _and(*terms):
+    flat = [t for t in terms if t is not _TRUE]
+    if any(t is _FALSE for t in flat):
+        return _FALSE
+    if not flat:
+        return _TRUE
+    return flat[0] if len(flat) == 1 else N.And(tuple(flat))
+
+
+def _or(*terms):
+    flat = [t for t in terms if t is not _FALSE]
+    if any(t is _TRUE for t in flat):
+        return _TRUE
+    if not flat:
+        return _FALSE
+    return flat[0] if len(flat) == 1 else N.Or(tuple(flat))
+
+
+class _CelLowerer:
+    def __init__(self, variables: dict, vocab, schema_hint: Optional[dict]):
+        self.variables = variables  # name -> CEL AST
+        self.vocab = vocab
+        self.schema = Schema()
+        self.schema_hint = (schema_hint or {}).get("properties", {})
+        self.param_kinds: dict[str, str] = {}
+        self.weak_params: set = set()  # has()-only params (type unclaimed)
+        self._var_stack: list[str] = []
+
+    # --- schema/column helpers ---------------------------------------
+    def _scalar_col(self, path: tuple) -> ScalarCol:
+        col = ScalarCol(path=path)
+        if col not in self.schema.scalars:
+            self.schema.scalars.append(col)
+        return col
+
+    def _ragged_col(self, axis: Axis, subpath: tuple) -> RaggedCol:
+        col = RaggedCol(axis=axis, subpath=subpath)
+        if col not in self.schema.raggeds:
+            self.schema.raggeds.append(col)
+        return col
+
+    def _feat_col(self, sv: SVal):
+        if isinstance(sv, SObj):
+            return self._scalar_col(sv.path)
+        if isinstance(sv, SItem):
+            return self._ragged_col(sv.axis, sv.subpath)
+        raise LowerError(f"no column for {sv}")
+
+    def _note_param(self, name: str, kind: str):
+        prev = self.param_kinds.get(name)
+        if prev is not None and prev != kind:
+            raise LowerError(f"param {name} used as {prev} and {kind}")
+        self.param_kinds[name] = kind
+
+    # --- operand builders --------------------------------------------
+    def _sid(self, sv: SVal) -> N.Expr:
+        """sid-valued operand (string reads)."""
+        if isinstance(sv, (SObj, SItem)):
+            return N.FeatSid(self._feat_col(sv))
+        if isinstance(sv, SParam):
+            if len(sv.path) != 1:
+                raise LowerError(f"nested param path {sv.path}")
+            self._note_param(sv.path[0], "str")
+            return N.ParamSid(sv.path[0])
+        if isinstance(sv, SParamElem):
+            return N.ParamElemSid()
+        if isinstance(sv, SLit) and isinstance(sv.value, str):
+            return N.ConstSid(self.vocab.intern(sv.value))
+        raise LowerError(f"not a string operand: {sv}")
+
+    def _is_str(self, sv: SVal) -> N.Expr:
+        """Defined-string test for the false-polarity gates."""
+        if isinstance(sv, (SObj, SItem)):
+            return N.KindIs(self._feat_col(sv), K_STR)
+        if isinstance(sv, SParam):
+            self._note_param(sv.path[0], "str")
+            return N.ParamPresent(sv.path[0])
+        if isinstance(sv, (SParamElem, SLit)):
+            return _TRUE
+        raise LowerError(f"not a string operand: {sv}")
+
+    def _defined(self, sv: SVal) -> N.Expr:
+        """The operand evaluates without error, any type (CEL equality is
+        heterogeneous: mixed-type == is a defined false, not an error)."""
+        if isinstance(sv, (SObj, SItem)):
+            return N.Present(self._feat_col(sv))
+        if isinstance(sv, SParam):
+            if len(sv.path) != 1:
+                raise LowerError(f"nested param path {sv.path}")
+            self.weak_params.add(sv.path[0])
+            return N.ParamPresent(sv.path[0])
+        if isinstance(sv, (SParamElem, SLit)):
+            return _TRUE
+        raise LowerError(f"no definedness test for {sv}")
+
+    def _has_pair(self, sv: SVal) -> tuple:
+        """CEL has(a.b.c): true iff the leaf exists (walk implies the base
+        chain was maps); exactly-FALSE requires every proper prefix to be a
+        present map (a broken base chain ERRORS — has() is not total)."""
+        if isinstance(sv, SObj):
+            if not sv.path:
+                raise LowerError("has() of the object root")
+            t = N.Present(self._scalar_col(sv.path))
+            gates = [
+                N.KindIs(self._scalar_col(sv.path[:i]), K_MAP)
+                for i in range(1, len(sv.path))
+            ]
+            return t, _and(*gates, N.Not(t))
+        if isinstance(sv, SItem):
+            if not sv.subpath:
+                raise LowerError("has() of a bare loop variable")
+            t = N.Present(self._ragged_col(sv.axis, sv.subpath))
+            gates = [
+                N.KindIs(self._ragged_col(sv.axis, sv.subpath[:i]), K_MAP)
+                for i in range(1, len(sv.subpath))
+            ]
+            return t, _and(*gates, N.Not(t))
+        if isinstance(sv, SParam):
+            if len(sv.path) != 1:
+                raise LowerError(f"nested param path {sv.path}")
+            # kind noted at the USE site; has() alone doesn't fix a type —
+            # weak 'str' default applied at build unless a use claims it
+            self.weak_params.add(sv.path[0])
+            pres = N.ParamPresent(sv.path[0])
+            return pres, N.Not(pres)  # params root is always a map
+        raise LowerError(f"has() of {sv}")
+
+    def _num(self, sv: SVal) -> N.Expr:
+        if isinstance(sv, SLit) and isinstance(sv.value, (int, float)) \
+                and not isinstance(sv.value, bool):
+            return N.ConstNum(float(sv.value))
+        if isinstance(sv, SQuantity):
+            arg = sv.arg
+            if isinstance(arg, SParam):
+                if len(arg.path) != 1:
+                    raise LowerError(f"nested param path {arg.path}")
+                self._note_param(arg.path[0], "str")
+                return N.ParamFnNum(QUANTITY_FN, arg.path[0])
+            return N.StrFnNum(QUANTITY_FN, self._sid(arg))
+        if isinstance(sv, (SObj, SItem)):
+            return N.FeatNum(self._feat_col(sv))
+        raise LowerError(f"not numeric: {sv}")
+
+    def _num_gate(self, sv: SVal) -> N.Expr:
+        """CEL errors on cross-type comparison (no Rego total order): gate
+        feature reads on the numeric kind tag."""
+        if isinstance(sv, (SObj, SItem)):
+            return N.KindIs(self._feat_col(sv), K_NUM)
+        return _TRUE  # literals always; quantities gate via validity
+
+    # --- value lowering ----------------------------------------------
+    def value(self, ast, env: dict) -> SVal:
+        if isinstance(ast, C.Lit):
+            return SLit(ast.value)
+        if isinstance(ast, C.Ident):
+            name = ast.name
+            if name in env:
+                return env[name]
+            if name == "variables":
+                return _VARIABLES
+            if name in ("object", "anyObject"):
+                return SObj(())
+            if name == "params":
+                return SParam(())
+            if name in ("oldObject", "request", "namespaceObject"):
+                raise LowerError(f"unsupported root {name}")
+            raise LowerError(f"unknown ident {name}")
+        if isinstance(ast, C.Select):
+            base = self.value(ast.base, env)
+            if isinstance(base, _VariablesMarker):
+                return self._resolve_variable(ast.field, env)
+            if isinstance(base, SObj):
+                return SObj(base.path + (ast.field,))
+            if isinstance(base, SItem):
+                return SItem(base.axis, base.subpath + (ast.field,))
+            if isinstance(base, SParam):
+                return SParam(base.path + (ast.field,))
+            raise LowerError(f"select .{ast.field} on {base}")
+        if isinstance(ast, C.Index):
+            base = self.value(ast.base, env)
+            if isinstance(ast.index, C.Lit) and isinstance(
+                    ast.index.value, str):
+                if isinstance(base, SObj):
+                    return SObj(base.path + (ast.index.value,))
+                if isinstance(base, SItem):
+                    return SItem(base.axis,
+                                 base.subpath + (ast.index.value,))
+                if isinstance(base, SParam):
+                    return SParam(base.path + (ast.index.value,))
+            raise LowerError("dynamic index")
+        if isinstance(ast, C.Call):
+            if ast.target is None and ast.name == "quantity" \
+                    and len(ast.args) == 1:
+                return SQuantity(self.value(ast.args[0], env))
+            raise LowerError(f"call {ast.name} in value position")
+        if isinstance(ast, C.Binary) and ast.op == "+":
+            lhs = self._as_list(self.value(ast.lhs, env))
+            rhs = self._as_list(self.value(ast.rhs, env))
+            if isinstance(lhs, SList) and isinstance(rhs, SList):
+                return SList(Axis(lhs.axis.segments + rhs.axis.segments),
+                             lhs.parts + rhs.parts)
+            raise LowerError("+ on non-lists")
+        if isinstance(ast, C.Ternary):
+            return self._guarded_list(ast, env)
+        if isinstance(ast, C.ListLit):
+            if not ast.items:
+                return SList(Axis(()), ())  # empty list literal
+            items = [self.value(i, env) for i in ast.items]
+            if all(isinstance(i, SLit) and isinstance(i.value, str)
+                   for i in items):
+                return SLit([i.value for i in items])
+            raise LowerError("non-string list literal")
+        if isinstance(ast, C.Macro):
+            if ast.name == "filter" and ast.var2 is None:
+                target = self._as_list(self.value(ast.target, env))
+                if isinstance(target, SList):
+                    return SFiltered(target, ast.var, ast.body,
+                                     tuple(env.items()))
+            raise LowerError(f"macro {ast.name} in value position")
+        raise LowerError(f"value {type(ast).__name__}")
+
+    def _resolve_variable(self, name: str, env: dict) -> SVal:
+        if name == "anyObject":
+            return SObj(())
+        if name == "params":
+            return SParam(())
+        if name not in self.variables:
+            raise LowerError(f"unknown variable {name}")
+        if name in self._var_stack:
+            raise LowerError(f"variable cycle at {name}")
+        self._var_stack.append(name)
+        try:
+            return self.value(self.variables[name], {})
+        finally:
+            self._var_stack.pop()
+
+    def _as_list(self, sv: SVal) -> SVal:
+        if isinstance(sv, (SList, SFiltered, SParamList)):
+            return sv
+        if isinstance(sv, SObj):
+            return SList(Axis(((sv.path,),)), (ListPart(sv.path),))
+        if isinstance(sv, SItem):
+            raise LowerError("nested item list (needs NestedAny)")
+        if isinstance(sv, SParam):
+            if len(sv.path) != 1:
+                raise LowerError(f"nested param list {sv.path}")
+            self._note_param(sv.path[0], "strlist")
+            return SParamList(sv.path[0])
+        raise LowerError(f"not a list: {sv}")
+
+    def _guarded_list(self, ast: C.Ternary, env: dict) -> SVal:
+        """``!has(p) ? [] : x`` / ``has(p) ? x : []``: the guard's exactly-
+        false form becomes an empty_guard on the resulting list parts (the
+        value is a DEFINED [] when the guard fires; a broken base chain
+        still errors through the has itself)."""
+        def is_empty_list(a):
+            return isinstance(a, C.ListLit) and not a.items
+
+        cond, then, other = ast.cond, ast.then, ast.other
+        neg = isinstance(cond, C.Unary) and cond.op == "!"
+        inner = cond.operand if neg else cond
+        if not (isinstance(inner, C.Call) and inner.target is None
+                and inner.name == "has" and len(inner.args) == 1):
+            raise LowerError("ternary outside the has()-guard idiom")
+        guarded_sv = self.value(inner.args[0], env)
+        t_has, f_has = self._has_pair(guarded_sv)
+        if neg and is_empty_list(then):
+            taken = self.value(other, env)
+        elif not neg and is_empty_list(other):
+            taken = self.value(then, env)
+        else:
+            raise LowerError("ternary outside the has()-guard idiom")
+        if isinstance(taken, SParam):
+            taken = self._as_list(taken)
+        if isinstance(taken, SParamList):
+            return taken  # param-table counts already encode absence
+        taken = self._as_list(taken)
+        if not isinstance(taken, SList):
+            raise LowerError(f"guarded non-list {taken}")
+        parts = tuple(
+            ListPart(p.path, p.empty_guards + (f_has,))
+            for p in taken.parts
+        )
+        return SList(taken.axis, parts)
+
+    def _list_ok(self, target: SList, allow_empty_map: bool) -> N.Expr:
+        """The target expression evaluates to a DEFINED list (or, when
+        allowed, an empty map — CEL macros over empty maps are vacuous).
+        Anything else (error, non-list value, NON-empty map whose keys the
+        axis cannot represent) fails both polarities → error → violation."""
+        oks = []
+        for part in target.parts:
+            col = self._scalar_col(part.path)
+            alts = list(part.empty_guards)
+            alts.append(N.KindIs(col, K_OTHER))
+            if allow_empty_map:
+                axis = Axis(((part.path,),))
+                self._touch_axis(axis)
+                alts.append(_and(
+                    N.KindIs(col, K_MAP),
+                    N.Not(N.AnyAxis(axis, _TRUE)),
+                ))
+            oks.append(_or(*alts))
+        return _and(*oks)
+
+    def _touch_axis(self, axis: Axis):
+        """Ensure the axis's counts are materialized in the schema."""
+        col = RaggedCol(axis=axis, subpath=())
+        if col not in self.schema.raggeds:
+            self.schema.raggeds.append(col)
+
+    # --- boolean lowering (dual polarity) ----------------------------
+    def bool_pair(self, ast, env: dict) -> tuple:
+        if isinstance(ast, C.Lit):
+            if ast.value is True:
+                return _TRUE, _FALSE
+            if ast.value is False:
+                return _FALSE, _TRUE
+            raise LowerError("non-bool literal in bool position")
+        if isinstance(ast, C.Unary):
+            if ast.op == "!":
+                t, f = self.bool_pair(ast.operand, env)
+                return f, t
+            raise LowerError(f"unary {ast.op}")
+        if isinstance(ast, C.Ternary):
+            tc, fc = self.bool_pair(ast.cond, env)
+            ta, fa = self.bool_pair(ast.then, env)
+            tb, fb = self.bool_pair(ast.other, env)
+            return (_or(_and(tc, ta), _and(fc, tb)),
+                    _or(_and(tc, fa), _and(fc, fb)))
+        if isinstance(ast, C.Binary):
+            return self._binary_pair(ast, env)
+        if isinstance(ast, C.Macro):
+            return self._macro_pair(ast, env)
+        if isinstance(ast, C.Call):
+            return self._call_pair(ast, env)
+        if isinstance(ast, (C.Ident, C.Select, C.Index)):
+            # a bare boolean field read
+            sv = self.value(ast, env)
+            if isinstance(sv, (SObj, SItem)):
+                col = self._feat_col(sv)
+                return N.KindIs(col, K_TRUE), N.KindIs(col, K_FALSE)
+            if isinstance(sv, SParam):
+                if len(sv.path) != 1:
+                    raise LowerError(f"nested param path {sv.path}")
+                self._note_param(sv.path[0], "bool")
+                return (N.ParamBoolIs(sv.path[0], True),
+                        N.ParamBoolIs(sv.path[0], False))
+            raise LowerError(f"bool read of {sv}")
+        raise LowerError(f"bool {type(ast).__name__}")
+
+    def _binary_pair(self, ast: C.Binary, env: dict) -> tuple:
+        op = ast.op
+        if op == "&&":
+            ta, fa = self.bool_pair(ast.lhs, env)
+            tb, fb = self.bool_pair(ast.rhs, env)
+            return _and(ta, tb), _or(fa, fb)
+        if op == "||":
+            ta, fa = self.bool_pair(ast.lhs, env)
+            tb, fb = self.bool_pair(ast.rhs, env)
+            return _or(ta, tb), _and(fa, fb)
+        if op in ("==", "!="):
+            t, f = self._eq_pair(ast.lhs, ast.rhs, env)
+            return (f, t) if op == "!=" else (t, f)
+        if op in ("<", "<=", ">", ">="):
+            ir_op = {"<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}[op]
+            inv = {"lt": "gte", "lte": "gt", "gt": "lte", "gte": "lt"}[ir_op]
+            return self._cmp_pair(ast.lhs, ast.rhs, ir_op, inv, env)
+        if op == "in":
+            needle = self.value(ast.lhs, env)
+            hay = self._as_list(self.value(ast.rhs, env))
+            if isinstance(hay, SParamList):
+                hit = N.InStrList(self._sid(needle), hay.name)
+                # heterogeneous membership: a defined non-string needle is
+                # simply not in a string list (false, not error)
+                return hit, _and(self._defined(needle), N.Not(hit))
+            raise LowerError("in over non-param list")
+        raise LowerError(f"binary {op}")
+
+    def _size_of(self, ast, env: dict) -> Optional[SVal]:
+        if isinstance(ast, C.Call) and ast.name == "size" \
+                and len(ast.args) == 1 and ast.target is None:
+            return self._as_list(self.value(ast.args[0], env))
+        return None
+
+    def _cmp_pair(self, lhs_ast, rhs_ast, ir_op, inv_op, env) -> tuple:
+        sized = self._size_of(lhs_ast, env)
+        if sized is not None:
+            k = self.value(rhs_ast, env)
+            if isinstance(k, SLit) and k.value == 0:
+                return self._size_cmp_zero(sized, ir_op)
+            raise LowerError("size() compared to non-zero")
+        sized = self._size_of(rhs_ast, env)
+        if sized is not None:
+            flip = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}
+            return self._cmp_pair(rhs_ast, lhs_ast, flip[ir_op],
+                                  flip[inv_op], env)
+        lv = self.value(lhs_ast, env)
+        rv = self.value(rhs_ast, env)
+        gates = _and(self._num_gate(lv), self._num_gate(rv))
+        ln, rn = self._num(lv), self._num(rv)
+        return (_and(gates, N.CmpNum(ln, ir_op, rn)),
+                _and(gates, N.CmpNum(ln, inv_op, rn)))
+
+    def _size_cmp_zero(self, target: SVal, ir_op: str) -> tuple:
+        """size(L) <op> 0 for list targets (axis count semantics)."""
+        if isinstance(target, SFiltered):
+            src = target.source
+            _check_no_bare_var(target.body, target.var)
+            sub_env = dict(target.env)
+            sub_env[target.var] = SItem(src.axis, ())
+            tp, fp = self.bool_pair(target.body, sub_env)
+            ok = self._list_ok(src, allow_empty_map=len(src.parts) == 1)
+            if not src.axis.segments:
+                eq0_t, eq0_f = _TRUE, _FALSE  # filter of [] is []
+            else:
+                all_false = N.Not(N.AnyAxis(src.axis, N.Not(fp)))
+                some_true = N.AnyAxis(src.axis, tp)
+                defined = N.Not(N.AnyAxis(src.axis,
+                                          _and(N.Not(tp), N.Not(fp))))
+                eq0_t = _and(ok, all_false)
+                eq0_f = _and(ok, some_true, defined)
+        elif isinstance(target, SList):
+            if not target.axis.segments:
+                eq0_t, eq0_f = _TRUE, _FALSE  # empty list literal
+            else:
+                ok = self._list_ok(target,
+                                   allow_empty_map=len(target.parts) == 1)
+                nonempty = N.AnyAxis(target.axis, _TRUE)
+                eq0_t = _and(ok, N.Not(nonempty))
+                eq0_f = _and(ok, nonempty)
+        else:
+            raise LowerError(f"size() of {target}")
+        if ir_op == "eq":
+            return eq0_t, eq0_f
+        if ir_op == "neq":
+            return eq0_f, eq0_t
+        if ir_op == "gt":  # size > 0 ⇔ not (size == 0)
+            return eq0_f, eq0_t
+        if ir_op == "lte":  # size <= 0 ⇔ size == 0
+            return eq0_t, eq0_f
+        raise LowerError(f"size() {ir_op} 0")
+
+    def _eq_pair(self, lhs_ast, rhs_ast, env) -> tuple:
+        sized = self._size_of(lhs_ast, env) or self._size_of(rhs_ast, env)
+        if sized is not None:
+            other = rhs_ast if self._size_of(lhs_ast, env) is not None \
+                else lhs_ast
+            k = self.value(other, env)
+            if isinstance(k, SLit) and k.value == 0:
+                return self._size_cmp_zero(sized, "eq")
+            raise LowerError("size() compared to non-zero")
+        lv = self.value(lhs_ast, env)
+        rv = self.value(rhs_ast, env)
+        # boolean equality: x == true / x == false.  CEL equality is
+        # heterogeneous: ANY defined non-matching value (other bool, string,
+        # number, null) compares false — only absence errors
+        for a, b in ((lv, rv), (rv, lv)):
+            if isinstance(b, SLit) and isinstance(b.value, bool):
+                if not isinstance(a, (SObj, SItem)):
+                    raise LowerError("bool == on non-column")
+                col = self._feat_col(a)
+                want = K_TRUE if b.value else K_FALSE
+                t = N.KindIs(col, want)
+                return t, _and(N.Present(col), N.Not(t))
+        # numeric equality (literal number or quantity on either side):
+        # CmpNum(eq) is false on mixed types and CmpNum(neq) true — exactly
+        # CEL's heterogeneous semantics — with presence/validity built into
+        # the operand flags, so no extra kind gates
+        if any(isinstance(x, SLit) and isinstance(x.value, (int, float))
+               and not isinstance(x.value, bool) for x in (lv, rv)) or \
+                any(isinstance(x, SQuantity) for x in (lv, rv)):
+            ln, rn = self._num(lv), self._num(rv)
+            return N.CmpNum(ln, "eq", rn), N.CmpNum(ln, "neq", rn)
+        # string equality: one side must be a known-string (literal, param
+        # element) so EqStr covers the true polarity; the false polarity is
+        # CEL's heterogeneous equality — DEFINED operands of any type that
+        # are not string-equal compare false, not error
+        if not any(isinstance(x, SLit) or isinstance(x, SParamElem)
+                   or isinstance(x, SParam) for x in (lv, rv)):
+            raise LowerError("== between two object fields")
+        ls, rs = self._sid(lv), self._sid(rv)
+        eq = N.EqStr(ls, rs)
+        return eq, _and(self._defined(lv), self._defined(rv), N.Not(eq))
+
+    def _macro_pair(self, ast: C.Macro, env: dict) -> tuple:
+        if ast.var2 is not None:
+            raise LowerError("two-variable macro")
+        target = self._as_list(self.value(ast.target, env))
+        if isinstance(target, SList):
+            _check_no_bare_var(ast.body, ast.var)
+            sub_env = dict(env)
+            sub_env[ast.var] = SItem(target.axis, ())
+            tp, fp = self.bool_pair(ast.body, sub_env)
+            if not target.axis.segments:  # empty-list literal
+                if ast.name == "all":
+                    return _TRUE, _FALSE
+                if ast.name == "exists":
+                    return _FALSE, _TRUE
+                raise LowerError(f"macro {ast.name}")
+            ok = self._list_ok(target,
+                               allow_empty_map=len(target.parts) == 1)
+            if ast.name == "all":
+                return (_and(ok, N.Not(N.AnyAxis(target.axis, N.Not(tp)))),
+                        _and(ok, N.AnyAxis(target.axis, fp)))
+            if ast.name == "exists":
+                return (_and(ok, N.AnyAxis(target.axis, tp)),
+                        _and(ok,
+                             N.Not(N.AnyAxis(target.axis, N.Not(fp)))))
+            raise LowerError(f"macro {ast.name}")
+        if isinstance(target, SParamList):
+            sub_env = dict(env)
+            sub_env[ast.var] = SParamElem(target.name)
+            tp, fp = self.bool_pair(ast.body, sub_env)
+            tp = self._bind_elem_needles(tp, target.name)
+            fp = self._bind_elem_needles(fp, target.name)
+            if ast.name == "all":
+                return (N.Not(N.AnyParamList(target.name, N.Not(tp))),
+                        N.AnyParamList(target.name, fp))
+            if ast.name == "exists":
+                return (N.AnyParamList(target.name, tp),
+                        N.Not(N.AnyParamList(target.name, N.Not(fp))))
+            raise LowerError(f"macro {ast.name}")
+        raise LowerError(f"macro over {target}")
+
+    def _bind_elem_needles(self, expr: N.Expr, param: str) -> N.Expr:
+        """Rewrite bare ParamElemSid StrPred needles to the table-backed
+        _ElemListSid marker (build_param_table's strlist path)."""
+        if isinstance(expr, N.StrPred) and \
+                isinstance(expr.needle, N.ParamElemSid):
+            return N.StrPred(expr.op, expr.subject, _ElemListSid(param))
+        if isinstance(expr, N.Not):
+            return N.Not(self._bind_elem_needles(expr.inner, param))
+        if isinstance(expr, N.And):
+            return N.And(tuple(self._bind_elem_needles(t, param)
+                               for t in expr.terms))
+        if isinstance(expr, N.Or):
+            return N.Or(tuple(self._bind_elem_needles(t, param)
+                              for t in expr.terms))
+        return expr
+
+    def _call_pair(self, ast: C.Call, env: dict) -> tuple:
+        if ast.target is None:
+            if ast.name == "has" and len(ast.args) == 1:
+                sv = self.value(ast.args[0], env)
+                return self._has_pair(sv)
+            if ast.name == "isQuantity" and len(ast.args) == 1:
+                sv = self.value(ast.args[0], env)
+                valid = N.StrFnValid(QUANTITY_FN, self._sid(sv))
+                return valid, _and(self._is_str(sv), N.Not(valid))
+            raise LowerError(f"call {ast.name}")
+        # method calls
+        if ast.name in _STR_METHODS and len(ast.args) == 1:
+            subject = self.value(ast.target, env)
+            needle = self.value(ast.args[0], env)
+            pred = N.StrPred(_STR_METHODS[ast.name], self._sid(subject),
+                             self._sid(needle))
+            return pred, _and(self._is_str(subject), self._is_str(needle),
+                              N.Not(pred))
+        if ast.name in _QTY_CMP and len(ast.args) == 1:
+            lhs = self.value(ast.target, env)
+            rhs = self.value(ast.args[0], env)
+            if not isinstance(lhs, SQuantity) or not isinstance(
+                    rhs, SQuantity):
+                raise LowerError(f"{ast.name} on non-quantity")
+            op, inv = _QTY_CMP[ast.name]
+            ln, rn = self._num(lhs), self._num(rhs)
+            return N.CmpNum(ln, op, rn), N.CmpNum(ln, inv, rn)
+        raise LowerError(f"method {ast.name}")
+
+
+def lower_cel_template(compiled, template_kind: str, vocab,
+                       schema_hint: Optional[dict] = None) -> N.Program:
+    """Lower a _CompiledCELTemplate (drivers/cel_driver.py) to a Program,
+    or raise LowerError (→ interpreter fallback)."""
+    if compiled.match_conditions:
+        raise LowerError("matchConditions")
+    if compiled.failure_policy != "Fail":
+        raise LowerError(f"failurePolicy {compiled.failure_policy}")
+    low = _CelLowerer(compiled.variables, vocab, schema_hint)
+    violations = []
+    for v in compiled.validations:
+        t, _f = low.bool_pair(v.expression.ast, {})
+        violations.append(N.Not(t))
+    expr = violations[0] if len(violations) == 1 \
+        else N.Or(tuple(violations))
+    kinds = dict(low.param_kinds)
+    for name in low.weak_params:
+        kinds.setdefault(name, "str")
+    params = tuple(
+        N.ParamSpec(name=k, kind=v) for k, v in sorted(kinds.items())
+    )
+    return N.Program(
+        template_kind=template_kind,
+        expr=expr,
+        params=params,
+        schema=low.schema,
+    )

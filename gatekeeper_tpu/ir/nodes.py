@@ -126,6 +126,15 @@ class ParamFnNum(Expr):
 
 
 @dataclass(frozen=True)
+class StrFnValid(Expr):
+    """True iff the operand is a string the vocab function parses
+    (CEL isQuantity; the validity half of the StrFnNum table)."""
+
+    fn: str
+    operand: Expr  # sid-valued
+
+
+@dataclass(frozen=True)
 class InvTableSpec:
     """Host-built inventory join table: for every object of ``kind`` in
     data.inventory.namespace[*][apiver][kind][*], the values at

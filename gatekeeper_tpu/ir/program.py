@@ -27,6 +27,7 @@ import numpy as np
 from gatekeeper_tpu.ir import nodes as N
 from gatekeeper_tpu.ops.flatten import (
     ColumnBatch,
+    K_MAP,
     K_NUM,
     K_OTHER,
     K_STR,
@@ -44,7 +45,7 @@ from gatekeeper_tpu.ops.flatten import (
 # Rego term-order rank per kind tag (value.py _TYPE_ORDER): null < bool <
 # number < string < composites.  Indexed by kind tag (absent -> -1
 # sentinel); numpy so importing this module never initializes a backend.
-_RANK_BY_KIND = np.asarray([-1, 1, 1, 2, 3, 6, 0], np.int8)
+_RANK_BY_KIND = np.asarray([-1, 1, 1, 2, 3, 6, 0, 6], np.int8)
 
 
 def _py_rank(v) -> int:
@@ -117,6 +118,12 @@ _STR_FNS = {
 
 
 def _apply_str_fn(fn: str, s: str):
+    if fn == "cel.quantity":
+        # k8s resource.Quantity semantics (CEL quantity()/isQuantity())
+        from gatekeeper_tpu.lang.cel.cel import _parse_quantity
+
+        q = _parse_quantity(s)
+        return None if q is None else float(q.value)
     from gatekeeper_tpu.lang.rego import builtins as rb
     from gatekeeper_tpu.lang.rego.value import UNDEFINED
 
@@ -667,6 +674,9 @@ def vocab_tables(program: N.Program, vocab: Vocab) -> dict:
             num, valid = fn_table(vocab, node.fn)
             out[f"fn:{node.fn}:num"] = num
             out[f"fn:{node.fn}:ok"] = valid
+        elif isinstance(node, N.StrFnValid):
+            _num, valid = fn_table(vocab, node.fn)
+            out[f"fn:{node.fn}:ok"] = valid
         elif isinstance(node, N.StrPred):
             out[f"st:{node.op}"] = pred_matrix(vocab, node.op)
         elif isinstance(node, N.CountNum):
@@ -762,7 +772,7 @@ def _eval_cmp_operand(ctx: _Ctx, e: N.Expr):
         num = jnp.where(kind == K_STR, strlen[safe],
                         cnt.astype(jnp.float32))
         # count() is defined for strings and composites only
-        valid = (kind == K_STR) | (kind == K_OTHER)
+        valid = (kind == K_STR) | (kind == K_OTHER) | (kind == K_MAP)
         return num, jnp.int8(2), valid, valid
     raise LowerError(f"not a numeric operand: {e}")
 
@@ -837,6 +847,11 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         a = _feat_arrays(ctx, e.col)
         ragged = isinstance(e.col, RaggedCol)
         return _expand_for_ctx(ctx, a["kind"] == e.kind, ragged)
+    if isinstance(e, N.StrFnValid):
+        sid, sok, _sp = _eval_sidlike(ctx, e.operand)
+        ok = ctx.cols[f"fn:{e.fn}:ok"]
+        safe = jnp.clip(sid, 0, ok.shape[0] - 1)
+        return sok & (sid >= 0) & ok[safe]
     if isinstance(e, N.CmpNum):
         lv, lrank, lnum, lpres = _eval_cmp_operand(ctx, e.lhs)
         rv, rrank, rnum, rpres = _eval_cmp_operand(ctx, e.rhs)
@@ -966,6 +981,9 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             inner = eval_expr(ctx, e.inner)  # [N, M] (+K)
         finally:
             ctx.axis = None
+        if getattr(inner, "ndim", 0) < 2:
+            # item-independent inner (e.g. ConstBool): ∃item ⇔ inner ∧ count>0
+            return jnp.asarray(inner) & (counts > 0)
         m = inner.shape[1]
         valid = jnp.arange(m) < counts[:, None]
         if inner.ndim == 3:

@@ -26,8 +26,12 @@ from typing import Any, Iterable, Optional, Sequence
 import numpy as np
 
 # value-kind tags (K_NULL split from K_OTHER so device term-order ranks can
+# distinguish them; K_MAP split from K_OTHER for CEL semantics — CEL macros
+# iterate map KEYS and error on list selects, so the device must tell
+# lists and maps apart; Rego consumers treat K_OTHER|K_MAP alike)
 # distinguish null(<numbers) from composites(>strings))
 K_ABSENT, K_FALSE, K_TRUE, K_NUM, K_STR, K_OTHER, K_NULL = 0, 1, 2, 3, 4, 5, 6
+K_MAP = 7
 
 
 class Vocab:
@@ -263,7 +267,9 @@ def _classify(v: Any, vocab: Vocab):
         return K_STR, 0.0, vocab.intern(v)
     if v is None:
         return K_NULL, 0.0, -1
-    return K_OTHER, 0.0, -1  # list / dict
+    if isinstance(v, dict):
+        return K_MAP, 0.0, -1
+    return K_OTHER, 0.0, -1  # list
 
 
 def _walk(obj: Any, path: Sequence[str]):

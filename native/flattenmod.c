@@ -24,7 +24,7 @@
 
 /* value-kind tags (must match ops/flatten.py) */
 enum { K_ABSENT = 0, K_FALSE = 1, K_TRUE = 2, K_NUM = 3, K_STR = 4,
-       K_OTHER = 5, K_NULL = 6 };
+       K_OTHER = 5, K_NULL = 6, K_MAP = 7 };
 
 typedef struct {
     PyObject *to_id;  /* dict: str -> int */
@@ -106,8 +106,10 @@ classify(Vocab *vocab, PyObject *val, signed char *kind, float *num,
         *sid = (int)id;
     } else if (val == Py_None) {
         *kind = K_NULL;
+    } else if (PyDict_Check(val)) {
+        *kind = K_MAP;
     } else {
-        *kind = K_OTHER; /* list / dict */
+        *kind = K_OTHER; /* list */
     }
     return 0;
 }

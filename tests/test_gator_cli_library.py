@@ -114,3 +114,13 @@ def test_sync_requirements():
              "kind": "Ingress"}]}},
     }
     assert missing_requirements([t, config]) == {}
+
+
+def test_bench_tpu_engine_handles_cel_templates():
+    objs = []
+    for f in ("template.yaml", "samples/constraint.yaml",
+              "samples/example_disallowed.yaml"):
+        objs.extend(load_yaml_file(
+            os.path.join(LIBRARY, "general", "containerlimitscel", f)))
+    r = run_bench(objs, "tpu", iterations=2)
+    assert r.violations == 1
