@@ -260,19 +260,20 @@ class AuditManager:
             (d for d in self.client.drivers if hasattr(d, "query_batch")),
             None,
         )
-        reviews = None
+        review_cache: dict = {}
+
+        def get_review(oi):
+            # per-index lazy: a chunk renders only its kept hits, so
+            # building every review up front is O(chunk) waste
+            r = review_cache.get(oi)
+            if r is None:
+                r = target.handle_review(AugmentedUnstructured(
+                    object=objects[oi], source=SOURCE_ORIGINAL))
+                review_cache[oi] = r
+            return r
 
         def get_reviews():
-            nonlocal reviews
-            if reviews is None:
-                reviews = [
-                    target.handle_review(
-                        AugmentedUnstructured(object=o,
-                                              source=SOURCE_ORIGINAL)
-                    )
-                    for o in objects
-                ]
-            return reviews
+            return [get_review(oi) for oi in range(len(objects))]
 
         exact = self.config.exact_totals
         n_obj = len(objects)
@@ -286,7 +287,7 @@ class AuditManager:
                     for oi in hit_idx.tolist():
                         totals[key] += self._render_kept(
                             driver, con, objects[oi],
-                            get_reviews()[oi], kept[key], limit
+                            get_review(oi), kept[key], limit
                         )
                 else:
                     totals[key] += int(ccounts[ci])
@@ -295,7 +296,7 @@ class AuditManager:
                             continue
                         oi = int(idx[ci, j])
                         self._render_kept(
-                            driver, con, objects[oi], get_reviews()[oi],
+                            driver, con, objects[oi], get_review(oi),
                             kept[key], limit
                         )
         # everything the device sweep did not cover (non-lowered kinds, CEL

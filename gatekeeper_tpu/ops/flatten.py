@@ -379,11 +379,13 @@ class Flattener:
             schema.keysets = list(self.schema.keysets)
             # ragged_keysets/map_keys stay on the inner schema so axes()
             # materializes their axis counts; the extraction itself happens
-            # below (python-side; native support is a ROADMAP item)
+            # below — natively via extract_extras when the built module
+            # provides it, else through the Python loops
             schema.ragged_keysets = list(ragged_keysets)
             schema.map_keys = list(map_key_cols)
             schema.parent_idx = list(parent_idx_cols)
         inner = Flattener(schema, self.vocab, self.use_native)
+        mod = None
         if inner.use_native:
             from gatekeeper_tpu.ops import native
 
@@ -419,6 +421,29 @@ class Flattener:
                     if isinstance(key, str):
                         sid[i, j] = self.vocab.intern(key)
             batch.map_keys[mk] = MapKeyColumn(sid)
+        if mod is not None and hasattr(mod, "extract_extras") and \
+                (parent_idx_cols or ragged_keysets):
+            p_specs = [
+                (pic.axis.segments, pic.parent.segments,
+                 round_up(int(batch.axis_counts[pic.axis].max(initial=0))))
+                for pic in parent_idx_cols
+            ]
+            rk_specs = [
+                (rk.axis.segments, tuple(rk.subpath),
+                 round_up(int(batch.axis_counts[rk.axis].max(initial=0))))
+                for rk in ragged_keysets
+            ]
+            extras = mod.extract_extras(
+                list(objects), p_specs, rk_specs,
+                self.vocab._to_id, self.vocab._to_str,
+                batch.n, 8,
+            )
+            for pic, idx in zip(parent_idx_cols, extras["parent_idx"]):
+                batch.parent_idx[pic] = ParentIdxColumn(idx)
+            for rk, (sid, count) in zip(ragged_keysets,
+                                        extras["ragged_keysets"]):
+                batch.ragged_keysets[rk] = RaggedKeySetColumn(sid, count)
+            return batch
         for pic in parent_idx_cols:
             n = batch.n
             m = round_up(int(batch.axis_counts[pic.axis].max(initial=0)))

@@ -704,9 +704,241 @@ fail:
     return NULL;
 }
 
+/* extract_extras(objects, parent_specs, rkeyset_specs, to_id, to_str,
+ *                pad_n, ragged_bucket)
+ *
+ *   parent_specs:  list[(child_segments, parent_segments, m)]
+ *   rkeyset_specs: list[(axis_segments, subpath, m)]
+ *
+ * Returns dict:
+ *   "parent_idx":     list[idx int32 [N, M]]
+ *   "ragged_keysets": list[(sid int32 [N, M, L], count int32 [N, M])]
+ *
+ * Semantics mirror ops/flatten.py _axis_items_with_parent and the
+ * ragged-keyset loop exactly (differential-tested).
+ */
+static PyObject *
+extract_extras(PyObject *self, PyObject *args)
+{
+    PyObject *objects, *parent_specs, *rk_specs, *to_id, *to_str;
+    Py_ssize_t pad_n;
+    long ragged_bucket;
+    if (!PyArg_ParseTuple(args, "OOOOOnl", &objects, &parent_specs,
+                          &rk_specs, &to_id, &to_str, &pad_n,
+                          &ragged_bucket))
+        return NULL;
+    Vocab vocab = {to_id, to_str};
+    Py_ssize_t n_real = PyList_GET_SIZE(objects);
+    Py_ssize_t n = pad_n > n_real ? pad_n : n_real;
+
+    PyObject *result = PyDict_New();
+    if (result == NULL)
+        return NULL;
+
+    /* --- parent-idx columns ------------------------------------------ */
+    {
+        Py_ssize_t np_ = PyList_GET_SIZE(parent_specs);
+        PyObject *out = PyList_New(np_);
+        if (out == NULL)
+            goto fail;
+        for (Py_ssize_t s = 0; s < np_; s++) {
+            PyObject *spec = PyList_GET_ITEM(parent_specs, s);
+            PyObject *csegs = PyTuple_GET_ITEM(spec, 0);
+            PyObject *psegs = PyTuple_GET_ITEM(spec, 1);
+            Py_ssize_t m = PyLong_AsSsize_t(PyTuple_GET_ITEM(spec, 2));
+            npy_intp dims2[2] = {(npy_intp)n, (npy_intp)m};
+            PyArrayObject *a_idx = new_array(2, dims2, NPY_INT32, 1);
+            if (a_idx == NULL) {
+                Py_DECREF(out);
+                goto fail;
+            }
+            int *di = (int *)PyArray_DATA(a_idx);
+            Py_ssize_t nseg = PyTuple_GET_SIZE(csegs);
+            if (PyTuple_GET_SIZE(psegs) < nseg) {
+                PyErr_SetString(PyExc_ValueError,
+                                "parent axis has fewer segments than "
+                                "child axis");
+                Py_DECREF((PyObject *)a_idx); Py_DECREF(out);
+                goto fail;
+            }
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *obj = PyList_GET_ITEM(objects, i);
+                Py_ssize_t j = 0, base = 0;
+                for (Py_ssize_t g = 0; g < nseg; g++) {
+                    PyObject *pseg = PyTuple_GET_ITEM(psegs, g);
+                    PyObject *cseg = PyTuple_GET_ITEM(csegs, g);
+                    PyObject *sub = PyTuple_GET_ITEM(
+                        cseg, PyTuple_GET_SIZE(cseg) - 1);
+                    PyObject *parents = PyList_New(0);
+                    if (parents == NULL) {
+                        Py_DECREF((PyObject *)a_idx); Py_DECREF(out);
+                        goto fail;
+                    }
+                    /* parent axis segment g only (base offsets match the
+                     * parent enumeration across segments) */
+                    if (collect_segment(obj, pseg, parents) < 0) {
+                        Py_DECREF(parents);
+                        Py_DECREF((PyObject *)a_idx); Py_DECREF(out);
+                        goto fail;
+                    }
+                    Py_ssize_t npar = PyList_GET_SIZE(parents);
+                    for (Py_ssize_t k = 0; k < npar; k++) {
+                        PyObject *pit = PyList_GET_ITEM(parents, k);
+                        PyObject *val = walk(pit, sub);
+                        if (val != NULL && PyList_Check(val)) {
+                            Py_ssize_t nv = PyList_GET_SIZE(val);
+                            for (Py_ssize_t q = 0; q < nv && j < m; q++)
+                                di[i * m + j++] = (int)(base + k);
+                        } else if (val != NULL && PyDict_Check(val)) {
+                            Py_ssize_t nv = PyDict_GET_SIZE(val);
+                            for (Py_ssize_t q = 0; q < nv && j < m; q++)
+                                di[i * m + j++] = (int)(base + k);
+                        }
+                    }
+                    base += npar;
+                    Py_DECREF(parents);
+                }
+            }
+            PyList_SET_ITEM(out, s, (PyObject *)a_idx);
+        }
+        if (PyDict_SetItemString(result, "parent_idx", out) < 0) {
+            Py_DECREF(out);
+            goto fail;
+        }
+        Py_DECREF(out);
+    }
+
+    /* --- ragged keysets ---------------------------------------------- */
+    {
+        Py_ssize_t nk = PyList_GET_SIZE(rk_specs);
+        PyObject *out = PyList_New(nk);
+        if (out == NULL)
+            goto fail;
+        for (Py_ssize_t s = 0; s < nk; s++) {
+            PyObject *spec = PyList_GET_ITEM(rk_specs, s);
+            PyObject *segs = PyTuple_GET_ITEM(spec, 0);
+            PyObject *subpath = PyTuple_GET_ITEM(spec, 1);
+            Py_ssize_t m = PyLong_AsSsize_t(PyTuple_GET_ITEM(spec, 2));
+            Py_ssize_t sub_len = PyTuple_GET_SIZE(subpath);
+            /* pass 1: per-object per-item truthy key lists */
+            PyObject *rows = PyList_New(0);  /* list[list[list[str]]] */
+            Py_ssize_t maxl = 0;
+            if (rows == NULL) {
+                Py_DECREF(out);
+                goto fail;
+            }
+            for (Py_ssize_t i = 0; i < n_real; i++) {
+                PyObject *obj = PyList_GET_ITEM(objects, i);
+                PyObject *items = PyList_New(0);
+                PyObject *row = PyList_New(0);
+                if (items == NULL || row == NULL) {
+                    Py_XDECREF(items); Py_XDECREF(row);
+                    Py_DECREF(rows); Py_DECREF(out);
+                    goto fail;
+                }
+                Py_ssize_t nseg = PyTuple_GET_SIZE(segs);
+                int err = 0;
+                for (Py_ssize_t g = 0; g < nseg && !err; g++)
+                    err = collect_segment(
+                        obj, PyTuple_GET_ITEM(segs, g), items) < 0;
+                Py_ssize_t ni = PyList_GET_SIZE(items);
+                if (ni > m)
+                    ni = m;
+                for (Py_ssize_t j = 0; j < ni && !err; j++) {
+                    PyObject *item = PyList_GET_ITEM(items, j);
+                    PyObject *val = sub_len
+                        ? walk(item, subpath) : item;
+                    PyObject *keys = PyList_New(0);
+                    if (keys == NULL) {
+                        err = 1;
+                        break;
+                    }
+                    if (val != NULL && PyDict_Check(val)) {
+                        PyObject *k2, *v2;
+                        Py_ssize_t pos = 0;
+                        while (PyDict_Next(val, &pos, &k2, &v2)) {
+                            if (v2 == Py_False)
+                                continue;
+                            if (PyList_Append(keys, k2) < 0) {
+                                err = 1;
+                                break;
+                            }
+                        }
+                        if (!err && PyList_Sort(keys) < 0)
+                            err = 1;
+                    }
+                    if (!err) {
+                        Py_ssize_t lk = PyList_GET_SIZE(keys);
+                        if (lk > maxl)
+                            maxl = lk;
+                        err = PyList_Append(row, keys) < 0;
+                    }
+                    Py_DECREF(keys);
+                }
+                Py_DECREF(items);
+                if (err || PyList_Append(rows, row) < 0) {
+                    Py_DECREF(row); Py_DECREF(rows); Py_DECREF(out);
+                    goto fail;
+                }
+                Py_DECREF(row);
+            }
+            Py_ssize_t l = ragged_bucket;
+            while (l < maxl)
+                l += ragged_bucket;
+            npy_intp dims3[3] = {(npy_intp)n, (npy_intp)m, (npy_intp)l};
+            npy_intp dims2[2] = {(npy_intp)n, (npy_intp)m};
+            PyArrayObject *a_sid = new_array(3, dims3, NPY_INT32, 1);
+            PyArrayObject *a_cnt = new_array(2, dims2, NPY_INT32, 0);
+            if (!a_sid || !a_cnt) {
+                Py_XDECREF(a_sid); Py_XDECREF(a_cnt);
+                Py_DECREF(rows); Py_DECREF(out);
+                goto fail;
+            }
+            int *ds = (int *)PyArray_DATA(a_sid);
+            int *dc = (int *)PyArray_DATA(a_cnt);
+            for (Py_ssize_t i = 0; i < PyList_GET_SIZE(rows); i++) {
+                PyObject *row = PyList_GET_ITEM(rows, i);
+                Py_ssize_t nr = PyList_GET_SIZE(row);
+                for (Py_ssize_t j = 0; j < nr; j++) {
+                    PyObject *keys = PyList_GET_ITEM(row, j);
+                    Py_ssize_t lk = PyList_GET_SIZE(keys);
+                    dc[i * m + j] = (int)lk;
+                    for (Py_ssize_t q = 0; q < lk && q < l; q++) {
+                        PyObject *kk = PyList_GET_ITEM(keys, q);
+                        if (PyUnicode_Check(kk)) {
+                            long sid = vocab_intern(&vocab, kk);
+                            if (sid < 0) {
+                                Py_DECREF((PyObject *)a_sid);
+                                Py_DECREF((PyObject *)a_cnt);
+                                Py_DECREF(rows); Py_DECREF(out);
+                                goto fail;
+                            }
+                            ds[(i * m + j) * l + q] = (int)sid;
+                        }
+                    }
+                }
+            }
+            Py_DECREF(rows);
+            PyList_SET_ITEM(out, s, Py_BuildValue("(NN)", a_sid, a_cnt));
+        }
+        if (PyDict_SetItemString(result, "ragged_keysets", out) < 0) {
+            Py_DECREF(out);
+            goto fail;
+        }
+        Py_DECREF(out);
+    }
+    return result;
+
+fail:
+    Py_DECREF(result);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"flatten_batch", flatten_batch, METH_VARARGS,
      "Flatten a batch of objects into columnar arrays."},
+    {"extract_extras", extract_extras, METH_VARARGS,
+     "Extract parent-idx and ragged-keyset columns."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -148,3 +148,57 @@ def test_native_huge_int_saturates_no_pending_exception():
     assert np.isneginf(nat.scalars[spec].num[1])
     # no pending exception corrupts the next unrelated call
     assert 1 + 1 == 2
+
+
+@pytest.mark.skipif(native.load() is None, reason="native build unavailable")
+def test_native_extract_extras_matches_python():
+    """parent-idx and ragged-keyset columns: C extract_extras vs the Python
+    loops, bit-identical (incl. vocab interning order)."""
+    from gatekeeper_tpu.ops.flatten import ParentIdxCol, RaggedKeySetCol
+
+    containers = Axis(((("spec", "containers"),),
+                       (("spec", "initContainers"),)))
+    drops = Axis(((("spec", "containers"),
+                   ("securityContext", "capabilities", "drop")),
+                  (("spec", "initContainers"),
+                   ("securityContext", "capabilities", "drop"))))
+    s = Schema()
+    s.raggeds = [RaggedCol(containers, ("name",)),
+                 RaggedCol(drops, ())]
+    s.parent_idx = [ParentIdxCol(axis=drops, parent=containers)]
+    s.ragged_keysets = [RaggedKeySetCol(axis=containers, subpath=())]
+
+    rng = random.Random(5)
+    objs = []
+    for i in range(200):
+        cs = []
+        for j in range(rng.randint(0, 4)):
+            c = {"name": f"c{j}"}
+            if rng.random() < 0.6:
+                c["securityContext"] = {"capabilities": {
+                    "drop": [rng.choice(["ALL", "NET_RAW", "KILL"])
+                             for _ in range(rng.randint(0, 3))]}}
+            if rng.random() < 0.3:
+                c["livenessProbe"] = {"tcpSocket": {}}
+            if rng.random() < 0.2:
+                c["extra"] = False  # truthy-key filter
+            cs.append(c)
+        spec = {"containers": cs}
+        if rng.random() < 0.3:
+            spec["initContainers"] = [{"name": "i", "securityContext": {
+                "capabilities": {"drop": ["X"]}}}]
+        objs.append({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"p{i}"}, "spec": spec})
+
+    v_py, v_c = Vocab(), Vocab()
+    py = Flattener(s, v_py, use_native=False).flatten(objs, pad_n=256)
+    nat = Flattener(s, v_c, use_native=True).flatten(objs, pad_n=256)
+    assert v_py._to_str == v_c._to_str
+    for spec_ in s.parent_idx:
+        np.testing.assert_array_equal(py.parent_idx[spec_].idx,
+                                      nat.parent_idx[spec_].idx)
+    for spec_ in s.ragged_keysets:
+        np.testing.assert_array_equal(py.ragged_keysets[spec_].sid,
+                                      nat.ragged_keysets[spec_].sid)
+        np.testing.assert_array_equal(py.ragged_keysets[spec_].count,
+                                      nat.ragged_keysets[spec_].count)
