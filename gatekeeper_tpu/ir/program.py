@@ -135,10 +135,14 @@ _VOCAB_BUCKET = 1024
 
 
 def _vpad(v: int) -> int:
-    # vocab-axis bucketing: tables grow in steps so jit shapes stay stable
-    # across batches that intern a few new strings (matching the pad_n
-    # bucketing philosophy everywhere else)
-    return ((v + _VOCAB_BUCKET - 1) // _VOCAB_BUCKET) * _VOCAB_BUCKET
+    # vocab-axis bucketing: tables grow GEOMETRICALLY so jit shapes stay
+    # stable across big interning bursts — audit sweeps intern every object
+    # NAME, so linear buckets would cross a boundary (= XLA recompile of
+    # every verdict program) on nearly every chunk
+    p = _VOCAB_BUCKET
+    while p < v:
+        p *= 2
+    return p
 
 
 def fn_table(vocab: Vocab, fn: str):
@@ -195,6 +199,46 @@ def pred_table_row(vocab: Vocab, op: str, needle: str) -> int:
     return rows[needle]
 
 
+def _pred_row_fill(mat, ri: int, op: str, needle: str, strings: list,
+                   start: int):
+    """Fill mat[ri, start:start+len(strings)] with op(s, needle) —
+    vectorized via numpy char ops where possible (the vocab grows O(N)
+    with object names at audit scale; per-sid Python loops here would
+    dominate the sweep)."""
+    import numpy as _np
+
+    if not strings:
+        return
+    if op in ("startswith", "endswith"):
+        arr = _np.asarray(strings, dtype=object)
+        fn = _np.char.startswith if op == "startswith" \
+            else _np.char.endswith
+        mat[ri, start: start + len(strings)] = fn(
+            arr.astype(str), needle)
+        return
+    if op == "contains":
+        arr = _np.asarray(strings, dtype=object).astype(str)
+        mat[ri, start: start + len(strings)] = (
+            _np.char.find(arr, needle) >= 0)
+        return
+    if op == "re_match":
+        import re as _re
+
+        try:
+            rx = _re.compile(needle)
+        except _re.error:
+            mat[ri, start: start + len(strings)] = False
+            return
+        mat[ri, start: start + len(strings)] = [
+            rx.search(s) is not None for s in strings
+        ]
+        return
+    impl = _PRED_IMPL[op]
+    mat[ri, start: start + len(strings)] = [
+        impl(s, needle) for s in strings
+    ]
+
+
 def pred_matrix(vocab: Vocab, op: str):
     """[T, Vpad] bool matrix for op, rows in registration order, extended
     incrementally as needles/vocab grow (bucketed V keeps jit shapes
@@ -204,7 +248,6 @@ def pred_matrix(vocab: Vocab, op: str):
     cache = vocab.__dict__.setdefault("_pred_tables", {})
     rows, memo = cache.setdefault(op, ({}, []))
     v = len(vocab)
-    impl = _PRED_IMPL[op]
     if memo:
         (prev_t, prev_v), mat = memo
         if prev_t == len(rows) and prev_v >= v and mat.shape[1] >= v:
@@ -213,17 +256,22 @@ def pred_matrix(vocab: Vocab, op: str):
         new = _np.zeros((max(len(rows), 1), vp), bool)
         new[: mat.shape[0], : mat.shape[1]] = mat
         # new needles: full scan; existing needles: only new vocab entries
+        tail = [vocab.string(s) for s in range(prev_v, v)]
+        full = None
         for needle, ri in rows.items():
-            start = 0 if ri >= prev_t else prev_v
-            for sid in range(start, v):
-                new[ri, sid] = impl(vocab.string(sid), needle)
+            if ri >= prev_t:
+                if full is None:
+                    full = [vocab.string(s) for s in range(v)]
+                _pred_row_fill(new, ri, op, needle, full, 0)
+            else:
+                _pred_row_fill(new, ri, op, needle, tail, prev_v)
         mat = new
     else:
         vp = _vpad(v)
         mat = _np.zeros((max(len(rows), 1), vp), bool)
+        strings = [vocab.string(s) for s in range(v)]
         for needle, ri in rows.items():
-            for sid in range(v):
-                mat[ri, sid] = impl(vocab.string(sid), needle)
+            _pred_row_fill(mat, ri, op, needle, strings, 0)
     memo.clear()
     memo.extend(((len(rows), v), mat))
     return mat

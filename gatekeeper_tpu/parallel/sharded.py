@@ -47,19 +47,31 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, ("data", "model"))
 
 
-def shard_batch_arrays(cols: dict, mesh: Mesh) -> dict:
+def shard_batch_arrays(cols: dict, mesh: Mesh,
+                       table_cache: Optional[dict] = None) -> dict:
     """device_put column arrays with the object axis sharded over 'data'.
 
     Columns are [N] or [N, M]; N shards, M stays local (ragged items of one
-    object live on one chip).
+    object live on one chip).  ``table_cache`` keeps the big shared lookup
+    tables (vocab preds, inventory joins) device-resident across chunks —
+    they only change when the vocab crosses a bucket or the data version
+    moves, so re-uploading them per chunk wastes HBM bandwidth.
     """
     out = {}
     for key, val in cols.items():
         if key.startswith(("fn:", "st:", "inv:")):
             # vocab-derived tables are shared lookup state: replicate
-            out[key] = jax.device_put(
+            if table_cache is not None:
+                hit = table_cache.get(key)
+                if hit is not None and hit[0] is val:
+                    out[key] = hit[1]
+                    continue
+            dev = jax.device_put(
                 val, NamedSharding(mesh, P(*([None] * val.ndim)))
             )
+            if table_cache is not None:
+                table_cache[key] = (val, dev)
+            out[key] = dev
             continue
         if isinstance(val, dict):
             out[key] = {
@@ -129,6 +141,7 @@ class ShardedEvaluator:
         self.mesh = mesh
         self.violations_limit = violations_limit
         self._sweep_fns: dict = {}
+        self._table_dev_cache: dict = {}  # key -> (host_array, dev_array)
 
     def _sweep_fn(self, kinds: tuple, k: int, return_bits: bool = False):
         """One fused jitted program for the whole sweep: every template's
@@ -240,7 +253,8 @@ class ShardedEvaluator:
                 cols[tk] = tv
             for tk, tv in self.driver.inventory_cols(kind)[0].items():
                 cols[tk] = tv
-        sharded_cols = shard_batch_arrays(cols, self.mesh)
+        sharded_cols = shard_batch_arrays(cols, self.mesh,
+                                          self._table_dev_cache)
         mask = np.concatenate(mask_rows, axis=0)
         mask_dev = jax.device_put(
             mask, NamedSharding(self.mesh, P(None, "data"))
