@@ -157,17 +157,17 @@ def rand_obj(rng, i):
     return {"apiVersion": av, "kind": kind, "metadata": meta, "spec": spec}
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
-    seeds = [int(s) for s in sys.argv[2:]] or [0, 1, 2, 3, 4]
+def build_fuzz_driver():
+    """(tpu, constraints): the full library incl. CEL templates on a
+    unified TpuDriver, with referential inventory seeded."""
 
-    tpu = TpuDriver(batch_bucket=64)
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+
+    tpu = TpuDriver(batch_bucket=64, cel_driver=CELDriver())
     constraints = []
     for name in sorted(os.listdir(LIB)):
         t = ConstraintTemplate.from_unstructured(
             load_yaml_file(os.path.join(LIB, name, "template.yaml"))[0])
-        if not t.targets[0].rego:
-            continue
         tpu.add_template(t)
         constraints.append(Constraint.from_unstructured(load_yaml_file(
             os.path.join(LIB, name, "samples", "constraint.yaml"))[0]))
@@ -185,8 +185,27 @@ def main():
             {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
              "metadata": {"name": name, "namespace": ns},
              "spec": {"rules": [{"host": h} for h in hosts]}})
-    print(f"templates: {len(constraints)} "
-          f"({len(tpu.lowered_kinds())} lowered)")
+    assert not tpu.fallback_kinds(), (
+        "library templates fell back to the interpreter — the fuzz would "
+        f"compare the oracle to itself: {tpu.fallback_kinds()}")
+    return tpu, constraints
+
+
+def oracle_results(tpu, con, review):
+    """The exact engine for one (constraint, review): the CEL evaluator
+    for CEL-owned kinds, the Rego interpreter otherwise."""
+    if con.kind in tpu._cel_kinds:
+        return tpu._cel.query(TARGET, [con], review).results
+    return tpu._interp.query(TARGET, [con], review).results
+
+
+def run_fuzz(n, seeds, quiet=False, tpu=None, constraints=None):
+    """Differential fuzz: returns the number of diverging objects."""
+    if tpu is None or constraints is None:
+        tpu, constraints = build_fuzz_driver()
+    if not quiet:
+        print(f"templates: {len(constraints)} "
+              f"({len(tpu.lowered_kinds())} lowered)")
 
     target = K8sValidationTarget()
     failures = 0
@@ -196,27 +215,51 @@ def main():
         reviews = [target.handle_review(AugmentedUnstructured(object=o))
                    for o in objs]
         got = tpu.query_batch(TARGET, constraints, reviews)
+        # raw grid lane: render_messages=False keeps every device hit as a
+        # Result — the rendered lane re-checks hits through the exact
+        # engine, which would MASK false-positive lowering bugs (the grid
+        # drives audit totals, so its hits must be exact both ways)
+        raw = tpu.query_batch(TARGET, constraints, reviews,
+                              render_messages=False)
         mismatches = 0
         for oi, review in enumerate(reviews):
             expected = []
+            exp_hit_kinds = set()
             for con in constraints:
                 if not target.to_matcher(con.match).match(review):
                     continue
-                expected.extend(
-                    tpu._interp.query(TARGET, [con], review).results)
+                results = oracle_results(tpu, con, review)
+                expected.extend(results)
+                if results:
+                    exp_hit_kinds.add(con.name)
             key = lambda r: (r.constraint["metadata"]["name"], r.msg)
-            if sorted(map(key, got[oi].results)) != sorted(
-                    map(key, expected)):
+            raw_hits = {r.constraint["metadata"]["name"]
+                        for r in raw[oi].results}
+            ok_rendered = sorted(map(key, got[oi].results)) == sorted(
+                map(key, expected))
+            ok_raw = raw_hits == exp_hit_kinds
+            if not (ok_rendered and ok_raw):
                 mismatches += 1
                 if mismatches <= 3:
                     print(f"  DIVERGENCE seed={seed} obj={oi}: {objs[oi]}")
-                    print(f"    got:  {sorted(map(key, got[oi].results))}")
-                    print(f"    want: {sorted(map(key, expected))}")
+                    if not ok_rendered:
+                        print(f"    got:  {sorted(map(key, got[oi].results))}")
+                        print(f"    want: {sorted(map(key, expected))}")
+                    if not ok_raw:
+                        print(f"    raw grid hits: {sorted(raw_hits)}")
+                        print(f"    oracle hits:   {sorted(exp_hit_kinds)}")
         total = sum(len(g.results) for g in got)
         status = "OK" if mismatches == 0 else f"{mismatches} MISMATCHES"
-        print(f"seed {seed}: {n} objects, {total} violations -> {status}")
+        if not quiet or mismatches:
+            print(f"seed {seed}: {n} objects, {total} violations -> {status}")
         failures += mismatches
-    return 1 if failures else 0
+    return failures
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    seeds = [int(s) for s in sys.argv[2:]] or [0, 1, 2, 3, 4]
+    return 1 if run_fuzz(n, seeds) else 0
 
 
 if __name__ == "__main__":
