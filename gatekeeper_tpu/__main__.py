@@ -22,6 +22,10 @@ def main(argv=None) -> int:
     p.add_argument("--manifests", action="append", default=[],
                    help="directory/file of templates, constraints, config, "
                         "mutators, data objects")
+    p.add_argument("--kubeconfig", default="",
+                   help="run against a live Kubernetes apiserver (watch + "
+                        "paged list informer plane); 'in-cluster' uses the "
+                        "service-account environment")
     p.add_argument("--operation", action="append", default=[],
                    help="audit|webhook|mutation-webhook (repeatable; "
                         "default all)")
@@ -104,7 +108,19 @@ def main(argv=None) -> int:
     client = Client(target=K8sValidationTarget(),
                     drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
-    cluster = FakeCluster()
+    kube_cluster = None
+    if args.kubeconfig:
+        from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
+
+        cfg = (KubeConfig.in_cluster() if args.kubeconfig == "in-cluster"
+               else KubeConfig.from_kubeconfig(args.kubeconfig))
+        kube_cluster = cluster = KubeCluster(cfg)
+        print(f"informer plane: apiserver {cfg.server}", file=sys.stderr)
+        if args.management_manifests:
+            p.error("--management-manifests (remote-cluster routing) is "
+                    "not supported together with --kubeconfig yet")
+    else:
+        cluster = FakeCluster()
     if args.management_manifests:
         from gatekeeper_tpu.sync.routing import RoutingCluster
 
@@ -136,9 +152,28 @@ def main(argv=None) -> int:
         evaluator = ShardedEvaluator(
             tpu, make_mesh(),
             violations_limit=args.constraint_violations_limit)
+
+        if kube_cluster is not None:
+            # discovery-driven audit listing (auditResources,
+            # pkg/audit/manager.go:369-422): every listable GVK, paged;
+            # transient apiserver errors skip the sweep, never kill the pod
+            def lister():
+                try:
+                    gvks = kube_cluster.server_preferred_gvks()
+                except Exception as e:
+                    print(f"audit discovery failed: {e}", file=sys.stderr)
+                    return
+                for gvk in gvks:
+                    try:
+                        yield from kube_cluster.list_iter(gvk)
+                    except Exception as e:
+                        print(f"audit list {gvk}: {e}", file=sys.stderr)
+        else:
+            def lister():
+                return iter(cluster.list())
         audit_mgr = AuditManager(
             client,
-            lister=lambda: iter(cluster.list()),
+            lister=lister,
             config=AuditConfig(
                 interval_s=args.audit_interval,
                 violations_limit=args.constraint_violations_limit,
@@ -161,6 +196,32 @@ def main(argv=None) -> int:
                       f"{v.message}")
         return 0
 
+    # namespace lookup for the webhook hot path: with a live apiserver,
+    # serve from a watch-fed cache (the reference's cached client with
+    # API-reader fallback, policy.go:694-702) — never a blocking GET per
+    # admission request
+    if kube_cluster is not None:
+        ns_cache: dict = {}
+
+        def _ns_event(ev):
+            name = (ev.obj.get("metadata") or {}).get("name", "")
+            if ev.type == "DELETED":
+                ns_cache.pop(name, None)
+            else:
+                ns_cache[name] = ev.obj
+
+        kube_cluster.subscribe(("", "v1", "Namespace"), _ns_event,
+                               replay=True)
+
+        def namespace_lookup(name):
+            hit = ns_cache.get(name)
+            if hit is not None:
+                return hit
+            return kube_cluster.get(("", "v1", "Namespace"), "", name)
+    else:
+        def namespace_lookup(name):
+            return cluster.get(("", "v1", "Namespace"), "", name)
+
     batcher = Batcher(client).start()
     server = None
     if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
@@ -181,8 +242,7 @@ def main(argv=None) -> int:
                 client,
                 expansion_system=mgr.expansion_system,
                 process_excluder=mgr.excluder,
-                namespace_lookup=lambda name: cluster.get(
-                    ("", "v1", "Namespace"), "", name),
+                namespace_lookup=namespace_lookup,
                 batcher=batcher,
                 log_denies=args.log_denies,
                 metrics=metrics,
@@ -190,8 +250,7 @@ def main(argv=None) -> int:
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,
-                namespace_lookup=lambda name: cluster.get(
-                    ("", "v1", "Namespace"), "", name),
+                namespace_lookup=namespace_lookup,
                 process_excluder=mgr.excluder,
             ) if mgr.is_assigned("mutation-webhook") else None,
             namespace_label_handler=NamespaceLabelHandler(

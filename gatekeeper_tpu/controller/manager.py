@@ -21,6 +21,7 @@ serves no admission — both reconcile the shared state.
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Iterable, Optional
 
@@ -87,13 +88,23 @@ class Manager:
 
     # --- boot (reference: readiness tracker seeding, ready_tracker.go:326)
     def start(self) -> "Manager":
-        for obj in self.cluster.list(TEMPLATES_GVK):
+        def boot_list(gvk):
+            # a missing CRD / transient apiserver error must not crash
+            # boot: the watch plane retries with backoff, readiness just
+            # starts with zero expectations for that kind
+            try:
+                return self.cluster.list(gvk)
+            except Exception as e:
+                print(f"boot list {gvk}: {e}", file=sys.stderr)  # noqa: T201
+                return []
+
+        for obj in boot_list(TEMPLATES_GVK):
             self.tracker.expect("templates", name_of(obj))
         self.tracker.populated("templates")
         for gvk, kind in ((CONFIG_GVK, "config"),
                           (EXPANSION_GVK, "expansions"),
                           (PROVIDER_GVK, "providers")):
-            for obj in self.cluster.list(gvk):
+            for obj in boot_list(gvk):
                 self.tracker.expect(kind, name_of(obj))
             self.tracker.populated(kind)
         for gvk in [TEMPLATES_GVK, CONFIG_GVK, SYNCSET_GVK, EXPANSION_GVK,

@@ -1,0 +1,310 @@
+"""Minimal in-process Kubernetes apiserver for integration tests.
+
+The reference tests its informer plane against controller-runtime envtest
+(a real kube-apiserver + etcd, SURVEY.md §4); this is the equivalent test
+double for ``KubeCluster``: discovery, paged LIST with continue tokens,
+streaming WATCH (chunked JSON lines) with resourceVersion bookkeeping,
+injectable 410 Gone, POST/PUT/DELETE.  State lives in a plain dict; no
+validation — it exists to exercise the CLIENT, not to be an apiserver.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+# resources the mock serves: kind -> (group, version, plural, namespaced).
+# Includes the gatekeeper CRDs a real deployment installs, so the
+# reconciliation Manager's readiness seeding and watches resolve.
+DEFAULT_RESOURCES = {
+    "Pod": ("", "v1", "pods", True),
+    "Namespace": ("", "v1", "namespaces", False),
+    "Service": ("", "v1", "services", True),
+    "Ingress": ("networking.k8s.io", "v1", "ingresses", True),
+    "Deployment": ("apps", "v1", "deployments", True),
+    "ConstraintTemplate": ("templates.gatekeeper.sh", "v1",
+                           "constrainttemplates", False),
+    "Config": ("config.gatekeeper.sh", "v1alpha1", "configs", True),
+    "SyncSet": ("syncset.gatekeeper.sh", "v1alpha1", "syncsets", False),
+    "ExpansionTemplate": ("expansion.gatekeeper.sh", "v1alpha1",
+                          "expansiontemplates", False),
+    "Provider": ("externaldata.gatekeeper.sh", "v1beta1", "providers",
+                 False),
+    "Connection": ("connection.gatekeeper.sh", "v1alpha1", "connections",
+                   True),
+    "ValidatingWebhookConfiguration": (
+        "admissionregistration.k8s.io", "v1",
+        "validatingwebhookconfigurations", False),
+    "Assign": ("mutations.gatekeeper.sh", "v1", "assign", False),
+    "AssignMetadata": ("mutations.gatekeeper.sh", "v1", "assignmetadata",
+                       False),
+    "ModifySet": ("mutations.gatekeeper.sh", "v1", "modifyset", False),
+    "AssignImage": ("mutations.gatekeeper.sh", "v1alpha1", "assignimage",
+                    False),
+}
+
+
+class MockApiServer:
+    def __init__(self, resources: Optional[dict] = None):
+        self.resources = dict(resources or DEFAULT_RESOURCES)
+        self._objects: dict = {}  # (kind, ns, name) -> obj
+        self._rv = 0
+        self._watchers: list = []  # (kind, queue-ish list, condition)
+        self._lock = threading.RLock()
+        self.force_gone = False  # next watch request answers 410
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                outer._handle_get(self)
+
+            def do_POST(self):
+                outer._handle_write(self, "POST")
+
+            def do_PUT(self):
+                outer._handle_write(self, "PUT")
+
+            def do_DELETE(self):
+                outer._handle_write(self, "DELETE")
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle ----------------------------------------------------
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def start(self) -> "MockApiServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # --- direct state manipulation (test hooks) ------------------------
+    def add_resource(self, kind: str, group: str, version: str,
+                     plural: str, namespaced: bool):
+        """Install a CRD-backed resource (e.g. a dynamic constraint kind)."""
+        with self._lock:
+            self.resources[kind] = (group, version, plural, namespaced)
+
+    def put_object(self, obj: dict):
+        """Upsert from the test side, notifying watchers."""
+        kind = obj.get("kind", "")
+        key = (kind, obj.get("metadata", {}).get("namespace", ""),
+               obj.get("metadata", {}).get("name", ""))
+        with self._lock:
+            self._rv += 1
+            existed = key in self._objects
+            obj = dict(obj)
+            meta = dict(obj.get("metadata") or {})
+            meta["resourceVersion"] = str(self._rv)
+            obj["metadata"] = meta
+            self._objects[key] = obj
+            self._notify("MODIFIED" if existed else "ADDED", obj)
+
+    def delete_object(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            obj = self._objects.pop((kind, namespace, name), None)
+            if obj is not None:
+                self._rv += 1
+                self._notify("DELETED", obj)
+
+    def _notify(self, etype: str, obj: dict):
+        for kind, buf, cond in list(self._watchers):
+            if kind == obj.get("kind"):
+                with cond:
+                    buf.append({"type": etype, "object": obj})
+                    cond.notify_all()
+
+    # --- request handling ----------------------------------------------
+    def _kind_for_path(self, parts):
+        """(kind, namespace, name) from a collection/item path."""
+        # /api/v1/<res>[/name], /api/v1/namespaces/<ns>/<res>[/name],
+        # /apis/<g>/<v>/<res>..., same namespaced form
+        if parts[0] == "api":
+            rest = parts[2:]
+            group = ""
+        else:
+            rest = parts[3:]
+            group = parts[1]
+        ns = ""
+        if len(rest) >= 2 and rest[0] == "namespaces" and \
+                (len(rest) > 2 or group or True) and rest[1] and \
+                len(rest) > 2:
+            ns, rest = rest[1], rest[2:]
+        resource = rest[0] if rest else ""
+        name = rest[1] if len(rest) > 1 else ""
+        for kind, (g, _v, plural, _nsd) in self.resources.items():
+            if plural == resource and g == group:
+                return kind, ns, name
+        return None, ns, name
+
+    def _handle_get(self, h: BaseHTTPRequestHandler):
+        parsed = urlparse(h.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        q = parse_qs(parsed.query)
+        # discovery endpoints
+        if parts == ["api"]:
+            return self._json(h, {"versions": ["v1"]})
+        if parts == ["apis"]:
+            groups = {}
+            for _k, (g, v, _p, _n) in self.resources.items():
+                if g:
+                    groups.setdefault(g, v)
+            return self._json(h, {"groups": [
+                {"name": g,
+                 "preferredVersion": {"version": v,
+                                      "groupVersion": f"{g}/{v}"}}
+                for g, v in groups.items()]})
+        if parts == ["api", "v1"] or (
+                len(parts) == 3 and parts[0] == "apis"):
+            group = "" if parts[0] == "api" else parts[1]
+            res = [
+                {"name": plural, "kind": kind, "namespaced": nsd,
+                 "verbs": ["get", "list", "watch", "create", "update",
+                           "delete"]}
+                for kind, (g, _v, plural, nsd) in self.resources.items()
+                if g == group
+            ]
+            return self._json(h, {"resources": res})
+        kind, ns, name = self._kind_for_path(parts)
+        if kind is None:
+            return self._json(h, {"message": "not found"}, 404)
+        if name:
+            with self._lock:
+                obj = self._objects.get((kind, ns, name))
+            if obj is None:
+                return self._json(h, {"message": "not found"}, 404)
+            return self._json(h, obj)
+        if q.get("watch", ["0"])[0] in ("1", "true"):
+            return self._handle_watch(h, kind)
+        # paged list
+        with self._lock:
+            items = [o for (k, _ns, _n), o in sorted(
+                self._objects.items()) if k == kind]
+            rv = str(self._rv)
+        limit = int(q.get("limit", ["500"])[0])
+        start = int(q.get("continue", ["0"])[0] or 0)
+        page = items[start: start + limit]
+        meta = {"resourceVersion": rv}
+        if start + limit < len(items):
+            meta["continue"] = str(start + limit)
+        g, v, _p, _n = self.resources[kind]
+        return self._json(h, {
+            "apiVersion": f"{g}/{v}" if g else v,
+            "kind": f"{kind}List",
+            "metadata": meta,
+            "items": page,
+        })
+
+    def _handle_watch(self, h: BaseHTTPRequestHandler, kind: str):
+        if self.force_gone:
+            self.force_gone = False
+            return self._json(h, {"kind": "Status", "code": 410,
+                                  "message": "too old resource version"},
+                              410)
+        buf: list = []
+        cond = threading.Condition()
+        entry = (kind, buf, cond)
+        with self._lock:
+            self._watchers.append(entry)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def send_line(doc):
+                data = (json.dumps(doc) + "\n").encode()
+                h.wfile.write(f"{len(data):x}\r\n".encode() + data
+                              + b"\r\n")
+                h.wfile.flush()
+
+            deadline = 30.0
+            waited = 0.0
+            while waited < deadline:
+                with cond:
+                    if not buf:
+                        cond.wait(0.2)
+                    events, buf[:] = list(buf), []
+                for ev in events:
+                    if ev.get("type") == "__GONE__":
+                        send_line({"type": "ERROR",
+                                   "object": {"kind": "Status",
+                                              "code": 410}})
+                        h.wfile.write(b"0\r\n\r\n")
+                        return
+                    send_line(ev)
+                if not events:
+                    waited += 0.2
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+    def break_watches(self, kind: str):
+        """Inject a mid-stream 410 into live watches of ``kind``."""
+        for k, buf, cond in list(self._watchers):
+            if k == kind:
+                with cond:
+                    buf.append({"type": "__GONE__"})
+                    cond.notify_all()
+
+    def _handle_write(self, h: BaseHTTPRequestHandler, method: str):
+        parsed = urlparse(h.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        kind, ns, name = self._kind_for_path(parts)
+        if kind is None:
+            return self._json(h, {"message": "not found"}, 404)
+        if method == "DELETE":
+            with self._lock:
+                obj = self._objects.pop((kind, ns, name), None)
+                if obj is None:
+                    return self._json(h, {"message": "not found"}, 404)
+                self._rv += 1
+                self._notify("DELETED", obj)
+            return self._json(h, {"kind": "Status", "status": "Success"})
+        length = int(h.headers.get("Content-Length", 0))
+        obj = json.loads(h.rfile.read(length) or b"{}")
+        oname = obj.get("metadata", {}).get("name", "")
+        key = (kind, ns or obj.get("metadata", {}).get("namespace", ""),
+               oname)
+        with self._lock:
+            exists = key in self._objects
+            if method == "POST" and exists:
+                return self._json(h, {"message": "already exists"}, 409)
+            if method == "PUT" and not exists:
+                return self._json(h, {"message": "not found"}, 404)
+            self._rv += 1
+            obj = dict(obj)
+            meta = dict(obj.get("metadata") or {})
+            meta["resourceVersion"] = str(self._rv)
+            obj["metadata"] = meta
+            self._objects[key] = obj
+            self._notify("MODIFIED" if exists else "ADDED", obj)
+        return self._json(h, obj, 201 if method == "POST" else 200)
+
+    def _json(self, h: BaseHTTPRequestHandler, doc: dict,
+              status: int = 200):
+        data = json.dumps(doc).encode()
+        h.send_response(status)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        h.wfile.write(data)

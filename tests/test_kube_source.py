@@ -1,0 +1,201 @@
+"""KubeCluster (apiserver ObjectSource) integration tests against the
+in-process mock apiserver — the envtest-equivalent layer (SURVEY.md §4.2;
+ref informer plane pkg/watch/manager.go:147-202, resync
+pkg/cachemanager/cachemanager.go:410-540)."""
+
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
+from gatekeeper_tpu.sync.mock_apiserver import MockApiServer
+from gatekeeper_tpu.sync.source import ADDED, DELETED, MODIFIED
+
+POD_GVK = ("", "v1", "Pod")
+ING_GVK = ("networking.k8s.io", "v1", "Ingress")
+
+
+def pod(name, ns="default", labels=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": {"containers": [{"name": "c", "image": "x"}]}}
+
+
+@pytest.fixture()
+def server():
+    srv = MockApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def cluster(server):
+    kc = KubeCluster(KubeConfig(server=server.url), page_limit=3,
+                     watch_backoff_s=0.05, watch_timeout_s=20.0)
+    yield kc
+    kc.close()
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_paged_list_and_get(server, cluster):
+    for i in range(8):  # 8 objects with page_limit 3 -> 3 pages
+        server.put_object(pod(f"p{i}"))
+    objs = cluster.list(POD_GVK)
+    assert sorted(o["metadata"]["name"] for o in objs) == \
+        sorted(f"p{i}" for i in range(8))
+    assert all(o["kind"] == "Pod" and o["apiVersion"] == "v1"
+               for o in objs)
+    got = cluster.get(POD_GVK, "default", "p3")
+    assert got["metadata"]["name"] == "p3"
+    assert cluster.get(POD_GVK, "default", "nope") is None
+
+
+def test_watch_replay_and_live_events(server, cluster):
+    server.put_object(pod("existing"))
+    events = []
+    seen = threading.Event()
+
+    def cb(ev):
+        events.append(ev)
+        seen.set()
+
+    cancel = cluster.subscribe(POD_GVK, cb, replay=True)
+    assert wait_for(lambda: any(
+        e.type == ADDED and e.obj["metadata"]["name"] == "existing"
+        for e in events))
+    server.put_object(pod("live"))
+    assert wait_for(lambda: any(
+        e.type == ADDED and e.obj["metadata"]["name"] == "live"
+        for e in events))
+    server.put_object(pod("live", labels={"x": "y"}))
+    assert wait_for(lambda: any(
+        e.type == MODIFIED and e.obj["metadata"]["name"] == "live"
+        for e in events))
+    server.delete_object("Pod", "default", "live")
+    assert wait_for(lambda: any(
+        e.type == DELETED and e.obj["metadata"]["name"] == "live"
+        for e in events))
+    cancel()
+
+
+def test_watch_410_resync_emits_deleted_diff(server, cluster):
+    """On 410 Gone mid-stream the client relists; objects deleted during
+    the outage surface as synthetic DELETED events (the reference's
+    wipe-and-replay, cachemanager.go:527)."""
+    server.put_object(pod("stay"))
+    server.put_object(pod("goner"))
+    events = []
+    cluster.subscribe(POD_GVK, events.append, replay=True)
+    assert wait_for(lambda: len(
+        [e for e in events if e.type == ADDED]) >= 2)
+    # delete behind the watcher's back while forcing the stream to die
+    with server._lock:
+        server._objects.pop(("Pod", "default", "goner"))
+    server.break_watches("Pod")
+    assert wait_for(lambda: any(
+        e.type == DELETED and e.obj["metadata"]["name"] == "goner"
+        for e in events), timeout=8.0)
+    # the survivor is NOT re-announced as deleted
+    assert not any(e.type == DELETED and
+                   e.obj["metadata"]["name"] == "stay" for e in events)
+
+
+def test_apply_create_conflict_update_delete(server, cluster):
+    cluster.apply(pod("a"))
+    assert server._objects[("Pod", "default", "a")]
+    # second apply takes the read-modify-write path (409 -> PUT)
+    cluster.apply(pod("a", labels={"v": "2"}))
+    stored = server._objects[("Pod", "default", "a")]
+    assert stored["metadata"]["labels"] == {"v": "2"}
+    cluster.delete(pod("a"))
+    assert ("Pod", "default", "a") not in server._objects
+    cluster.delete(pod("a"))  # idempotent
+
+
+def test_discovery_and_preferred_gvks(server, cluster):
+    server.put_object({"apiVersion": "networking.k8s.io/v1",
+                       "kind": "Ingress",
+                       "metadata": {"name": "i", "namespace": "default"},
+                       "spec": {"rules": [{"host": "a.com"}]}})
+    objs = cluster.list(ING_GVK)
+    assert objs[0]["metadata"]["name"] == "i"
+    gvks = cluster.server_preferred_gvks()
+    assert POD_GVK in gvks and ING_GVK in gvks
+
+
+def test_controller_manager_runs_against_kube_cluster(server, cluster):
+    """The reconciliation Manager pointed at the apiserver source: a
+    ConstraintTemplate arriving through a real watch compiles into the
+    client (the e2e shape of VERDICT r1 next-step #3)."""
+    from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.controller.manager import Manager
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    cel = CELDriver()
+    tpu = TpuDriver(batch_bucket=8, cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
+    mgr = Manager(client, cluster, operations=["webhook", "audit"]).start()
+    t = load_yaml_file(
+        "/root/reference/demo/basic/templates/"
+        "k8srequiredlabels_template.yaml")[0]
+    server.put_object(t)
+    assert wait_for(
+        lambda: client.get_template("K8sRequiredLabels") is not None)
+    assert "K8sRequiredLabels" in tpu.lowered_kinds()
+
+    # dynamic constraint kind: the Manager subscribed to it on template
+    # arrival; installing the CRD resource + a constraint must make it
+    # active for Review (watch retried until discovery resolved)
+    server.add_resource("K8sRequiredLabels", "constraints.gatekeeper.sh",
+                        "v1beta1", "k8srequiredlabels", False)
+    server.put_object({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}},
+    })
+    assert wait_for(lambda: client.get_constraint(
+        "K8sRequiredLabels", "need-owner") is not None, timeout=8.0)
+
+
+def test_kubeconfig_parsing(tmp_path):
+    import base64 as b64
+
+    kc_path = tmp_path / "config"
+    kc_path.write_text("""
+apiVersion: v1
+kind: Config
+current-context: ctx
+contexts:
+- name: ctx
+  context: {cluster: c1, user: u1}
+clusters:
+- name: c1
+  cluster:
+    server: https://example:6443
+    certificate-authority-data: %s
+users:
+- name: u1
+  user:
+    token: sekrit
+""" % b64.b64encode(b"CA PEM").decode())
+    cfg = KubeConfig.from_kubeconfig(str(kc_path))
+    assert cfg.server == "https://example:6443"
+    assert cfg.token == "sekrit"
+    assert open(cfg.ca_file, "rb").read() == b"CA PEM"
