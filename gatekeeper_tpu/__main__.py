@@ -26,6 +26,11 @@ def main(argv=None) -> int:
                    help="run against a live Kubernetes apiserver (watch + "
                         "paged list informer plane); 'in-cluster' uses the "
                         "service-account environment")
+    p.add_argument("--evaluate-sidecar", default="",
+                   help="host:port of a device-owning Evaluate sidecar "
+                        "(python -m gatekeeper_tpu.rpc.sidecar); this "
+                        "process then runs the control plane only — no "
+                        "local accelerator")
     p.add_argument("--operation", action="append", default=[],
                    help="audit|webhook|mutation-webhook (repeatable; "
                         "default all)")
@@ -104,7 +109,24 @@ def main(argv=None) -> int:
     operations = args.operation or list(ALL_OPERATIONS)
     metrics = MetricsRegistry()
     cel = CELDriver()
-    tpu = TpuDriver(cel_driver=cel)
+    if args.evaluate_sidecar:
+        from gatekeeper_tpu.drivers.remote import RemoteDriver
+
+        tpu = RemoteDriver(args.evaluate_sidecar)
+        # the sidecar container may still be initializing its devices:
+        # wait for channel readiness instead of crash-looping on a race
+        import grpc as _grpc
+
+        try:
+            _grpc.channel_ready_future(tpu._channel).result(timeout=120)
+            print(f"evaluation plane: sidecar {args.evaluate_sidecar} "
+                  f"({tpu.dump()['sidecar']})", file=sys.stderr)
+        except Exception as e:
+            print(f"evaluate sidecar unreachable after 120s: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        tpu = TpuDriver(cel_driver=cel)
     client = Client(target=K8sValidationTarget(),
                     drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
@@ -144,14 +166,22 @@ def main(argv=None) -> int:
 
     audit_mgr = None
     if mgr.is_assigned("audit") or args.once:
-        from gatekeeper_tpu.parallel.sharded import (
-            ShardedEvaluator,
-            make_mesh,
-        )
+        if args.evaluate_sidecar:
+            from gatekeeper_tpu.drivers.remote import RemoteEvaluator
 
-        evaluator = ShardedEvaluator(
-            tpu, make_mesh(),
-            violations_limit=args.constraint_violations_limit)
+            evaluator = RemoteEvaluator(
+                tpu, violations_limit=args.constraint_violations_limit)
+        else:
+            # only the local path touches jax (the sidecar-mode control
+            # plane stays accelerator-free)
+            from gatekeeper_tpu.parallel.sharded import (
+                ShardedEvaluator,
+                make_mesh,
+            )
+
+            evaluator = ShardedEvaluator(
+                tpu, make_mesh(),
+                violations_limit=args.constraint_violations_limit)
 
         if kube_cluster is not None:
             # discovery-driven audit listing (auditResources,

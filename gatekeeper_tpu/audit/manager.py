@@ -251,10 +251,63 @@ class AuditManager:
                             kept[key].append(
                                 self._violation(con, obj, r.msg, r.details))
 
+    @staticmethod
+    def fold_swept(swept, n_objects, render, limit, exact):
+        """Yield (constraint, total, kept[(oi, msg, details)]) per
+        constraint of a device sweep result — the single definition of the
+        kept/total fold, shared by the in-process audit and the Evaluate
+        sidecar (their parity is asserted in tests/test_sidecar.py).
+
+        ``render(con, oi)`` -> list of exact-engine Results for one hit.
+        ``exact``: totals count RESULTS via bit-packed hit rows; otherwise
+        totals are the device's violating-object counts and only top-k
+        hits render."""
+        for kind, (kcons, idx, valid, counts, bits) in swept.items():
+            for ci, con in enumerate(kcons):
+                kept_list: list = []
+                if exact and bits is not None:
+                    hit_idx = np.nonzero(
+                        np.unpackbits(bits[ci], count=n_objects))[0]
+                    total = 0
+                    for oi in hit_idx.tolist():
+                        results = render(con, oi)
+                        total += len(results)
+                        for r in results:
+                            if len(kept_list) < limit:
+                                kept_list.append(
+                                    (oi, r.msg,
+                                     (r.metadata or {}).get("details")))
+                else:
+                    total = int(counts[ci])
+                    for j in range(idx.shape[1]):
+                        if not valid[ci, j] or len(kept_list) >= limit:
+                            continue
+                        oi = int(idx[ci, j])
+                        for r in render(con, oi):
+                            if len(kept_list) < limit:
+                                kept_list.append(
+                                    (oi, r.msg,
+                                     (r.metadata or {}).get("details")))
+                yield con, total, kept_list
+
     def _process_swept(self, swept, objects, constraints, kept, totals,
                        limit):
         """Fold one chunk's device results into the run state and run the
         fallback kinds through the exact engine."""
+        if getattr(self.evaluator, "renders", False):
+            # sidecar lane: the sweep RPC already rendered kept violations
+            # and covered every constraint (incl. non-lowered kinds)
+            for (ckind, cname), (total, kept_list) in swept.items():
+                key = (ckind, cname)
+                if key not in totals:
+                    continue
+                totals[key] += total
+                con = self.client.get_constraint(ckind, cname)
+                for oi, msg, details in kept_list:
+                    if con is not None and len(kept[key]) < limit:
+                        kept[key].append(
+                            self._violation(con, objects[oi], msg, details))
+            return
         target = self.client.target
         driver = next(
             (d for d in self.client.drivers if hasattr(d, "query_batch")),
@@ -276,29 +329,25 @@ class AuditManager:
             return [get_review(oi) for oi in range(len(objects))]
 
         exact = self.config.exact_totals
-        n_obj = len(objects)
-        for kind, (cons, idx, valid, ccounts, bits) in swept.items():
-            for ci, con in enumerate(cons):
-                key = con.key()
-                if exact and bits is not None:
-                    hit_idx = np.nonzero(
-                        np.unpackbits(bits[ci], count=n_obj)
-                    )[0]
-                    for oi in hit_idx.tolist():
-                        totals[key] += self._render_kept(
-                            driver, con, objects[oi],
-                            get_review(oi), kept[key], limit
-                        )
-                else:
-                    totals[key] += int(ccounts[ci])
-                    for j in range(idx.shape[1]):
-                        if not valid[ci, j] or len(kept[key]) >= limit:
-                            continue
-                        oi = int(idx[ci, j])
-                        self._render_kept(
-                            driver, con, objects[oi], get_review(oi),
-                            kept[key], limit
-                        )
+        cfg = ReviewCfg(enforcement_point=AUDIT_EP)
+
+        def render(con, oi):
+            if hasattr(driver, "render_query"):
+                return driver.render_query(
+                    self.client.target.name, con, get_review(oi), cfg
+                ).results
+            return driver._interp.query(
+                self.client.target.name, [con], get_review(oi), cfg
+            ).results
+
+        for con, total, kept_list in self.fold_swept(
+                swept, len(objects), render, limit, exact):
+            key = con.key()
+            totals[key] += total
+            for oi, msg, details in kept_list:
+                if len(kept[key]) < limit:
+                    kept[key].append(
+                        self._violation(con, objects[oi], msg, details))
         # everything the device sweep did not cover (non-lowered kinds, CEL
         # templates owned by another driver, inventory-inexact referential
         # kinds) goes through its own driver's exact path
@@ -326,23 +375,6 @@ class AuditManager:
                     kept[key].append(
                         self._violation(con, objects[oi], r.msg, r.details)
                     )
-
-    def _render_kept(self, driver, con, obj, review, out_list, limit) -> int:
-        """Render one hit through the exact engine; append to ``out_list``
-        up to ``limit`` (the reference's LimitQueue cap applies to *results*,
-        audit/manager.go:161-202).  Returns the number of results."""
-        cfg = ReviewCfg(enforcement_point=AUDIT_EP)
-        if hasattr(driver, "render_query"):
-            qr = driver.render_query(self.client.target.name, con, review,
-                                     cfg)
-        else:
-            qr = driver._interp.query(
-                self.client.target.name, [con], review, cfg,
-            )
-        for r in qr.results:
-            if len(out_list) < limit:
-                out_list.append(self._violation(con, obj, r.msg, r.details))
-        return len(qr.results)
 
     def _violation(self, con, obj, msg, details) -> Violation:
         group, version, kind = gvk_of(obj)

@@ -1,0 +1,261 @@
+"""Client side of the Evaluate sidecar seam.
+
+``RemoteDriver`` implements the Driver protocol by replicating template/
+constraint/data lifecycle into the sidecar (Reconcile) and evaluating via
+QueryBatch — the control-plane process never touches the accelerator.
+``RemoteEvaluator`` is the audit chunk lane: one Sweep RPC per chunk,
+returning rendered kept violations + totals (the whole audit middle runs
+device-side; ref shape pkg/audit/manager.go:668-774 collapsed into one
+call).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional, Sequence
+
+import grpc
+
+from gatekeeper_tpu.apis.constraints import Constraint
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.client.types import QueryResponse, Result
+from gatekeeper_tpu.drivers.base import ReviewCfg
+from gatekeeper_tpu.rpc import SERVICE, load_pb2
+from gatekeeper_tpu.target.review import GkReview
+
+pb = load_pb2()
+
+DRIVER_NAME = "TPU-remote"
+
+
+class RemoteError(Exception):
+    pass
+
+
+class _Stub:
+    """Hand-rolled unary stubs (no grpc_tools plugin in this image)."""
+
+    def __init__(self, channel: grpc.Channel):
+        def unary(method, req_cls, resp_cls):
+            return channel.unary_unary(
+                f"/{SERVICE}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+
+        self.reconcile = unary("Reconcile", pb.ReconcileRequest,
+                               pb.ReconcileResponse)
+        self.query_batch = unary("QueryBatch", pb.QueryBatchRequest,
+                                 pb.QueryBatchResponse)
+        self.sweep = unary("Sweep", pb.SweepRequest, pb.SweepResponse)
+        self.status = unary("Status", pb.StatusRequest, pb.StatusResponse)
+
+
+def _review_to_pb(review: GkReview) -> "pb.Review":
+    req = review.request
+    doc = {
+        "uid": req.uid, "kind": req.kind, "resource": req.resource,
+        "subResource": req.sub_resource, "name": req.name,
+        "namespace": req.namespace, "operation": req.operation,
+        "userInfo": req.user_info, "object": req.object,
+        "oldObject": req.old_object, "dryRun": req.dry_run,
+        "options": req.options,
+    }
+    out = pb.Review(admission_request_json=json.dumps(doc).encode(),
+                    source=getattr(review, "source", "") or "",
+                    is_admission=bool(getattr(review, "is_admission",
+                                              False)))
+    if review.namespace:  # the Namespace OBJECT (GkReview.namespace)
+        out.namespace_json = json.dumps(review.namespace).encode()
+    return out
+
+
+def _results_from_pb(rr, target: str) -> list:
+    out = []
+    for r in rr.results:
+        metadata = {}
+        if r.details_json:
+            metadata["details"] = json.loads(r.details_json)
+        out.append(Result(
+            target=target,
+            msg=r.msg,
+            constraint=json.loads(r.constraint_json or b"{}"),
+            metadata=metadata,
+        ))
+    return out
+
+
+class RemoteDriver:
+    """Driver protocol over the Evaluate sidecar."""
+
+    def __init__(self, address: str, timeout_s: float = 120.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[("grpc.max_receive_message_length",
+                      256 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 256 * 1024 * 1024)],
+        )
+        self._stub = _Stub(self._channel)
+        self._lowered: list = []
+        self._lock = threading.RLock()
+
+    def name(self) -> str:
+        return DRIVER_NAME
+
+    # --- lifecycle (replicated to the sidecar) ------------------------
+    def has_source_for(self, template: ConstraintTemplate) -> bool:
+        # static source check: rego or K8sNativeValidation (mirrors the
+        # sidecar's TpuDriver+CELDriver acceptance without compiling)
+        from gatekeeper_tpu.apis.templates import ENGINE_REGO
+        from gatekeeper_tpu.drivers.cel_driver import parse_source
+
+        if template.targets[0].source_for(ENGINE_REGO) is not None:
+            return True
+        return parse_source(template) is not None
+
+    def _reconcile(self, **kwargs) -> "pb.ReconcileResponse":
+        resp = self._stub.reconcile(pb.ReconcileRequest(**kwargs),
+                                    timeout=self.timeout_s)
+        if resp.error:
+            raise RemoteError(resp.error)
+        with self._lock:
+            self._lowered = list(resp.lowered)
+        return resp
+
+    def add_template(self, template: ConstraintTemplate) -> None:
+        self._reconcile(verb="add_template",
+                        object_json=json.dumps(template.raw).encode())
+
+    def remove_template(self, template_kind: str) -> None:
+        self._reconcile(verb="remove_template", kind=template_kind)
+
+    def add_constraint(self, constraint: Constraint) -> None:
+        self._reconcile(verb="add_constraint",
+                        object_json=json.dumps(constraint.raw).encode())
+
+    def remove_constraint(self, constraint: Constraint) -> None:
+        self._reconcile(verb="remove_constraint",
+                        object_json=json.dumps(constraint.raw).encode())
+
+    def add_data(self, target: str, path: Sequence[str],
+                 data: Any) -> None:
+        self._reconcile(verb="add_data", path=list(path),
+                        object_json=json.dumps(data).encode())
+
+    def remove_data(self, target: str, path: Sequence[str]) -> None:
+        self._reconcile(verb="remove_data", path=list(path))
+
+    def wipe_data(self) -> None:
+        self._reconcile(verb="wipe_data")
+
+    # --- evaluation ---------------------------------------------------
+    def query(self, target, constraints, review, cfg=None) -> QueryResponse:
+        responses = self.query_batch(target, constraints, [review], cfg)
+        return responses[0]
+
+    def query_batch(self, target: str, constraints, reviews,
+                    cfg: Optional[ReviewCfg] = None,
+                    render_messages: bool = True) -> list:
+        cfg = cfg or ReviewCfg()
+        req = pb.QueryBatchRequest(
+            enforcement_point=cfg.enforcement_point or "",
+            render_messages=render_messages,
+        )
+        req.reviews.extend(_review_to_pb(r) for r in reviews)
+        resp = self._stub.query_batch(req, timeout=self.timeout_s)
+        if resp.error:
+            raise RemoteError(resp.error)
+        want = {(c.kind, c.name) for c in constraints}
+        out = []
+        for rr in resp.responses:
+            qr = QueryResponse()
+            for r in _results_from_pb(rr, target):
+                ckind = r.constraint.get("kind", "")
+                cname = (r.constraint.get("metadata") or {}).get("name", "")
+                # the sidecar evaluates its full constraint set; filter to
+                # the caller's slice (Driver.Query contract)
+                if (ckind, cname) in want:
+                    qr.results.append(r)
+            out.append(qr)
+        return out
+
+    def lowered_kinds(self) -> list:
+        status = self._stub.status(pb.StatusRequest(),
+                                   timeout=self.timeout_s)
+        return list(status.lowered)
+
+    def fallback_kinds(self) -> dict:
+        status = self._stub.status(pb.StatusRequest(),
+                                   timeout=self.timeout_s)
+        return dict(status.fallback)
+
+    def dump(self) -> dict:
+        status = self._stub.status(pb.StatusRequest(),
+                                   timeout=self.timeout_s)
+        return {
+            "lowered": list(status.lowered),
+            "fallback": dict(status.fallback),
+            "sidecar": {"devices": status.n_devices,
+                        "platform": status.platform},
+        }
+
+    def get_description_for_stat(self, stat_name: str) -> str:
+        return ""
+
+    def close(self):
+        self._channel.close()
+
+
+class RemoteEvaluator:
+    """Audit chunk lane over the sidecar: sweep_submit dispatches the RPC
+    on a thread (pipelining with the host's next-chunk prep, like the
+    local evaluator's async jit dispatch); sweep_collect joins it.
+
+    ``renders = True``: responses carry rendered kept violations +
+    totals, so the AuditManager folds them directly instead of rendering
+    host-side."""
+
+    renders = True
+
+    def __init__(self, driver: RemoteDriver, violations_limit: int = 20,
+                 exact_totals: bool = False):
+        self.driver = driver
+        self.violations_limit = violations_limit
+        self.exact_totals = exact_totals
+
+    def sweep_submit(self, constraints, objects, return_bits=False):
+        req = pb.SweepRequest(
+            violations_limit=self.violations_limit,
+            exact_totals=return_bits or self.exact_totals,
+        )
+        # restrict the sweep to the caller's constraint slice (the audit
+        # passes only audit-actionable constraints)
+        req.constraint_keys.extend(
+            f"{c.kind}/{c.name}" for c in constraints)
+        req.object_json.extend(
+            json.dumps(o).encode() for o in objects)
+        return self.driver._stub.sweep.future(
+            req, timeout=self.driver.timeout_s)
+
+    def sweep_collect(self, pending):
+        if pending is None or isinstance(pending, dict):
+            return pending or {}
+        resp = pending.result()
+        if resp.error:
+            raise RemoteError(resp.error)
+        out = {}
+        for cs in resp.constraints:
+            kept = [
+                (kv.object_index, kv.msg,
+                 json.loads(kv.details_json) if kv.details_json else None)
+                for kv in cs.kept
+            ]
+            out[(cs.kind, cs.name)] = (int(cs.total), kept)
+        return out
+
+    def sweep(self, constraints, objects, return_bits=False):
+        return self.sweep_collect(
+            self.sweep_submit(constraints, objects, return_bits))

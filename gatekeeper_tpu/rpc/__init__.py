@@ -1,0 +1,43 @@
+"""gRPC seam between the control plane and the device-owning sidecar.
+
+``evaluate_pb2.py`` is generated from ``evaluate.proto`` by protoc and
+committed; ``load_pb2()`` regenerates it when the proto is newer (protoc
+has no Python-gRPC plugin in this image, so the service stubs in
+sidecar.py/client code are hand-written over grpc's generic handlers —
+the wire format is standard gRPC + protobuf either way)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(__file__)
+
+
+def load_pb2():
+    proto = os.path.join(_DIR, "evaluate.proto")
+    out = os.path.join(_DIR, "evaluate_pb2.py")
+    if os.path.exists(proto) and (
+        not os.path.exists(out)
+        or os.path.getmtime(out) < os.path.getmtime(proto)
+    ):
+        try:
+            subprocess.run(
+                ["protoc", f"--python_out={_DIR}", f"--proto_path={_DIR}",
+                 proto],
+                check=True, capture_output=True,
+            )
+        except (FileNotFoundError, subprocess.CalledProcessError):
+            # no protoc (slim image) or regen failure: the committed pb2
+            # is authoritative — mtimes lie after a fresh checkout
+            if not os.path.exists(out):
+                raise
+    if _DIR not in sys.path:
+        sys.path.insert(0, _DIR)
+    import evaluate_pb2  # noqa: E402
+
+    return evaluate_pb2
+
+
+SERVICE = "gatekeeper.tpu.v1.Evaluate"

@@ -1,0 +1,193 @@
+"""Evaluate-sidecar seam tests: the control plane evaluates only through
+gRPC (SURVEY.md §7 "only Driver.Query crosses the boundary"); verdicts,
+messages and audit results must be identical to the in-process driver."""
+
+import json
+import os
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.remote import RemoteDriver, RemoteEvaluator
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.rpc.sidecar import serve
+from gatekeeper_tpu.target.review import AugmentedUnstructured
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.synthetic import load_library, make_cluster_objects
+
+LIB = os.path.join(os.path.dirname(__file__), "..", "library")
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    server, port, servicer = serve(port=0, violations_limit=20)
+    yield f"127.0.0.1:{port}", servicer
+    server.stop(grace=1)
+
+
+def _remote_client(address):
+    remote = RemoteDriver(address)
+    client = Client(target=K8sValidationTarget(),
+                    drivers=[remote, CELDriver()],
+                    enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+    return client, remote
+
+
+def _local_client():
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+    return client, tpu
+
+
+def test_remote_driver_review_parity(sidecar):
+    address, _svc = sidecar
+    rc, remote = _remote_client(address)
+    lc, _tpu = _local_client()
+    load_library(rc)
+    load_library(lc)
+    assert remote.fallback_kinds() == {}
+    assert len(remote.lowered_kinds()) == 23
+
+    objects = make_cluster_objects(120, seed=17)
+    for o in objects:
+        if o.get("kind") == "Ingress":
+            rc.add_data(o)
+            lc.add_data(o)
+    for o in objects[:60]:
+        aug = AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
+        rr = rc.review(aug, enforcement_point=AUDIT_EP)
+        lr = lc.review(aug, enforcement_point=AUDIT_EP)
+        key = lambda r: ((r.constraint.get("metadata") or {})
+                         .get("name", ""), r.msg)
+        assert sorted(map(key, rr.results())) == \
+            sorted(map(key, lr.results())), o.get("metadata")
+
+
+def test_remote_audit_sweep_parity(sidecar):
+    address, _svc = sidecar
+    rc, remote = _remote_client(address)
+    lc, ltpu = _local_client()
+    load_library(rc)
+    load_library(lc)
+    remote.wipe_data()  # the module-scoped sidecar keeps prior tests' data
+    objects = make_cluster_objects(300, seed=23)
+    for o in objects:
+        if o.get("kind") == "Ingress":
+            rc.add_data(o)
+            lc.add_data(o)
+
+    r_mgr = AuditManager(
+        rc, lister=lambda: iter(objects),
+        config=AuditConfig(chunk_size=128, exact_totals=False),
+        evaluator=RemoteEvaluator(remote, violations_limit=20),
+    )
+    l_mgr = AuditManager(
+        lc, lister=lambda: iter(objects),
+        config=AuditConfig(chunk_size=128, exact_totals=False),
+        evaluator=ShardedEvaluator(ltpu, make_mesh(), violations_limit=20),
+    )
+    r_run = r_mgr.audit()
+    l_run = l_mgr.audit()
+    assert r_run.total_objects == l_run.total_objects == 300
+    assert r_run.total_violations == l_run.total_violations
+    for k in l_run.kept:
+        assert sorted(v.message for v in r_run.kept[k]) == \
+            sorted(v.message for v in l_run.kept[k]), k
+
+
+def test_remote_exact_totals(sidecar):
+    """exact_totals through the sidecar must match the local exact path
+    (the CEL noprivileged template yields ONE result per violating pod —
+    its validation is size(badContainers)==0 — so totals count pods)."""
+    address, _svc = sidecar
+    rc, remote = _remote_client(address)
+    lc, ltpu = _local_client()
+    for c in (rc, lc):
+        load_library(c, skip_kinds=("K8sUniqueIngressHost",))
+    remote.wipe_data()
+    pods = [{
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"p{i}"},
+        "spec": {"containers": [
+            {"name": "a", "image": "x", "securityContext":
+                {"privileged": True}},
+            {"name": "b", "image": "y", "securityContext":
+                {"privileged": True}},
+        ]},
+    } for i in range(4)]
+    r_mgr = AuditManager(
+        rc, lister=lambda: iter(pods), config=AuditConfig(),
+        evaluator=RemoteEvaluator(remote, violations_limit=20,
+                                  exact_totals=True),
+    )
+    l_mgr = AuditManager(
+        lc, lister=lambda: iter(pods),
+        config=AuditConfig(exact_totals=True),
+        evaluator=ShardedEvaluator(ltpu, make_mesh(), violations_limit=20),
+    )
+    r_run, l_run = r_mgr.audit(), l_mgr.audit()
+    assert r_run.total_violations == l_run.total_violations
+    key = ("K8sNoPrivileged", "no-privileged-containers")
+    assert r_run.total_violations[key] == 4  # one result per violating pod
+
+
+def test_sidecar_process_e2e(tmp_path):
+    """Two real processes: device-owning sidecar + control plane running
+    an audit through it (the reference's two-pod deployment shape)."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    side = subprocess.Popen(
+        [sys.executable, "-m", "gatekeeper_tpu.rpc.sidecar",
+         "--port", str(port)],
+        env=env, cwd=root, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = side.stderr.readline()
+            if "serving on" in line:
+                break
+        else:
+            pytest.fail("sidecar never came up")
+        mani = tmp_path / "m"
+        mani.mkdir()
+        for name in ("noprivileged", "containerlimitscel"):
+            src = os.path.join(LIB, "general", name)
+            (mani / f"{name}-t.yaml").write_text(
+                open(os.path.join(src, "template.yaml")).read())
+            (mani / f"{name}-c.yaml").write_text(
+                open(os.path.join(src, "samples", "constraint.yaml"))
+                .read())
+        (mani / "bad.yaml").write_text(json.dumps({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "privpod"},
+            "spec": {"containers": [{
+                "name": "c", "image": "x",
+                "securityContext": {"privileged": True}}]},
+        }))
+        out = subprocess.run(
+            [sys.executable, "-m", "gatekeeper_tpu",
+             "--manifests", str(mani),
+             "--evaluate-sidecar", f"127.0.0.1:{port}", "--once"],
+            env=env, cwd=root, capture_output=True, text=True,
+            timeout=180)
+        assert "Privileged container is not allowed" in out.stdout, (
+            out.stdout, out.stderr[-2000:])
+        assert "memory limit" in out.stdout
+    finally:
+        side.terminate()
+        side.wait(timeout=10)
