@@ -164,6 +164,10 @@ class RemoteDriver:
             enforcement_point=cfg.enforcement_point or "",
             render_messages=render_messages,
         )
+        # restrict evaluation to the caller's constraint slice server-side
+        # (per-request device work must not scale with the full set)
+        req.constraint_keys.extend(
+            f"{c.kind}/{c.name}" for c in constraints)
         req.reviews.extend(_review_to_pb(r) for r in reviews)
         resp = self._stub.query_batch(req, timeout=self.timeout_s)
         if resp.error:

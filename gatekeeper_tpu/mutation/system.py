@@ -35,6 +35,7 @@ class MutationSystem:
     # --- registry (reference: Upsert system.go:80, Remove :121) ----------
     def upsert(self, mutator: BaseMutator) -> None:
         self._mutators[mutator.id] = mutator
+        self._revision = self.__dict__.get("_revision", 0) + 1
         self._recompute_conflicts()
 
     def upsert_unstructured(self, obj: dict) -> BaseMutator:
@@ -44,6 +45,7 @@ class MutationSystem:
 
     def remove(self, mutator_id: MutatorID) -> None:
         self._mutators.pop(mutator_id, None)
+        self._revision = self.__dict__.get("_revision", 0) + 1
         self._recompute_conflicts()
 
     def get(self, mutator_id: MutatorID) -> Optional[BaseMutator]:
@@ -120,6 +122,43 @@ class MutationSystem:
             f"mutation system failed to converge after {max_iterations} "
             "iterations"
         )
+
+    def mutate_batch(self, objects: list, namespace=None,
+                     source: str = "") -> list:
+        """Batch mutation with the device path-match prefilter (BASELINE
+        config #4): the [M, N] would-change grid runs once on device; the
+        host fixed-point walk runs ONLY on objects some mutator would
+        actually touch (plus every object when non-lowerable mutators
+        exist — they stay host-authoritative).  Returns changed flags."""
+        active = [m for m in self.mutators() if m.id not in self._conflicts]
+        if not active or not objects:
+            return [False] * len(objects)
+        from gatekeeper_tpu.mutation.device import MutationPrefilter
+
+        # cache keyed on the system REVISION (not just ids: an in-place
+        # upsert changing a mutator's value/location must recompile)
+        rev = self.__dict__.get("_revision", 0)
+        pre = self.__dict__.get("_prefilter")
+        if pre is None or self.__dict__.get("_prefilter_rev") != rev:
+            pre = MutationPrefilter()
+            for m in active:
+                pre.add_mutator(m)
+            self.__dict__["_prefilter"] = pre
+            self.__dict__["_prefilter_rev"] = rev
+        all_lowered = len(pre.lowered_ids()) == len(active)
+        changed = [False] * len(objects)
+        if all_lowered:
+            # the walk must also run where it would ERROR, so callers see
+            # the same MutateError the per-object path raises
+            needs = (pre.would_change(active, objects)
+                     | pre.would_error(active, objects)).any(axis=0)
+        else:
+            needs = [True] * len(objects)
+        for oi, obj in enumerate(objects):
+            if needs[oi]:
+                changed[oi] = self.mutate(obj, namespace=namespace,
+                                          source=source)
+        return changed
 
     def _resolve_placeholders(self, obj: Any) -> None:
         """Resolve external-data placeholders at convergence

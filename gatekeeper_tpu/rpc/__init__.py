@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import os
 import subprocess
-import sys
 
 _DIR = os.path.dirname(__file__)
 
@@ -33,9 +32,7 @@ def load_pb2():
             # is authoritative — mtimes lie after a fresh checkout
             if not os.path.exists(out):
                 raise
-    if _DIR not in sys.path:
-        sys.path.insert(0, _DIR)
-    import evaluate_pb2  # noqa: E402
+    from gatekeeper_tpu.rpc import evaluate_pb2  # package-relative
 
     return evaluate_pb2
 

@@ -122,6 +122,10 @@ class EvaluateServicer:
                        for rv in req.reviews]
             with self._lock:
                 cons = list(self._constraints.values())
+                if req.constraint_keys:
+                    want = set(req.constraint_keys)
+                    cons = [c for c in cons
+                            if f"{c.kind}/{c.name}" in want]
                 results = self.tpu.query_batch(
                     self.target.name, cons, reviews,
                     ReviewCfg(enforcement_point=req.enforcement_point
@@ -153,17 +157,21 @@ class EvaluateServicer:
         try:
             objects = [json.loads(b) for b in req.object_json]
             limit = req.violations_limit or 20
+            ep = req.enforcement_point or "audit.gatekeeper.sh"
+            cfg = ReviewCfg(enforcement_point=ep)
+            # ONE lock span: the constraint snapshot must stay valid
+            # through evaluation (a concurrent remove_template would tear
+            # down tables under the sweep), and the evaluator/driver state
+            # (vocab interning, jit caches) is not thread-safe
             with self._lock:
                 cons = list(self._constraints.values())
                 if req.constraint_keys:
                     want = set(req.constraint_keys)
                     cons = [c for c in cons
                             if f"{c.kind}/{c.name}" in want]
-            ep = req.enforcement_point or "audit.gatekeeper.sh"
-            cfg = ReviewCfg(enforcement_point=ep)
-            # the evaluator/driver state (vocab interning, jit caches,
-            # device tables) is not thread-safe: serialize evaluation RPCs
-            with self._lock:
+                # honor the CALLER's top-k capacity (config drift between
+                # control plane and sidecar must not truncate silently)
+                self.evaluator.violations_limit = limit
                 swept = self.evaluator.sweep(
                     cons, objects, return_bits=req.exact_totals)
 
@@ -275,6 +283,8 @@ def serve(port: int = 9090, violations_limit: int = 20,
     )
     server.add_generic_rpc_handlers((_handler(servicer),))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    if bound == 0:
+        raise RuntimeError(f"could not bind 127.0.0.1:{port}")
     server.start()
     return server, bound, servicer
 

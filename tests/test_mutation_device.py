@@ -1,0 +1,188 @@
+"""Differential tests for the mutation path-match device kernel
+(mutation/device.py): grid[m, n] must equal "core.mutate changes object n"
+for every lowerable (mutator, object) pair — including the walk's error
+outcomes (BASELINE config #4; ref semantics
+pkg/mutation/mutators/core/mutation_function.go:26-239)."""
+
+import copy
+import random
+
+import numpy as np
+
+from gatekeeper_tpu.mutation.core import MutateError
+from gatekeeper_tpu.mutation.device import MutationPrefilter
+from gatekeeper_tpu.mutation.mutators import from_unstructured
+
+
+def _mutator(kind, name, location, value, extra_params=None):
+    params = {"assign": {"value": value}}
+    params.update(extra_params or {})
+    spec = {"location": location, "parameters": params}
+    if kind == "Assign":
+        spec["applyTo"] = [{"groups": [""], "versions": ["v1"],
+                            "kinds": ["Pod"]}]
+    return from_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": kind, "metadata": {"name": name},
+        "spec": spec,
+    })
+
+
+MUTATORS = [
+    _mutator("Assign", "pull-policy",
+             "spec.containers[name: *].imagePullPolicy", "Always"),
+    _mutator("Assign", "keyed-image",
+             "spec.containers[name: app].image", "nginx:1.19"),
+    _mutator("Assign", "scalar-host", "spec.hostNetwork", False),
+    _mutator("Assign", "nested-scalar",
+             "spec.securityContext.runAsNonRoot", True),
+    _mutator("Assign", "priority-num", "spec.priority", 100),
+    _mutator("Assign", "deep-glob",
+             "spec.containers[name: *].securityContext.readOnlyRootFilesystem",
+             True),
+    _mutator("AssignMetadata", "owner-label",
+             "metadata.labels.owner", "platform-team"),
+    _mutator("AssignMetadata", "note-ann",
+             "metadata.annotations.note", "n1"),
+]
+
+
+def rand_obj(rng, i):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": f"p{i}"}}
+    r = rng.random()
+    if r < 0.3:
+        obj["metadata"]["labels"] = rng.choice(
+            [{"owner": "platform-team"}, {"owner": "other"},
+             {"app": "x"}, "notadict", {}])
+    if r < 0.2:
+        obj["metadata"]["annotations"] = rng.choice(
+            [{"note": "n1"}, {"note": "other"}, {}])
+    spec = {}
+    if rng.random() < 0.9:
+        containers = []
+        for j in range(rng.randint(0, 3)):
+            c = {}
+            if rng.random() < 0.9:
+                c["name"] = rng.choice(["app", "side", "app"])
+            if rng.random() < 0.7:
+                c["imagePullPolicy"] = rng.choice(
+                    ["Always", "IfNotPresent", True, 5])
+            if rng.random() < 0.5:
+                c["image"] = rng.choice(["nginx:1.19", "nginx:1.20", 7])
+            if rng.random() < 0.4:
+                c["securityContext"] = rng.choice(
+                    [{"readOnlyRootFilesystem": True},
+                     {"readOnlyRootFilesystem": False},
+                     {}, "bogus"])
+            containers.append(c)
+        if rng.random() < 0.08:
+            spec["containers"] = rng.choice(["notalist", {"a": {}}, 5])
+        else:
+            spec["containers"] = containers
+    if rng.random() < 0.4:
+        spec["hostNetwork"] = rng.choice([True, False, "false", 0])
+    if rng.random() < 0.3:
+        spec["securityContext"] = rng.choice(
+            [{"runAsNonRoot": True}, {"runAsNonRoot": False}, {},
+             "bogus", 3])
+    if rng.random() < 0.3:
+        spec["priority"] = rng.choice([100, 100.0, 50, True, "100"])
+    obj["spec"] = spec
+    return obj
+
+
+def host_would_change(mutator, obj) -> bool:
+    clone = copy.deepcopy(obj)
+    try:
+        return bool(mutator.mutate_obj(clone))
+    except MutateError:
+        return False  # walk error: the system records it, object unchanged
+
+
+def test_device_grid_matches_host_walk():
+    pre = MutationPrefilter()
+    for m in MUTATORS:
+        assert pre.add_mutator(m), (m.id, pre.unsupported())
+    rng = random.Random(42)
+    objects = [rand_obj(rng, i) for i in range(400)]
+    grid = pre.would_change(MUTATORS, objects)
+    for mi, m in enumerate(MUTATORS):
+        for oi, obj in enumerate(objects):
+            want = host_would_change(m, obj)
+            assert bool(grid[mi, oi]) == want, (
+                f"divergence: mutator={m.id} object={obj}")
+
+
+def test_unsupported_mutators_fall_back():
+    pre = MutationPrefilter()
+    # assignIf → host-only
+    m = _mutator("Assign", "cond", "spec.x", "v",
+                 {"assignIf": {"in": ["a"]}})
+    assert not pre.add_mutator(m)
+    assert any("cond" in str(k) for k in pre.unsupported())
+    # ModifySet → host-only
+    ms = from_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "ModifySet", "metadata": {"name": "args"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.containers[name: *].args",
+                 "parameters": {"operation": "merge",
+                                "values": {"fromList": ["-v"]}}},
+    })
+    assert not pre.add_mutator(ms)
+    # grid rows for non-lowered mutators stay False
+    grid = pre.would_change([m], [{"apiVersion": "v1", "kind": "Pod",
+                                   "metadata": {"name": "p"},
+                                   "spec": {}}])
+    assert not grid.any()
+
+
+def test_grid_prefilters_system_batch():
+    """The intended integration: run the host fixed-point only on objects
+    some mutator would actually change."""
+    pre = MutationPrefilter()
+    lowerable = [m for m in MUTATORS if pre.add_mutator(m)]
+    rng = random.Random(7)
+    objects = [rand_obj(rng, i) for i in range(100)]
+    grid = pre.would_change(lowerable, objects)
+    needs_walk = grid.any(axis=0)
+    for oi, obj in enumerate(objects):
+        host_any = any(host_would_change(m, obj) for m in lowerable)
+        assert bool(needs_walk[oi]) == host_any
+
+
+def test_system_mutate_batch_parity():
+    """mutate_batch (device-prefiltered) must match per-object mutate,
+    including raising MutateError for the same objects."""
+    from gatekeeper_tpu.mutation.system import MutationSystem
+
+    sys_a, sys_b = MutationSystem(), MutationSystem()
+    for m in MUTATORS:
+        sys_a.upsert(m)
+        sys_b.upsert(m)
+    rng = random.Random(99)
+    objs = [rand_obj(rng, i) for i in range(120)]
+
+    def outcome(system, obj):
+        try:
+            return system.mutate(obj), None
+        except MutateError as e:
+            return "error", str(e)
+
+    n_err = 0
+    for obj in objs:
+        a, b = copy.deepcopy(obj), copy.deepcopy(obj)
+        flag_b, err_b = outcome(sys_b, b)
+        try:
+            flag_a = sys_a.mutate_batch([a])[0]
+            err_a = None
+        except MutateError as e:
+            flag_a, err_a = "error", str(e)
+        assert flag_a == flag_b, (obj, err_a, err_b)
+        if err_b:
+            n_err += 1
+        else:
+            assert a == b  # identical post-mutation trees
+    assert n_err > 0  # the corpus exercises the error-parity path
