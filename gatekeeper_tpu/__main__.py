@@ -42,6 +42,9 @@ def main(argv=None) -> int:
                    help="enable disk export of audit violations")
     p.add_argument("--log-denies", action="store_true",
                    help="log structured deny events (reference --log-denies)")
+    p.add_argument("--log-stats-admission", action="store_true",
+                   help="log per-request evaluation stats (reference "
+                        "--log-stats-admission)")
     p.add_argument("--certs-dir", default="",
                    help="serve TLS using (or generating) certs in this dir")
     p.add_argument("--client-ca-file", default="",
@@ -252,7 +255,7 @@ def main(argv=None) -> int:
         def namespace_lookup(name):
             return cluster.get(("", "v1", "Namespace"), "", name)
 
-    batcher = Batcher(client).start()
+    batcher = Batcher(client, stats=args.log_stats_admission).start()
     server = None
     if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
         certfile = keyfile = None
@@ -277,6 +280,8 @@ def main(argv=None) -> int:
                 log_denies=args.log_denies,
                 metrics=metrics,
                 fail_open=args.fail_open_on_error,
+                trace_config=lambda: mgr.validation_traces,
+                log_stats=args.log_stats_admission,
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,

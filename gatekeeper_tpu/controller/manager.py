@@ -48,6 +48,22 @@ WEBHOOKCONFIG_GVK = ("admissionregistration.k8s.io", "v1",
 ALL_OPERATIONS = ("audit", "webhook", "mutation-webhook",
                   "mutation-controller", "status", "generate")
 
+# per-pod status substrate (reference: apis/status/v1beta1)
+STATUS_GROUP = "status.gatekeeper.sh"
+STATUS_VERSION = "v1beta1"
+STATUS_KIND_FOR = {
+    "ConstraintTemplate": "ConstraintTemplatePodStatus",
+    CONSTRAINTS_GROUP: "ConstraintPodStatus",
+    "Config": "ConfigPodStatus",
+    "ExpansionTemplate": "ExpansionTemplatePodStatus",
+    "Assign": "MutatorPodStatus",
+    "AssignMetadata": "MutatorPodStatus",
+    "ModifySet": "MutatorPodStatus",
+    "AssignImage": "MutatorPodStatus",
+    "Provider": "ExternalDataProviderPodStatus",
+    "Connection": "ConnectionPodStatus",
+}
+
 
 class Manager:
     def __init__(
@@ -60,10 +76,15 @@ class Manager:
         provider_cache: Optional[ProviderCache] = None,
         export_system=None,
         metrics=None,
+        pod_name: Optional[str] = None,
     ):
+        import os
+
         self.client = client
         self.cluster = cluster
         self.operations = set(operations)
+        self.pod_name = pod_name or os.environ.get(
+            "POD_NAME", "gatekeeper-tpu-0")
         self.tracker = Tracker()
         self.excluder = ProcessExcluder()
         self.webhookconfig_cache = None  # validating webhook match scope
@@ -81,6 +102,8 @@ class Manager:
         self._constraint_watches: dict[str, callable] = {}  # kind -> cancel
         self._lock = threading.RLock()
         self._template_errors: dict[str, str] = {}
+        # Config spec.validation.traces[] (per-request webhook tracing)
+        self.validation_traces: list = []
 
     def is_assigned(self, op: str) -> bool:
         """Reference: operations.IsAssigned (operations.go:92)."""
@@ -115,6 +138,11 @@ class Manager:
                 self.cluster.subscribe((MUTATIONS_GROUP, version, mkind),
                                        self._dispatch, replay=True)
         self.tracker.populated("mutators")
+        # status controllers: fold per-pod status CRs into parent status
+        for status_kind in sorted(set(STATUS_KIND_FOR.values())):
+            self.cluster.subscribe(
+                (STATUS_GROUP, STATUS_VERSION, status_kind),
+                self._dispatch, replay=True)
         # constraints tracked once their kinds exist; mark populated for the
         # boot snapshot (dynamic watches will observe them)
         self.tracker.populated("constraints")
@@ -124,6 +152,12 @@ class Manager:
     # --- dispatch -------------------------------------------------------
     def _dispatch(self, event: Event) -> None:
         group, _version, kind = gvk_of(event.obj)
+        if event.type == DELETED and group != STATUS_GROUP and (
+                kind in STATUS_KIND_FOR or group in STATUS_KIND_FOR):
+            # every replica removes ITS pod-status with the parent (the
+            # reference's status controllers do the same), so recreated
+            # parents never fold departed pods' orphans
+            self._delete_pod_status(event.obj)
         try:
             if (group, kind) == (TEMPLATES_GVK[0], TEMPLATES_GVK[2]):
                 self._reconcile_template(event)
@@ -144,6 +178,8 @@ class Manager:
             elif (group, kind) == (WEBHOOKCONFIG_GVK[0],
                                    WEBHOOKCONFIG_GVK[2]):
                 self._reconcile_webhookconfig(event)
+            elif group == STATUS_GROUP:
+                self._reconcile_podstatus(event)
         except Exception as e:  # reconcile errors surface via status
             self._set_status(event.obj, error=str(e))
 
@@ -213,10 +249,15 @@ class Manager:
             self.cache_manager.remove_source(("config", name))
             # excluder reset must wipe + replay like any excluder change
             self.cache_manager.replace_excluder(ProcessExcluder())
+            self.validation_traces = []
             return
         match_entries = deep_get(event.obj, ("spec", "match"), []) or []
         self.cache_manager.replace_excluder(
             ProcessExcluder.from_config_match(match_entries))
+        # per-request decision tracing selectors (config_types.go:42-54),
+        # consulted by the webhook via Manager.validation_traces
+        self.validation_traces = deep_get(
+            event.obj, ("spec", "validation", "traces"), []) or []
         gvks = []
         for e in deep_get(event.obj, ("spec", "sync", "syncOnly"), []) or []:
             gvks.append((e.get("group", ""), e.get("version", ""),
@@ -275,20 +316,117 @@ class Manager:
 
     # --- status (reference: per-pod *PodStatus CRs folded by status
     # controllers; single-process equivalent writes .status directly) ----
+    # --- per-pod status CRs (reference: apis/status/v1beta1 + the 7
+    # status controllers, e.g. constraintstatus_controller.go:251) -------
     def _set_status(self, obj: dict, error: Optional[str] = None,
                     created: bool = False) -> None:
-        status = obj.setdefault("status", {})
-        by_pod = status.setdefault("byPod", [{}])
-        entry = by_pod[0]
-        entry["id"] = "gatekeeper-tpu-0"
-        entry["observedGeneration"] = deep_get(
-            obj, ("metadata", "generation"), 1)
+        """Write THIS pod's status as a namespaced *PodStatus object; the
+        status fold (_reconcile_podstatus, running in every replica)
+        aggregates all pods' entries into the parent's .status.byPod —
+        the reference's multi-replica coordination substrate (no leader
+        election; per-pod CRs avoid write contention)."""
+        group, version, kind = gvk_of(obj)
+        status_kind = STATUS_KIND_FOR.get(
+            kind if kind in STATUS_KIND_FOR else group)
+        name = name_of(obj)
+        namespace = deep_get(obj, ("metadata", "namespace"), "") or ""
+        if status_kind is None or not name:
+            return
+        entry = {
+            "id": self.pod_name,
+            "observedGeneration": deep_get(
+                obj, ("metadata", "generation"), 1),
+            "operations": sorted(self.operations),
+        }
         if error is not None:
             entry["errors"] = [{"message": error}]
-        else:
-            entry.pop("errors", None)
-        if created:
-            status["created"] = True
+        pod_status = {
+            "apiVersion": f"{STATUS_GROUP}/{STATUS_VERSION}",
+            "kind": status_kind,
+            "metadata": {
+                "name": f"{self.pod_name}-{kind}-{name}".lower(),
+                "namespace": "gatekeeper-system",
+                "labels": {
+                    "internal.gatekeeper.sh/pod": self.pod_name,
+                    "internal.gatekeeper.sh/parent-kind": kind,
+                    "internal.gatekeeper.sh/parent-name": name,
+                    "internal.gatekeeper.sh/parent-group": group,
+                    "internal.gatekeeper.sh/parent-version": version,
+                    "internal.gatekeeper.sh/parent-namespace": namespace,
+                },
+            },
+            "status": {**entry, "created": created},
+        }
+        existing = self.cluster.get(
+            (STATUS_GROUP, STATUS_VERSION, status_kind),
+            "gatekeeper-system", pod_status["metadata"]["name"])
+        if existing is not None and \
+                existing.get("status") == pod_status["status"]:
+            # unchanged PodStatus won't fire the watch, but the PARENT may
+            # have been rewritten without status (spec update): refold
+            self._fold_parent(status_kind, kind, name, group, version,
+                              namespace)
+            return
+        self.cluster.apply(pod_status)
+
+    def _delete_pod_status(self, obj: dict) -> None:
+        group, version, kind = gvk_of(obj)
+        status_kind = STATUS_KIND_FOR.get(
+            kind if kind in STATUS_KIND_FOR else group)
+        name = name_of(obj)
+        if status_kind is None or not name:
+            return
+        self.cluster.delete({
+            "apiVersion": f"{STATUS_GROUP}/{STATUS_VERSION}",
+            "kind": status_kind,
+            "metadata": {
+                "name": f"{self.pod_name}-{kind}-{name}".lower(),
+                "namespace": "gatekeeper-system",
+            },
+        })
+
+    def _reconcile_podstatus(self, event: Event) -> None:
+        """Fold every pod's *PodStatus for one parent into the parent's
+        .status.byPod (the reference's status controllers)."""
+        labels = deep_get(event.obj, ("metadata", "labels"), {}) or {}
+        p_kind = labels.get("internal.gatekeeper.sh/parent-kind", "")
+        p_name = labels.get("internal.gatekeeper.sh/parent-name", "")
+        p_group = labels.get("internal.gatekeeper.sh/parent-group", "")
+        p_version = labels.get("internal.gatekeeper.sh/parent-version", "")
+        p_ns = labels.get("internal.gatekeeper.sh/parent-namespace", "")
+        if not p_kind or not p_name:
+            return
+        _g, _v, status_kind = gvk_of(event.obj)
+        self._fold_parent(status_kind, p_kind, p_name, p_group, p_version,
+                          p_ns)
+
+    def _fold_parent(self, status_kind, p_kind, p_name, p_group,
+                     p_version, p_namespace: str = "") -> None:
+        entries = []
+        created = False
+        for ps in self.cluster.list(
+                (STATUS_GROUP, STATUS_VERSION, status_kind)):
+            pl = deep_get(ps, ("metadata", "labels"), {}) or {}
+            if pl.get("internal.gatekeeper.sh/parent-kind") != p_kind or \
+                    pl.get("internal.gatekeeper.sh/parent-name") != p_name:
+                continue
+            st = dict(ps.get("status") or {})
+            created = created or bool(st.pop("created", False))
+            entries.append(st)
+        entries.sort(key=lambda e: e.get("id", ""))
+        parent = self.cluster.get((p_group, p_version, p_kind),
+                                  p_namespace, p_name)
+        if parent is None:
+            return
+        status = dict(parent.get("status") or {})
+        if status.get("byPod") == entries and \
+                status.get("created", False) == created:
+            return  # converged: break the reconcile echo
+        status["byPod"] = entries
+        status["created"] = created
+        updated = dict(parent)
+        updated["status"] = status
+        self.cluster.apply(updated)
 
     def template_error(self, name: str) -> Optional[str]:
         return self._template_errors.get(name)

@@ -84,6 +84,8 @@ class ValidationHandler:
         event_sink=None,
         metrics=None,
         fail_open: bool = False,
+        trace_config=None,  # callable -> list of Config trace entries
+        log_stats: bool = False,  # --log-stats-admission
     ):
         self.client = client
         self.expansion_system = expansion_system
@@ -94,6 +96,8 @@ class ValidationHandler:
         self.event_sink = event_sink
         self.metrics = metrics
         self.fail_open = fail_open
+        self.trace_config = trace_config
+        self.log_stats = log_stats
 
     # --- the handler (reference: validationHandler.Handle, policy.go:139) -
     def handle(self, review_body: dict) -> ValidationResponse:
@@ -210,9 +214,63 @@ class ValidationHandler:
         return resp
 
     def _review(self, augmented):
-        if self.batcher is not None:
-            return self.batcher.review(augmented)
-        return self.client.review(augmented, enforcement_point=WEBHOOK_EP)
+        req = augmented.admission_request
+        trace = self._trace_for(req)
+        if trace is None and self.batcher is not None:
+            # hot path: stats ride the coalesced batch (the Batcher's own
+            # stats flag); only TRACED requests bypass it — per-request
+            # tracing doesn't coalesce (policy.go:632-675)
+            responses = self.batcher.review(augmented)
+            if self.log_stats:
+                self._log_stats(responses)
+            return responses
+        responses = self.client.review(
+            augmented, enforcement_point=WEBHOOK_EP,
+            tracing=trace is not None, stats=self.log_stats,
+        )
+        from gatekeeper_tpu.utils.logging import log_event
+
+        if trace is not None:
+            log_event("info", "admission trace",
+                      event_type="admission_trace",
+                      request_user=(req.user_info or {}).get(
+                          "username", ""),
+                      resource_kind=(req.kind or {}).get("kind", ""),
+                      trace_dump=responses.trace_dump())
+            if str(trace.get("dump", "")).lower() == "all":
+                log_event("info", "cache dump",
+                          event_type="admission_trace_dump",
+                          dump=str(self.client.dump()))
+        if self.log_stats:
+            self._log_stats(responses)
+        return responses
+
+    def _log_stats(self, responses) -> None:
+        from gatekeeper_tpu.utils.logging import log_event
+
+        for entry in getattr(responses, "stats_entries", []) or []:
+            log_event("info", "admission stats",
+                      event_type="admission_stats",
+                      scope=entry.scope,
+                      stats_for=entry.stats_for,
+                      stats=[(s.name, s.value) for s in entry.stats])
+
+    def _trace_for(self, req) -> Optional[dict]:
+        """Config spec.validation.traces[] lookup (config_types.go:42-54:
+        both user and kind must match)."""
+        if self.trace_config is None:
+            return None
+        username = (req.user_info or {}).get("username", "")
+        kind = req.kind or {}
+        for t in self.trace_config() or []:
+            if t.get("user", "") != username:
+                continue
+            want = t.get("kind") or {}
+            if (want.get("group", "") == kind.get("group", "")
+                    and want.get("version", "") == kind.get("version", "")
+                    and want.get("kind", "") == kind.get("kind", "")):
+                return t
+        return None
 
     # --- deny/warn partition (reference: getValidationMessages,
     # policy.go:205-355) --------------------------------------------------
@@ -285,10 +343,12 @@ class Batcher:
     the small-batch low-latency lane, audit the big-batch lane).
     """
 
-    def __init__(self, client, window_s: float = 0.003, max_batch: int = 64):
+    def __init__(self, client, window_s: float = 0.003, max_batch: int = 64,
+                 stats: bool = False):
         self.client = client
         self.window_s = window_s
         self.max_batch = max_batch
+        self.stats = stats
         self._queue: queue.Queue = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -331,7 +391,8 @@ class Batcher:
             reviews = [b[0] for b in batch]
             try:
                 all_responses = self.client.review_batch(
-                    reviews, enforcement_point=WEBHOOK_EP
+                    reviews, enforcement_point=WEBHOOK_EP,
+                    stats=self.stats,
                 )
                 for (_, done, slot), responses in zip(batch, all_responses):
                     # per-slot isolation: one bad request must not poison the

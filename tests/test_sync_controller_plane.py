@@ -92,8 +92,13 @@ def test_manager_template_error_cancels_readiness():
     assert mgr.tracker.satisfied()  # cancelled, not wedged
     assert "lowercase" in mgr.template_error(
         (bad.get("metadata") or {}).get("name"))
-    # status carries the error (per-pod status equivalent)
-    assert bad["status"]["byPod"][0]["errors"]
+    # the error travels via this pod's *PodStatus CR, folded into the
+    # parent's .status.byPod by the status controller
+    name = (bad.get("metadata") or {}).get("name")
+    stored = cluster.get(
+        ("templates.gatekeeper.sh", "v1", "ConstraintTemplate"), "", name)
+    assert stored["status"]["byPod"][0]["errors"]
+    assert stored["status"]["byPod"][0]["id"] == mgr.pod_name
 
 
 def test_manager_excluder_wipe_and_replay():
@@ -497,3 +502,49 @@ def test_warn_log_sampling():
     finally:
         gklog._warn_sampler = old
         gklog._logger.removeHandler(handler)
+
+
+def test_two_replicas_fold_per_pod_status():
+    """Two replicas (distinct pod names) sharing one cluster: each writes
+    its own *PodStatus CR; the status controllers fold BOTH entries into
+    the parent's .status.byPod without write contention (reference
+    multi-replica model, constraintstatus_controller.go:251)."""
+    from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.controller.manager import Manager
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.sync.source import FakeCluster
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+
+    cluster = FakeCluster()
+
+    def replica(pod_name, ops):
+        client = Client(target=K8sValidationTarget(),
+                        drivers=[TpuDriver()],
+                        enforcement_points=[WEBHOOK_EP,
+                                            "audit.gatekeeper.sh"])
+        return Manager(client, cluster, operations=ops,
+                       pod_name=pod_name).start()
+
+    mgr_a = replica("gatekeeper-audit-0", ["audit"])
+    mgr_b = replica("gatekeeper-webhook-0", ["webhook"])
+
+    t = load_yaml_file(
+        "/root/reference/demo/basic/templates/"
+        "k8srequiredlabels_template.yaml")[0]
+    cluster.apply(t)
+    name = t["metadata"]["name"]
+    gvk = ("templates.gatekeeper.sh", t["apiVersion"].split("/")[1],
+           "ConstraintTemplate")
+    stored = cluster.get(gvk, "", name)
+    by_pod = stored["status"]["byPod"]
+    assert [e["id"] for e in by_pod] == [
+        "gatekeeper-audit-0", "gatekeeper-webhook-0"]
+    assert by_pod[0]["operations"] == ["audit"]
+    assert by_pod[1]["operations"] == ["webhook"]
+    assert stored["status"]["created"] is True
+    # a replica's pod-status update converges (no reconcile echo storm):
+    # re-applying the same template leaves byPod unchanged
+    cluster.apply(dict(t))
+    stored2 = cluster.get(gvk, "", name)
+    assert stored2["status"]["byPod"] == by_pod

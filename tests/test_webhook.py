@@ -286,3 +286,30 @@ def test_metrics_endpoint_and_request_counters():
             in body
     finally:
         srv.stop()
+
+
+def test_admission_trace_and_stats(caplog):
+    """Config spec.validation.traces[]: a matching (user, GVK) request is
+    reviewed with tracing and its TraceDump logged (policy.go:632-675);
+    --log-stats-admission logs per-request engine stats."""
+    import logging
+
+    client = make_client()
+    traces = [{"user": "alice", "kind": {"group": "", "version": "v1",
+                                         "kind": "Namespace"},
+               "dump": "All"}]
+    handler = ValidationHandler(
+        client, trace_config=lambda: traces, log_stats=True)
+    review = admission_review(ns("bad"), username="alice")
+    with caplog.at_level(logging.INFO):
+        out = handler.handle(review)
+    assert out.allowed is False
+    text = caplog.text
+    assert "admission_trace" in text
+    assert "admission_trace_dump" in text  # dump: All
+    assert "admission_stats" in text
+    # a non-matching user reviews without tracing
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        handler.handle(admission_review(ns("bad"), username="bob"))
+    assert "admission_trace" not in caplog.text
