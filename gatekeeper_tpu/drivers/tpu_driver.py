@@ -93,6 +93,7 @@ class TpuDriver:
         self._inv_cache: dict = {}  # kind -> (versions, cols, exact)
         self._render_specs: dict = {}  # kind -> Optional[list[(spec, col)]]
         self._render_idx: dict = {}  # spec.key() -> (version, value -> entries)
+        self._dev_cache: dict = {}  # host array id -> device array (bounded)
         self.batch_bucket = batch_bucket
 
     # --- Driver protocol (delegating lifecycle to the exact engine) ------
@@ -430,7 +431,8 @@ class TpuDriver:
             cons = by_kind[kind]
             table = build_param_table(prog.program, cons, self.vocab)
             grid = prog.run(batch, table, vocab=self.vocab,
-                            extra_cols=self.inventory_cols(kind)[0])
+                            extra_cols=self.inventory_cols(kind)[0],
+                            dev_cache=self._dev_cache)
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )

@@ -1136,16 +1136,49 @@ class CompiledProgram:
 
     def run(self, batch: ColumnBatch, param_table: dict,
             vocab: Optional[Vocab] = None,
-            extra_cols: Optional[dict] = None) -> np.ndarray:
+            extra_cols: Optional[dict] = None,
+            dev_cache: Optional[dict] = None) -> np.ndarray:
         """Returns verdicts [C, N] (numpy bool).  ``extra_cols``: shared
-        non-batch arrays (inventory join tables)."""
+        non-batch arrays (inventory join tables).  ``dev_cache``: host
+        array -> device array memo shared ACROSS programs evaluating the
+        same batch (and across batches for the persistent vocab tables) —
+        without it, a many-template query_batch re-uploads every column
+        once per template."""
+
+        def conv(a):
+            if dev_cache is None:
+                return jnp.asarray(a)
+            return _dev_cached(dev_cache, a)
+
         cols = jax.tree.map(
-            jnp.asarray,
+            conv,
             slim_cols(pack_batch_cols(batch), needed_fields(self.program)))
         if vocab is not None:
             for k, v in vocab_tables(self.program, vocab).items():
-                cols[k] = jnp.asarray(v)
+                cols[k] = conv(v)
         for k, v in (extra_cols or {}).items():
-            cols[k] = jnp.asarray(v)
+            cols[k] = conv(v)
         out = self._fn(param_table, cols)
         return np.asarray(out)
+
+
+_DEV_CACHE_CAP = 4096
+_DEV_CACHE_LOCK = __import__("threading").Lock()
+
+
+def _dev_cached(cache: dict, a):
+    """Bounded id-keyed host→device LRU memo; holds a ref to the host
+    array so ids can't be reused while an entry lives.  Lock-guarded: the
+    webhook batcher thread and the audit thread share one driver."""
+    key = id(a)
+    with _DEV_CACHE_LOCK:
+        hit = cache.pop(key, None)
+        if hit is not None and hit[0] is a:
+            cache[key] = hit  # re-insert = move to the recent end
+            return hit[1]
+    dev = jnp.asarray(a)
+    with _DEV_CACHE_LOCK:
+        cache[key] = (a, dev)
+        while len(cache) > _DEV_CACHE_CAP:
+            cache.pop(next(iter(cache)), None)
+    return dev

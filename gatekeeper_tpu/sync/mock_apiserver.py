@@ -75,7 +75,10 @@ class MockApiServer:
             def do_DELETE(self):
                 outer._handle_write(self, "DELETE")
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 128
+
+        self._server = _Server(("127.0.0.1", 0), Handler)
         self._thread: Optional[threading.Thread] = None
 
     # --- lifecycle ----------------------------------------------------

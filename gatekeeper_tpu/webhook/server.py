@@ -69,6 +69,12 @@ class WebhookServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: the default 1.0 closes the connection
+            # after every response, which resets concurrent clients
+            # mid-reuse (every response sets Content-Length, as 1.1
+            # persistence requires)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
@@ -118,6 +124,11 @@ class WebhookServer:
                 self.wfile.write(data)
 
             def do_POST(self):
+                if self.headers.get("Content-Length") is None:
+                    # keep-alive connections would desync on an undrained
+                    # chunked body: require a length (411)
+                    self._reply(411, {"error": "Content-Length required"})
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
                 try:
@@ -181,7 +192,12 @@ class WebhookServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # the socketserver default backlog of 5 resets bursts of
+            # concurrent connects (the apiserver opens many at once)
+            request_queue_size = 128
+
+        self._server = _Server((host, port), Handler)
         self._certfile, self._keyfile = certfile, keyfile
         self._ssl_ctx = None
         if certfile:

@@ -313,3 +313,50 @@ def test_admission_trace_and_stats(caplog):
     with caplog.at_level(logging.INFO):
         handler.handle(admission_review(ns("bad"), username="bob"))
     assert "admission_trace" not in caplog.text
+
+
+def test_concurrent_keepalive_connections(server):
+    """Serving-layer regression (round-2 load test findings): HTTP/1.1
+    keep-alive must hold across concurrent persistent connections, and
+    the listen backlog must absorb a 48-connection burst without resets."""
+    import http.client
+
+    results = []
+    errors = []
+    lock = threading.Lock()
+
+    def worker(wid):
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", server.port,
+                                           timeout=30)
+            sock = None
+            for i in range(6):
+                body = json.dumps(admission_review(
+                    ns(f"w{wid}-{i}", {"gatekeeper": "x"}))).encode()
+                c.request("POST", "/v1/admit", body=body,
+                          headers={"Content-Type": "application/json"})
+                r = json.loads(c.getresponse().read())
+                # true keep-alive: the SAME socket across requests
+                # (http.client silently reconnects on server close, which
+                # would mask an HTTP/1.0 regression)
+                if sock is None:
+                    sock = c.sock
+                    assert sock is not None
+                else:
+                    assert c.sock is sock, "connection was not kept alive"
+                with lock:
+                    results.append(r["response"]["allowed"])
+            c.close()
+        except Exception as e:
+            with lock:
+                errors.append(f"{wid}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(48)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert len(results) == 48 * 6
+    assert all(results)  # labeled namespaces admit
