@@ -121,9 +121,22 @@ class Manager:
                 print(f"boot list {gvk}: {e}", file=sys.stderr)  # noqa: T201
                 return []
 
-        for obj in boot_list(TEMPLATES_GVK):
+        boot_templates = boot_list(TEMPLATES_GVK)
+        for obj in boot_templates:
             self.tracker.expect("templates", name_of(obj))
         self.tracker.populated("templates")
+        # per-template constraint listers (reference: SingleRunner listers
+        # per template kind, ready_tracker.go:326): each pre-existing
+        # template's constraints become expectations, observed as the
+        # dynamic watches reconcile them
+        for obj in boot_templates:
+            ckind = deep_get(obj,
+                             ("spec", "crd", "spec", "names", "kind"), "")
+            if not ckind:
+                continue
+            for con in boot_list((CONSTRAINTS_GROUP, "v1beta1", ckind)):
+                self.tracker.expect("constraints",
+                                    (ckind, name_of(con)))
         for gvk, kind in ((CONFIG_GVK, "config"),
                           (EXPANSION_GVK, "expansions"),
                           (PROVIDER_GVK, "providers")):
@@ -194,12 +207,19 @@ class Manager:
                 cancel = self._constraint_watches.pop(kind, None)
                 if cancel:
                     cancel()
+                self._prune_constraints_of(kind)
+            # a template deleted before its boot expectation was observed
+            # must not wedge /readyz (reference CancelExpect on delete)
+            self.tracker.try_cancel("templates", name)
             return
         try:
             crd = self.client.add_template(event.obj)
         except Exception as e:
             # compile failure: cancel the readiness expectation
-            # (constrainttemplate_controller.go:391,484)
+            # (constrainttemplate_controller.go:391,484) and prune the
+            # kind's constraint expectations (they can never be observed)
+            self._prune_constraints_of(deep_get(
+                event.obj, ("spec", "crd", "spec", "names", "kind"), ""))
             self.tracker.try_cancel("templates", name)
             self._template_errors[name] = str(e)
             self._set_status(event.obj, error=str(e))
@@ -226,9 +246,18 @@ class Manager:
                 )
         self._set_status(event.obj, created=True)
 
+    def _prune_constraints_of(self, kind: str) -> None:
+        """The kind's constraint expectations die with its template."""
+        if kind:
+            self.tracker.prune("constraints", lambda k: k[0] == kind)
+
     def _reconcile_constraint(self, event: Event) -> None:
         if event.type == DELETED:
             self.client.remove_constraint(event.obj)
+            # deleted before observed must not wedge readiness
+            self.tracker.try_cancel(
+                "constraints",
+                (event.obj.get("kind", ""), name_of(event.obj)))
         else:
             self.client.add_constraint(event.obj)
             self.tracker.observe(

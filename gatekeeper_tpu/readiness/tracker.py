@@ -38,6 +38,18 @@ class ObjectTracker:
             self._cancelled.add(key)
             self._expected.discard(key)
 
+    def prune(self, predicate) -> int:
+        """Cancel every expectation matching ``predicate`` — the
+        ExpectationsPruner: expectations for objects whose parent/watch
+        went away must not wedge readiness (reference:
+        pkg/readiness/pruner/pruner.go:28-58).  Returns pruned count."""
+        with self._lock:
+            doomed = [k for k in self._expected if predicate(k)]
+            for k in doomed:
+                self._cancelled.add(k)
+                self._expected.discard(k)
+            return len(doomed)
+
     def expectations_done(self) -> None:
         with self._lock:
             self._populated = True
@@ -81,6 +93,9 @@ class Tracker:
 
     def populated(self, kind: str) -> None:
         self._trackers[kind].expectations_done()
+
+    def prune(self, kind: str, predicate) -> int:
+        return self._trackers[kind].prune(predicate)
 
     def all_populated(self) -> None:
         for t in self._trackers.values():

@@ -51,7 +51,22 @@ class CacheManager:
         for gvk in current - wanted:
             self._cancels.pop(gvk)()
             self._remove_gvk_data(gvk)
+            if self.readiness_tracker is not None:
+                # ExpectationsPruner (pruner.go:48-58): data expectations
+                # for a GVK nobody watches anymore can never be observed
+                self.readiness_tracker.prune(
+                    "data", lambda k, g=gvk: k[0] == g)
         for gvk in wanted - current:
+            # seed data expectations from current state (the reference's
+            # boot-time data trackers, ready_tracker.go:326); the replay
+            # below observes them immediately when they sync
+            if self.readiness_tracker is not None:
+                try:
+                    for obj in self.cluster.list(gvk):
+                        self.readiness_tracker.expect(
+                            "data", _obj_key(obj))
+                except Exception:
+                    pass  # listing races/missing CRDs: watch retries
             self._cancels[gvk] = self.cluster.subscribe(
                 gvk, self._on_event, replay=True
             )
@@ -64,11 +79,17 @@ class CacheManager:
         if event.type == DELETED:
             self.client.remove_data(obj)
             self._synced.discard(key)
+            if self.readiness_tracker is not None:
+                self.readiness_tracker.try_cancel("data", key)
         else:
             if ns and self.excluder.is_excluded("sync", ns):
                 # excluded namespaces never reach the eval-plane inventory
                 self.client.remove_data(obj)
                 self._synced.discard(key)
+                if self.readiness_tracker is not None:
+                    # a seeded expectation for an excluded object can
+                    # never be observed
+                    self.readiness_tracker.try_cancel("data", key)
                 return
             self.client.add_data(obj)
             self._synced.add(key)

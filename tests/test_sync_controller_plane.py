@@ -548,3 +548,71 @@ def test_two_replicas_fold_per_pod_status():
     cluster.apply(dict(t))
     stored2 = cluster.get(gvk, "", name)
     assert stored2["status"]["byPod"] == by_pod
+
+
+def test_readiness_constraint_listers_and_pruner():
+    """Boot with pre-existing template + constraints: the constraints
+    become expectations (per-template listers); deleting the template
+    prunes them (ExpectationsPruner) instead of wedging /readyz."""
+    client = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                    enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
+    cluster = FakeCluster()
+    t = load_yaml_file(
+        "/root/reference/demo/basic/templates/"
+        "k8srequiredlabels_template.yaml")[0]
+    con = {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": ["owner"]}},
+    }
+    ghost = {**con, "metadata": {"name": "never-reconciled"}}
+    cluster.apply(t)
+    cluster.apply(con)
+    cluster.apply(ghost)
+    mgr = Manager(client, cluster).start()
+    mgr.tracker.all_populated()
+    # both constraints were expected; the dynamic watch observed them
+    assert mgr.tracker.satisfied()
+    st = mgr.tracker.stats()["constraints"]
+    assert st["expected"] == 2 and st["observed"] >= 2
+
+    # a template whose kind never compiles: its constraint expectations
+    # prune away rather than wedge
+    client2 = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                     enforcement_points=[WEBHOOK_EP])
+    cluster2 = FakeCluster()
+    bad = load_yaml_file(
+        "/root/reference/demo/basic/bad/bad_template.yaml")[0]
+    bad_kind = bad["spec"]["crd"]["spec"]["names"]["kind"]
+    cluster2.apply(bad)
+    cluster2.apply({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": bad_kind, "metadata": {"name": "orphan"}, "spec": {},
+    })
+    mgr2 = Manager(client2, cluster2).start()
+    mgr2.tracker.all_populated()
+    assert mgr2.tracker.satisfied()  # pruned, not wedged
+
+
+def test_readiness_data_pruner_on_watch_removal():
+    """Unwatching a GVK prunes its data expectations (pruner.go:48-58)."""
+    client, cluster, mgr = make_manager()
+    mgr.tracker.for_kind("data")._populated = False
+    mgr.tracker.expect(
+        "data", ((("", "v1", "Secret")), "default", "ghost"))
+    mgr.tracker.populated("data")
+    cluster.apply({
+        "apiVersion": "config.gatekeeper.sh/v1alpha1",
+        "kind": "Config", "metadata": {"name": "config"},
+        "spec": {"sync": {"syncOnly": [
+            {"group": "", "version": "v1", "kind": "Secret"}]}},
+    })
+    assert not mgr.tracker.for_kind("data").satisfied()  # ghost expected
+    # stop syncing Secrets: the expectation can never be observed -> prune
+    cluster.apply({
+        "apiVersion": "config.gatekeeper.sh/v1alpha1",
+        "kind": "Config", "metadata": {"name": "config"},
+        "spec": {"sync": {"syncOnly": []}},
+    })
+    assert mgr.tracker.for_kind("data").satisfied()
