@@ -141,17 +141,19 @@ def main(argv=None) -> int:
                else KubeConfig.from_kubeconfig(args.kubeconfig))
         kube_cluster = cluster = KubeCluster(cfg)
         print(f"informer plane: apiserver {cfg.server}", file=sys.stderr)
-        if args.management_manifests:
-            p.error("--management-manifests (remote-cluster routing) is "
-                    "not supported together with --kubeconfig yet")
     else:
         cluster = FakeCluster()
     if args.management_manifests:
+        # remote-cluster mode: gatekeeper-internal state (status group +
+        # Secrets) lives on the management side; everything else — incl. a
+        # live --kubeconfig apiserver — is the target
         from gatekeeper_tpu.sync.routing import RoutingCluster
 
         mgmt = FakeCluster()
         FileSource(args.management_manifests).populate(mgmt)
         cluster = RoutingCluster(mgmt, cluster)
+        if kube_cluster is not None:
+            kube_cluster = cluster  # audit discovery routes via the target
     export = ExportSystem()
     if args.export_dir:
         export.upsert_connection("disk", "disk", {"path": args.export_dir})

@@ -57,3 +57,20 @@ class RoutingCluster:
     def subscribe(self, gvk: tuple, callback: Callable[[Event], None],
                   replay: bool = False):
         return self._for(gvk).subscribe(gvk, callback, replay=replay)
+
+    # --- live-target passthroughs (KubeCluster surface) ---------------
+    def server_preferred_gvks(self) -> list:
+        """Discovery spans the TARGET cluster (audit sweeps its objects;
+        management holds only gatekeeper-internal state)."""
+        return self.target.server_preferred_gvks()
+
+    def list_iter(self, gvk: tuple):
+        src = self._for(gvk)
+        if hasattr(src, "list_iter"):
+            return src.list_iter(gvk)
+        return iter(src.list(gvk))
+
+    def close(self):
+        for c in (self.management, self.target):
+            if hasattr(c, "close"):
+                c.close()

@@ -199,3 +199,30 @@ users:
     assert cfg.server == "https://example:6443"
     assert cfg.token == "sekrit"
     assert open(cfg.ca_file, "rb").read() == b"CA PEM"
+
+
+def test_routing_cluster_over_live_target(server, cluster):
+    """--management-manifests x --kubeconfig: the RoutingCluster keeps
+    gatekeeper-internal state (status group, Secrets) on the management
+    side while audit listing/discovery spans the live target."""
+    from gatekeeper_tpu.sync.routing import RoutingCluster
+    from gatekeeper_tpu.sync.source import FakeCluster
+
+    mgmt = FakeCluster()
+    routed = RoutingCluster(mgmt, cluster)
+    server.put_object(pod("t1"))
+    assert POD_GVK in routed.server_preferred_gvks()
+    assert [o["metadata"]["name"] for o in routed.list_iter(POD_GVK)] == \
+        ["t1"]
+    status_obj = {
+        "apiVersion": "status.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplatePodStatus",
+        "metadata": {"name": "pod-x", "namespace": "gatekeeper-system"},
+        "status": {"id": "pod-x"},
+    }
+    routed.apply(status_obj)  # routes to management, NOT the apiserver
+    assert mgmt.get(("status.gatekeeper.sh", "v1beta1",
+                     "ConstraintTemplatePodStatus"),
+                    "gatekeeper-system", "pod-x") is not None
+    assert ("ConstraintTemplatePodStatus" not in
+            [k for (k, _ns, _n) in server._objects])
