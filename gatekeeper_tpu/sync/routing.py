@@ -18,13 +18,19 @@ from gatekeeper_tpu.sync.source import Event, gvk_of
 STATUS_GROUP = "status.gatekeeper.sh"
 
 
-def _routes_to_management(gvk: tuple) -> bool:
+OPERATOR_NAMESPACE = "gatekeeper-system"
+
+
+def _routes_to_management(gvk: tuple, namespace: str = None) -> bool:
     group, _version, kind = gvk
     if group == STATUS_GROUP:
         return True
-    # local Secrets hold the webhook serving certs (cert rotation writes
-    # them where the pod runs)
-    return (group, kind) == ("", "Secret")
+    # LOCAL Secrets (the operator namespace: webhook serving certs) live
+    # management-side; the target cluster's Secrets are ordinary audited
+    # objects (ref pkg/routing routes the operator-local secret only)
+    if (group, kind) == ("", "Secret"):
+        return namespace is None or namespace == OPERATOR_NAMESPACE
+    return False
 
 
 class RoutingCluster:
@@ -35,28 +41,46 @@ class RoutingCluster:
         self.management = management
         self.target = target
 
-    def _for(self, gvk: tuple):
-        return self.management if _routes_to_management(gvk) else self.target
+    def _for(self, gvk: tuple, namespace: str = None):
+        return (self.management
+                if _routes_to_management(gvk, namespace) else self.target)
 
     def apply(self, obj: dict) -> None:
-        self._for(gvk_of(obj)).apply(obj)
+        from gatekeeper_tpu.utils.unstructured import namespace_of
+
+        self._for(gvk_of(obj), namespace_of(obj)).apply(obj)
 
     def delete(self, obj: dict) -> None:
-        self._for(gvk_of(obj)).delete(obj)
+        from gatekeeper_tpu.utils.unstructured import namespace_of
+
+        self._for(gvk_of(obj), namespace_of(obj)).delete(obj)
 
     def get(self, gvk: tuple, namespace: str, name: str) -> Optional[dict]:
-        return self._for(gvk).get(gvk, namespace, name)
+        return self._for(gvk, namespace).get(gvk, namespace, name)
 
     def list(self, gvk: Optional[tuple] = None) -> list:
         if gvk is not None:
-            return self._for(gvk).list(gvk)
+            # collection-level routing has no namespace: Secret lists span
+            # the TARGET (audit must see the real cluster's Secrets) —
+            # only the status group is management-only
+            group = gvk[0]
+            src = self.management if group == STATUS_GROUP else self.target
+            return src.list(gvk)
         # unfiltered list spans both clusters (management state is
-        # gatekeeper-internal and comes last)
-        return list(self.target.list()) + list(self.management.list())
+        # gatekeeper-internal and comes last); a live target has no
+        # unfiltered list — iterate its discovered GVKs
+        if hasattr(self.target, "server_preferred_gvks"):
+            out = []
+            for gvk_t in self.target.server_preferred_gvks():
+                out.extend(self.target.list(gvk_t))
+        else:
+            out = list(self.target.list())
+        return out + list(self.management.list())
 
     def subscribe(self, gvk: tuple, callback: Callable[[Event], None],
                   replay: bool = False):
-        return self._for(gvk).subscribe(gvk, callback, replay=replay)
+        src = self.management if gvk[0] == STATUS_GROUP else self.target
+        return src.subscribe(gvk, callback, replay=replay)
 
     # --- live-target passthroughs (KubeCluster surface) ---------------
     def server_preferred_gvks(self) -> list:
@@ -65,7 +89,7 @@ class RoutingCluster:
         return self.target.server_preferred_gvks()
 
     def list_iter(self, gvk: tuple):
-        src = self._for(gvk)
+        src = self.management if gvk[0] == STATUS_GROUP else self.target
         if hasattr(src, "list_iter"):
             return src.list_iter(gvk)
         return iter(src.list(gvk))
