@@ -121,6 +121,7 @@ class TpuDriver:
                 self.vocab,
                 schema_hint=template.parameters_schema,
             )
+            self._trial_param_table(program, template.kind)
             self._programs[template.kind] = CompiledProgram(program)
             self._lower_errors.pop(template.kind, None)
         except LowerError as e:
@@ -128,6 +129,16 @@ class TpuDriver:
             self._lower_errors[template.kind] = str(e)
         self._inv_cache.pop(template.kind, None)
         self._render_specs.pop(template.kind, None)
+
+    def _trial_param_table(self, program, kind: str) -> None:
+        """Compile-time dry run of build_param_table with a synthetic
+        empty constraint: structural table errors (e.g. an unbound
+        param-list element needle the lowering missed) surface HERE as a
+        LowerError — falling back to the exact engine — instead of
+        erroring every query at serve time (ADVICE r2 high)."""
+        trial = Constraint(kind=kind, name="__lower_trial__", match={},
+                           parameters={}, enforcement_action="deny")
+        build_param_table(program, [trial], self.vocab)
 
     def _add_cel_template(self, template: ConstraintTemplate) -> None:
         from gatekeeper_tpu.ir.lower_cel import lower_cel_template
@@ -140,6 +151,7 @@ class TpuDriver:
                 compiled, template.kind, self.vocab,
                 schema_hint=template.parameters_schema,
             )
+            self._trial_param_table(program, template.kind)
             self._programs[template.kind] = CompiledProgram(program)
             self._lower_errors.pop(template.kind, None)
         except LowerError as e:
@@ -426,13 +438,15 @@ class TpuDriver:
         flatten_ns = time.perf_counter_ns() - tf
         eval_ns = 0
         te = time.perf_counter_ns()
+        batch_memo: dict = {}  # this batch's uploads, shared across kinds
         for kind in lowered_kinds:
             prog = self._programs[kind]
             cons = by_kind[kind]
             table = build_param_table(prog.program, cons, self.vocab)
             grid = prog.run(batch, table, vocab=self.vocab,
                             extra_cols=self.inventory_cols(kind)[0],
-                            dev_cache=self._dev_cache)
+                            dev_cache=self._dev_cache,
+                            batch_cache=batch_memo)
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )

@@ -771,6 +771,8 @@ class _CelLowerer:
             tp, fp = self.bool_pair(ast.body, sub_env)
             tp = self._bind_elem_needles(tp, target.name)
             fp = self._bind_elem_needles(fp, target.name)
+            self._assert_no_bare_elem(tp)
+            self._assert_no_bare_elem(fp)
             if ast.name == "all":
                 return (N.Not(N.AnyParamList(target.name, N.Not(tp))),
                         N.AnyParamList(target.name, fp))
@@ -782,7 +784,17 @@ class _CelLowerer:
 
     def _bind_elem_needles(self, expr: N.Expr, param: str) -> N.Expr:
         """Rewrite bare ParamElemSid StrPred needles to the table-backed
-        _ElemListSid marker (build_param_table's strlist path)."""
+        _ElemListSid marker (build_param_table's strlist path).
+
+        Recurses through every composite the macro body can produce —
+        including AnyAxis/NestedAny, so an object-list macro nested inside
+        a param-list macro (e.g. ``params.prefixes.exists(p,
+        object.spec.containers.all(c, c.image.startsWith(p)))``) binds its
+        needle; the kernel evaluates the [N, M, K] grid (eval_expr's
+        elem-needle StrPred path handles the extra axis).  Any needle left
+        bare after this pass would raise in build_param_table on EVERY
+        query, so _assert_no_bare_elem turns that into a lowering-time
+        fallback instead (ADVICE r2 high)."""
         if isinstance(expr, N.StrPred) and \
                 isinstance(expr.needle, N.ParamElemSid):
             return N.StrPred(expr.op, expr.subject, _ElemListSid(param))
@@ -794,7 +806,30 @@ class _CelLowerer:
         if isinstance(expr, N.Or):
             return N.Or(tuple(self._bind_elem_needles(t, param)
                               for t in expr.terms))
+        if isinstance(expr, N.AnyAxis):
+            return N.AnyAxis(expr.axis,
+                             self._bind_elem_needles(expr.inner, param))
+        if isinstance(expr, N.NestedAny):
+            return N.NestedAny(expr.col, expr.parent_col,
+                               self._bind_elem_needles(expr.inner, param))
         return expr
+
+    def _assert_no_bare_elem(self, expr: N.Expr) -> None:
+        """LowerError if a bare ParamElemSid StrPred needle survived
+        binding (a composite _bind_elem_needles doesn't know) — the
+        template then falls back to the CEL evaluator instead of
+        compiling a program that errors at query time."""
+        if isinstance(expr, N.StrPred) and \
+                isinstance(expr.needle, N.ParamElemSid):
+            raise LowerError("unbound param-list element needle")
+        for f in getattr(expr, "__dataclass_fields__", {}):
+            v = getattr(expr, f)
+            if isinstance(v, N.Expr):
+                self._assert_no_bare_elem(v)
+            elif isinstance(v, tuple):
+                for t in v:
+                    if isinstance(t, N.Expr):
+                        self._assert_no_bare_elem(t)
 
     def _call_pair(self, ast: C.Call, env: dict) -> tuple:
         if ast.target is None:

@@ -1031,7 +1031,13 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             ctx.axis = None
         if getattr(inner, "ndim", 0) < 2:
             # item-independent inner (e.g. ConstBool): ∃item ⇔ inner ∧ count>0
-            return jnp.asarray(inner) & (counts > 0)
+            # counts is a raw [N] column — under an elem (K) context it must
+            # carry the trailing size-1 axis or broadcasting misaligns N
+            # against K (found by the nested param/object macro repro)
+            base = counts > 0
+            if ctx.elem_k is not None:
+                base = base[..., None]
+            return jnp.asarray(inner) & base
         m = inner.shape[1]
         valid = jnp.arange(m) < counts[:, None]
         if inner.ndim == 3:
@@ -1137,27 +1143,40 @@ class CompiledProgram:
     def run(self, batch: ColumnBatch, param_table: dict,
             vocab: Optional[Vocab] = None,
             extra_cols: Optional[dict] = None,
-            dev_cache: Optional[dict] = None) -> np.ndarray:
+            dev_cache: Optional[dict] = None,
+            batch_cache: Optional[dict] = None) -> np.ndarray:
         """Returns verdicts [C, N] (numpy bool).  ``extra_cols``: shared
-        non-batch arrays (inventory join tables).  ``dev_cache``: host
-        array -> device array memo shared ACROSS programs evaluating the
-        same batch (and across batches for the persistent vocab tables) —
-        without it, a many-template query_batch re-uploads every column
-        once per template."""
+        non-batch arrays (inventory join tables).
 
-        def conv(a):
+        Two memo scopes (ADVICE r2: one LRU for both leaked per-batch
+        device arrays across audits):
+        - ``dev_cache``: persistent host->device LRU for arrays that
+          recur ACROSS batches — vocab pred/fn tables, inventory join
+          tables.
+        - ``batch_cache``: per-query memo for THIS batch's columns,
+          shared across the per-kind programs evaluating the same batch
+          (a many-template query_batch would otherwise re-upload every
+          column once per template); dies with the query, so chunk
+          columns can never pin device memory."""
+
+        def conv_batch(a):
+            if batch_cache is None:
+                return jnp.asarray(a)
+            return _dev_cached(batch_cache, a)
+
+        def conv_shared(a):
             if dev_cache is None:
                 return jnp.asarray(a)
             return _dev_cached(dev_cache, a)
 
         cols = jax.tree.map(
-            conv,
+            conv_batch,
             slim_cols(pack_batch_cols(batch), needed_fields(self.program)))
         if vocab is not None:
             for k, v in vocab_tables(self.program, vocab).items():
-                cols[k] = conv(v)
+                cols[k] = conv_shared(v)
         for k, v in (extra_cols or {}).items():
-            cols[k] = conv(v)
+            cols[k] = conv_shared(v)
         out = self._fn(param_table, cols)
         return np.asarray(out)
 

@@ -20,6 +20,8 @@ Design notes
 
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Sequence
 
@@ -234,6 +236,9 @@ class ColumnBatch:
     kind_sid: np.ndarray = None
     ns_sid: np.ndarray = None
     name_sid: np.ndarray = None
+    # uint8 [N] metadata.generateName presence (native JSON path only;
+    # lets mask building skip materializing RawJSON objects)
+    has_generate_name: np.ndarray = None
 
     def arrays(self) -> dict[str, np.ndarray]:
         """Stable name -> array mapping (the device-transfer payload)."""
@@ -337,11 +342,13 @@ def _synth_review(obj: dict) -> dict:
 
     group, version, kind = gvk_of(obj)
     meta = obj.get("metadata") or {}
+    nm = meta.get("name", "")
+    ns = meta.get("namespace", "")
     return {
         "kind": {"group": group, "version": version, "kind": kind},
         "operation": "",
-        "name": meta.get("name", "") or "",
-        "namespace": meta.get("namespace", "") or "",
+        "name": nm if isinstance(nm, str) else "",
+        "namespace": ns if isinstance(ns, str) else "",
     }
 
 
@@ -365,6 +372,26 @@ class Flattener:
         """``reviews``: per-object review documents (kind/operation/...)
         backing __review__-rooted scalar columns; synthesized from the
         objects when not supplied (the audit path)."""
+        if objects:
+            from gatekeeper_tpu.utils.rawjson import RawJSON
+
+            if self.use_native and all(isinstance(o, RawJSON)
+                                       for o in objects):
+                from gatekeeper_tpu.ops import native
+
+                if native.load_json() is not None:
+                    # materialized (possibly mutated) RawJSONs are
+                    # re-serialized inside flatten_raw, so the lane stays
+                    # correct for mixed batches
+                    return self.flatten_raw(objects, pad_n=pad_n,
+                                            reviews=reviews)
+            # the C dict columnizer reads dict storage directly
+            # (PyDict_GetItem), bypassing RawJSON's lazy __getitem__ —
+            # materialize before the dict path so laziness can't read as
+            # an empty object
+            for o in objects:
+                if isinstance(o, RawJSON):
+                    o._load()
         review_cols = [c for c in self.schema.scalars
                        if c.path[:1] == ("__review__",)]
         ragged_keysets = list(getattr(self.schema, "ragged_keysets", []))
@@ -398,16 +425,7 @@ class Flattener:
         if review_cols:
             if reviews is None:
                 reviews = [_synth_review(o) for o in objects]
-            n = batch.n
-            for spec in review_cols:
-                kind = np.zeros(n, np.int8)
-                num = np.zeros(n, np.float32)
-                sid = np.full(n, -1, np.int32)
-                for i, rdoc in enumerate(reviews):
-                    val, ok = _walk(rdoc, spec.path[1:])
-                    if ok:
-                        kind[i], num[i], sid[i] = _classify(val, self.vocab)
-                batch.scalars[spec] = ScalarColumn(kind, num, sid)
+            self._fill_review_cols(batch, review_cols, reviews)
         for mk in getattr(self.schema, "map_keys", []):
             if mk in batch.map_keys:
                 continue  # the native flattener already extracted it
@@ -482,6 +500,100 @@ class Flattener:
             batch.ragged_keysets[rk] = RaggedKeySetColumn(sid, count)
         return batch
 
+    def flatten_raw(self, raws: Sequence,
+                    pad_n: Optional[int] = None,
+                    reviews: Optional[Sequence[dict]] = None) -> ColumnBatch:
+        """Columnarize raw JSON documents (bytes or RawJSON) without ever
+        materializing Python dicts: the threaded native module
+        (native/flattenjsonmod.c) parses and columnizes with the GIL
+        released.  Semantics match ``flatten`` exactly (differential-tested
+        in tests/test_native_flatten.py); falls back to parse+flatten when
+        the native module is unavailable."""
+        from gatekeeper_tpu.utils.rawjson import RawJSON
+
+        from gatekeeper_tpu.ops import native
+
+        mod = native.load_json() if self.use_native else None
+        if mod is None:
+            objects = [o if isinstance(o, dict) else RawJSON(bytes(o))
+                       for o in raws]
+            return self.flatten(objects, pad_n=pad_n, reviews=reviews)
+        schema = self.schema
+        axes = schema.axes()
+        axis_index = {a: i for i, a in enumerate(axes)}
+        items = []
+        for o in raws:
+            if isinstance(o, RawJSON) and not o._loaded:
+                items.append(o.raw)
+            elif isinstance(o, (bytes, bytearray, memoryview)):
+                items.append(bytes(o))
+            else:
+                # plain dict, or a materialized RawJSON whose dict state
+                # may have diverged from .raw — serialize current state
+                items.append(json.dumps(o, separators=(",", ":")).encode())
+        nthreads = int(os.environ.get("GTPU_FLATTEN_THREADS", "0") or 0) \
+            or (os.cpu_count() or 1)
+        out = mod.flatten_json_batch(
+            items,
+            [tuple(s.path) for s in schema.scalars],
+            [a.segments for a in axes],
+            [(axis_index[r.axis], tuple(r.subpath))
+             for r in schema.raggeds],
+            [tuple(k.path) for k in schema.keysets],
+            [axis_index[mk.axis] for mk in schema.map_keys],
+            [(axis_index[p.axis], axis_index[p.parent])
+             for p in schema.parent_idx],
+            [(axis_index[rk.axis], tuple(rk.subpath))
+             for rk in schema.ragged_keysets],
+            self.vocab._to_id,
+            self.vocab._to_str,
+            int(pad_n or len(items)),
+            8,  # ragged bucket, matches round_up()
+            nthreads,
+        )
+        n = max(pad_n or 0, len(items))
+        batch = ColumnBatch(n=n, scalars={}, raggeds={}, axis_counts={},
+                            keysets={})
+        (batch.group_sid, batch.kind_sid, batch.ns_sid, batch.name_sid,
+         batch.has_generate_name) = out["identity"]
+        for spec, (kind, num, sid) in zip(schema.scalars, out["scalars"]):
+            batch.scalars[spec] = ScalarColumn(kind, num, sid)
+        for axis, cnt in zip(axes, out["axes"]):
+            batch.axis_counts[axis] = cnt
+        for spec, (kind, num, sid) in zip(schema.raggeds, out["raggeds"]):
+            batch.raggeds[spec] = RaggedColumn(kind, num, sid)
+        for spec, (sid, cnt) in zip(schema.keysets, out["keysets"]):
+            batch.keysets[spec] = KeySetColumn(sid, cnt)
+        for spec, sid in zip(schema.map_keys, out["map_keys"]):
+            batch.map_keys[spec] = MapKeyColumn(sid)
+        for spec, idx in zip(schema.parent_idx, out["parent_idx"]):
+            batch.parent_idx[spec] = ParentIdxColumn(idx)
+        for spec, (sid, cnt) in zip(schema.ragged_keysets,
+                                    out["ragged_keysets"]):
+            batch.ragged_keysets[spec] = RaggedKeySetColumn(sid, cnt)
+        if reviews is not None:
+            # provided review docs override the synthesized columns
+            self._fill_review_cols(
+                batch,
+                [c for c in schema.scalars
+                 if c.path[:1] == ("__review__",)],
+                reviews)
+        return batch
+
+    def _fill_review_cols(self, batch: ColumnBatch, specs, reviews) -> None:
+        """(Re)fill __review__-rooted scalar columns from review docs —
+        the single definition shared by the dict and JSON lanes."""
+        n = batch.n
+        for spec in specs:
+            kind = np.zeros(n, np.int8)
+            num = np.zeros(n, np.float32)
+            sid = np.full(n, -1, np.int32)
+            for i, rdoc in enumerate(reviews):
+                val, ok = _walk(rdoc, spec.path[1:])
+                if ok:
+                    kind[i], num[i], sid[i] = _classify(val, self.vocab)
+            batch.scalars[spec] = ScalarColumn(kind, num, sid)
+
     def _flatten_native(self, mod, objects: Sequence[dict],
                         pad_n: Optional[int]) -> ColumnBatch:
         """Columnarize via the C extension (native/flattenmod.c); layout and
@@ -539,10 +651,13 @@ class Flattener:
         for i, obj in enumerate(objects):
             group, _, kind = gvk_of(obj)
             meta = obj.get("metadata") or {}
+            ns = meta.get("namespace", "")
+            nm = meta.get("name", "")
             batch.group_sid[i] = vocab.intern(group)
             batch.kind_sid[i] = vocab.intern(kind)
-            batch.ns_sid[i] = vocab.intern(meta.get("namespace", "") or "")
-            batch.name_sid[i] = vocab.intern(meta.get("name", "") or "")
+            batch.ns_sid[i] = vocab.intern(ns if isinstance(ns, str) else "")
+            batch.name_sid[i] = vocab.intern(
+                nm if isinstance(nm, str) else "")
 
         for spec in self.schema.scalars:
             kind = np.zeros(n, np.int8)

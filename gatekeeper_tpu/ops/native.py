@@ -14,49 +14,62 @@ from typing import Optional
 
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                           "build")
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
-                    "flattenmod.c")
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 
-_mod = None
-_tried = False
+_mods: dict = {}
+_tried: set = set()
+
+
+def _load_named(name: str, src_file: str) -> Optional[object]:
+    if name in _mods or name in _tried:
+        return _mods.get(name)
+    _tried.add(name)
+    if not os.path.exists(os.path.join(_NATIVE_DIR, src_file)):
+        # no source (installed wheel): the prebuilt module is the only
+        # option.  When the source IS present, go through _build so its
+        # mtime staleness check runs even if a sibling module already put
+        # native/build on sys.path (an edited .c must not silently run as
+        # the previous binary)
+        try:
+            import importlib
+
+            _mods[name] = importlib.import_module(name)
+            return _mods[name]
+        except ImportError:
+            pass
+    try:
+        _mods[name] = _build(name, src_file)
+    except subprocess.CalledProcessError as e:
+        sys.stderr.write(
+            f"{name} build failed ({e}):\n{e.stderr}\n"
+            "using Python flattener\n"
+        )
+        _mods[name] = None
+    except Exception as e:  # build env problems -> Python fallback
+        sys.stderr.write(f"{name} build failed ({e}); "
+                         "using Python flattener\n")
+        _mods[name] = None
+    return _mods[name]
 
 
 def load() -> Optional[object]:
-    """Returns the gtpu_flatten module, building it on first use."""
-    global _mod, _tried
-    if _mod is not None or _tried:
-        return _mod
-    _tried = True
-    try:
-        import gtpu_flatten  # already importable (built earlier)
-
-        _mod = gtpu_flatten
-        return _mod
-    except ImportError:
-        pass
-    try:
-        _mod = _build()
-    except subprocess.CalledProcessError as e:
-        sys.stderr.write(
-            f"gtpu_flatten build failed ({e}):\n{e.stderr}\n"
-            "using Python flattener\n"
-        )
-        _mod = None
-    except Exception as e:  # build env problems -> Python fallback
-        sys.stderr.write(f"gtpu_flatten build failed ({e}); "
-                         "using Python flattener\n")
-        _mod = None
-    return _mod
+    """The dict-walking columnizer (native/flattenmod.c)."""
+    return _load_named("gtpu_flatten", "flattenmod.c")
 
 
-def _build():
+def load_json() -> Optional[object]:
+    """The threaded JSON columnizer (native/flattenjsonmod.c)."""
+    return _load_named("gtpu_flattenjson", "flattenjsonmod.c")
+
+
+def _build(name: str, src_file: str):
     import numpy as np
 
-    src = os.path.abspath(_SRC)
+    src = os.path.abspath(os.path.join(_NATIVE_DIR, src_file))
     out_dir = os.path.abspath(_BUILD_DIR)
     os.makedirs(out_dir, exist_ok=True)
     ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    out = os.path.join(out_dir, "gtpu_flatten" + ext)
+    out = os.path.join(out_dir, name + ext)
     if not os.path.exists(out) or (
         os.path.getmtime(out) < os.path.getmtime(src)
     ):
@@ -66,7 +79,7 @@ def _build():
         np_include = np.get_include()
         cmd = (
             cc.split()
-            + ["-O3", "-shared", "-fPIC", src, "-o", out,
+            + ["-O3", "-shared", "-fPIC", "-pthread", src, "-o", out,
                f"-I{include}", f"-I{np_include}"]
             + [f for f in cflags if f.startswith("-f") or f.startswith("-m")]
         )
@@ -75,4 +88,4 @@ def _build():
         sys.path.insert(0, out_dir)
     import importlib
 
-    return importlib.import_module("gtpu_flatten")
+    return importlib.import_module(name)

@@ -224,8 +224,14 @@ class ShardedEvaluator:
                 needs.setdefault(ck, set()).update(fields)
         cols = slim_cols(cols, needs)
 
-        any_gen = any(
-            "generateName" in (o.get("metadata") or {}) for o in objects)
+        if batch.has_generate_name is not None:
+            # native JSON lane: presence came back as a column — avoids
+            # materializing RawJSON objects just for this scan
+            any_gen = bool(batch.has_generate_name[:n].any())
+        else:
+            any_gen = any(
+                "generateName" in (o.get("metadata") or {})
+                for o in objects)
         kinds = tuple(sorted(lowered))
         k = self.violations_limit
         tables = []

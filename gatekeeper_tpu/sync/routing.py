@@ -65,7 +65,21 @@ class RoutingCluster:
             # only the status group is management-only
             group = gvk[0]
             src = self.management if group == STATUS_GROUP else self.target
-            return src.list(gvk)
+            out = src.list(gvk)
+            if (group, gvk[2]) == ("", "Secret"):
+                # writes to operator-local Secrets (webhook certs) routed
+                # management-side — merge them so a component that writes
+                # the cert Secret sees its own write in a list (ADVICE r2).
+                # Management WINS for the operator namespace: the target
+                # cluster may run its own gatekeeper whose same-named cert
+                # Secret must not show up as a duplicate identity
+                from gatekeeper_tpu.utils.unstructured import namespace_of
+
+                out = [o for o in out
+                       if namespace_of(o) != OPERATOR_NAMESPACE]
+                out += [o for o in self.management.list(gvk)
+                        if namespace_of(o) == OPERATOR_NAMESPACE]
+            return out
         # unfiltered list spans both clusters (management state is
         # gatekeeper-internal and comes last); a live target has no
         # unfiltered list — iterate its discovered GVKs
@@ -79,6 +93,11 @@ class RoutingCluster:
 
     def subscribe(self, gvk: tuple, callback: Callable[[Event], None],
                   replay: bool = False):
+        # NOTE: Secret WATCHES are target-only (unlike list(), which merges
+        # operator-local management Secrets): components needing the cert
+        # Secret must use get() — a watch will not observe management-side
+        # writes.  Matches the reference, where the cert-controller reads
+        # its secret with a direct client, not via the informer plane.
         src = self.management if gvk[0] == STATUS_GROUP else self.target
         return src.subscribe(gvk, callback, replay=replay)
 

@@ -1,0 +1,151 @@
+"""RawJSON: a lazy dict proxy over raw JSON bytes.
+
+The audit sweep's host bottleneck is JSON-dict materialization + dict
+walking (~15µs/object on one core, ROADMAP.md "Performance levers").  The
+threaded native flattener (native/flattenjsonmod.c) columnizes raw bytes
+directly with the GIL released — but the surrounding planes (match slow
+paths, message rendering for hits, expansion) still expect dict objects.
+
+``RawJSON`` bridges the two: it subclasses ``dict`` (so every
+``isinstance(o, dict)`` check in the target/match/mutation planes holds)
+but stays *empty* until first access, at which point it parses ``raw``
+once and self-populates.  The flatten fast path recognizes the class and
+reads ``.raw`` without ever triggering the parse; only slow-path matchers
+and violation rendering — a tiny fraction of a sweep — pay for
+materialization.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class RawJSON(dict):
+    """Lazy dict view of one JSON document (bytes)."""
+
+    __slots__ = ("raw", "_loaded")
+
+    def __init__(self, raw: bytes):
+        super().__init__()
+        self.raw = raw
+        self._loaded = False
+
+    def _load(self):
+        if not self._loaded:
+            self._loaded = True
+            obj = json.loads(self.raw)
+            if isinstance(obj, dict):
+                dict.update(self, obj)
+
+    # -- read AND write accessors trigger the parse -----------------------
+    # (a write before the parse would otherwise be silently overwritten
+    # when a later read triggers _load's dict.update; and the mutation
+    # plane's clear()/update() restore pattern must see loaded state)
+    def __getitem__(self, k):
+        self._load()
+        return dict.__getitem__(self, k)
+
+    def __setitem__(self, k, v):
+        self._load()
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        self._load()
+        dict.__delitem__(self, k)
+
+    def update(self, *args, **kwargs):
+        self._load()
+        dict.update(self, *args, **kwargs)
+
+    def setdefault(self, k, default=None):
+        self._load()
+        return dict.setdefault(self, k, default)
+
+    def pop(self, *args):
+        self._load()
+        return dict.pop(self, *args)
+
+    def popitem(self):
+        self._load()
+        return dict.popitem(self)
+
+    def clear(self):
+        self._load()  # mark loaded so raw can't resurrect cleared keys
+        dict.clear(self)
+
+    def get(self, k, default=None):
+        self._load()
+        return dict.get(self, k, default)
+
+    def __contains__(self, k):
+        self._load()
+        return dict.__contains__(self, k)
+
+    def __iter__(self):
+        self._load()
+        return dict.__iter__(self)
+
+    def __len__(self):
+        self._load()
+        return dict.__len__(self)
+
+    def __bool__(self):
+        self._load()
+        return dict.__len__(self) > 0
+
+    def keys(self):
+        self._load()
+        return dict.keys(self)
+
+    def values(self):
+        self._load()
+        return dict.values(self)
+
+    def items(self):
+        self._load()
+        return dict.items(self)
+
+    def __eq__(self, other):
+        self._load()
+        if isinstance(other, RawJSON):
+            other._load()
+        return dict.__eq__(self, other)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):  # dicts are unhashable; keep that behavior
+        raise TypeError("unhashable type: 'RawJSON'")
+
+    def copy(self):
+        self._load()
+        return dict(self)
+
+    def __reduce__(self):
+        # a materialized (possibly mutated) instance must round-trip its
+        # CURRENT dict state — reconstructing from .raw would silently
+        # revert mutations under copy/deepcopy/pickle
+        if not self._loaded:
+            return (RawJSON, (self.raw,))
+        return (_restore_loaded, (self.raw, dict(self)))
+
+    def __repr__(self):
+        if not self._loaded:
+            return f"RawJSON(<{len(self.raw)} bytes, unparsed>)"
+        return f"RawJSON({dict.__repr__(self)})"
+
+
+def _restore_loaded(raw: bytes, state: dict) -> "RawJSON":
+    r = RawJSON(raw)
+    r._loaded = True
+    dict.update(r, state)
+    return r
+
+
+def as_raw(obj) -> "RawJSON":
+    """Wrap a dict (serializing once) or bytes into a RawJSON."""
+    if isinstance(obj, RawJSON):
+        return obj
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return RawJSON(bytes(obj))
+    return RawJSON(json.dumps(obj, separators=(",", ":")).encode())

@@ -57,6 +57,10 @@ def gvk_of(obj: dict) -> tuple[str, str, str]:
     """
     api_version = obj.get("apiVersion", "") or ""
     kind = obj.get("kind", "") or ""
+    if not isinstance(api_version, str):  # tolerate malformed docs
+        api_version = ""
+    if not isinstance(kind, str):
+        kind = ""
     if "/" in api_version:
         group, version = api_version.split("/", 1)
     else:

@@ -126,15 +126,33 @@ class WebhookServer:
             def do_POST(self):
                 if self.headers.get("Content-Length") is None:
                     # keep-alive connections would desync on an undrained
-                    # chunked body: require a length (411)
-                    self._reply(411, {"error": "Content-Length required"})
+                    # chunked body: require a length (411) AND close — a
+                    # kept-alive socket would parse the undrained body
+                    # bytes as the next request line (ADVICE r2)
+                    self._reply(411, {"error": "Content-Length required"},
+                                close=True)
                     return
-                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    self._reply(400, {"error": "bad Content-Length"},
+                                close=True)
+                    return
+                if length < 0 or length > 64 * 1024 * 1024:
+                    # negative would make rfile.read block until client
+                    # EOF (thread leak); cap mirrors the apiserver's
+                    # admission payload bound
+                    self._reply(400 if length < 0 else 413,
+                                {"error": "bad Content-Length"}, close=True)
+                    return
                 raw = self.rfile.read(length) if length else b""
                 try:
                     body = json.loads(raw or b"{}")
                 except json.JSONDecodeError:
-                    self._reply(400, {"error": "invalid JSON body"})
+                    # the declared length may have lied: don't trust the
+                    # stream position for another request
+                    self._reply(400, {"error": "invalid JSON body"},
+                                close=True)
                     return
                 uid = ((body.get("request") or {}).get("uid", "")) or ""
                 try:
@@ -184,11 +202,18 @@ class WebhookServer:
                     r.uid or uid, r.allowed, r.message, r.code
                 ))
 
-            def _reply(self, status: int, payload: dict):
+            def _reply(self, status: int, payload: dict,
+                       close: bool = False):
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if close:
+                    # send_header("Connection", "close") also sets
+                    # close_connection so handle() drops the socket after
+                    # this response — undrained request bodies can't
+                    # desync a kept-alive connection
+                    self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -1,0 +1,1969 @@
+/* gtpu_flattenjson: threaded, GIL-released JSON -> columnar flattener.
+ *
+ * The dict-walking columnizer (flattenmod.c) can never release the GIL:
+ * it touches PyObjects on every step, which caps a host at ~65k objects/s
+ * (one core) -- below the 100k reviews/s/chip target of BASELINE.md even
+ * with an infinitely fast device.  This module moves the host->device
+ * boundary to raw JSON bytes: each batch item is parsed and columnized
+ * entirely in C with the GIL released, sharded over a pthread pool.
+ *
+ * Interning is three-phase so ids stay consistent with the shared Python
+ * Vocab (ops/flatten.py) without a lock on the hot path:
+ *   1. (no GIL, threads) parse + columnize; strings intern into
+ *      per-thread tables, sid cells hold thread-local ids.
+ *   2. (GIL) per-thread tables merge into the Python vocab in
+ *      deterministic (thread, first-seen) order -> local->global maps.
+ *   3. (no GIL, threads) sid arrays remap in-place per row range.
+ *
+ * Semantics mirror ops/flatten.py exactly (differential-tested in
+ * tests/test_native_flatten.py) -- the Python flattener remains the
+ * oracle.  Reference anchor for the loop this replaces: the audit
+ * spill-review loop, /root/reference/pkg/audit/manager.go:686-774.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <math.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+/* value-kind tags (must match ops/flatten.py) */
+enum { K_ABSENT = 0, K_FALSE = 1, K_TRUE = 2, K_NUM = 3, K_STR = 4,
+       K_OTHER = 5, K_NULL = 6, K_MAP = 7 };
+
+/* ---------------- arena ---------------- */
+
+typedef struct ArenaBlock {
+    struct ArenaBlock *next;
+    size_t used, cap;
+    char data[];
+} ArenaBlock;
+
+typedef struct {
+    ArenaBlock *head;
+} Arena;
+
+static void *
+arena_alloc(Arena *a, size_t sz)
+{
+    sz = (sz + 15) & ~(size_t)15;
+    if (a->head == NULL || a->head->used + sz > a->head->cap) {
+        size_t cap = 1 << 20;
+        if (cap < sz)
+            cap = sz;
+        ArenaBlock *b = (ArenaBlock *)malloc(sizeof(ArenaBlock) + cap);
+        if (b == NULL)
+            return NULL;
+        b->next = a->head;
+        b->used = 0;
+        b->cap = cap;
+        a->head = b;
+    }
+    void *p = a->head->data + a->head->used;
+    a->head->used += sz;
+    return p;
+}
+
+static void
+arena_free(Arena *a)
+{
+    ArenaBlock *b = a->head;
+    while (b) {
+        ArenaBlock *n = b->next;
+        free(b);
+        b = n;
+    }
+    a->head = NULL;
+}
+
+/* ---------------- per-thread string interner ---------------- */
+
+typedef struct {
+    const char **strs;   /* local id -> ptr */
+    uint32_t *lens;      /* local id -> len */
+    uint32_t count, scap;
+    int32_t *tab;        /* open addressing; value = local id + 1 */
+    uint32_t *tabhash;
+    uint32_t cap;        /* power of two */
+} Intern;
+
+static uint32_t
+fnv1a(const char *s, uint32_t n)
+{
+    uint32_t h = 2166136261u;
+    for (uint32_t i = 0; i < n; i++) {
+        h ^= (unsigned char)s[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+static int
+intern_init(Intern *it)
+{
+    it->cap = 1 << 12;
+    it->scap = 1 << 10;
+    it->count = 0;
+    it->strs = (const char **)malloc(it->scap * sizeof(char *));
+    it->lens = (uint32_t *)malloc(it->scap * sizeof(uint32_t));
+    it->tab = (int32_t *)calloc(it->cap, sizeof(int32_t));
+    it->tabhash = (uint32_t *)malloc(it->cap * sizeof(uint32_t));
+    return (it->strs && it->lens && it->tab && it->tabhash) ? 0 : -1;
+}
+
+static void
+intern_destroy(Intern *it)
+{
+    free(it->strs); free(it->lens); free(it->tab); free(it->tabhash);
+}
+
+static int
+intern_grow(Intern *it)
+{
+    uint32_t ncap = it->cap << 1;
+    int32_t *ntab = (int32_t *)calloc(ncap, sizeof(int32_t));
+    uint32_t *nhash = (uint32_t *)malloc(ncap * sizeof(uint32_t));
+    if (!ntab || !nhash) {
+        free(ntab); free(nhash);
+        return -1;
+    }
+    for (uint32_t i = 0; i < it->cap; i++) {
+        if (it->tab[i]) {
+            uint32_t h = it->tabhash[i];
+            uint32_t j = h & (ncap - 1);
+            while (ntab[j])
+                j = (j + 1) & (ncap - 1);
+            ntab[j] = it->tab[i];
+            nhash[j] = h;
+        }
+    }
+    free(it->tab); free(it->tabhash);
+    it->tab = ntab; it->tabhash = nhash; it->cap = ncap;
+    return 0;
+}
+
+/* returns local id, or -1 on OOM */
+static int32_t
+intern_get(Intern *it, const char *s, uint32_t n)
+{
+    uint32_t h = fnv1a(s, n);
+    uint32_t j = h & (it->cap - 1);
+    while (it->tab[j]) {
+        if (it->tabhash[j] == h) {
+            int32_t id = it->tab[j] - 1;
+            if (it->lens[id] == n && memcmp(it->strs[id], s, n) == 0)
+                return id;
+        }
+        j = (j + 1) & (it->cap - 1);
+    }
+    if (it->count == it->scap) {
+        it->scap <<= 1;
+        const char **ns = (const char **)realloc(
+            (void *)it->strs, it->scap * sizeof(char *));
+        uint32_t *nl = (uint32_t *)realloc(it->lens,
+                                           it->scap * sizeof(uint32_t));
+        if (!ns || !nl) {
+            if (ns) it->strs = ns;
+            if (nl) it->lens = nl;
+            return -1;
+        }
+        it->strs = ns; it->lens = nl;
+    }
+    int32_t id = (int32_t)it->count++;
+    it->strs[id] = s;
+    it->lens[id] = n;
+    it->tab[j] = id + 1;
+    it->tabhash[j] = h;
+    if (it->count * 2 > it->cap && intern_grow(it) < 0)
+        return -1;
+    return id;
+}
+
+/* ---------------- JSON DOM + parser ---------------- */
+
+enum { JT_NULL, JT_FALSE, JT_TRUE, JT_NUM, JT_STR, JT_ARR, JT_OBJ };
+
+typedef struct JNode JNode;
+struct JNode {
+    uint8_t type;
+    uint32_t n; /* children count (arr/obj) or byte length (str) */
+    union {
+        double num;
+        const char *str;
+        JNode **items;                 /* JT_ARR */
+        struct {
+            const char **keys;
+            uint32_t *klens;
+            JNode **vals;
+        } obj;                         /* JT_OBJ */
+    } u;
+};
+
+typedef struct {
+    const char *p, *end;
+    Arena *arena;
+    /* scratch stacks for building child arrays */
+    JNode **nstack;
+    const char **kstack;
+    uint32_t *lstack;
+    size_t stop, scap;
+    int err;
+} Parser;
+
+static int
+pstack_reserve(Parser *ps, size_t need)
+{
+    if (ps->stop + need <= ps->scap)
+        return 0;
+    size_t ncap = ps->scap ? ps->scap * 2 : 256;
+    while (ncap < ps->stop + need)
+        ncap *= 2;
+    JNode **nn = (JNode **)realloc((void *)ps->nstack,
+                                   ncap * sizeof(JNode *));
+    const char **nk = (const char **)realloc((void *)ps->kstack,
+                                             ncap * sizeof(char *));
+    uint32_t *nl = (uint32_t *)realloc(ps->lstack, ncap * sizeof(uint32_t));
+    if (!nn || !nk || !nl) {
+        if (nn) ps->nstack = nn;
+        if (nk) ps->kstack = nk;
+        if (nl) ps->lstack = nl;
+        return -1;
+    }
+    ps->nstack = nn; ps->kstack = nk; ps->lstack = nl; ps->scap = ncap;
+    return 0;
+}
+
+static void
+skip_ws(Parser *ps)
+{
+    const char *p = ps->p;
+    while (p < ps->end &&
+           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+        p++;
+    ps->p = p;
+}
+
+static JNode *
+jnode_new(Parser *ps, uint8_t type)
+{
+    JNode *n = (JNode *)arena_alloc(ps->arena, sizeof(JNode));
+    if (n == NULL) {
+        ps->err = 1;
+        return NULL;
+    }
+    n->type = type;
+    n->n = 0;
+    return n;
+}
+
+/* UTF-8 encode cp into out; returns bytes written */
+static int
+utf8_put(char *out, uint32_t cp)
+{
+    if (cp < 0x80) {
+        out[0] = (char)cp;
+        return 1;
+    } else if (cp < 0x800) {
+        out[0] = (char)(0xC0 | (cp >> 6));
+        out[1] = (char)(0x80 | (cp & 0x3F));
+        return 2;
+    } else if (cp < 0x10000) {
+        out[0] = (char)(0xE0 | (cp >> 12));
+        out[1] = (char)(0x80 | ((cp >> 6) & 0x3F));
+        out[2] = (char)(0x80 | (cp & 0x3F));
+        return 3;
+    }
+    out[0] = (char)(0xF0 | (cp >> 18));
+    out[1] = (char)(0x80 | ((cp >> 12) & 0x3F));
+    out[2] = (char)(0x80 | ((cp >> 6) & 0x3F));
+    out[3] = (char)(0x80 | (cp & 0x3F));
+    return 4;
+}
+
+static int
+hex4(const char *p, uint32_t *out)
+{
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) {
+        char c = p[i];
+        v <<= 4;
+        if (c >= '0' && c <= '9') v |= (uint32_t)(c - '0');
+        else if (c >= 'a' && c <= 'f') v |= (uint32_t)(c - 'a' + 10);
+        else if (c >= 'A' && c <= 'F') v |= (uint32_t)(c - 'A' + 10);
+        else return -1;
+    }
+    *out = v;
+    return 0;
+}
+
+/* parse a JSON string (after the opening quote); returns 0 ok.
+ * *sout/*nout point either into the input (no escapes) or an arena copy. */
+static int
+parse_string(Parser *ps, const char **sout, uint32_t *nout)
+{
+    const char *p = ps->p;
+    const char *start = p;
+    while (p < ps->end && *p != '"' && *p != '\\')
+        p++;
+    if (p >= ps->end)
+        return -1;
+    if (*p == '"') { /* fast path: no escapes */
+        *sout = start;
+        *nout = (uint32_t)(p - start);
+        ps->p = p + 1;
+        return 0;
+    }
+    /* slow path: decode escapes into arena buffer (<= raw length) */
+    size_t maxlen = 0;
+    {
+        const char *q = p;
+        int esc = 0;
+        while (q < ps->end) {
+            if (esc) esc = 0;
+            else if (*q == '\\') esc = 1;
+            else if (*q == '"') break;
+            q++;
+        }
+        if (q >= ps->end)
+            return -1;
+        maxlen = (size_t)(q - start) + 4;
+    }
+    char *buf = (char *)arena_alloc(ps->arena, maxlen);
+    if (buf == NULL)
+        return -1;
+    size_t o = (size_t)(p - start);
+    memcpy(buf, start, o);
+    while (p < ps->end && *p != '"') {
+        if (*p != '\\') {
+            buf[o++] = *p++;
+            continue;
+        }
+        p++;
+        if (p >= ps->end)
+            return -1;
+        char c = *p++;
+        switch (c) {
+        case '"': buf[o++] = '"'; break;
+        case '\\': buf[o++] = '\\'; break;
+        case '/': buf[o++] = '/'; break;
+        case 'b': buf[o++] = '\b'; break;
+        case 'f': buf[o++] = '\f'; break;
+        case 'n': buf[o++] = '\n'; break;
+        case 'r': buf[o++] = '\r'; break;
+        case 't': buf[o++] = '\t'; break;
+        case 'u': {
+            uint32_t cp;
+            if (p + 4 > ps->end || hex4(p, &cp) < 0)
+                return -1;
+            p += 4;
+            if (cp >= 0xD800 && cp <= 0xDBFF && p + 6 <= ps->end &&
+                p[0] == '\\' && p[1] == 'u') {
+                uint32_t lo;
+                if (hex4(p + 2, &lo) == 0 && lo >= 0xDC00 && lo <= 0xDFFF) {
+                    cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                    p += 6;
+                }
+            }
+            o += (size_t)utf8_put(buf + o, cp);
+            break;
+        }
+        default:
+            return -1;
+        }
+    }
+    if (p >= ps->end)
+        return -1;
+    ps->p = p + 1;
+    *sout = buf;
+    *nout = (uint32_t)o;
+    return 0;
+}
+
+static JNode *parse_value(Parser *ps, int depth);
+
+static JNode *
+parse_object(Parser *ps, int depth)
+{
+    /* collect keys/vals on the scratch stack, then copy to arena */
+    size_t base = ps->stop;
+    ps->p++; /* '{' */
+    skip_ws(ps);
+    if (ps->p < ps->end && *ps->p == '}') {
+        ps->p++;
+    } else {
+        for (;;) {
+            skip_ws(ps);
+            if (ps->p >= ps->end || *ps->p != '"')
+                return NULL;
+            ps->p++;
+            const char *ks;
+            uint32_t kn;
+            if (parse_string(ps, &ks, &kn) < 0)
+                return NULL;
+            skip_ws(ps);
+            if (ps->p >= ps->end || *ps->p != ':')
+                return NULL;
+            ps->p++;
+            JNode *v = parse_value(ps, depth + 1);
+            if (v == NULL)
+                return NULL;
+            /* duplicate key: last wins (json.loads semantics) */
+            int dup = 0;
+            for (size_t i = base; i < ps->stop; i++) {
+                if (ps->lstack[i] == kn &&
+                    memcmp(ps->kstack[i], ks, kn) == 0) {
+                    ps->nstack[i] = v;
+                    dup = 1;
+                    break;
+                }
+            }
+            if (!dup) {
+                if (pstack_reserve(ps, 1) < 0)
+                    return NULL;
+                ps->nstack[ps->stop] = v;
+                ps->kstack[ps->stop] = ks;
+                ps->lstack[ps->stop] = kn;
+                ps->stop++;
+            }
+            skip_ws(ps);
+            if (ps->p < ps->end && *ps->p == ',') {
+                ps->p++;
+                continue;
+            }
+            if (ps->p < ps->end && *ps->p == '}') {
+                ps->p++;
+                break;
+            }
+            return NULL;
+        }
+    }
+    JNode *n = jnode_new(ps, JT_OBJ);
+    if (n == NULL)
+        return NULL;
+    size_t cnt = ps->stop - base;
+    n->n = (uint32_t)cnt;
+    if (cnt) {
+        n->u.obj.keys = (const char **)arena_alloc(ps->arena,
+                                                   cnt * sizeof(char *));
+        n->u.obj.klens = (uint32_t *)arena_alloc(ps->arena,
+                                                 cnt * sizeof(uint32_t));
+        n->u.obj.vals = (JNode **)arena_alloc(ps->arena,
+                                              cnt * sizeof(JNode *));
+        if (!n->u.obj.keys || !n->u.obj.klens || !n->u.obj.vals)
+            return NULL;
+        memcpy((void *)n->u.obj.keys, ps->kstack + base,
+               cnt * sizeof(char *));
+        memcpy(n->u.obj.klens, ps->lstack + base, cnt * sizeof(uint32_t));
+        memcpy((void *)n->u.obj.vals, ps->nstack + base,
+               cnt * sizeof(JNode *));
+    }
+    ps->stop = base;
+    return n;
+}
+
+static JNode *
+parse_array(Parser *ps, int depth)
+{
+    size_t base = ps->stop;
+    ps->p++; /* '[' */
+    skip_ws(ps);
+    if (ps->p < ps->end && *ps->p == ']') {
+        ps->p++;
+    } else {
+        for (;;) {
+            JNode *v = parse_value(ps, depth + 1);
+            if (v == NULL)
+                return NULL;
+            if (pstack_reserve(ps, 1) < 0)
+                return NULL;
+            ps->nstack[ps->stop] = v;
+            ps->kstack[ps->stop] = NULL;
+            ps->lstack[ps->stop] = 0;
+            ps->stop++;
+            skip_ws(ps);
+            if (ps->p < ps->end && *ps->p == ',') {
+                ps->p++;
+                continue;
+            }
+            if (ps->p < ps->end && *ps->p == ']') {
+                ps->p++;
+                break;
+            }
+            return NULL;
+        }
+    }
+    JNode *n = jnode_new(ps, JT_ARR);
+    if (n == NULL)
+        return NULL;
+    size_t cnt = ps->stop - base;
+    n->n = (uint32_t)cnt;
+    if (cnt) {
+        n->u.items = (JNode **)arena_alloc(ps->arena, cnt * sizeof(JNode *));
+        if (n->u.items == NULL)
+            return NULL;
+        memcpy((void *)n->u.items, ps->nstack + base, cnt * sizeof(JNode *));
+    }
+    ps->stop = base;
+    return n;
+}
+
+static JNode *
+parse_value(Parser *ps, int depth)
+{
+    if (depth > 256)
+        return NULL;
+    skip_ws(ps);
+    if (ps->p >= ps->end)
+        return NULL;
+    char c = *ps->p;
+    if (c == '{')
+        return parse_object(ps, depth);
+    if (c == '[')
+        return parse_array(ps, depth);
+    if (c == '"') {
+        ps->p++;
+        JNode *n = jnode_new(ps, JT_STR);
+        if (n == NULL)
+            return NULL;
+        if (parse_string(ps, &n->u.str, &n->n) < 0)
+            return NULL;
+        return n;
+    }
+    if (c == 't') {
+        if (ps->end - ps->p < 4 || memcmp(ps->p, "true", 4) != 0)
+            return NULL;
+        ps->p += 4;
+        return jnode_new(ps, JT_TRUE);
+    }
+    if (c == 'f') {
+        if (ps->end - ps->p < 5 || memcmp(ps->p, "false", 5) != 0)
+            return NULL;
+        ps->p += 5;
+        return jnode_new(ps, JT_FALSE);
+    }
+    if (c == 'n') {
+        if (ps->end - ps->p < 4 || memcmp(ps->p, "null", 4) != 0)
+            return NULL;
+        ps->p += 4;
+        return jnode_new(ps, JT_NULL);
+    }
+    /* number (json.loads also accepts NaN/Infinity/-Infinity) */
+    if (c == 'N' && ps->end - ps->p >= 3 && memcmp(ps->p, "NaN", 3) == 0) {
+        ps->p += 3;
+        JNode *n = jnode_new(ps, JT_NUM);
+        if (n) n->u.num = NAN;
+        return n;
+    }
+    if (c == 'I' && ps->end - ps->p >= 8 &&
+        memcmp(ps->p, "Infinity", 8) == 0) {
+        ps->p += 8;
+        JNode *n = jnode_new(ps, JT_NUM);
+        if (n) n->u.num = HUGE_VAL;
+        return n;
+    }
+    if (c == '-' && ps->end - ps->p >= 9 &&
+        memcmp(ps->p, "-Infinity", 9) == 0) {
+        ps->p += 9;
+        JNode *n = jnode_new(ps, JT_NUM);
+        if (n) n->u.num = -HUGE_VAL;
+        return n;
+    }
+    if (c == '-' || (c >= '0' && c <= '9')) {
+        char *endp = NULL;
+        double d = strtod(ps->p, &endp);
+        if (endp == ps->p)
+            return NULL;
+        ps->p = endp;
+        JNode *n = jnode_new(ps, JT_NUM);
+        if (n) n->u.num = d;
+        return n;
+    }
+    return NULL;
+}
+
+/* parse one document; NULL on error.  Trailing garbage is an error
+ * (json.loads semantics). */
+static JNode *
+parse_doc(Parser *ps, const char *buf, Py_ssize_t len)
+{
+    ps->p = buf;
+    ps->end = buf + len;
+    ps->stop = 0;
+    JNode *n = parse_value(ps, 0);
+    if (n == NULL)
+        return NULL;
+    skip_ws(ps);
+    if (ps->p != ps->end)
+        return NULL;
+    return n;
+}
+
+/* ---------------- specs (converted from Python tuples, GIL-held) ------- */
+
+typedef struct {
+    const char **parts;
+    uint32_t *lens;
+    int n;
+} CPath;
+
+typedef struct {
+    CPath *paths; /* the "parts" of one segment */
+    int n;
+} CSeg;
+
+typedef struct {
+    CSeg *segs;
+    int n;
+} CAxis;
+
+typedef struct {
+    int axis;
+    CPath sub;
+} CRagged;
+
+typedef struct {
+    int child, parent; /* axis indices */
+} CParentSpec;
+
+typedef struct {
+    int axis;
+    CPath sub;
+} CRKSpec;
+
+/* ---------------- DOM helpers ---------------- */
+
+static JNode *
+obj_get(JNode *o, const char *k, uint32_t kn)
+{
+    if (o == NULL || o->type != JT_OBJ)
+        return NULL;
+    for (uint32_t i = 0; i < o->n; i++) {
+        if (o->u.obj.klens[i] == kn &&
+            memcmp(o->u.obj.keys[i], k, kn) == 0)
+            return o->u.obj.vals[i];
+    }
+    return NULL;
+}
+
+static JNode *
+jwalk(JNode *o, const CPath *path)
+{
+    JNode *cur = o;
+    for (int i = 0; i < path->n; i++) {
+        cur = obj_get(cur, path->parts[i], path->lens[i]);
+        if (cur == NULL)
+            return NULL;
+    }
+    return cur;
+}
+
+/* classify into (kind, num, local sid) with per-thread interning */
+static int
+jclassify(Intern *it, JNode *v, signed char *kind, float *num, int32_t *sid)
+{
+    *num = 0.0f;
+    *sid = -1;
+    switch (v->type) {
+    case JT_TRUE: *kind = K_TRUE; break;
+    case JT_FALSE: *kind = K_FALSE; break;
+    case JT_NUM: *kind = K_NUM; *num = (float)v->u.num; break;
+    case JT_STR: {
+        *kind = K_STR;
+        int32_t id = intern_get(it, v->u.str, v->n);
+        if (id < 0)
+            return -1;
+        *sid = id;
+        break;
+    }
+    case JT_NULL: *kind = K_NULL; break;
+    case JT_OBJ: *kind = K_MAP; break;
+    default: *kind = K_OTHER; break; /* array */
+    }
+    return 0;
+}
+
+/* growable (node, key) list used during axis collection */
+typedef struct {
+    JNode **items;
+    const char **keys;
+    uint32_t *klens;
+    size_t n, cap;
+} NKList;
+
+static int
+nklist_push(NKList *l, JNode *n, const char *k, uint32_t kn)
+{
+    if (l->n == l->cap) {
+        size_t ncap = l->cap ? l->cap * 2 : 64;
+        JNode **ni = (JNode **)realloc((void *)l->items,
+                                       ncap * sizeof(JNode *));
+        const char **nk = (const char **)realloc((void *)l->keys,
+                                                 ncap * sizeof(char *));
+        uint32_t *nl = (uint32_t *)realloc(l->klens,
+                                           ncap * sizeof(uint32_t));
+        if (!ni || !nk || !nl) {
+            if (ni) l->items = ni;
+            if (nk) l->keys = nk;
+            if (nl) l->klens = nl;
+            return -1;
+        }
+        l->items = ni; l->keys = nk; l->klens = nl; l->cap = ncap;
+    }
+    l->items[l->n] = n;
+    l->keys[l->n] = k;
+    l->klens[l->n] = kn;
+    l->n++;
+    return 0;
+}
+
+/* append items of one segment (mirrors collect_segment_keyed in
+ * flattenmod.c: lists extend values keyless; maps extend values with
+ * their keys). scratch a/b alternate as BFS levels. */
+static int
+jcollect_segment(JNode *root, const CSeg *seg, NKList *out,
+                 NKList *a, NKList *b)
+{
+    a->n = 0;
+    if (nklist_push(a, root, NULL, 0) < 0)
+        return -1;
+    NKList *level = a, *next = b;
+    for (int p = 0; p < seg->n; p++) {
+        next->n = 0;
+        for (size_t i = 0; i < level->n; i++) {
+            JNode *val = jwalk(level->items[i], &seg->paths[p]);
+            if (val == NULL)
+                continue;
+            if (val->type == JT_ARR) {
+                for (uint32_t j = 0; j < val->n; j++)
+                    if (nklist_push(next, val->u.items[j], NULL, 0) < 0)
+                        return -1;
+            } else if (val->type == JT_OBJ) {
+                for (uint32_t j = 0; j < val->n; j++)
+                    if (nklist_push(next, val->u.obj.vals[j],
+                                    val->u.obj.keys[j],
+                                    val->u.obj.klens[j]) < 0)
+                        return -1;
+            }
+        }
+        NKList *t = level;
+        level = next;
+        next = t;
+    }
+    for (size_t i = 0; i < level->n; i++)
+        if (nklist_push(out, level->items[i], level->keys[i],
+                        level->klens[i]) < 0)
+            return -1;
+    return 0;
+}
+
+/* sorted truthy keys of a map node (Rego {k | m[k]} semantics: value not
+ * false).  Byte-wise sort == code-point sort for UTF-8. */
+typedef struct {
+    const char *s;
+    uint32_t n;
+} KeyRef;
+
+static int
+keyref_cmp(const void *pa, const void *pb)
+{
+    const KeyRef *a = (const KeyRef *)pa, *b = (const KeyRef *)pb;
+    uint32_t m = a->n < b->n ? a->n : b->n;
+    int c = memcmp(a->s, b->s, m);
+    if (c)
+        return c;
+    return a->n < b->n ? -1 : (a->n > b->n ? 1 : 0);
+}
+
+/* collect truthy keys of map node into arena array; returns count */
+static int
+truthy_keys(Arena *arena, JNode *val, KeyRef **out)
+{
+    if (val == NULL || val->type != JT_OBJ) {
+        *out = NULL;
+        return 0;
+    }
+    KeyRef *keys = (KeyRef *)arena_alloc(arena,
+                                         (val->n ? val->n : 1) *
+                                         sizeof(KeyRef));
+    if (keys == NULL)
+        return -1;
+    int c = 0;
+    for (uint32_t i = 0; i < val->n; i++) {
+        if (val->u.obj.vals[i]->type == JT_FALSE)
+            continue;
+        keys[c].s = val->u.obj.keys[i];
+        keys[c].n = val->u.obj.klens[i];
+        c++;
+    }
+    qsort(keys, (size_t)c, sizeof(KeyRef), keyref_cmp);
+    *out = keys;
+    return c;
+}
+
+/* ---------------- work context ---------------- */
+
+typedef struct {
+    JNode **items;
+    const char **keys;
+    uint32_t *klens;
+    int count;
+} AxisItems;
+
+typedef struct {
+    KeyRef *keys;
+    int count;
+} KeysetRow;
+
+typedef struct {
+    KeyRef **item_keys;
+    int *item_counts;
+    int n_items;
+} RKRow;
+
+typedef struct {
+    JNode *root;
+    AxisItems *axes;   /* n_axes */
+    KeysetRow *keysets; /* n_keysets */
+    RKRow *rks;         /* n_rks */
+} Row;
+
+struct Work;
+
+typedef struct {
+    struct Work *w;
+    int tid;
+    Py_ssize_t row0, row1;
+    Arena arena;
+    Intern intern;
+    Parser parser;
+    NKList sa, sb, sout;
+    int err; /* 0 ok, 1 oom, 2 parse error */
+    Py_ssize_t err_row;
+    Py_ssize_t *max_axis;   /* per axis */
+    Py_ssize_t *max_keyset; /* per keyset */
+    Py_ssize_t *max_rk_l;   /* per rk spec */
+    int32_t *remap;         /* local id -> global id */
+    pthread_t thread;
+} ThreadCtx;
+
+typedef struct Work {
+    const char **bufs;
+    Py_ssize_t *blens;
+    Py_ssize_t n_real, n_pad;
+    CPath *scalars;
+    int *scalar_review; /* 1 if path starts with __review__ (synth) */
+    int n_scalars;
+    CAxis *axes;
+    int n_axes;
+    CRagged *raggeds;
+    int n_raggeds;
+    CPath *keysets;
+    int n_keysets;
+    int *mk_axes;
+    int n_mk;
+    CParentSpec *parents;
+    int n_parents;
+    CRKSpec *rks;
+    int n_rks;
+    long bucket;
+    Row *rows;
+    /* phase-1 outputs */
+    int32_t *gid, *kid, *nsid, *nmid;
+    uint8_t *genname;
+    signed char **s_kind;
+    float **s_num;
+    int32_t **s_sid;
+    int32_t **a_count;
+    /* phase-2 outputs */
+    signed char **r_kind;
+    float **r_num;
+    int32_t **r_sid;
+    Py_ssize_t *r_m;
+    int32_t **k_sid, **k_cnt;
+    Py_ssize_t *k_l;
+    int32_t **mk_sid;
+    Py_ssize_t *mk_m;
+    int32_t **p_idx;
+    Py_ssize_t *p_m;
+    int32_t **rk_sid, **rk_cnt;
+    Py_ssize_t *rk_m, *rk_l;
+    int phase;
+    int nthreads;
+    ThreadCtx *tc;
+} Work;
+
+static long
+bucket_up(long n, long bucket)
+{
+    if (n <= 0)
+        return bucket;
+    return ((n + bucket - 1) / bucket) * bucket;
+}
+
+/* synthesize a __review__-rooted scalar (audit sweeps: _synth_review in
+ * ops/flatten.py — kind{group,version,kind}, operation "", name,
+ * namespace). */
+static int
+synth_review_scalar(ThreadCtx *t, JNode *root, const CPath *path,
+                    signed char *kind, float *num, int32_t *sid)
+{
+    *num = 0.0f;
+    *sid = -1;
+    const char **parts = path->parts;
+    uint32_t *lens = path->lens;
+    int n = path->n; /* includes leading __review__ */
+    if (n == 1) {
+        *kind = K_MAP;
+        return 0;
+    }
+    const char *p1 = parts[1];
+    uint32_t l1 = lens[1];
+    JNode *av = obj_get(root, "apiVersion", 10);
+    const char *avs = (av && av->type == JT_STR) ? av->u.str : "";
+    uint32_t avn = (av && av->type == JT_STR) ? av->n : 0;
+    if (l1 == 4 && memcmp(p1, "kind", 4) == 0) {
+        if (n == 2) {
+            *kind = K_MAP;
+            return 0;
+        }
+        if (n > 3) {
+            *kind = K_ABSENT;
+            return 0;
+        }
+        const char *p2 = parts[2];
+        uint32_t l2 = lens[2];
+        /* split apiVersion at first '/' */
+        const char *slash = (const char *)memchr(avs, '/', avn);
+        const char *g = "", *v = avs;
+        uint32_t gn = 0, vn = avn;
+        if (slash != NULL) {
+            g = avs;
+            gn = (uint32_t)(slash - avs);
+            v = slash + 1;
+            vn = avn - gn - 1;
+        }
+        const char *out = NULL;
+        uint32_t outn = 0;
+        if (l2 == 5 && memcmp(p2, "group", 5) == 0) {
+            out = g; outn = gn;
+        } else if (l2 == 7 && memcmp(p2, "version", 7) == 0) {
+            out = v; outn = vn;
+        } else if (l2 == 4 && memcmp(p2, "kind", 4) == 0) {
+            JNode *k = obj_get(root, "kind", 4);
+            out = (k && k->type == JT_STR) ? k->u.str : "";
+            outn = (k && k->type == JT_STR) ? k->n : 0;
+        } else {
+            *kind = K_ABSENT;
+            return 0;
+        }
+        *kind = K_STR;
+        int32_t id = intern_get(&t->intern, out, outn);
+        if (id < 0)
+            return -1;
+        *sid = id;
+        return 0;
+    }
+    if (n != 2) {
+        *kind = K_ABSENT;
+        return 0;
+    }
+    const char *out = NULL;
+    uint32_t outn = 0;
+    if (l1 == 9 && memcmp(p1, "operation", 9) == 0) {
+        out = "";
+        outn = 0;
+    } else if ((l1 == 4 && memcmp(p1, "name", 4) == 0) ||
+               (l1 == 9 && memcmp(p1, "namespace", 9) == 0)) {
+        JNode *meta = obj_get(root, "metadata", 8);
+        JNode *f = meta ? obj_get(meta, p1, l1) : NULL;
+        out = (f && f->type == JT_STR) ? f->u.str : "";
+        outn = (f && f->type == JT_STR) ? f->n : 0;
+    } else {
+        *kind = K_ABSENT;
+        return 0;
+    }
+    *kind = K_STR;
+    int32_t id = intern_get(&t->intern, out, outn);
+    if (id < 0)
+        return -1;
+    *sid = id;
+    return 0;
+}
+
+static int
+phase1_row(ThreadCtx *t, Py_ssize_t i)
+{
+    Work *w = t->w;
+    t->parser.arena = &t->arena;
+    JNode *root = parse_doc(&t->parser, w->bufs[i], w->blens[i]);
+    if (root == NULL) {
+        t->err = t->parser.err ? 1 : 2;
+        t->err_row = i;
+        return -1;
+    }
+    if (root->type != JT_OBJ)
+        root = NULL; /* non-object doc: behave as empty row */
+    Row *row = &w->rows[i];
+    row->root = root;
+
+    /* identity */
+    JNode *av = obj_get(root, "apiVersion", 10);
+    const char *avs = (av && av->type == JT_STR) ? av->u.str : "";
+    uint32_t avn = (av && av->type == JT_STR) ? av->n : 0;
+    const char *slash = (const char *)memchr(avs, '/', avn);
+    int32_t gidv;
+    if (slash != NULL)
+        gidv = intern_get(&t->intern, avs, (uint32_t)(slash - avs));
+    else
+        gidv = intern_get(&t->intern, "", 0);
+    if (gidv < 0)
+        goto oom;
+    w->gid[i] = gidv;
+    JNode *kv = obj_get(root, "kind", 4);
+    int32_t kidv = (kv && kv->type == JT_STR)
+        ? intern_get(&t->intern, kv->u.str, kv->n)
+        : intern_get(&t->intern, "", 0);
+    if (kidv < 0)
+        goto oom;
+    w->kid[i] = kidv;
+    JNode *meta = obj_get(root, "metadata", 8);
+    JNode *ns = meta ? obj_get(meta, "namespace", 9) : NULL;
+    JNode *nm = meta ? obj_get(meta, "name", 4) : NULL;
+    int32_t nsv = (ns && ns->type == JT_STR)
+        ? intern_get(&t->intern, ns->u.str, ns->n)
+        : intern_get(&t->intern, "", 0);
+    if (nsv < 0)
+        goto oom;
+    w->nsid[i] = nsv;
+    int32_t nmv = (nm && nm->type == JT_STR)
+        ? intern_get(&t->intern, nm->u.str, nm->n)
+        : intern_get(&t->intern, "", 0);
+    if (nmv < 0)
+        goto oom;
+    w->nmid[i] = nmv;
+    w->genname[i] = (meta && obj_get(meta, "generateName", 12)) ? 1 : 0;
+
+    /* scalars */
+    for (int s = 0; s < w->n_scalars; s++) {
+        signed char k = 0;
+        float nmb = 0.0f;
+        int32_t sd = -1;
+        if (w->scalar_review[s]) {
+            if (synth_review_scalar(t, root, &w->scalars[s], &k, &nmb,
+                                    &sd) < 0)
+                goto oom;
+        } else {
+            JNode *val = jwalk(root, &w->scalars[s]);
+            if (val != NULL && jclassify(&t->intern, val, &k, &nmb,
+                                         &sd) < 0)
+                goto oom;
+        }
+        w->s_kind[s][i] = k;
+        w->s_num[s][i] = nmb;
+        w->s_sid[s][i] = sd;
+    }
+
+    /* axes */
+    for (int a = 0; a < w->n_axes; a++) {
+        t->sout.n = 0;
+        const CAxis *ax = &w->axes[a];
+        for (int g = 0; g < ax->n; g++) {
+            if (jcollect_segment(root, &ax->segs[g], &t->sout, &t->sa,
+                                 &t->sb) < 0)
+                goto oom;
+        }
+        size_t c = t->sout.n;
+        AxisItems *ai = &row->axes[a];
+        ai->count = (int)c;
+        if (c) {
+            ai->items = (JNode **)arena_alloc(&t->arena,
+                                              c * sizeof(JNode *));
+            ai->keys = (const char **)arena_alloc(&t->arena,
+                                                  c * sizeof(char *));
+            ai->klens = (uint32_t *)arena_alloc(&t->arena,
+                                                c * sizeof(uint32_t));
+            if (!ai->items || !ai->keys || !ai->klens)
+                goto oom;
+            memcpy((void *)ai->items, t->sout.items, c * sizeof(JNode *));
+            memcpy((void *)ai->keys, t->sout.keys, c * sizeof(char *));
+            memcpy(ai->klens, t->sout.klens, c * sizeof(uint32_t));
+        }
+        w->a_count[a][i] = (int32_t)c;
+        if ((Py_ssize_t)c > t->max_axis[a])
+            t->max_axis[a] = (Py_ssize_t)c;
+    }
+
+    /* flat keysets */
+    for (int s = 0; s < w->n_keysets; s++) {
+        JNode *val = jwalk(root, &w->keysets[s]);
+        KeyRef *keys = NULL;
+        int c = truthy_keys(&t->arena, val, &keys);
+        if (c < 0)
+            goto oom;
+        row->keysets[s].keys = keys;
+        row->keysets[s].count = c;
+        if (c > t->max_keyset[s])
+            t->max_keyset[s] = c;
+    }
+
+    /* ragged keysets: per-item truthy keys (clipping to m happens in
+     * phase 2; key extraction covers all items) */
+    for (int s = 0; s < w->n_rks; s++) {
+        const CRKSpec *spec = &w->rks[s];
+        AxisItems *ai = &row->axes[spec->axis];
+        RKRow *rk = &row->rks[s];
+        rk->n_items = ai->count;
+        if (ai->count == 0) {
+            rk->item_keys = NULL;
+            rk->item_counts = NULL;
+            continue;
+        }
+        rk->item_keys = (KeyRef **)arena_alloc(
+            &t->arena, (size_t)ai->count * sizeof(KeyRef *));
+        rk->item_counts = (int *)arena_alloc(
+            &t->arena, (size_t)ai->count * sizeof(int));
+        if (!rk->item_keys || !rk->item_counts)
+            goto oom;
+        for (int j = 0; j < ai->count; j++) {
+            JNode *val = spec->sub.n
+                ? jwalk(ai->items[j], &spec->sub)
+                : ai->items[j];
+            KeyRef *keys = NULL;
+            int c = truthy_keys(&t->arena, val, &keys);
+            if (c < 0)
+                goto oom;
+            rk->item_keys[j] = keys;
+            rk->item_counts[j] = c;
+            if (c > t->max_rk_l[s])
+                t->max_rk_l[s] = c;
+        }
+    }
+    return 0;
+oom:
+    t->err = 1;
+    t->err_row = i;
+    return -1;
+}
+
+static int
+phase2_row(ThreadCtx *t, Py_ssize_t i)
+{
+    Work *w = t->w;
+    Row *row = &w->rows[i];
+
+    for (int r = 0; r < w->n_raggeds; r++) {
+        const CRagged *spec = &w->raggeds[r];
+        AxisItems *ai = &row->axes[spec->axis];
+        Py_ssize_t m = w->r_m[r];
+        int cnt = ai->count;
+        if ((Py_ssize_t)cnt > m)
+            cnt = (int)m;
+        for (int j = 0; j < cnt; j++) {
+            JNode *val = spec->sub.n
+                ? jwalk(ai->items[j], &spec->sub)
+                : ai->items[j];
+            if (val == NULL)
+                continue;
+            Py_ssize_t off = i * m + j;
+            if (jclassify(&t->intern, val, &w->r_kind[r][off],
+                          &w->r_num[r][off], &w->r_sid[r][off]) < 0)
+                goto oom;
+        }
+    }
+
+    for (int s = 0; s < w->n_keysets; s++) {
+        KeysetRow *kr = &row->keysets[s];
+        Py_ssize_t l = w->k_l[s];
+        w->k_cnt[s][i] = (int32_t)kr->count;
+        int cnt = kr->count;
+        if ((Py_ssize_t)cnt > l)
+            cnt = (int)l;
+        for (int j = 0; j < cnt; j++) {
+            int32_t id = intern_get(&t->intern, kr->keys[j].s,
+                                    kr->keys[j].n);
+            if (id < 0)
+                goto oom;
+            w->k_sid[s][i * l + j] = id;
+        }
+    }
+
+    for (int q = 0; q < w->n_mk; q++) {
+        AxisItems *ai = &row->axes[w->mk_axes[q]];
+        Py_ssize_t m = w->mk_m[q];
+        int cnt = ai->count;
+        if ((Py_ssize_t)cnt > m)
+            cnt = (int)m;
+        for (int j = 0; j < cnt; j++) {
+            if (ai->keys[j] == NULL)
+                continue;
+            int32_t id = intern_get(&t->intern, ai->keys[j], ai->klens[j]);
+            if (id < 0)
+                goto oom;
+            w->mk_sid[q][i * m + j] = id;
+        }
+    }
+
+    /* parent-idx: ordinal of each child item's parent in the parent
+     * axis's enumeration (mirrors extract_extras in flattenmod.c) */
+    for (int p = 0; p < w->n_parents; p++) {
+        const CAxis *cax = &w->axes[w->parents[p].child];
+        const CAxis *pax = &w->axes[w->parents[p].parent];
+        Py_ssize_t m = w->p_m[p];
+        Py_ssize_t j = 0, base = 0;
+        int nseg = cax->n < pax->n ? cax->n : pax->n;
+        for (int g = 0; g < nseg; g++) {
+            const CSeg *cseg = &cax->segs[g];
+            const CPath *sub = &cseg->paths[cseg->n - 1];
+            t->sout.n = 0;
+            if (jcollect_segment(row->root, &pax->segs[g], &t->sout,
+                                 &t->sa, &t->sb) < 0)
+                goto oom;
+            size_t npar = t->sout.n;
+            for (size_t k = 0; k < npar; k++) {
+                JNode *val = jwalk(t->sout.items[k], sub);
+                if (val == NULL)
+                    continue;
+                if (val->type == JT_ARR || val->type == JT_OBJ) {
+                    for (uint32_t q2 = 0; q2 < val->n && j < m; q2++)
+                        w->p_idx[p][i * m + j++] =
+                            (int32_t)(base + (Py_ssize_t)k);
+                }
+            }
+            base += (Py_ssize_t)npar;
+        }
+    }
+
+    for (int s = 0; s < w->n_rks; s++) {
+        RKRow *rk = &row->rks[s];
+        Py_ssize_t m = w->rk_m[s], l = w->rk_l[s];
+        int cnt = rk->n_items;
+        if ((Py_ssize_t)cnt > m)
+            cnt = (int)m;
+        for (int j = 0; j < cnt; j++) {
+            w->rk_cnt[s][i * m + j] = (int32_t)rk->item_counts[j];
+            KeyRef *keys = rk->item_keys[j];
+            int kc = rk->item_counts[j];
+            if ((Py_ssize_t)kc > l)
+                kc = (int)l;
+            for (int q = 0; q < kc; q++) {
+                int32_t id = intern_get(&t->intern, keys[q].s, keys[q].n);
+                if (id < 0)
+                    goto oom;
+                w->rk_sid[s][(i * m + j) * l + q] = id;
+            }
+        }
+    }
+    return 0;
+oom:
+    t->err = 1;
+    t->err_row = i;
+    return -1;
+}
+
+static void
+remap_range(const int32_t *remap, int32_t *arr, Py_ssize_t lo,
+            Py_ssize_t hi)
+{
+    for (Py_ssize_t i = lo; i < hi; i++) {
+        if (arr[i] >= 0)
+            arr[i] = remap[arr[i]];
+    }
+}
+
+static void
+phase3_remap(ThreadCtx *t)
+{
+    Work *w = t->w;
+    const int32_t *rm = t->remap;
+    Py_ssize_t r0 = t->row0, r1 = t->row1;
+    remap_range(rm, w->gid, r0, r1);
+    remap_range(rm, w->kid, r0, r1);
+    remap_range(rm, w->nsid, r0, r1);
+    remap_range(rm, w->nmid, r0, r1);
+    for (int s = 0; s < w->n_scalars; s++)
+        remap_range(rm, w->s_sid[s], r0, r1);
+    for (int r = 0; r < w->n_raggeds; r++)
+        remap_range(rm, w->r_sid[r], r0 * w->r_m[r], r1 * w->r_m[r]);
+    for (int s = 0; s < w->n_keysets; s++)
+        remap_range(rm, w->k_sid[s], r0 * w->k_l[s], r1 * w->k_l[s]);
+    for (int q = 0; q < w->n_mk; q++)
+        remap_range(rm, w->mk_sid[q], r0 * w->mk_m[q], r1 * w->mk_m[q]);
+    for (int s = 0; s < w->n_rks; s++)
+        remap_range(rm, w->rk_sid[s], r0 * w->rk_m[s] * w->rk_l[s],
+                    r1 * w->rk_m[s] * w->rk_l[s]);
+}
+
+static void *
+worker_main(void *arg)
+{
+    ThreadCtx *t = (ThreadCtx *)arg;
+    Work *w = t->w;
+    if (w->phase == 1) {
+        for (Py_ssize_t i = t->row0; i < t->row1; i++)
+            if (phase1_row(t, i) < 0)
+                break;
+    } else if (w->phase == 2) {
+        if (!t->err) {
+            for (Py_ssize_t i = t->row0; i < t->row1; i++)
+                if (phase2_row(t, i) < 0)
+                    break;
+        }
+    } else {
+        phase3_remap(t);
+    }
+    return NULL;
+}
+
+static int
+run_phase(Work *w, int phase)
+{
+    w->phase = phase;
+    if (w->nthreads == 1) {
+        worker_main(&w->tc[0]);
+        return 0;
+    }
+    for (int t = 0; t < w->nthreads; t++) {
+        if (pthread_create(&w->tc[t].thread, NULL, worker_main,
+                           &w->tc[t]) != 0) {
+            /* fall back: run remaining contexts inline */
+            for (int u = t; u < w->nthreads; u++)
+                worker_main(&w->tc[u]);
+            for (int u = 0; u < t; u++)
+                pthread_join(w->tc[u].thread, NULL);
+            return 0;
+        }
+    }
+    for (int t = 0; t < w->nthreads; t++)
+        pthread_join(w->tc[t].thread, NULL);
+    return 0;
+}
+
+/* ---------------- GIL-side glue ---------------- */
+
+static PyArrayObject *
+new_arr(int nd, npy_intp *dims, int typenum, int fill_minus1)
+{
+    PyArrayObject *a = (PyArrayObject *)PyArray_ZEROS(nd, dims, typenum, 0);
+    if (a == NULL)
+        return NULL;
+    if (fill_minus1) {
+        int32_t *data = (int32_t *)PyArray_DATA(a);
+        npy_intp total = PyArray_SIZE(a);
+        for (npy_intp i = 0; i < total; i++)
+            data[i] = -1;
+    }
+    return a;
+}
+
+static int
+cpath_conv(PyObject *tup, CPath *out, Arena *ar)
+{
+    Py_ssize_t n = PyTuple_GET_SIZE(tup);
+    out->n = (int)n;
+    out->parts = (const char **)arena_alloc(ar, (n ? n : 1) *
+                                            sizeof(char *));
+    out->lens = (uint32_t *)arena_alloc(ar, (n ? n : 1) *
+                                        sizeof(uint32_t));
+    if (!out->parts || !out->lens)
+        return -1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_ssize_t len;
+        const char *s = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(tup, i),
+                                                &len);
+        if (s == NULL)
+            return -1;
+        out->parts[i] = s;
+        out->lens[i] = (uint32_t)len;
+    }
+    return 0;
+}
+
+static int
+caxis_conv(PyObject *segments, CAxis *out, Arena *ar)
+{
+    Py_ssize_t n = PyTuple_GET_SIZE(segments);
+    out->n = (int)n;
+    out->segs = (CSeg *)arena_alloc(ar, (n ? n : 1) * sizeof(CSeg));
+    if (out->segs == NULL)
+        return -1;
+    for (Py_ssize_t g = 0; g < n; g++) {
+        PyObject *seg = PyTuple_GET_ITEM(segments, g);
+        Py_ssize_t np_ = PyTuple_GET_SIZE(seg);
+        CSeg *cs = &out->segs[g];
+        cs->n = (int)np_;
+        cs->paths = (CPath *)arena_alloc(ar, (np_ ? np_ : 1) *
+                                         sizeof(CPath));
+        if (cs->paths == NULL)
+            return -1;
+        for (Py_ssize_t p = 0; p < np_; p++) {
+            if (cpath_conv(PyTuple_GET_ITEM(seg, p), &cs->paths[p], ar) < 0)
+                return -1;
+        }
+    }
+    return 0;
+}
+
+static void
+work_free(Work *w, Py_buffer *views, Py_ssize_t n_views, Arena *spec_arena)
+{
+    if (w->tc) {
+        for (int t = 0; t < w->nthreads; t++) {
+            ThreadCtx *tc = &w->tc[t];
+            arena_free(&tc->arena);
+            intern_destroy(&tc->intern);
+            free(tc->parser.nstack);
+            free((void *)tc->parser.kstack);
+            free(tc->parser.lstack);
+            free(tc->sa.items); free((void *)tc->sa.keys); free(tc->sa.klens);
+            free(tc->sb.items); free((void *)tc->sb.keys); free(tc->sb.klens);
+            free(tc->sout.items); free((void *)tc->sout.keys);
+            free(tc->sout.klens);
+            free(tc->max_axis);
+            free(tc->max_keyset);
+            free(tc->max_rk_l);
+            free(tc->remap);
+        }
+        free(w->tc);
+    }
+    if (w->rows) {
+        free(w->rows[0].axes);    /* block-allocated */
+        free(w->rows[0].keysets);
+        free(w->rows[0].rks);
+        free(w->rows);
+    }
+    free(w->scalars); free(w->scalar_review);
+    free(w->axes); free(w->raggeds); free(w->keysets); free(w->mk_axes);
+    free(w->parents); free(w->rks);
+    free(w->s_kind); free(w->s_num); free(w->s_sid);
+    free(w->a_count);
+    free(w->r_kind); free(w->r_num); free(w->r_sid); free(w->r_m);
+    free(w->k_sid); free(w->k_cnt); free(w->k_l);
+    free(w->mk_sid); free(w->mk_m);
+    free(w->p_idx); free(w->p_m);
+    free(w->rk_sid); free(w->rk_cnt); free(w->rk_m); free(w->rk_l);
+    free((void *)w->bufs); free(w->blens);
+    if (views) {
+        for (Py_ssize_t i = 0; i < n_views; i++)
+            if (views[i].obj)
+                PyBuffer_Release(&views[i]);
+        free(views);
+    }
+    arena_free(spec_arena);
+}
+
+/* flatten_json_batch(items, scalars, axes, raggeds, keysets, map_key_axes,
+ *                    parent_specs, rk_specs, to_id, to_str,
+ *                    pad_n, bucket, nthreads) -> dict
+ *
+ *   items:        list of bytes-like (one JSON document per object)
+ *   scalars:      list[tuple[str, ...]] (paths; __review__-rooted paths
+ *                 are synthesized from object identity, the audit case)
+ *   axes:         list[segments] as in flatten_batch
+ *   raggeds:      list[(axis_idx, subpath)]
+ *   keysets:      list[path]
+ *   map_key_axes: list[int]
+ *   parent_specs: list[(child_axis_idx, parent_axis_idx)]
+ *   rk_specs:     list[(axis_idx, subpath)]
+ *
+ * Returns the flatten_batch result dict plus "genname" (uint8 [N]),
+ * "parent_idx" and "ragged_keysets" (extras computed in the same pass).
+ */
+static PyObject *
+py_flatten_json_batch(PyObject *self, PyObject *args)
+{
+    PyObject *items, *scalars, *axes, *raggeds, *keysets, *mk_axes;
+    PyObject *parent_specs, *rk_specs, *to_id, *to_str;
+    Py_ssize_t pad_n;
+    long bucket;
+    int nthreads;
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOnli", &items, &scalars, &axes,
+                          &raggeds, &keysets, &mk_axes, &parent_specs,
+                          &rk_specs, &to_id, &to_str, &pad_n, &bucket,
+                          &nthreads))
+        return NULL;
+    if (!PyList_Check(items)) {
+        PyErr_SetString(PyExc_TypeError, "items must be a list");
+        return NULL;
+    }
+    Work w;
+    memset(&w, 0, sizeof(w));
+    Arena spec_arena = {NULL};
+    Py_buffer *views = NULL;
+    PyObject *result = NULL;
+
+    w.n_real = PyList_GET_SIZE(items);
+    w.n_pad = pad_n > w.n_real ? pad_n : w.n_real;
+    w.bucket = bucket > 0 ? bucket : 8;
+    w.n_scalars = (int)PyList_GET_SIZE(scalars);
+    w.n_axes = (int)PyList_GET_SIZE(axes);
+    w.n_raggeds = (int)PyList_GET_SIZE(raggeds);
+    w.n_keysets = (int)PyList_GET_SIZE(keysets);
+    w.n_mk = (int)PyList_GET_SIZE(mk_axes);
+    w.n_parents = (int)PyList_GET_SIZE(parent_specs);
+    w.n_rks = (int)PyList_GET_SIZE(rk_specs);
+
+    /* buffers */
+    views = (Py_buffer *)calloc((size_t)(w.n_real ? w.n_real : 1),
+                                sizeof(Py_buffer));
+    w.bufs = (const char **)malloc((size_t)(w.n_real ? w.n_real : 1) *
+                                   sizeof(char *));
+    w.blens = (Py_ssize_t *)malloc((size_t)(w.n_real ? w.n_real : 1) *
+                                   sizeof(Py_ssize_t));
+    if (!views || !w.bufs || !w.blens)
+        goto oom;
+    for (Py_ssize_t i = 0; i < w.n_real; i++) {
+        if (PyObject_GetBuffer(PyList_GET_ITEM(items, i), &views[i],
+                               PyBUF_SIMPLE) < 0)
+            goto error;
+        w.bufs[i] = (const char *)views[i].buf;
+        w.blens[i] = views[i].len;
+    }
+
+    /* specs */
+#define ALLOCN(ptr, type, count) \
+    do { \
+        (ptr) = (type *)calloc((size_t)((count) ? (count) : 1), \
+                               sizeof(type)); \
+        if ((ptr) == NULL) \
+            goto oom; \
+    } while (0)
+    ALLOCN(w.scalars, CPath, w.n_scalars);
+    ALLOCN(w.scalar_review, int, w.n_scalars);
+    for (int s = 0; s < w.n_scalars; s++) {
+        PyObject *tup = PyList_GET_ITEM(scalars, s);
+        if (cpath_conv(tup, &w.scalars[s], &spec_arena) < 0)
+            goto error;
+        w.scalar_review[s] = (w.scalars[s].n > 0 &&
+                              w.scalars[s].lens[0] == 10 &&
+                              memcmp(w.scalars[s].parts[0], "__review__",
+                                     10) == 0);
+    }
+    ALLOCN(w.axes, CAxis, w.n_axes);
+    for (int a = 0; a < w.n_axes; a++) {
+        if (caxis_conv(PyList_GET_ITEM(axes, a), &w.axes[a],
+                       &spec_arena) < 0)
+            goto error;
+    }
+    ALLOCN(w.raggeds, CRagged, w.n_raggeds);
+    for (int r = 0; r < w.n_raggeds; r++) {
+        PyObject *e = PyList_GET_ITEM(raggeds, r);
+        w.raggeds[r].axis = (int)PyLong_AsLong(PyTuple_GET_ITEM(e, 0));
+        if (cpath_conv(PyTuple_GET_ITEM(e, 1), &w.raggeds[r].sub,
+                       &spec_arena) < 0)
+            goto error;
+    }
+    ALLOCN(w.keysets, CPath, w.n_keysets);
+    for (int s = 0; s < w.n_keysets; s++) {
+        if (cpath_conv(PyList_GET_ITEM(keysets, s), &w.keysets[s],
+                       &spec_arena) < 0)
+            goto error;
+    }
+    ALLOCN(w.mk_axes, int, w.n_mk);
+    for (int q = 0; q < w.n_mk; q++)
+        w.mk_axes[q] = (int)PyLong_AsLong(PyList_GET_ITEM(mk_axes, q));
+    ALLOCN(w.parents, CParentSpec, w.n_parents);
+    for (int p = 0; p < w.n_parents; p++) {
+        PyObject *e = PyList_GET_ITEM(parent_specs, p);
+        w.parents[p].child = (int)PyLong_AsLong(PyTuple_GET_ITEM(e, 0));
+        w.parents[p].parent = (int)PyLong_AsLong(PyTuple_GET_ITEM(e, 1));
+    }
+    ALLOCN(w.rks, CRKSpec, w.n_rks);
+    for (int s = 0; s < w.n_rks; s++) {
+        PyObject *e = PyList_GET_ITEM(rk_specs, s);
+        w.rks[s].axis = (int)PyLong_AsLong(PyTuple_GET_ITEM(e, 0));
+        if (cpath_conv(PyTuple_GET_ITEM(e, 1), &w.rks[s].sub,
+                       &spec_arena) < 0)
+            goto error;
+    }
+    if (PyErr_Occurred())
+        goto error;
+
+    /* rows (block-allocated sub-arrays) */
+    if (w.n_real > 0) {
+        w.rows = (Row *)calloc((size_t)w.n_real, sizeof(Row));
+        AxisItems *ax_blk = (AxisItems *)calloc(
+            (size_t)w.n_real * (size_t)(w.n_axes ? w.n_axes : 1),
+            sizeof(AxisItems));
+        KeysetRow *ks_blk = (KeysetRow *)calloc(
+            (size_t)w.n_real * (size_t)(w.n_keysets ? w.n_keysets : 1),
+            sizeof(KeysetRow));
+        RKRow *rk_blk = (RKRow *)calloc(
+            (size_t)w.n_real * (size_t)(w.n_rks ? w.n_rks : 1),
+            sizeof(RKRow));
+        if (!w.rows || !ax_blk || !ks_blk || !rk_blk) {
+            free(ax_blk); free(ks_blk); free(rk_blk);
+            goto oom;
+        }
+        for (Py_ssize_t i = 0; i < w.n_real; i++) {
+            w.rows[i].axes = ax_blk + i * (w.n_axes ? w.n_axes : 1);
+            w.rows[i].keysets = ks_blk + i * (w.n_keysets ? w.n_keysets : 1);
+            w.rows[i].rks = rk_blk + i * (w.n_rks ? w.n_rks : 1);
+        }
+    }
+
+    /* threads */
+    if (nthreads < 1)
+        nthreads = 1;
+    if (nthreads > 64)
+        nthreads = 64;
+    {
+        long by_rows = (long)(w.n_real / 128) + 1;
+        if ((long)nthreads > by_rows)
+            nthreads = (int)by_rows;
+    }
+    w.nthreads = nthreads;
+    ALLOCN(w.tc, ThreadCtx, w.nthreads);
+    {
+        Py_ssize_t block = w.nthreads
+            ? (w.n_real + w.nthreads - 1) / w.nthreads : 0;
+        for (int t = 0; t < w.nthreads; t++) {
+            ThreadCtx *tc = &w.tc[t];
+            tc->w = &w;
+            tc->tid = t;
+            tc->row0 = (Py_ssize_t)t * block;
+            tc->row1 = tc->row0 + block;
+            if (tc->row0 > w.n_real)
+                tc->row0 = w.n_real;
+            if (tc->row1 > w.n_real)
+                tc->row1 = w.n_real;
+            if (intern_init(&tc->intern) < 0)
+                goto oom;
+            ALLOCN(tc->max_axis, Py_ssize_t, w.n_axes);
+            ALLOCN(tc->max_keyset, Py_ssize_t, w.n_keysets);
+            ALLOCN(tc->max_rk_l, Py_ssize_t, w.n_rks);
+        }
+    }
+
+    /* phase-1 output arrays + result containers */
+    result = PyDict_New();
+    if (result == NULL)
+        goto error;
+    {
+        npy_intp d1[1] = {(npy_intp)w.n_pad};
+        PyArrayObject *gid = new_arr(1, d1, NPY_INT32, 1);
+        PyArrayObject *kid = new_arr(1, d1, NPY_INT32, 1);
+        PyArrayObject *nsid = new_arr(1, d1, NPY_INT32, 1);
+        PyArrayObject *nmid = new_arr(1, d1, NPY_INT32, 1);
+        PyArrayObject *gen = new_arr(1, d1, NPY_UINT8, 0);
+        if (!gid || !kid || !nsid || !nmid || !gen) {
+            Py_XDECREF(gid); Py_XDECREF(kid); Py_XDECREF(nsid);
+            Py_XDECREF(nmid); Py_XDECREF(gen);
+            goto error;
+        }
+        w.gid = (int32_t *)PyArray_DATA(gid);
+        w.kid = (int32_t *)PyArray_DATA(kid);
+        w.nsid = (int32_t *)PyArray_DATA(nsid);
+        w.nmid = (int32_t *)PyArray_DATA(nmid);
+        w.genname = (uint8_t *)PyArray_DATA(gen);
+        PyObject *identity = Py_BuildValue("(NNNNN)", gid, kid, nsid, nmid,
+                                           gen);
+        if (identity == NULL ||
+            PyDict_SetItemString(result, "identity", identity) < 0) {
+            Py_XDECREF(identity);
+            goto error;
+        }
+        Py_DECREF(identity);
+
+        ALLOCN(w.s_kind, signed char *, w.n_scalars);
+        ALLOCN(w.s_num, float *, w.n_scalars);
+        ALLOCN(w.s_sid, int32_t *, w.n_scalars);
+        PyObject *s_out = PyList_New(w.n_scalars);
+        if (s_out == NULL)
+            goto error;
+        for (int s = 0; s < w.n_scalars; s++) {
+            PyArrayObject *a_kind = new_arr(1, d1, NPY_INT8, 0);
+            PyArrayObject *a_num = new_arr(1, d1, NPY_FLOAT32, 0);
+            PyArrayObject *a_sid = new_arr(1, d1, NPY_INT32, 1);
+            if (!a_kind || !a_num || !a_sid) {
+                Py_XDECREF(a_kind); Py_XDECREF(a_num); Py_XDECREF(a_sid);
+                Py_DECREF(s_out);
+                goto error;
+            }
+            w.s_kind[s] = (signed char *)PyArray_DATA(a_kind);
+            w.s_num[s] = (float *)PyArray_DATA(a_num);
+            w.s_sid[s] = (int32_t *)PyArray_DATA(a_sid);
+            PyList_SET_ITEM(s_out, s, Py_BuildValue("(NNN)", a_kind, a_num,
+                                                    a_sid));
+        }
+        if (PyDict_SetItemString(result, "scalars", s_out) < 0) {
+            Py_DECREF(s_out);
+            goto error;
+        }
+        Py_DECREF(s_out);
+
+        ALLOCN(w.a_count, int32_t *, w.n_axes);
+        PyObject *a_out = PyList_New(w.n_axes);
+        if (a_out == NULL)
+            goto error;
+        for (int a = 0; a < w.n_axes; a++) {
+            PyArrayObject *cnt = new_arr(1, d1, NPY_INT32, 0);
+            if (cnt == NULL) {
+                Py_DECREF(a_out);
+                goto error;
+            }
+            w.a_count[a] = (int32_t *)PyArray_DATA(cnt);
+            PyList_SET_ITEM(a_out, a, (PyObject *)cnt);
+        }
+        if (PyDict_SetItemString(result, "axes", a_out) < 0) {
+            Py_DECREF(a_out);
+            goto error;
+        }
+        Py_DECREF(a_out);
+    }
+
+    /* phase 1: parse + fixed-dim columns (GIL released) */
+    Py_BEGIN_ALLOW_THREADS
+    run_phase(&w, 1);
+    Py_END_ALLOW_THREADS
+    for (int t = 0; t < w.nthreads; t++) {
+        if (w.tc[t].err == 1)
+            goto oom;
+        if (w.tc[t].err == 2) {
+            PyErr_Format(PyExc_ValueError,
+                         "invalid JSON in batch item %zd",
+                         (Py_ssize_t)w.tc[t].err_row);
+            goto error;
+        }
+    }
+
+    /* widths from thread-local maxima, then phase-2 arrays */
+    {
+        npy_intp d1[1] = {(npy_intp)w.n_pad};
+        ALLOCN(w.r_kind, signed char *, w.n_raggeds);
+        ALLOCN(w.r_num, float *, w.n_raggeds);
+        ALLOCN(w.r_sid, int32_t *, w.n_raggeds);
+        ALLOCN(w.r_m, Py_ssize_t, w.n_raggeds);
+        PyObject *r_out = PyList_New(w.n_raggeds);
+        if (r_out == NULL)
+            goto error;
+        for (int r = 0; r < w.n_raggeds; r++) {
+            Py_ssize_t maxc = 0;
+            for (int t = 0; t < w.nthreads; t++)
+                if (w.tc[t].max_axis[w.raggeds[r].axis] > maxc)
+                    maxc = w.tc[t].max_axis[w.raggeds[r].axis];
+            Py_ssize_t m = bucket_up((long)maxc, w.bucket);
+            w.r_m[r] = m;
+            npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
+            PyArrayObject *a_kind = new_arr(2, d2, NPY_INT8, 0);
+            PyArrayObject *a_num = new_arr(2, d2, NPY_FLOAT32, 0);
+            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, 1);
+            if (!a_kind || !a_num || !a_sid) {
+                Py_XDECREF(a_kind); Py_XDECREF(a_num); Py_XDECREF(a_sid);
+                Py_DECREF(r_out);
+                goto error;
+            }
+            w.r_kind[r] = (signed char *)PyArray_DATA(a_kind);
+            w.r_num[r] = (float *)PyArray_DATA(a_num);
+            w.r_sid[r] = (int32_t *)PyArray_DATA(a_sid);
+            PyList_SET_ITEM(r_out, r, Py_BuildValue("(NNN)", a_kind, a_num,
+                                                    a_sid));
+        }
+        if (PyDict_SetItemString(result, "raggeds", r_out) < 0) {
+            Py_DECREF(r_out);
+            goto error;
+        }
+        Py_DECREF(r_out);
+
+        ALLOCN(w.k_sid, int32_t *, w.n_keysets);
+        ALLOCN(w.k_cnt, int32_t *, w.n_keysets);
+        ALLOCN(w.k_l, Py_ssize_t, w.n_keysets);
+        PyObject *k_out = PyList_New(w.n_keysets);
+        if (k_out == NULL)
+            goto error;
+        for (int s = 0; s < w.n_keysets; s++) {
+            Py_ssize_t maxc = 0;
+            for (int t = 0; t < w.nthreads; t++)
+                if (w.tc[t].max_keyset[s] > maxc)
+                    maxc = w.tc[t].max_keyset[s];
+            Py_ssize_t l = bucket_up((long)maxc, w.bucket);
+            w.k_l[s] = l;
+            npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)l};
+            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, 1);
+            PyArrayObject *a_cnt = new_arr(1, d1, NPY_INT32, 0);
+            if (!a_sid || !a_cnt) {
+                Py_XDECREF(a_sid); Py_XDECREF(a_cnt); Py_DECREF(k_out);
+                goto error;
+            }
+            w.k_sid[s] = (int32_t *)PyArray_DATA(a_sid);
+            w.k_cnt[s] = (int32_t *)PyArray_DATA(a_cnt);
+            PyList_SET_ITEM(k_out, s, Py_BuildValue("(NN)", a_sid, a_cnt));
+        }
+        if (PyDict_SetItemString(result, "keysets", k_out) < 0) {
+            Py_DECREF(k_out);
+            goto error;
+        }
+        Py_DECREF(k_out);
+
+        ALLOCN(w.mk_sid, int32_t *, w.n_mk);
+        ALLOCN(w.mk_m, Py_ssize_t, w.n_mk);
+        PyObject *mk_out = PyList_New(w.n_mk);
+        if (mk_out == NULL)
+            goto error;
+        for (int q = 0; q < w.n_mk; q++) {
+            Py_ssize_t maxc = 0;
+            for (int t = 0; t < w.nthreads; t++)
+                if (w.tc[t].max_axis[w.mk_axes[q]] > maxc)
+                    maxc = w.tc[t].max_axis[w.mk_axes[q]];
+            Py_ssize_t m = bucket_up((long)maxc, w.bucket);
+            w.mk_m[q] = m;
+            npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
+            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, 1);
+            if (a_sid == NULL) {
+                Py_DECREF(mk_out);
+                goto error;
+            }
+            w.mk_sid[q] = (int32_t *)PyArray_DATA(a_sid);
+            PyList_SET_ITEM(mk_out, q, (PyObject *)a_sid);
+        }
+        if (PyDict_SetItemString(result, "map_keys", mk_out) < 0) {
+            Py_DECREF(mk_out);
+            goto error;
+        }
+        Py_DECREF(mk_out);
+
+        ALLOCN(w.p_idx, int32_t *, w.n_parents);
+        ALLOCN(w.p_m, Py_ssize_t, w.n_parents);
+        PyObject *p_out = PyList_New(w.n_parents);
+        if (p_out == NULL)
+            goto error;
+        for (int p = 0; p < w.n_parents; p++) {
+            Py_ssize_t maxc = 0;
+            for (int t = 0; t < w.nthreads; t++)
+                if (w.tc[t].max_axis[w.parents[p].child] > maxc)
+                    maxc = w.tc[t].max_axis[w.parents[p].child];
+            Py_ssize_t m = bucket_up((long)maxc, w.bucket);
+            w.p_m[p] = m;
+            npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
+            PyArrayObject *a_idx = new_arr(2, d2, NPY_INT32, 1);
+            if (a_idx == NULL) {
+                Py_DECREF(p_out);
+                goto error;
+            }
+            w.p_idx[p] = (int32_t *)PyArray_DATA(a_idx);
+            PyList_SET_ITEM(p_out, p, (PyObject *)a_idx);
+        }
+        if (PyDict_SetItemString(result, "parent_idx", p_out) < 0) {
+            Py_DECREF(p_out);
+            goto error;
+        }
+        Py_DECREF(p_out);
+
+        ALLOCN(w.rk_sid, int32_t *, w.n_rks);
+        ALLOCN(w.rk_cnt, int32_t *, w.n_rks);
+        ALLOCN(w.rk_m, Py_ssize_t, w.n_rks);
+        ALLOCN(w.rk_l, Py_ssize_t, w.n_rks);
+        PyObject *rk_out = PyList_New(w.n_rks);
+        if (rk_out == NULL)
+            goto error;
+        for (int s = 0; s < w.n_rks; s++) {
+            Py_ssize_t maxm = 0, maxl = 0;
+            for (int t = 0; t < w.nthreads; t++) {
+                if (w.tc[t].max_axis[w.rks[s].axis] > maxm)
+                    maxm = w.tc[t].max_axis[w.rks[s].axis];
+                if (w.tc[t].max_rk_l[s] > maxl)
+                    maxl = w.tc[t].max_rk_l[s];
+            }
+            Py_ssize_t m = bucket_up((long)maxm, w.bucket);
+            Py_ssize_t l = bucket_up((long)maxl, w.bucket);
+            w.rk_m[s] = m;
+            w.rk_l[s] = l;
+            npy_intp d3[3] = {(npy_intp)w.n_pad, (npy_intp)m, (npy_intp)l};
+            npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
+            PyArrayObject *a_sid = new_arr(3, d3, NPY_INT32, 1);
+            PyArrayObject *a_cnt = new_arr(2, d2, NPY_INT32, 0);
+            if (!a_sid || !a_cnt) {
+                Py_XDECREF(a_sid); Py_XDECREF(a_cnt); Py_DECREF(rk_out);
+                goto error;
+            }
+            w.rk_sid[s] = (int32_t *)PyArray_DATA(a_sid);
+            w.rk_cnt[s] = (int32_t *)PyArray_DATA(a_cnt);
+            PyList_SET_ITEM(rk_out, s, Py_BuildValue("(NN)", a_sid, a_cnt));
+        }
+        if (PyDict_SetItemString(result, "ragged_keysets", rk_out) < 0) {
+            Py_DECREF(rk_out);
+            goto error;
+        }
+        Py_DECREF(rk_out);
+    }
+
+    /* phase 2: variable-width columns (GIL released) */
+    Py_BEGIN_ALLOW_THREADS
+    run_phase(&w, 2);
+    Py_END_ALLOW_THREADS
+    for (int t = 0; t < w.nthreads; t++)
+        if (w.tc[t].err == 1)
+            goto oom;
+
+    /* merge per-thread interns into the Python vocab (deterministic:
+     * thread order, then first-seen order) */
+    for (int t = 0; t < w.nthreads; t++) {
+        ThreadCtx *tc = &w.tc[t];
+        if (tc->intern.count == 0)
+            continue;
+        tc->remap = (int32_t *)malloc(tc->intern.count * sizeof(int32_t));
+        if (tc->remap == NULL)
+            goto oom;
+        for (uint32_t id = 0; id < tc->intern.count; id++) {
+            PyObject *key = PyUnicode_DecodeUTF8(
+                tc->intern.strs[id], (Py_ssize_t)tc->intern.lens[id],
+                "strict");
+            if (key == NULL)
+                goto error;
+            PyObject *hit = PyDict_GetItem(to_id, key);
+            long gl;
+            if (hit != NULL) {
+                gl = PyLong_AsLong(hit);
+            } else {
+                gl = (long)PyList_GET_SIZE(to_str);
+                PyObject *idobj = PyLong_FromLong(gl);
+                if (idobj == NULL ||
+                    PyDict_SetItem(to_id, key, idobj) < 0 ||
+                    PyList_Append(to_str, key) < 0) {
+                    Py_XDECREF(idobj);
+                    Py_DECREF(key);
+                    goto error;
+                }
+                Py_DECREF(idobj);
+            }
+            Py_DECREF(key);
+            tc->remap[id] = (int32_t)gl;
+        }
+    }
+
+    /* phase 3: remap local sids -> global (GIL released) */
+    Py_BEGIN_ALLOW_THREADS
+    run_phase(&w, 3);
+    Py_END_ALLOW_THREADS
+
+    work_free(&w, views, w.n_real, &spec_arena);
+    return result;
+
+oom:
+    PyErr_NoMemory();
+error:
+    work_free(&w, views, w.n_real, &spec_arena);
+    Py_XDECREF(result);
+    return NULL;
+}
+
+static PyMethodDef jmethods[] = {
+    {"flatten_json_batch", py_flatten_json_batch, METH_VARARGS,
+     "Flatten a batch of raw JSON documents into columnar arrays "
+     "(threaded, GIL-released)."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef jmoduledef = {
+    PyModuleDef_HEAD_INIT, "gtpu_flattenjson", NULL, -1, jmethods,
+};
+
+PyMODINIT_FUNC
+PyInit_gtpu_flattenjson(void)
+{
+    import_array();
+    return PyModule_Create(&jmoduledef);
+}

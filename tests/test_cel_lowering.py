@@ -289,3 +289,67 @@ validations:
     message: bad
 """, kind="K8sCelAbsorb")
     assert "K8sCelAbsorb" in tpu.fallback_kinds()
+
+
+def test_cel_object_macro_nested_in_param_macro():
+    """ADVICE r2 (high): a StrPred needle under AnyAxis inside a
+    param-list macro (object-list macro nested in a param-list macro)
+    must either lower with its needle bound — evaluating the [N, M, K]
+    grid — or fall back at add_template time.  It must NEVER lower
+    'successfully' into a program that raises on every query."""
+    import yaml as _yaml
+
+    kind = "K8sCelNestedElem"
+    tpu = TpuDriver(batch_bucket=16, cel_driver=CELDriver())
+    doc = {
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind},
+                             "validation": {"openAPIV3Schema": {
+                                 "type": "object",
+                                 "properties": {"prefixes": {
+                                     "type": "array",
+                                     "items": {"type": "string"}}}}}}},
+            "targets": [{
+                "target": TARGET,
+                "code": [{"engine": "K8sNativeValidation",
+                          "source": _yaml.safe_load("""
+validations:
+  - expression: >-
+      params.prefixes.exists(p,
+      object.spec.containers.all(c, c.image.startsWith(p)))
+    message: no common registry prefix
+""")}],
+            }],
+        },
+    }
+    tpu.add_template(ConstraintTemplate.from_unstructured(doc))
+    con = Constraint.from_unstructured({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind, "metadata": {"name": "nested"},
+        "spec": {"parameters": {"prefixes": ["good/", "ok-"]}},
+    })
+    tpu.add_constraint(con)
+    objs = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"containers": [{"name": "c", "image": "good/x"},
+                                 {"name": "d", "image": "good/y"}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"name": "c", "image": "good/x"},
+                                 {"name": "d", "image": "bad/y"}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "c"},
+         "spec": {"containers": [{"name": "c", "image": "ok-1"}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "d"},
+         "spec": {"containers": []}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "e"},
+         "spec": {}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "f"},
+         "spec": {"containers": [{"name": "c", "image": 7}]}},
+    ]
+    # whichever way it resolved (device or fallback), verdicts must match
+    # the CEL oracle — and queries must not raise
+    _assert_agreement(tpu, [con], objs)
+    # with the AnyAxis recursion the template should stay on the device
+    assert kind in tpu.lowered_kinds(), tpu.fallback_kinds()

@@ -1,0 +1,316 @@
+"""Differential tests for the threaded JSON flattener (flattenjsonmod.c).
+
+The JSON lane interns strings in thread-partition order, so vocab *order*
+differs from the sequential Python walk.  Exactness contract instead: run
+the JSON lane FIRST, then the Python oracle over the SAME vocab — every
+Python intern is then a lookup hit, so all sid arrays must be
+bit-identical.  (Same trick as the audit pipeline: one shared driver
+vocab, consistency is what matters, not order.)
+"""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.ops import native
+from gatekeeper_tpu.ops.flatten import (
+    Axis,
+    Flattener,
+    KeySetCol,
+    MapKeyCol,
+    ParentIdxCol,
+    RaggedCol,
+    RaggedKeySetCol,
+    ScalarCol,
+    Schema,
+    Vocab,
+)
+from gatekeeper_tpu.utils.rawjson import RawJSON, as_raw
+
+jmod = native.load_json()
+
+
+def rich_schema():
+    containers = Axis(((("spec", "containers"),),
+                       (("spec", "initContainers"),)))
+    ports = Axis(((("spec", "containers"), ("ports",)),
+                  (("spec", "initContainers"), ("ports",))))
+    labels = Axis(((("metadata", "labels"),),))
+    s = Schema()
+    s.scalars = [
+        ScalarCol(("spec", "hostNetwork")),
+        ScalarCol(("spec", "priority")),
+        ScalarCol(("metadata", "name")),
+        ScalarCol(("spec", "nodeName")),
+        ScalarCol(("__review__", "kind", "group")),
+        ScalarCol(("__review__", "kind", "kind")),
+        ScalarCol(("__review__", "operation")),
+        ScalarCol(("__review__", "namespace")),
+        ScalarCol(("__review__", "userInfo", "username")),
+    ]
+    s.raggeds = [
+        RaggedCol(containers, ("securityContext", "privileged")),
+        RaggedCol(containers, ("name",)),
+        RaggedCol(containers, ()),
+        RaggedCol(ports, ("hostPort",)),
+        RaggedCol(labels, ()),
+    ]
+    s.keysets = [KeySetCol(("metadata", "labels")),
+                 KeySetCol(("metadata", "annotations"))]
+    s.map_keys = [MapKeyCol(labels)]
+    s.ragged_keysets = [RaggedKeySetCol(axis=containers, subpath=()),
+                        RaggedKeySetCol(axis=containers,
+                                        subpath=("resources", "limits"))]
+    s.parent_idx = [ParentIdxCol(axis=ports, parent=containers)]
+    return s
+
+
+def rich_objects(n, seed=0):
+    rng = random.Random(seed)
+    objs = []
+    strings = ["a", "", "b" * 50, "unié中文", "tab\there",
+               'quote"back\\slash', "line\nbreak", "☃ snowman"]
+    for i in range(n):
+        containers = []
+        for j in range(rng.randint(0, 5)):
+            c = {"name": f"c{j}-{rng.choice(strings)}"}
+            if rng.random() < 0.5:
+                c["securityContext"] = {"privileged": rng.choice(
+                    [True, False, "x", 1, None, {"m": 1}, [1]])}
+            if rng.random() < 0.4:
+                c["ports"] = [{"hostPort": rng.choice(
+                    [rng.randint(1, 70000), 2.5, -1, 1e300, "80"])}
+                    for _ in range(rng.randint(0, 3))]
+            if rng.random() < 0.4:
+                c["resources"] = {"limits": {
+                    rng.choice(["cpu", "memory", "gpu"]): "1"
+                    for _ in range(rng.randint(0, 3))}}
+            if rng.random() < 0.2:
+                c["flag"] = False  # truthy-key filter in ragged keysets
+            containers.append(c)
+        obj = {
+            "apiVersion": rng.choice(["v1", "apps/v1", "batch/v1", ""]),
+            "kind": rng.choice(["Pod", "Deployment", "ReplicaSet"]),
+            "metadata": {
+                "name": f"o{i}",
+                "namespace": rng.choice(["default", "kube-system", ""]),
+            },
+            "spec": {"containers": containers},
+        }
+        if rng.random() < 0.4:
+            obj["metadata"]["labels"] = {
+                f"k{x}{rng.choice(strings)}": rng.choice(
+                    [f"v{x}", True, False, None, 3])
+                for x in range(rng.randint(1, 5))
+            }
+        if rng.random() < 0.2:
+            obj["metadata"]["annotations"] = {
+                "a": "b", "c": False, "d": rng.choice(strings)}
+        if rng.random() < 0.2:
+            obj["metadata"]["generateName"] = "gen-"
+        if rng.random() < 0.3:
+            obj["spec"]["hostNetwork"] = rng.choice([True, False, "maybe"])
+        if rng.random() < 0.3:
+            obj["spec"]["priority"] = rng.choice(
+                [1, 2.5, -3, "high", None, 10 ** 400, -(10 ** 400), 0.1])
+        if rng.random() < 0.3:
+            obj["spec"]["nodeName"] = rng.choice(strings)
+        if rng.random() < 0.2:
+            obj["spec"]["initContainers"] = [
+                {"name": "init", "ports": [{"hostPort": 53}]}]
+        objs.append(obj)
+    return objs
+
+
+def assert_batches_equal(schema, a, b):
+    np.testing.assert_array_equal(a.group_sid, b.group_sid)
+    np.testing.assert_array_equal(a.kind_sid, b.kind_sid)
+    np.testing.assert_array_equal(a.ns_sid, b.ns_sid)
+    np.testing.assert_array_equal(a.name_sid, b.name_sid)
+    for spec in schema.scalars:
+        np.testing.assert_array_equal(
+            a.scalars[spec].kind, b.scalars[spec].kind, err_msg=str(spec))
+        np.testing.assert_array_equal(
+            a.scalars[spec].num, b.scalars[spec].num, err_msg=str(spec))
+        np.testing.assert_array_equal(
+            a.scalars[spec].sid, b.scalars[spec].sid, err_msg=str(spec))
+    for axis in schema.axes():
+        np.testing.assert_array_equal(a.axis_counts[axis],
+                                      b.axis_counts[axis])
+    for spec in schema.raggeds:
+        np.testing.assert_array_equal(
+            a.raggeds[spec].kind, b.raggeds[spec].kind, err_msg=str(spec))
+        np.testing.assert_array_equal(
+            a.raggeds[spec].num, b.raggeds[spec].num, err_msg=str(spec))
+        np.testing.assert_array_equal(
+            a.raggeds[spec].sid, b.raggeds[spec].sid, err_msg=str(spec))
+    for spec in schema.keysets:
+        np.testing.assert_array_equal(a.keysets[spec].sid,
+                                      b.keysets[spec].sid)
+        np.testing.assert_array_equal(a.keysets[spec].count,
+                                      b.keysets[spec].count)
+    for spec in schema.map_keys:
+        np.testing.assert_array_equal(a.map_keys[spec].sid,
+                                      b.map_keys[spec].sid)
+    for spec in schema.parent_idx:
+        np.testing.assert_array_equal(a.parent_idx[spec].idx,
+                                      b.parent_idx[spec].idx)
+    for spec in schema.ragged_keysets:
+        np.testing.assert_array_equal(a.ragged_keysets[spec].sid,
+                                      b.ragged_keysets[spec].sid)
+        np.testing.assert_array_equal(a.ragged_keysets[spec].count,
+                                      b.ragged_keysets[spec].count)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_matches_python_shared_vocab():
+    schema = rich_schema()
+    objs = rich_objects(400)
+    raws = [as_raw(o) for o in objs]
+    vocab = Vocab()
+    # JSON lane first: it creates every interning; the Python oracle then
+    # only looks up, so sids must agree bitwise.
+    nat = Flattener(schema, vocab).flatten_raw(raws, pad_n=512)
+    py = Flattener(schema, vocab, use_native=False).flatten(objs, pad_n=512)
+    assert_batches_equal(schema, py, nat)
+    # genname presence column
+    want = np.zeros(512, np.uint8)
+    for i, o in enumerate(objs):
+        if "generateName" in (o.get("metadata") or {}):
+            want[i] = 1
+    np.testing.assert_array_equal(nat.has_generate_name, want)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_thread_counts_agree():
+    """1-thread and 8-thread runs decode to the same strings (ids may
+    differ — vocabularies are independent)."""
+    schema = rich_schema()
+    objs = rich_objects(300, seed=7)
+    raws = [as_raw(o) for o in objs]
+    outs = []
+    for nt in ("1", "8"):
+        os.environ["GTPU_FLATTEN_THREADS"] = nt
+        try:
+            v = Vocab()
+            outs.append((v, Flattener(schema, v).flatten_raw(
+                raws, pad_n=320)))
+        finally:
+            del os.environ["GTPU_FLATTEN_THREADS"]
+    (v1, b1), (v8, b8) = outs
+
+    def decode(v, arr):
+        flat = arr.ravel()
+        return [v.string(s) if s >= 0 else None for s in flat.tolist()]
+
+    assert decode(v1, b1.name_sid) == decode(v8, b8.name_sid)
+    for spec in schema.raggeds:
+        assert decode(v1, b1.raggeds[spec].sid) == \
+            decode(v8, b8.raggeds[spec].sid)
+        np.testing.assert_array_equal(b1.raggeds[spec].kind,
+                                      b8.raggeds[spec].kind)
+    for spec in schema.keysets:
+        assert decode(v1, b1.keysets[spec].sid) == \
+            decode(v8, b8.keysets[spec].sid)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_invalid_raises():
+    schema = rich_schema()
+    raws = [as_raw({"kind": "Pod"}), RawJSON(b"{not json")]
+    with pytest.raises(ValueError, match="item 1"):
+        Flattener(schema, Vocab()).flatten_raw(raws, pad_n=8)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_weird_documents():
+    schema = rich_schema()
+    vocab = Vocab()
+    cases = [b"{}", b"[1,2]", b"null", b'"str"', b"3.5",
+             b'{"spec": null}', b'{"spec": {"containers": "x"}}',
+             b'{"apiVersion": 7, "kind": null}',
+             b'{"metadata": {"name": null, "namespace": 3}}',
+             b'{"a": "\\u00e9\\u4e2d\\ud83d\\ude00"}']
+    raws = [RawJSON(c) for c in cases]
+    nat = Flattener(schema, vocab).flatten_raw(raws, pad_n=16)
+    # dict-parseable cases must agree with the Python path; non-dict roots
+    # behave as empty rows (identity "")
+    objs = [json.loads(c) for c in cases]
+    objs = [o if isinstance(o, dict) else {} for o in objs]
+    py = Flattener(schema, vocab, use_native=False).flatten(objs, pad_n=16)
+    assert_batches_equal(schema, py, nat)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_flatten_delegates_rawjson():
+    """Flattener.flatten() auto-routes all-RawJSON batches to the native
+    JSON lane; a materialized (possibly mutated) RawJSON disables it."""
+    schema = rich_schema()
+    objs = rich_objects(50, seed=3)
+    vocab = Vocab()
+    raws = [as_raw(o) for o in objs]
+    nat = Flattener(schema, vocab).flatten(raws, pad_n=64)
+    assert nat.has_generate_name is not None  # proof the JSON lane ran
+    py = Flattener(schema, vocab, use_native=False).flatten(objs, pad_n=64)
+    assert_batches_equal(schema, py, nat)
+    # a touched (materialized) RawJSON stays on the JSON lane via
+    # re-serialization of its current dict state — mutations are honored
+    raws2 = [as_raw(o) for o in objs]
+    _ = raws2[0]["kind"]
+    raws2[1]["metadata"]["name"] = "mutated"  # diverges from .raw
+    touched = Flattener(schema, vocab).flatten(raws2, pad_n=64)
+    assert touched.has_generate_name is not None
+    assert vocab.string(int(touched.name_sid[1])) == "mutated"
+    objs2 = [dict(o) for o in objs]
+    objs2[1] = json.loads(json.dumps(objs2[1]))
+    objs2[1]["metadata"]["name"] = "mutated"
+    py2 = Flattener(schema, vocab, use_native=False).flatten(
+        objs2, pad_n=64)
+    assert_batches_equal(schema, py2, touched)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_review_docs_override():
+    """Provided review docs (webhook lane) override synthesized
+    __review__ columns."""
+    schema = rich_schema()
+    objs = rich_objects(20, seed=9)
+    reviews = [{"kind": {"group": "apps", "version": "v1",
+                         "kind": "Deployment"},
+                "operation": "CREATE", "name": f"n{i}", "namespace": "ns",
+                "userInfo": {"username": f"u{i}"}}
+               for i in range(len(objs))]
+    vocab = Vocab()
+    raws = [as_raw(o) for o in objs]
+    nat = Flattener(schema, vocab).flatten_raw(raws, pad_n=32,
+                                               reviews=reviews)
+    py = Flattener(schema, vocab, use_native=False).flatten(
+        objs, pad_n=32, reviews=reviews)
+    assert_batches_equal(schema, py, nat)
+
+
+def test_rawjson_mutation_and_copy_semantics():
+    """Review findings: writes before first read must survive the lazy
+    parse; deepcopy of a mutated instance must capture current state
+    (the mutation system's clear()/update() rollback pattern)."""
+    import copy
+
+    r = as_raw({"kind": "Pod", "metadata": {"name": "a"}})
+    r["kind"] = "Deployment"          # write before any read
+    assert r["kind"] == "Deployment"  # parse must not clobber the write
+    assert r["metadata"]["name"] == "a"
+
+    r2 = as_raw({"kind": "Pod", "spec": {"x": 1}})
+    r2["spec"]["x"] = 2               # materialize + mutate nested
+    snap = copy.deepcopy(r2)
+    assert snap["spec"]["x"] == 2     # deepcopy sees mutated state
+    r2.clear()
+    assert len(r2) == 0               # raw must not resurrect keys
+    r2.update(snap)
+    assert r2["spec"]["x"] == 2       # rollback pattern round-trips
+
+    r3 = as_raw({"a": 1})
+    assert copy.deepcopy(r3)["a"] == 1  # unloaded path still works

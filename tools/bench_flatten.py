@@ -1,0 +1,123 @@
+"""Host-flatten throughput benchmark: dict lane vs threaded JSON lane.
+
+The audit sweep's host-side ceiling is flatten throughput (VERDICT r2:
+~15µs/object single-core ≈ 65k objects/s < the 100k reviews/s/chip
+target).  This tool measures the shipped library's union flatten schema
+over synthetic cluster objects on:
+  - the Python flattener (oracle)
+  - the C dict columnizer (flattenmod.c, GIL-bound)
+  - the threaded JSON columnizer (flattenjsonmod.c) at 1..N threads
+
+Writes FLATTEN_BENCH.json at the repo root.
+
+Usage: python tools/bench_flatten.py [n_objects]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(n: int = 100_000):
+    from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
+    from gatekeeper_tpu.utils.rawjson import as_raw
+    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+
+    client, tpu, nt, nc = bench.build_client()
+    schema = Schema()
+    for kind in tpu.lowered_kinds():
+        schema.merge(tpu._programs[kind].program.schema)
+    n_cols = (len(schema.scalars) + len(schema.raggeds) +
+              len(schema.keysets) + len(schema.ragged_keysets) +
+              len(schema.map_keys) + len(schema.parent_idx))
+    print(f"library: {nt} templates; union schema: {n_cols} columns, "
+          f"{len(schema.axes())} axes")
+
+    print(f"generating {n} objects...")
+    objects = make_cluster_objects(n)
+    raws = [as_raw(o) for o in objects]
+    payload = sum(len(r.raw) for r in raws)
+    print(f"payload: {payload / 1e6:.1f} MB JSON "
+          f"({payload / max(1, n):.0f} B/object)")
+
+    chunk = 32_768
+    results = {}
+
+    def run(label, flatten_fn, repeats=2):
+        # warmup (page cache / allocator); then best-of-repeats
+        flatten_fn(0, min(n, 2 * chunk))
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for lo in range(0, n, chunk):
+                flatten_fn(lo, min(n, lo + chunk))
+            dt = time.perf_counter() - t0
+            best = dt if best is None or dt < best else best
+        rate = n / best
+        us = 1e6 * best / n
+        results[label] = {"objects_per_s": round(rate),
+                          "us_per_object": round(us, 2),
+                          "seconds": round(best, 3)}
+        print(f"{label:28s} {rate:10.0f} obj/s   {us:6.2f} µs/obj")
+
+    # Python oracle (sampled at 1/10 scale: it is far too slow at n)
+    sample = objects[: max(1, n // 10)]
+    v = Vocab()
+    f = Flattener(schema, v, use_native=False)
+    t0 = time.perf_counter()
+    for lo in range(0, len(sample), chunk):
+        f.flatten(sample[lo:lo + chunk], pad_n=None)
+    dt = time.perf_counter() - t0
+    results["python"] = {"objects_per_s": round(len(sample) / dt),
+                         "us_per_object": round(1e6 * dt / len(sample), 2),
+                         "seconds": round(dt, 3),
+                         "sampled_n": len(sample)}
+    print(f"{'python (oracle, 1/10 n)':28s} {len(sample) / dt:10.0f} obj/s"
+          f"   {1e6 * dt / len(sample):6.2f} µs/obj")
+
+    v = Vocab()
+    f = Flattener(schema, v, use_native=True)
+    run("c-dict (GIL-bound)",
+        lambda lo, hi: f.flatten(objects[lo:hi], pad_n=None))
+
+    for nt_ in (1, 2, 4, 8, 0):
+        os.environ["GTPU_FLATTEN_THREADS"] = str(nt_)
+        v = Vocab()
+        f = Flattener(schema, v, use_native=True)
+        label = (f"c-json {nt_}T" if nt_
+                 else f"c-json auto ({os.cpu_count()}cpu)")
+        run(label, lambda lo, hi: f.flatten_raw(raws[lo:hi], pad_n=None))
+    del os.environ["GTPU_FLATTEN_THREADS"]
+
+    best = max(results.values(), key=lambda r: r["objects_per_s"])
+    out = {
+        "n_objects": n,
+        "chunk": chunk,
+        "templates": nt,
+        "schema_columns": n_cols,
+        "payload_mb": round(payload / 1e6, 1),
+        "host_cpus": os.cpu_count(),
+        "lanes": results,
+        "headline_objects_per_s": best["objects_per_s"],
+        "target_objects_per_s": 100_000,
+        "vs_target": round(best["objects_per_s"] / 100_000, 2),
+    }
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "FLATTEN_BENCH.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({"metric": "host flatten throughput",
+                      "value": best["objects_per_s"],
+                      "unit": "objects/s",
+                      "vs_baseline": out["vs_target"]}))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 100_000)
