@@ -111,6 +111,13 @@ class Manager:
 
     # --- boot (reference: readiness tracker seeding, ready_tracker.go:326)
     def start(self) -> "Manager":
+        # stored-version migration first (reference: pkg/upgrade runs
+        # before controllers, manager.go:31-60) — prunes legacy
+        # storedVersions from owned CRDs left by older deployments
+        from gatekeeper_tpu.controller.upgrade import run_upgrade
+
+        run_upgrade(self.cluster)
+
         def boot_list(gvk):
             # a missing CRD / transient apiserver error must not crash
             # boot: the watch plane retries with backoff, readiness just

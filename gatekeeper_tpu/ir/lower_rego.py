@@ -164,6 +164,16 @@ class BoolComprVal:
 
 
 @dataclass(frozen=True)
+class ArithVal:
+    """Arithmetic over numeric abstract values (plus/minus/mul/div).
+    Rego arithmetic is partial: N.NumBin's validity gates every use."""
+
+    op: str  # "add" | "sub" | "mul" | "div"
+    a: "object"
+    b: "object"
+
+
+@dataclass(frozen=True)
 class ConstVal:
     value: Any
 
@@ -194,6 +204,7 @@ class InventoryObjVal:
     kind: str
     instance: int
     apiver_var: str = ""  # named apiVersion var (regex-filterable)
+    scope: str = "namespace"  # "namespace" | "cluster"
 
 
 @dataclass(frozen=True)
@@ -392,7 +403,8 @@ class _Lowerer:
             name_col = self._scalar_col(
                 PathVal(OBJECT_ROOT + ("metadata", "name")))
             spec = N.InvTableSpec(inv.kind, feat_path,
-                                  rec.get("apiver_regex", ""))
+                                  rec.get("apiver_regex", ""),
+                                  scope=inv.scope)
             add_pred(
                 N.InventoryUniqueJoin(spec, subj, ns_col, name_col,
                                       exclude_self=rec.get("exclude",
@@ -546,6 +558,11 @@ class _Lowerer:
                 out.extend(self._definedness_preds(v, env))
             return out
         if isinstance(term, ast.Call):
+            if term.op in ("minus", "plus", "mul", "div") and \
+                    len(term.args) == 2:
+                val = self._abstract(term, env)
+                if isinstance(val, ArithVal):
+                    return self._definedness_of_val(val)
             out = []
             for a in term.args:
                 out.extend(self._definedness_preds(a, env))
@@ -565,6 +582,9 @@ class _Lowerer:
             return [(N.ParamPresent(val.name), None)]
         if isinstance(val, (ConstVal, KeySetVal, ParamListSetVal, SetDiffVal)):
             return []
+        if isinstance(val, ArithVal):
+            group = self._arith_group(val)
+            return [(N.NumDefined(self._num_operand(val)), group)]
         if isinstance(val, DynFieldVal):
             # a false-valued key is DEFINED but outside the truthy keyset, so
             # keyset-contains cannot express definedness — fall back
@@ -604,12 +624,24 @@ class _Lowerer:
         if isinstance(term, ast.SetCompr):
             return self._abstract_set_compr(term, env)
         if isinstance(term, ast.Call):
-            if term.op == "minus" and len(term.args) == 2:
+            if term.op in ("minus", "plus", "mul", "div") and \
+                    len(term.args) == 2:
                 a = self._abstract(term.args[0], env)
                 b = self._abstract(term.args[1], env)
-                if isinstance(a, ParamListSetVal) and isinstance(b, KeySetVal):
-                    return SetDiffVal(a, b)
-                return OpaqueVal("minus of non set-pattern")
+                if isinstance(a, ParamListSetVal) and \
+                        isinstance(b, KeySetVal):
+                    # set difference is minus-only; +/*// on sets is a
+                    # Rego type error (undefined) we can't express
+                    if term.op == "minus":
+                        return SetDiffVal(a, b)
+                    return OpaqueVal(f"{term.op} on sets")
+                numeric = (PathVal, ItemVal, ParamVal, ConstVal, StrFnVal,
+                           ArithVal, ParamElemFieldVal)
+                if isinstance(a, numeric) and isinstance(b, numeric):
+                    op = {"minus": "sub", "plus": "add", "mul": "mul",
+                          "div": "div"}[term.op]
+                    return ArithVal(op, a, b)
+                return OpaqueVal(f"{term.op} of non-numeric pattern")
             if term.op in ("units.parse", "units.parse_bytes") and (
                 len(term.args) == 1
             ):
@@ -793,13 +825,23 @@ class _Lowerer:
 
     def _abstract_inventory_ref(self, term: ast.Ref, env: dict):
         args = term.args
-        if (len(args) < 6 or not isinstance(args[0], ast.Scalar)
+        if (len(args) < 5 or not isinstance(args[0], ast.Scalar)
                 or args[0].value != "inventory"
                 or not isinstance(args[1], ast.Scalar)
-                or args[1].value != "namespace"):
+                or args[1].value not in ("namespace", "cluster")):
             return OpaqueVal("unbound var data")
-        # data.inventory.namespace[ns][apiver][Kind][name]
-        ns_a, av_a, kind_a, name_a = args[2:6]
+        scope = args[1].value
+        if scope == "namespace":
+            # data.inventory.namespace[ns][apiver][Kind][name]
+            if len(args) < 6:
+                return OpaqueVal("short inventory ref")
+            ns_a, av_a, kind_a, name_a = args[2:6]
+            tail = args[6:]
+        else:
+            # data.inventory.cluster[apiver][Kind][name]
+            ns_a = None
+            av_a, kind_a, name_a = args[2:5]
+            tail = args[5:]
         if not (isinstance(kind_a, ast.Scalar)
                 and isinstance(kind_a.value, str)):
             return OpaqueVal("inventory ref without a literal kind")
@@ -809,20 +851,22 @@ class _Lowerer:
                 return a.name
             return None
 
-        for a in (ns_a, av_a, name_a):
+        slots = [a for a in (ns_a, av_a, name_a) if a is not None]
+        for a in slots:
             if slot_var(a) is None:
                 return OpaqueVal("inventory ref with non-var slot")
         inv = InventoryObjVal(kind_a.value, self._fresh_instance(),
                               apiver_var=(""
                                           if av_a.name.startswith("$w")
-                                          else av_a.name))
+                                          else av_a.name),
+                              scope=scope)
         for a, slot in ((ns_a, "ns"), (av_a, "apiver"), (name_a, "name")):
-            if not a.name.startswith("$w"):
+            if a is not None and not a.name.startswith("$w"):
                 if a.name in env:
                     return OpaqueVal("inventory slot var already bound")
                 env[a.name] = InventoryMetaVal(inv, slot)
         base = InventoryFeatVal(inv, ())
-        for arg in args[6:]:
+        for arg in tail:
             if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
                 base = InventoryFeatVal(inv, base.path + (arg.value,))
             elif isinstance(arg, ast.Var) and arg.name.startswith("$w"):
@@ -848,7 +892,10 @@ class _Lowerer:
             return ParamElemFieldVal(base.name, base.field + (key,),
                                      base.instance)
         if isinstance(base, ParamVal):
-            return OpaqueVal(f"nested parameter path {base.name}.{key}")
+            # nested object params (input.parameters.runAsUser.rule)
+            # lower to dotted ParamSpec names; p_get/p_has resolve the
+            # path at table-build time (PSP users/fsgroup shapes)
+            return ParamVal(f"{base.name}.{key}")
         if isinstance(base, OpaqueVal):
             return base
         return OpaqueVal(f"step on {type(base).__name__}")
@@ -1155,19 +1202,15 @@ class _Lowerer:
                     raise LowerError("inventory-to-inventory comparison")
                 raise _InvJoinSignal(a.inv, a.path, b)
         axis = None
-        for v in (lhs, rhs):
+        leaves = []
+        for v0 in (lhs, rhs):
+            leaves.extend(self._arith_leaves(v0))  # unwraps StrFn/Arith
+        for v in leaves:
             g = None
             if isinstance(v, (ItemVal, MapKeyVal)):
                 g = ("axis", v.axis, v.instance)
             elif isinstance(v, (ParamElemVal, ParamElemFieldVal)):
                 g = ("param", v.name, v.instance)
-            elif isinstance(v, StrFnVal) and isinstance(
-                v.inner, (ItemVal, ParamElemVal, ParamElemFieldVal)
-            ):
-                iv = v.inner
-                g = (("axis", iv.axis, iv.instance)
-                     if isinstance(iv, ItemVal)
-                     else ("param", iv.name, iv.instance))
             if g is not None:
                 if axis is not None and g != axis:
                     if {axis[0], g[0]} == {"axis", "param"}:
@@ -1211,8 +1254,25 @@ class _Lowerer:
         op_map = {"equal": "eq", "neq": "neq"}
         return N.CmpNum(lo, op_map.get(op, op), ro), axis
 
+    def _arith_leaves(self, val):
+        if isinstance(val, ArithVal):
+            return self._arith_leaves(val.a) + self._arith_leaves(val.b)
+        if isinstance(val, StrFnVal):
+            return self._arith_leaves(val.inner)
+        return [val]
+
+    def _arith_group(self, val):
+        group = None
+        for leaf in self._arith_leaves(val):
+            g = self._group_of(leaf)
+            if g is not None:
+                if group is not None and g != group:
+                    raise LowerError("arithmetic across existential groups")
+                group = g
+        return group
+
     def _group_of(self, val):
-        if isinstance(val, ItemVal):
+        if isinstance(val, (ItemVal, MapKeyVal)):
             return ("axis", val.axis, val.instance)
         if isinstance(val, (ParamElemVal, ParamElemFieldVal)):
             return ("param", val.name, val.instance)
@@ -1437,16 +1497,39 @@ class _Lowerer:
             self.depth -= 1
 
     # --- operand helpers ----------------------------------------------------
+    def _hint_type(self, name: str, field: tuple = ()):
+        """openAPIV3Schema-declared type of a (possibly dotted) parameter
+        path, descending through array items for object-list fields."""
+        node: dict = {"properties": self.schema_hint}
+        for part in name.split("."):
+            nxt = (node.get("properties") or {}).get(part)
+            if not isinstance(nxt, dict):
+                return None
+            node = nxt
+        for f in field:
+            if node.get("type") == "array":
+                node = node.get("items") or {}
+            nxt = (node.get("properties") or {}).get(f)
+            if not isinstance(nxt, dict):
+                return None
+            node = nxt
+        return node.get("type")
+
     def _is_stringy(self, val) -> bool:
         if isinstance(val, MapKeyVal):
             return True
         if isinstance(val, ConstVal):
             return isinstance(val.value, str)
         if isinstance(val, ParamVal):
-            hint = self.schema_hint.get(val.name, {})
-            return hint.get("type") == "string"
+            return self._hint_type(val.name) == "string"
         if isinstance(val, ParamElemVal):
             return True
+        if isinstance(val, ParamElemFieldVal):
+            # schema-declared string fields of object-list params compare
+            # as sids (K8sVerifyDeprecatedAPI kvs.kind, flexVolume driver,
+            # seLinuxOptions fields); undeclared fields stay numeric with
+            # cross-type term-rank semantics
+            return self._hint_type(val.name, val.field) == "string"
         return False
 
     def _num_operand(self, val):
@@ -1466,6 +1549,9 @@ class _Lowerer:
             return N.ParamElemFieldNum(val.name, val.field)
         if isinstance(val, MapKeyVal):
             raise LowerError("map iteration key used numerically")
+        if isinstance(val, ArithVal):
+            return N.NumBin(val.op, self._num_operand(val.a),
+                            self._num_operand(val.b))
         if isinstance(val, StrFnVal):
             inner = val.inner
             if isinstance(inner, PathVal):

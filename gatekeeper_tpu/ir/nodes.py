@@ -146,9 +146,11 @@ class InvTableSpec:
     kind: str
     join_path: tuple  # e.g. ("spec", "rules", "*", "host")
     apiver_regex: str = ""  # "" = any apiVersion
+    scope: str = "namespace"  # "namespace" | "cluster" (inventory root)
 
     def key(self) -> str:
-        return f"{self.kind}|{'.'.join(self.join_path)}|{self.apiver_regex}"
+        return (f"{self.kind}|{'.'.join(self.join_path)}|"
+                f"{self.apiver_regex}|{self.scope}")
 
 
 @dataclass(frozen=True)
@@ -163,6 +165,26 @@ class InventoryUniqueJoin(Expr):
     ns_col: "object"  # ScalarCol at metadata.namespace
     name_col: "object"  # ScalarCol at metadata.name
     exclude_self: bool = True
+
+
+@dataclass(frozen=True)
+class NumBin(Expr):
+    """Arithmetic over two numeric operands.  Rego arithmetic is PARTIAL:
+    defined only when both operands are numbers (and the divisor nonzero)
+    — validity gates every comparison using the result."""
+
+    op: str  # "add" | "sub" | "mul" | "div"
+    lhs: Expr
+    rhs: Expr
+
+
+@dataclass(frozen=True)
+class NumDefined(Expr):
+    """True iff a numeric operand tree is defined (used to charge the
+    definedness of an arithmetic assignment whose result may only appear
+    in the message head)."""
+
+    inner: Expr
 
 
 @dataclass(frozen=True)

@@ -304,8 +304,25 @@ def strtab_key(op: str, needle) -> str:
     return f"{base}__strtab_{op}{_xf_tag(needle)}"
 
 
+_MISSING = object()
+
+
 def p_has(params: dict, name: str) -> bool:
-    return name in params
+    """Presence of a parameter: literal key first (parameters may
+    legally contain dots, e.g. annotation keys), then as a dotted path
+    (nested object params like runAsUser.rule lower to dotted ParamSpec
+    names)."""
+    return p_get(params, name, _MISSING) is not _MISSING
+
+
+def p_get(params: dict, name: str, default=None):
+    """Fetch a parameter by literal key, falling back to a dotted-path
+    walk (utils.unstructured.deep_get)."""
+    if isinstance(params, dict) and name in params:
+        return params[name]
+    from gatekeeper_tpu.utils.unstructured import deep_get
+
+    return deep_get(params, name.split("."), default)
 
 
 def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
@@ -322,7 +339,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
         for con in constraints
     ]
     for spec in program.params:
-        vals = [p.get(spec.name) for p in params_by_con]
+        vals = [p_get(p, spec.name) for p in params_by_con]
         # every param row carries a kind tag: 0 absent, 1 false, 2 true,
         # 3 present-non-bool — so ParamTruthy (>=2), ParamPresent (>0) and
         # the exact ParamBoolIs (==2 / ==1) all read the same encoding
@@ -428,7 +445,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
     # --- derived entries: string-fn params and string-pred needle rows ----
     for node in expr_nodes(program):
         if isinstance(node, N.ParamFnNum):
-            vals = [p.get(node.name) for p in params_by_con]
+            vals = [p_get(p, node.name) for p in params_by_con]
             nums = np.zeros(c, np.float32)
             ok = np.zeros(c, bool)
             for i, v in enumerate(vals):
@@ -453,8 +470,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 if key in table:
                     continue
                 lists = [
-                    (p.get(needle.param) if isinstance(
-                        p.get(needle.param), list) else [])
+                    (p_get(p, needle.param) if isinstance(
+                        p_get(p, needle.param), list) else [])
                     for p in params_by_con
                 ]
                 k = round_up(max((len(x) for x in lists), default=0))
@@ -479,8 +496,9 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 if key in table:
                     continue
                 lists = [
-                    [x for x in (p.get(pname) or []) if isinstance(x, str)]
-                    if isinstance(p.get(pname), list) else []
+                    [x for x in (p_get(p, pname) or [])
+                     if isinstance(x, str)]
+                    if isinstance(p_get(p, pname), list) else []
                     for p in params_by_con
                 ]
                 k = round_up(max((len(x) for x in lists), default=0))
@@ -497,7 +515,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 key = f"{needle.name}__strtab_{node.op}"
                 if key in table:
                     continue
-                vals2 = [p.get(needle.name) for p in params_by_con]
+                vals2 = [p_get(p, needle.name) for p in params_by_con]
                 rowidx = np.zeros(c, np.int32)
                 ok = np.zeros(c, bool)
                 for i, v in enumerate(vals2):
@@ -661,7 +679,13 @@ def build_inventory_tables(program: N.Program, data_tree: dict,
             continue
         owners_by_sid: dict = {}
         rx = _re.compile(spec.apiver_regex) if spec.apiver_regex else None
-        for ns, by_apiver in (inv.get("namespace", {}) or {}).items():
+        if spec.scope == "cluster":
+            # data.inventory.cluster[apiver][Kind][name]: one pseudo
+            # namespace level so the loop below serves both scopes
+            scoped = {"": inv.get("cluster", {}) or {}}
+        else:
+            scoped = inv.get("namespace", {}) or {}
+        for ns, by_apiver in scoped.items():
             if not isinstance(by_apiver, dict):
                 continue
             for apiver, by_kind in by_apiver.items():
@@ -810,6 +834,28 @@ def _eval_cmp_operand(ctx: _Ctx, e: N.Expr):
         # units.parse of a non-string / unparseable string is UNDEFINED in
         # Rego (builtin error), so validity gates the whole comparison
         return num[safe], jnp.int8(2), valid, valid
+    if isinstance(e, N.NumBin):
+        # precision envelope: the whole eval plane carries numbers as
+        # float32 (module docstring), so arithmetic inherits f32 rounding
+        # vs the interpreter's f64 — exact for the integer/quantity ranges
+        # the library uses; adversarial fractions (10/3 == 3.3333333) can
+        # diverge at the 7th significant digit, same as any direct f32
+        # column comparison
+        lv, _lr, ln, lp = _eval_cmp_operand(ctx, e.lhs)
+        rv, _rr, rn, rp = _eval_cmp_operand(ctx, e.rhs)
+        valid = ln & rn & lp & rp
+        if e.op == "add":
+            num = lv + rv
+        elif e.op == "sub":
+            num = lv - rv
+        elif e.op == "mul":
+            num = lv * rv
+        else:  # div: Rego errors (undefined) on division by zero
+            valid = valid & (rv != 0)
+            num = lv / jnp.where(rv == 0, 1.0, rv)
+        # arithmetic is number-only: non-number operands are UNDEFINED, so
+        # term-order ranks never apply to the result
+        return num, jnp.int8(2), valid, valid
     if isinstance(e, N.CountNum):
         a = _feat_arrays(ctx, e.col)
         kind = _expand_for_ctx(ctx, a["kind"], False)
@@ -1112,6 +1158,9 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
             ctx.elem_k = None
         valid = jnp.arange(k) < cnt
         return jnp.any(inner & valid, axis=-1)
+    if isinstance(e, N.NumDefined):
+        _num, _rank, _isnum, present = _eval_cmp_operand(ctx, e.inner)
+        return present
     raise LowerError(f"cannot evaluate IR node {e}")
 
 

@@ -155,14 +155,22 @@ class EvaluateServicer:
 
         resp = pb.SweepResponse()
         try:
-            objects = [json.loads(b) for b in req.object_json]
+            from gatekeeper_tpu.utils.rawjson import RawJSON
+
+            # the wire bytes ARE the flatten input: RawJSON defers dict
+            # materialization to slow paths/rendering, and the threaded
+            # JSON columnizer parses GIL-released (ops/flatten.flatten_raw)
+            objects = [RawJSON(bytes(b)) for b in req.object_json]
             limit = req.violations_limit or 20
             ep = req.enforcement_point or "audit.gatekeeper.sh"
             cfg = ReviewCfg(enforcement_point=ep)
-            # ONE lock span: the constraint snapshot must stay valid
-            # through evaluation (a concurrent remove_template would tear
-            # down tables under the sweep), and the evaluator/driver state
-            # (vocab interning, jit caches) is not thread-safe
+            # SPLIT lock spans (round-3 de-serialization): flatten+submit
+            # hold the lock (vocab-table/param-table builds and the
+            # constraint snapshot aren't thread-safe), but the DEVICE
+            # execution wait (sweep_collect) runs outside it — a second
+            # Sweep RPC flattens chunk N+1 while chunk N evaluates.
+            # Concurrent flatten_raw merges into the shared vocab are safe
+            # by construction: per-thread intern tables, GIL-held merge.
             with self._lock:
                 cons = list(self._constraints.values())
                 if req.constraint_keys:
@@ -172,9 +180,10 @@ class EvaluateServicer:
                 # honor the CALLER's top-k capacity (config drift between
                 # control plane and sidecar must not truncate silently)
                 self.evaluator.violations_limit = limit
-                swept = self.evaluator.sweep(
+                pending = self.evaluator.sweep_submit(
                     cons, objects, return_bits=req.exact_totals)
-
+            swept = self.evaluator.sweep_collect(pending)
+            with self._lock:
                 review_cache: dict = {}
 
                 def review_of(oi):

@@ -317,6 +317,35 @@ class KubeCluster:
                 if e.status != 409 or attempt == 3:
                     raise
 
+    def apply_status(self, obj: dict) -> None:
+        """Write ``obj.status`` through the /status subresource (required
+        for kinds whose main PUT silently drops status — CRDs always,
+        constraint CRs when their CRD declares the subresource).  Falls
+        back to a main-resource apply when the server has no subresource
+        for the kind (404 on the status path)."""
+        gvk = gvk_of(obj)
+        ns, name = namespace_of(obj), name_of(obj)
+        coll = self._collection_path(gvk, ns)
+        for attempt in range(4):
+            try:
+                current = self._request("GET", f"{coll}/{name}")
+            except KubeError as e:
+                if e.status == 404:
+                    return  # object gone: nothing to update
+                raise
+            body = dict(current)
+            body["status"] = obj.get("status")
+            try:
+                self._request("PUT", f"{coll}/{name}/status", body=body)
+                return
+            except KubeError as e:
+                if e.status == 404:
+                    # no status subresource served: main-resource write
+                    self.apply(obj)
+                    return
+                if e.status != 409 or attempt == 3:
+                    raise
+
     def delete(self, obj: dict) -> None:
         gvk = gvk_of(obj)
         path = self._collection_path(gvk, namespace_of(obj)) \

@@ -50,6 +50,12 @@ class RoutingCluster:
 
         self._for(gvk_of(obj), namespace_of(obj)).apply(obj)
 
+    def apply_status(self, obj: dict) -> None:
+        from gatekeeper_tpu.utils.unstructured import namespace_of
+
+        src = self._for(gvk_of(obj), namespace_of(obj))
+        getattr(src, "apply_status", src.apply)(obj)
+
     def delete(self, obj: dict) -> None:
         from gatekeeper_tpu.utils.unstructured import namespace_of
 

@@ -255,8 +255,11 @@ def load_library(client, library: Optional[str] = None,
 
     library = library or library_dir()
     nt = nc = 0
-    for tpath in sorted(glob.glob(
-            os.path.join(library, "general", "*", "template.yaml"))):
+    for tpath in sorted(
+            glob.glob(os.path.join(library, "general", "*",
+                                   "template.yaml")) +
+            glob.glob(os.path.join(library, "pod-security-policy", "*",
+                                   "template.yaml"))):
         doc = load_yaml_file(tpath)[0]
         kind = (doc.get("spec", {}).get("crd", {}).get("spec", {})
                 .get("names", {}).get("kind", ""))

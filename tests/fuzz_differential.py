@@ -30,6 +30,8 @@ from gatekeeper_tpu.target.target import K8sValidationTarget  # noqa: E402
 from gatekeeper_tpu.utils.unstructured import load_yaml_file  # noqa: E402
 
 LIB = os.path.join(os.path.dirname(__file__), "..", "library", "general")
+LIB_PSP = os.path.join(os.path.dirname(__file__), "..", "library",
+                       "pod-security-policy")
 TARGET = "admission.k8s.gatekeeper.sh"
 
 IMAGES = ["openpolicyagent/opa:0.9.2", "nginx", "nginx:latest", "a/b:v1",
@@ -165,12 +167,20 @@ def build_fuzz_driver():
 
     tpu = TpuDriver(batch_bucket=64, cel_driver=CELDriver())
     constraints = []
-    for name in sorted(os.listdir(LIB)):
+    entries = [os.path.join(LIB, n) for n in sorted(os.listdir(LIB))] + \
+        [os.path.join(LIB_PSP, n) for n in sorted(os.listdir(LIB_PSP))]
+    for entry in entries:
         t = ConstraintTemplate.from_unstructured(
-            load_yaml_file(os.path.join(LIB, name, "template.yaml"))[0])
+            load_yaml_file(os.path.join(entry, "template.yaml"))[0])
         tpu.add_template(t)
         constraints.append(Constraint.from_unstructured(load_yaml_file(
-            os.path.join(LIB, name, "samples", "constraint.yaml"))[0]))
+            os.path.join(entry, "samples", "constraint.yaml"))[0]))
+    # cluster-scope referential coverage (storageclass joins)
+    for nm in ("standard", "fast"):
+        tpu.add_data(
+            TARGET, ["cluster", "storage.k8s.io/v1", "StorageClass", nm],
+            {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+             "metadata": {"name": nm}})
     # referential coverage: seed the inventory with ingresses sharing
     # hosts/names/namespaces with the generated review objects
     inv_rng = random.Random(991)

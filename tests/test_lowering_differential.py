@@ -1141,3 +1141,208 @@ violation[{"msg": msg}] {
         want = len(tpu._interp.query(TARGET, [con], review).results)
         assert g == want, (o, g, want)
     assert got == [1, 0, 0]
+
+
+def test_new_library_differential_adversarial():
+    """Round-3 library growth (PSP suite + arithmetic + cluster-scope
+    referential joins + dotted params): device grids must agree with the
+    interpreter over an adversarial population probing the NEW lowering
+    constructs — NumBin partiality (non-numeric operands, missing
+    fields), dotted param paths, param object-lists, map-key startswith
+    over annotations, negated cluster inventory joins."""
+    import os
+
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    lib = os.path.join(os.path.dirname(__file__), "..", "library")
+    names = [
+        ("pod-security-policy", "allowprivilegeescalation"),
+        ("pod-security-policy", "procmount"),
+        ("pod-security-policy", "flexvolumes"),
+        ("pod-security-policy", "seccomp"),
+        ("pod-security-policy", "selinux"),
+        ("pod-security-policy", "users"),
+        ("pod-security-policy", "fsgroup"),
+        ("pod-security-policy", "apparmor"),
+        ("pod-security-policy", "volumes"),
+        ("general", "horizontalpodautoscaler"),
+        ("general", "poddisruptionbudget"),
+        ("general", "storageclass"),
+        ("general", "verifydeprecatedapi"),
+        ("general", "disallowedrepos"),
+        ("general", "containerrequests"),
+        ("general", "ephemeralstoragelimit"),
+        ("general", "blockloadbalancer"),
+    ]
+    tpu = TpuDriver(batch_bucket=16)
+    constraints = []
+    for cat, name in names:
+        tdoc = load_yaml_file(
+            os.path.join(lib, cat, name, "template.yaml"))[0]
+        tpu.add_template(ConstraintTemplate.from_unstructured(tdoc))
+        cdoc = load_yaml_file(
+            os.path.join(lib, cat, name, "samples", "constraint.yaml"))[0]
+        con = Constraint.from_unstructured(cdoc)
+        tpu.add_constraint(con)
+        constraints.append(con)
+    assert not tpu.fallback_kinds(), tpu.fallback_kinds()
+
+    # referential inventory for storageclass (cluster-scoped join:
+    # data.inventory.cluster[apiVersion][Kind][name])
+    for nm in ("standard", "fast"):
+        tpu.add_data(
+            TARGET,
+            ["cluster", "storage.k8s.io/v1", "StorageClass", nm],
+            {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+             "metadata": {"name": nm}})
+
+    rng = random.Random(20260729)
+
+    def sec_ctx():
+        sc = {}
+        if rng.random() < 0.5:
+            sc["allowPrivilegeEscalation"] = rng.choice(
+                [True, False, "false", None, 0])
+        if rng.random() < 0.3:
+            sc["procMount"] = rng.choice(
+                ["Default", "Unmasked", "unmasked", 3])
+        if rng.random() < 0.4:
+            sc["seccompProfile"] = rng.choice([
+                {"type": "RuntimeDefault"}, {"type": "Unconfined"},
+                {"type": 5}, {}, "RuntimeDefault"])
+        if rng.random() < 0.3:
+            sc["seLinuxOptions"] = rng.choice([
+                {"level": "s0:c123,c456", "role": "object_r",
+                 "type": "svirt_sandbox_file_t", "user": "system_u"},
+                {"level": "s1:c9"}, {"level": 7}, {}, []])
+        if rng.random() < 0.4:
+            sc["runAsUser"] = rng.choice(
+                [0, 100, 150, 250, -3, "150", 2.5, None, True])
+        return sc
+
+    def rand_obj(i):
+        roll = rng.random()
+        if roll < 0.5:
+            meta = {"name": f"p{i}"}
+            if rng.random() < 0.4:
+                prefix = "container.apparmor.security.beta.kubernetes.io/"
+                meta["annotations"] = {
+                    rng.choice([prefix + "c0", prefix, "other/ann",
+                                prefix + "zzz"]): rng.choice(
+                        ["runtime/default", "unconfined", 7, None, True])
+                    for _ in range(rng.randint(1, 3))
+                }
+            spec = {}
+            cs = []
+            for j in range(rng.randint(0, 3)):
+                c = {"name": f"c{j}",
+                     "image": rng.choice(["nginx", "k8s.gcr.io/x",
+                                          "safeimages.corp/y", 7])}
+                if rng.random() < 0.6:
+                    c["securityContext"] = sec_ctx()
+                if rng.random() < 0.4:
+                    c["resources"] = {
+                        rng.choice(["requests", "limits"]): {
+                            "cpu": rng.choice(["100m", "5", 1, True]),
+                            "memory": rng.choice(["512Mi", "4Gi", "x"]),
+                            "ephemeral-storage": rng.choice(
+                                ["100Mi", "3Gi", 7, "zz"]),
+                        }}
+                cs.append(c)
+            spec["containers"] = cs
+            if rng.random() < 0.3:
+                spec["initContainers"] = [
+                    {"name": "i", "image": "busybox",
+                     "securityContext": sec_ctx()}]
+            if rng.random() < 0.4:
+                spec["securityContext"] = {
+                    k: v for k, v in (
+                        ("runAsUser", rng.choice([0, 120, 300, "x"])),
+                        ("fsGroup", rng.choice([5, 500, 1500, "500",
+                                                2.5, None])),
+                        ("seccompProfile", rng.choice(
+                            [{"type": "RuntimeDefault"},
+                             {"type": "Localhost"}])),
+                        ("seLinuxOptions",
+                         {"level": "s0:c123,c456", "role": "object_r",
+                          "type": "svirt_sandbox_file_t",
+                          "user": "system_u"}),
+                    ) if rng.random() < 0.5}
+            if rng.random() < 0.4:
+                vols = []
+                for v in range(rng.randint(1, 3)):
+                    vol = {"name": f"v{v}"}
+                    vol[rng.choice(["emptyDir", "hostPath", "configMap",
+                                    "flexVolume", "weird-type"])] = \
+                        rng.choice([{}, {"driver": "example/lvm"},
+                                    {"driver": "example/nope"},
+                                    {"driver": 9}, "x", None])
+                    vols.append(vol)
+                spec["volumes"] = vols
+            return {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": meta, "spec": spec}
+        if roll < 0.65:
+            return {"apiVersion": "autoscaling/v2",
+                    "kind": "HorizontalPodAutoscaler",
+                    "metadata": {"name": f"h{i}"},
+                    "spec": {k: v for k, v in (
+                        ("minReplicas", rng.choice(
+                            [1, 5, 11, "3", 2.5, None, True])),
+                        ("maxReplicas", rng.choice(
+                            [2, 5, 25, "9", 0, None])),
+                    ) if rng.random() < 0.9}}
+        if roll < 0.75:
+            return {"apiVersion": "policy/v1",
+                    "kind": "PodDisruptionBudget",
+                    "metadata": {"name": f"b{i}"},
+                    "spec": rng.choice([
+                        {"maxUnavailable": 0}, {"maxUnavailable": "0"},
+                        {"maxUnavailable": 1}, {"minAvailable": "100%"},
+                        {"minAvailable": 2}, {}])}
+        if roll < 0.9:
+            return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                    "metadata": {"name": f"v{i}"},
+                    "spec": {k: v for k, v in (
+                        ("storageClassName", rng.choice(
+                            ["standard", "fast", "nope", 7, None])),
+                    ) if rng.random() < 0.8}}
+        return {"apiVersion": rng.choice(
+                    ["extensions/v1beta1", "networking.k8s.io/v1"]),
+                "kind": "Ingress", "metadata": {"name": f"g{i}"},
+                "spec": {}}
+
+    objects = [rand_obj(i) for i in range(400)]
+    target = K8sValidationTarget()
+    reviews = [target.handle_review(AugmentedUnstructured(object=o))
+               for o in objects]
+    got = tpu.query_batch(TARGET, constraints, reviews)
+    # raw-grid lane: render_messages=False returns the grid verdicts
+    # directly — the rendered lane re-checks hits through the exact
+    # engine and so MASKS false-positive grid bugs (repo invariant)
+    raw = tpu.query_batch(TARGET, constraints, reviews,
+                          render_messages=False)
+    interp = tpu._interp
+    for oi, review in enumerate(reviews):
+        expected = []
+        for con in constraints:
+            if not target.to_matcher(con.match).match(review):
+                continue
+            expected.extend(interp.query(TARGET, [con], review).results)
+        key = lambda r: (r.constraint["metadata"]["name"], r.msg)
+        assert sorted(map(key, got[oi].results)) == sorted(
+            map(key, expected)), (
+            f"divergence on object {oi}: {objects[oi]}\n"
+            f"got={sorted(map(key, got[oi].results))}\n"
+            f"want={sorted(map(key, expected))}"
+        )
+        from collections import Counter
+
+        raw_counts = Counter(r.constraint["metadata"]["name"]
+                             for r in raw[oi].results)
+        want_counts = Counter(r.constraint["metadata"]["name"]
+                              for r in expected)
+        # the grid is per (constraint, object): multiple violations of
+        # one constraint collapse to one raw hit
+        assert set(raw_counts) == set(want_counts), (
+            f"raw-grid divergence on object {oi}: {objects[oi]}\n"
+            f"raw={sorted(raw_counts)} want={sorted(want_counts)}")

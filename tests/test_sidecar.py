@@ -53,7 +53,7 @@ def test_remote_driver_review_parity(sidecar):
     load_library(rc)
     load_library(lc)
     assert remote.fallback_kinds() == {}
-    assert len(remote.lowered_kinds()) == 23
+    assert len(remote.lowered_kinds()) >= 40  # full shipped library
 
     objects = make_cluster_objects(120, seed=17)
     for o in objects:
@@ -191,3 +191,59 @@ def test_sidecar_process_e2e(tmp_path):
     finally:
         side.terminate()
         side.wait(timeout=10)
+
+
+def test_concurrent_sweeps_pipeline_and_agree(sidecar):
+    """Round-3 de-serialization: the Sweep handler holds the lock only
+    through flatten+submit; device waits overlap.  Four threads sweeping
+    concurrently must each get results identical to a serial sweep of
+    the same chunk (correctness under contention), and the concurrent
+    wall-clock must not exceed the serial wall-clock by more than a
+    small factor (the old one-lock design serialized fully)."""
+    import threading
+    import time
+
+    address, _svc = sidecar
+    rc, remote = _remote_client(address)
+    load_library(rc)
+    remote.wipe_data()
+    ev = RemoteEvaluator(remote, violations_limit=20)
+    cons = [c for c in rc.constraints()]
+
+    chunks = [make_cluster_objects(200, seed=100 + i) for i in range(4)]
+
+    # serial reference pass (also warms vocab + jit for both lanes)
+    serial = []
+    t0 = time.perf_counter()
+    for ch in chunks:
+        serial.append(ev.sweep(cons, ch))
+    serial_s = time.perf_counter() - t0
+
+    results = [None] * 4
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = ev.sweep(cons, chunks[i])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    concurrent_s = time.perf_counter() - t0
+    assert not errors, errors
+
+    def fold(swept):
+        # RemoteEvaluator.sweep returns {(kind, name): (total, kept)}
+        return {k: (total, sorted(oi for oi, _m, _d in kept))
+                for k, (total, kept) in swept.items()}
+
+    for i in range(4):
+        assert fold(results[i]) == fold(serial[i]), f"chunk {i} diverged"
+    # not a benchmark: just catch a regression to full serialization
+    # (warm serial pass vs concurrent pass of identical work)
+    assert concurrent_s < serial_s * 2.0, (concurrent_s, serial_s)

@@ -616,3 +616,40 @@ def test_readiness_data_pruner_on_watch_removal():
         "spec": {"sync": {"syncOnly": []}},
     })
     assert mgr.tracker.for_kind("data").satisfied()
+
+
+def test_upgrade_manager_prunes_stored_versions():
+    """Boot-time CRD storedVersions migration (reference
+    pkg/upgrade/manager.go:31-60): legacy stored versions no longer in
+    spec.versions are pruned for owned CRDs; foreign CRDs untouched."""
+    from gatekeeper_tpu.controller.upgrade import CRD_GVK, run_upgrade
+    from gatekeeper_tpu.sync.source import FakeCluster
+
+    cluster = FakeCluster()
+    owned = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "k8srequiredlabels.constraints.gatekeeper.sh"},
+        "spec": {"group": "constraints.gatekeeper.sh",
+                 "versions": [{"name": "v1beta1", "served": True,
+                               "storage": True}]},
+        "status": {"storedVersions": ["v1alpha1", "v1beta1"]},
+    }
+    foreign = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "foos.example.com"},
+        "spec": {"group": "example.com",
+                 "versions": [{"name": "v1"}]},
+        "status": {"storedVersions": ["v1alpha1", "v1"]},
+    }
+    cluster.apply(owned)
+    cluster.apply(foreign)
+    assert run_upgrade(cluster) == 1
+    crds = {o["metadata"]["name"]: o for o in cluster.list(CRD_GVK)}
+    assert crds["k8srequiredlabels.constraints.gatekeeper.sh"]["status"][
+        "storedVersions"] == ["v1beta1"]
+    assert crds["foos.example.com"]["status"]["storedVersions"] == [
+        "v1alpha1", "v1"]
+    # second run: converged, no-op
+    assert run_upgrade(cluster) == 0
