@@ -35,6 +35,11 @@ def main(argv=None) -> int:
                    help="audit|webhook|mutation-webhook (repeatable; "
                         "default all)")
     p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--readiness-retries", type=int, default=0,
+                   help="ingestion attempts allowed before a failing "
+                        "resource's readiness expectation is cancelled; "
+                        "-1 retries indefinitely (reference "
+                        "--readiness-retries, object_tracker.go:36)")
     p.add_argument("--audit-interval", type=float, default=60.0)
     p.add_argument("--constraint-violations-limit", type=int, default=20)
     p.add_argument("--audit-chunk-size", type=int, default=500)
@@ -158,7 +163,8 @@ def main(argv=None) -> int:
     if args.export_dir:
         export.upsert_connection("disk", "disk", {"path": args.export_dir})
     mgr = Manager(client, cluster, operations=operations,
-                  export_system=export, metrics=metrics).start()
+                  export_system=export, metrics=metrics,
+                  readiness_retries=args.readiness_retries).start()
 
     if args.manifests:
         FileSource(*args.manifests).populate(cluster)
@@ -299,6 +305,7 @@ def main(argv=None) -> int:
             certfile=certfile,
             keyfile=keyfile,
             readiness_check=mgr.tracker.satisfied,
+            readiness_stats=mgr.tracker.stats,
             metrics=metrics,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)

@@ -77,6 +77,7 @@ class Manager:
         export_system=None,
         metrics=None,
         pod_name: Optional[str] = None,
+        readiness_retries: int = 0,
     ):
         import os
 
@@ -85,7 +86,7 @@ class Manager:
         self.operations = set(operations)
         self.pod_name = pod_name or os.environ.get(
             "POD_NAME", "gatekeeper-tpu-0")
-        self.tracker = Tracker()
+        self.tracker = Tracker(retries=readiness_retries)
         self.excluder = ProcessExcluder()
         self.webhookconfig_cache = None  # validating webhook match scope
         self.provider_cache = provider_cache or ProviderCache()
@@ -217,7 +218,7 @@ class Manager:
                 self._prune_constraints_of(kind)
             # a template deleted before its boot expectation was observed
             # must not wedge /readyz (reference CancelExpect on delete)
-            self.tracker.try_cancel("templates", name)
+            self.tracker.cancel("templates", name)
             return
         try:
             crd = self.client.add_template(event.obj)
@@ -262,7 +263,7 @@ class Manager:
         if event.type == DELETED:
             self.client.remove_constraint(event.obj)
             # deleted before observed must not wedge readiness
-            self.tracker.try_cancel(
+            self.tracker.cancel(
                 "constraints",
                 (event.obj.get("kind", ""), name_of(event.obj)))
         else:

@@ -278,15 +278,24 @@ class TpuDriver:
             return self._interp.query(target, [constraint], review, cfg)
         obj = review.request.object or {}
         ns_tree: dict = {}
+        cluster_tree: dict = {}
         for spec, col in specs:
             index = self._render_index(spec)
             for val in _col_values(obj, col):
                 for ns, apiver, name, entry in index.get(val, ()):
-                    ns_tree.setdefault(ns, {}).setdefault(
-                        apiver, {}).setdefault(spec.kind, {})[name] = entry
+                    if spec.scope == "cluster":
+                        # cluster-scope root has no namespace level
+                        # (target.go:60-66: ["cluster", GV, Kind, name])
+                        cluster_tree.setdefault(apiver, {}).setdefault(
+                            spec.kind, {})[name] = entry
+                    else:
+                        ns_tree.setdefault(ns, {}).setdefault(
+                            apiver, {}).setdefault(
+                                spec.kind, {})[name] = entry
         return self._interp.query(
             target, [constraint], review, cfg,
-            data_override={"inventory": {"namespace": ns_tree}},
+            data_override={"inventory": {"namespace": ns_tree,
+                                         "cluster": cluster_tree}},
         )
 
     def _render_restrict_specs(self, kind):
@@ -333,7 +342,14 @@ class TpuDriver:
         index: dict = {}
         rx = _re.compile(spec.apiver_regex) if spec.apiver_regex else None
         inv = (self._interp._data or {}).get("inventory", {})
-        for ns, by_apiver in (inv.get("namespace", {}) or {}).items():
+        if spec.scope == "cluster":
+            # cluster root is {apiver: {Kind: {name: obj}}}: walk it as a
+            # single pseudo-namespace (ns="" is never read back — the
+            # cluster tree rebuild drops it)
+            roots = [("", inv.get("cluster", {}) or {})]
+        else:
+            roots = list((inv.get("namespace", {}) or {}).items())
+        for ns, by_apiver in roots:
             if not isinstance(by_apiver, dict):
                 continue
             for apiver, by_kind in by_apiver.items():

@@ -184,6 +184,14 @@ class EvaluateServicer:
                     cons, objects, return_bits=req.exact_totals)
             swept = self.evaluator.sweep_collect(pending)
             with self._lock:
+                # the template/constraint set may have changed while the
+                # device wait ran unlocked: a concurrently-removed kind's
+                # hits are dropped (the reference audit likewise reviews
+                # against the then-current set), never allowed to error
+                # the whole chunk
+                live_kinds = {c.kind for c in self._constraints.values()}
+                swept = {kind: hits for kind, hits in swept.items()
+                         if kind in live_kinds}
                 review_cache: dict = {}
 
                 def review_of(oi):
@@ -197,8 +205,14 @@ class EvaluateServicer:
                     return r
 
                 def render(con, oi):
-                    return self.tpu.render_query(
-                        self.target.name, con, review_of(oi), cfg).results
+                    try:
+                        return self.tpu.render_query(
+                            self.target.name, con, review_of(oi),
+                            cfg).results
+                    except Exception:
+                        # template torn down between the liveness
+                        # snapshot and this render: drop the hit
+                        return []
 
                 handled = set(swept)
                 for con, total, kept_list in AuditManager.fold_swept(
@@ -214,8 +228,13 @@ class EvaluateServicer:
                         if details is not None:
                             kv.details_json = json.dumps(details).encode()
                 # constraints the device sweep did not cover (non-lowered
-                # / inventory-inexact kinds): exact engines per pair
-                rest = [c for c in cons if c.kind not in handled]
+                # / inventory-inexact kinds): exact engines per pair —
+                # restricted to constraints still registered (the rest
+                # lane must not query a concurrently-removed template)
+                live = {(c.kind, c.name) for c in
+                        self._constraints.values()}
+                rest = [c for c in cons if c.kind not in handled
+                        and (c.kind, c.name) in live]
                 if not rest:
                     return resp
                 by_con: dict = {}

@@ -80,7 +80,10 @@ class CacheManager:
             self.client.remove_data(obj)
             self._synced.discard(key)
             if self.readiness_tracker is not None:
-                self.readiness_tracker.try_cancel("data", key)
+                # deletion is terminal, not retryable: unconditional
+                # cancel (a budgeted try_cancel would never fire again
+                # for an object that can't reappear)
+                self.readiness_tracker.cancel("data", key)
         else:
             if ns and self.excluder.is_excluded("sync", ns):
                 # excluded namespaces never reach the eval-plane inventory
@@ -88,8 +91,8 @@ class CacheManager:
                 self._synced.discard(key)
                 if self.readiness_tracker is not None:
                     # a seeded expectation for an excluded object can
-                    # never be observed
-                    self.readiness_tracker.try_cancel("data", key)
+                    # never be observed — terminal, not retryable
+                    self.readiness_tracker.cancel("data", key)
                 return
             self.client.add_data(obj)
             self._synced.add(key)

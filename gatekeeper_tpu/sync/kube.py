@@ -340,7 +340,16 @@ class KubeCluster:
                 return
             except KubeError as e:
                 if e.status == 404:
-                    # no status subresource served: main-resource write
+                    # 404 is ambiguous: no status subresource served, OR
+                    # the object was deleted between the GET and the PUT.
+                    # Re-GET to disambiguate — a main-resource apply on a
+                    # deleted object would POST it back into existence.
+                    try:
+                        self._request("GET", f"{coll}/{name}")
+                    except KubeError as e2:
+                        if e2.status == 404:
+                            return  # object gone: nothing to update
+                        raise
                     self.apply(obj)
                     return
                 if e.status != 409 or attempt == 3:

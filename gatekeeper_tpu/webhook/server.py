@@ -55,6 +55,7 @@ class WebhookServer:
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
         readiness_check=None,  # callable -> bool
+        readiness_stats=None,  # callable -> dict (per-kind tracker stats)
         metrics=None,  # MetricsRegistry for /metrics exposition
         client_ca_file: Optional[str] = None,  # mTLS: require client certs
         tls_min_version: str = "1.3",  # reference --webhook-tls-min-version
@@ -64,6 +65,7 @@ class WebhookServer:
         self.mutation_handler = mutation_handler
         self.namespace_label_handler = namespace_label_handler
         self.readiness_check = readiness_check
+        self.readiness_stats = readiness_stats
         self.metrics = metrics
         self.enable_profile = enable_profile
         outer = self
@@ -82,8 +84,13 @@ class WebhookServer:
                 if self.path == HEALTH_PATH:
                     ready = (outer.readiness_check is None
                              or outer.readiness_check())
-                    self._reply(200 if ready else 503,
-                                {"ready": bool(ready)})
+                    body = {"ready": bool(ready)}
+                    if outer.readiness_stats is not None:
+                        # per-kind expectation stats (reference surfaces
+                        # readiness progress via ready_tracker logs +
+                        # the Config readiness stats, ready_tracker.go:133)
+                        body["readiness"] = outer.readiness_stats()
+                    self._reply(200 if ready else 503, body)
                 elif self.path.startswith(PROFILE_PATH) and \
                         outer.enable_profile:
                     self._profile()

@@ -222,6 +222,34 @@ def test_concurrent_sweeps_pipeline_and_agree(sidecar):
     results = [None] * 4
     errors = []
 
+    # instrument the pipelining claim directly: with the split lock, one
+    # RPC's flatten+submit (lock-held) runs WHILE another RPC waits on
+    # the device in sweep_collect (unlocked).  Record both spans per
+    # server thread; a cross-call submit/collect overlap proves the
+    # split — under the old one-lock design every span is mutually
+    # exclusive, so no overlap can ever be observed.  The sleep widens
+    # the wait window so scheduling jitter can't mask genuine overlap.
+    orig_submit = _svc.evaluator.sweep_submit
+    orig_collect = _svc.evaluator.sweep_collect
+    spans = []  # (phase, server-thread id, t0, t1)
+    spans_lock = threading.Lock()
+
+    def timed(phase, orig):
+        def wrapper(*a, **k):
+            if phase == "collect":
+                time.sleep(0.05)
+            t0 = time.perf_counter()
+            try:
+                return orig(*a, **k)
+            finally:
+                with spans_lock:
+                    spans.append((phase, threading.get_ident(), t0,
+                                  time.perf_counter()))
+        return wrapper
+
+    _svc.evaluator.sweep_submit = timed("submit", orig_submit)
+    _svc.evaluator.sweep_collect = timed("collect", orig_collect)
+
     def run(i):
         try:
             results[i] = ev.sweep(cons, chunks[i])
@@ -235,7 +263,25 @@ def test_concurrent_sweeps_pipeline_and_agree(sidecar):
     for t in threads:
         t.join()
     concurrent_s = time.perf_counter() - t0
+    _svc.evaluator.sweep_submit = orig_submit
+    _svc.evaluator.sweep_collect = orig_collect
     assert not errors, errors
+    # the collect wrapper's sleep sits BEFORE its span, widening the
+    # window in which another thread's submit can land
+    submits = [s for s in spans if s[0] == "submit"]
+    collects = [s for s in spans if s[0] == "collect"]
+    overlapped = any(
+        st != ct and s0 < c1 and c0 < s1
+        for _, st, s0, s1 in submits
+        for _, ct, c0, c1 in collects)
+    pre_waits = [(ct, c0 - 0.05, c1) for _, ct, c0, c1 in collects]
+    overlapped = overlapped or any(
+        st != ct and s0 < c1 and c0 < s1
+        for _, st, s0, s1 in submits
+        for ct, c0, c1 in pre_waits)
+    assert overlapped, (
+        "no cross-call submit/collect overlap: sweeps serialized\n"
+        + "\n".join(map(str, spans)))
 
     def fold(swept):
         # RemoteEvaluator.sweep returns {(kind, name): (total, kept)}
