@@ -46,6 +46,15 @@ class AuditConfig:
     # violating objects from the device grid — faster on violation-dense
     # clusters, at the cost of undercounting multi-violation objects.
     exact_totals: bool = True
+    # how many chunks may be in flight on the device before the oldest is
+    # collected.  Tunneled TPU backends degrade host->device bandwidth
+    # ~40x after the process's FIRST device->host fetch (measured on
+    # axon: 1.6GB/s -> ~40MB/s, permanent), so the sweep submits as many
+    # chunks as possible — every upload at full bandwidth — before the
+    # first collect.  Results are tiny (top-k + packed bits), inputs are
+    # freed as the device drains the queue, so a deep window costs
+    # little HBM.
+    submit_window: int = 64
 
 
 @dataclass
@@ -125,10 +134,15 @@ class AuditManager:
         kept: dict = {(c.kind, c.name): [] for c in constraints}
         totals: dict = {(c.kind, c.name): 0 for c in constraints}
 
-        # pipelined chunking: while the device evaluates chunk N, the host
-        # lists + flattens + dispatches chunk N+1 (jit dispatch is async);
-        # the fetch for N happens only when N+1 is in flight
-        pending = None  # (submitted, objects)
+        # windowed pipelined chunking: the host lists + flattens +
+        # dispatches up to ``submit_window`` chunks before collecting the
+        # oldest (jit dispatch is async, so the device drains the queue
+        # while the host keeps flattening).  The deep window front-loads
+        # every host->device upload before the process's first
+        # device->host fetch — see AuditConfig.submit_window.
+        from collections import deque
+
+        window: deque = deque()  # (submitted, objects)
         chunk: list[dict] = []
         for obj in self.lister():
             if kind_filter is not None:
@@ -138,14 +152,14 @@ class AuditManager:
             chunk.append(obj)
             run.total_objects += 1
             if len(chunk) >= self.config.chunk_size:
-                pending = self._pipeline_step(
-                    pending, chunk, constraints, kept, totals, limit)
+                self._pipeline_step(window, chunk, constraints, kept,
+                                    totals, limit)
                 chunk = []
         if chunk:
-            pending = self._pipeline_step(
-                pending, chunk, constraints, kept, totals, limit)
-        if pending is not None:
-            self._pipeline_step(pending, None, constraints, kept, totals,
+            self._pipeline_step(window, chunk, constraints, kept, totals,
+                                limit)
+        while window:
+            self._pipeline_step(window, None, constraints, kept, totals,
                                 limit)
 
         run.total_violations = totals
@@ -171,11 +185,12 @@ class AuditManager:
         return kinds
 
     # --- chunk evaluation ------------------------------------------------
-    def _pipeline_step(self, pending, next_chunk, constraints, kept, totals,
+    def _pipeline_step(self, window, next_chunk, constraints, kept, totals,
                        limit):
-        """Submit ``next_chunk`` to the device, then process the previous
-        chunk's results (which overlapped with this submission).  Without an
-        evaluator, falls back to synchronous per-chunk processing."""
+        """Submit ``next_chunk`` to the device; collect the oldest pending
+        chunk only once the window is full (or ``next_chunk`` is None —
+        the drain phase).  Without an evaluator, falls back to synchronous
+        per-chunk processing."""
         batch_driver = next(
             (d for d in self.client.drivers if hasattr(d, "query_batch")),
             None,
@@ -185,20 +200,20 @@ class AuditManager:
             if next_chunk:
                 self._audit_chunk(next_chunk, constraints, kept, totals,
                                   limit)
-            return None
-        submitted = None
+            return
         if next_chunk:
-            submitted = (
+            window.append((
                 self.evaluator.sweep_submit(
                     constraints, next_chunk,
                     return_bits=self.config.exact_totals),
                 next_chunk,
-            )
-        if pending is not None:
+            ))
+        if window and (next_chunk is None
+                       or len(window) > max(1, self.config.submit_window)):
+            pending = window.popleft()
             swept = self.evaluator.sweep_collect(pending[0])
             self._process_swept(swept, pending[1], constraints, kept, totals,
                                 limit)
-        return submitted
 
     def _audit_chunk(self, objects, constraints, kept, totals, limit):
         """No-evaluator path: every constraint goes through its template's

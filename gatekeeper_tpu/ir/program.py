@@ -333,7 +333,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
     """
     c = len(constraints)
     # always one leaf so vmap has a mapped axis even for param-less templates
-    table: dict[str, Any] = {"__row__": jnp.zeros(c, jnp.int8)}
+    table: dict[str, Any] = {"__row__": np.zeros(c, np.int8)}
     params_by_con = [
         (con.parameters or {}) if isinstance(con.parameters, dict) else {}
         for con in constraints
@@ -343,29 +343,29 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
         # every param row carries a kind tag: 0 absent, 1 false, 2 true,
         # 3 present-non-bool — so ParamTruthy (>=2), ParamPresent (>0) and
         # the exact ParamBoolIs (==2 / ==1) all read the same encoding
-        table[f"{spec.name}__kind"] = jnp.asarray(
+        table[f"{spec.name}__kind"] = np.asarray(
             [0 if v is None else (2 if v is True else (1 if v is False else 3))
-             for v in vals], jnp.int8)
+             for v in vals], np.int8)
         if spec.kind == "num":
-            table[f"{spec.name}__num"] = jnp.asarray(
+            table[f"{spec.name}__num"] = np.asarray(
                 [float(v) if isinstance(v, (int, float)) and not isinstance(v, bool)
-                 else 0.0 for v in vals], jnp.float32)
-            table[f"{spec.name}__isnum"] = jnp.asarray(
+                 else 0.0 for v in vals], np.float32)
+            table[f"{spec.name}__isnum"] = np.asarray(
                 [isinstance(v, (int, float)) and not isinstance(v, bool)
-                 for v in vals], jnp.bool_)
+                 for v in vals], np.bool_)
             # parameters keep full term-order info: a string-valued "numeric"
             # parameter still participates in Rego's total ordering
-            table[f"{spec.name}__present"] = jnp.asarray(
+            table[f"{spec.name}__present"] = np.asarray(
                 [p_has(params_by_con[i], spec.name) for i in range(c)],
-                jnp.bool_)
-            table[f"{spec.name}__rank"] = jnp.asarray(
-                [_py_rank(v) for v in vals], jnp.int8)
+                np.bool_)
+            table[f"{spec.name}__rank"] = np.asarray(
+                [_py_rank(v) for v in vals], np.int8)
         elif spec.kind == "str":
-            table[f"{spec.name}__sid"] = jnp.asarray(
+            table[f"{spec.name}__sid"] = np.asarray(
                 [vocab.intern(v) if isinstance(v, str) else -2 for v in vals],
-                jnp.int32)
-            table[f"{spec.name}__present"] = jnp.asarray(
-                [isinstance(v, str) for v in vals], jnp.bool_)
+                np.int32)
+            table[f"{spec.name}__present"] = np.asarray(
+                [isinstance(v, str) for v in vals], np.bool_)
         elif spec.kind == "bool":
             pass  # the __kind tag above is the entire encoding
         elif spec.kind == "strlist":
@@ -379,8 +379,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
             for i, xs in enumerate(lists):
                 cnt[i] = len(xs)
                 arr[i, : len(xs)] = xs
-            table[f"{spec.name}__sids"] = jnp.asarray(arr)
-            table[f"{spec.name}__count"] = jnp.asarray(cnt)
+            table[f"{spec.name}__sids"] = np.asarray(arr)
+            table[f"{spec.name}__count"] = np.asarray(cnt)
         elif spec.kind == "numlist":
             lists = [
                 [float(x) for x in v
@@ -393,15 +393,15 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
             for i, xs in enumerate(lists):
                 cnt[i] = len(xs)
                 arr[i, : len(xs)] = xs
-            table[f"{spec.name}__nums"] = jnp.asarray(arr)
-            table[f"{spec.name}__count"] = jnp.asarray(cnt)
+            table[f"{spec.name}__nums"] = np.asarray(arr)
+            table[f"{spec.name}__count"] = np.asarray(cnt)
         elif spec.kind == "objlist":
             lists = [v if isinstance(v, list) else [] for v in vals]
             k = round_up(max((len(x) for x in lists), default=0))
             cnt = np.zeros(c, np.int32)
             for i, xs in enumerate(lists):
                 cnt[i] = len(xs)
-            table[f"{spec.name}__count"] = jnp.asarray(cnt)
+            table[f"{spec.name}__count"] = np.asarray(cnt)
             for field, ftype in spec.fields:
                 dotted = ".".join(field)
                 if ftype == "num":
@@ -434,10 +434,10 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                             arr[i, j] = vocab.intern(cur)
                             ok[i, j] = True
                 suffix = "__nums" if ftype == "num" else "__sids"
-                table[f"{spec.name}.{dotted}{suffix}"] = jnp.asarray(arr)
-                table[f"{spec.name}.{dotted}__ok"] = jnp.asarray(ok)
-                table[f"{spec.name}.{dotted}__rank"] = jnp.asarray(rank)
-                table[f"{spec.name}.{dotted}__fpresent"] = jnp.asarray(
+                table[f"{spec.name}.{dotted}{suffix}"] = np.asarray(arr)
+                table[f"{spec.name}.{dotted}__ok"] = np.asarray(ok)
+                table[f"{spec.name}.{dotted}__rank"] = np.asarray(rank)
+                table[f"{spec.name}.{dotted}__fpresent"] = np.asarray(
                     fpresent)
         else:
             raise LowerError(f"unknown param kind {spec.kind}")
@@ -454,8 +454,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                     if r is not None:
                         nums[i] = r
                         ok[i] = True
-            table[f"{node.name}__fn_{node.fn}__num"] = jnp.asarray(nums)
-            table[f"{node.name}__fn_{node.fn}__ok"] = jnp.asarray(ok)
+            table[f"{node.name}__fn_{node.fn}__num"] = np.asarray(nums)
+            table[f"{node.name}__fn_{node.fn}__ok"] = np.asarray(ok)
         elif isinstance(node, N.StrPred):
             needle = node.needle
             if isinstance(needle, N.ParamElemSid):
@@ -487,8 +487,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                             rowidx[i, j] = pred_table_row(
                                 vocab, node.op, _needle_xform(needle, cur))
                             ok[i, j] = True
-                table[key] = jnp.asarray(rowidx)
-                table[key + "__ok"] = jnp.asarray(ok)
+                table[key] = np.asarray(rowidx)
+                table[key + "__ok"] = np.asarray(ok)
             elif isinstance(needle, _ELEM_OF):
                 # string-list elements: rows [C, K] from the list itself
                 pname = needle.param
@@ -509,8 +509,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                         rowidx[i, j] = pred_table_row(
                             vocab, node.op, _needle_xform(needle, x))
                         ok[i, j] = True
-                table[key] = jnp.asarray(rowidx)
-                table[key + "__ok"] = jnp.asarray(ok)
+                table[key] = np.asarray(rowidx)
+                table[key + "__ok"] = np.asarray(ok)
             elif isinstance(needle, N.ParamSid):
                 key = f"{needle.name}__strtab_{node.op}"
                 if key in table:
@@ -522,8 +522,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                     if isinstance(v, str):
                         rowidx[i] = pred_table_row(vocab, node.op, v)
                         ok[i] = True
-                table[key] = jnp.asarray(rowidx)
-                table[key + "__ok"] = jnp.asarray(ok)
+                table[key] = np.asarray(rowidx)
+                table[key + "__ok"] = np.asarray(ok)
             elif isinstance(needle, N.ConstSid):
                 key = f"__const{needle.sid}__strtab_{node.op}"
                 if key in table:
@@ -531,8 +531,8 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 rowidx = np.full(
                     c, pred_table_row(vocab, node.op,
                                       vocab.string(needle.sid)), np.int32)
-                table[key] = jnp.asarray(rowidx)
-                table[key + "__ok"] = jnp.asarray(np.ones(c, bool))
+                table[key] = np.asarray(rowidx)
+                table[key + "__ok"] = np.asarray(np.ones(c, bool))
     return table
 
 
@@ -1089,6 +1089,27 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         if inner.ndim == 3:
             valid = valid[..., None]
         return jnp.any(inner & valid, axis=1)
+    if isinstance(e, N.CountAxisIs):
+        if ctx.axis is not None:
+            raise LowerError("nested CountAxisIs unsupported")
+        counts = ctx.cols[axis_key(e.axis)]  # [N]
+        ctx.axis = e.axis
+        try:
+            inner = eval_expr(ctx, e.inner)  # [N, M] (+K)
+        finally:
+            ctx.axis = None
+        if getattr(inner, "ndim", 0) < 2:
+            # item-independent inner: satisfying-count = inner ? count : 0
+            base_eq = counts == e.k
+            zero_eq = jnp.asarray(e.k == 0)
+            if ctx.elem_k is not None:
+                base_eq = base_eq[..., None]
+            return jnp.where(jnp.asarray(inner), base_eq, zero_eq)
+        m = inner.shape[1]
+        valid = jnp.arange(m) < counts[:, None]
+        if inner.ndim == 3:
+            valid = valid[..., None]
+        return jnp.sum(inner & valid, axis=1) == e.k
     if isinstance(e, N.NestedAny):
         if ctx.axis is None:
             raise LowerError("NestedAny outside a parent AnyAxis")

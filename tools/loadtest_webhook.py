@@ -69,33 +69,17 @@ def make_body(i: int) -> bytes:
     }).encode()
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
-    conc = int(sys.argv[2]) if len(sys.argv) > 2 else 32
-    srv, batcher, nt, nc = build_server()
-    print(f"server on :{srv.port}; library {nt} templates / {nc} "
-          f"constraints; {n} requests x {conc} connections",
-          file=sys.stderr)
-    bodies = [make_body(i) for i in range(min(n, 256))]
-
-    # warmup (jit compile of the batch shapes)
-    conn = http.client.HTTPConnection("127.0.0.1", srv.port)
-    for i in range(8):
-        conn.request("POST", "/v1/admit", body=bodies[i % len(bodies)],
-                     headers={"Content-Type": "application/json"})
-        conn.getresponse().read()
-    conn.close()
-
+def run_load(port: int, bodies: list, n: int, conc: int) -> dict:
+    """Drive ``n`` requests over ``conc`` persistent connections; return
+    a stats dict (latency percentiles + throughput + histogram)."""
     latencies: list = []
     denied = [0]
     lock = threading.Lock()
     per_worker = n // conc
-
     errors: list = []
 
     def worker(wid: int):
-        # persistent connection per worker (connection reuse)
-        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
         local = []
         local_denied = 0
         try:
@@ -127,16 +111,17 @@ def main():
     elapsed = time.perf_counter() - t0
 
     lat_ms = sorted(x * 1000 for x in latencies)
+    if not lat_ms:
+        return {"errors": errors, "requests": 0, "concurrency": conc,
+                "elapsed_s": round(elapsed, 3)}
 
     def pct(p):
         return lat_ms[min(len(lat_ms) - 1, int(p / 100 * len(lat_ms)))]
 
     hist_edges = [1, 2, 5, 10, 20, 50, 100, 200, 500, 1000]
-    hist = {}
-    for edge in hist_edges:
-        hist[f"le_{edge}ms"] = sum(1 for x in lat_ms if x <= edge)
-    out = {
-        "metric": "webhook serving load",
+    hist = {f"le_{e}ms": sum(1 for x in lat_ms if x <= e)
+            for e in hist_edges}
+    return {
         "errors": errors,
         "requests": len(lat_ms),
         "concurrency": conc,
@@ -146,21 +131,155 @@ def main():
         "p50_ms": round(pct(50), 2),
         "p90_ms": round(pct(90), 2),
         "p99_ms": round(pct(99), 2),
-        "max_ms": round(lat_ms[-1], 2),
-        "mean_ms": round(statistics.mean(lat_ms), 2),
+        "max_ms": round(lat_ms[-1], 2) if lat_ms else 0,
+        "mean_ms": round(statistics.mean(lat_ms), 2) if lat_ms else 0,
         "histogram": hist,
+    }
+
+
+def warmup(port: int, bodies: list, k: int = 8) -> None:
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    for i in range(k):
+        conn.request("POST", "/v1/admit", body=bodies[i % len(bodies)],
+                     headers={"Content-Type": "application/json"})
+        conn.getresponse().read()
+    conn.close()
+
+
+def serve_worker(port: int) -> None:
+    """--worker mode: a full serving replica bound with SO_REUSEPORT;
+    prints its served-request count on SIGTERM (the parent asserts the
+    kernel spread load across replicas)."""
+    import signal
+
+    from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.metrics.registry import MetricsRegistry
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.utils.synthetic import load_library
+    from gatekeeper_tpu.webhook.policy import Batcher, ValidationHandler
+    from gatekeeper_tpu.webhook.server import WebhookServer
+
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+    load_library(client)
+    metrics = MetricsRegistry()
+    batcher = Batcher(client, window_s=0.002, max_batch=64).start()
+    handler = ValidationHandler(client, batcher=batcher, metrics=metrics)
+    srv = WebhookServer(validation_handler=handler, port=port,
+                        readiness_check=lambda: True,
+                        reuse_port=True).start()
+    print(f"worker {os.getpid()} on :{srv.port}", file=sys.stderr,
+          flush=True)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    stop.wait()
+    served = metrics.counter_total("validation_request_count")
+    print(json.dumps({"pid": os.getpid(), "served": served}), flush=True)
+    srv.stop()
+
+
+def multi_worker_lane(bodies: list, n: int, conc: int,
+                      n_workers: int = 2) -> dict:
+    """SO_REUSEPORT lane: W independent serving processes share one port;
+    the kernel balances connections.  Verifies every worker served
+    traffic and reports aggregate throughput."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    ) for _ in range(n_workers)]
+    # wait for all workers to bind + warm
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        try:
+            warmup(port, bodies, k=2)
+            break
+        except OSError:
+            time.sleep(1.0)
+    time.sleep(n_workers * 2)  # let every replica finish loading
+    warmup(port, bodies, k=16)
+    stats = run_load(port, bodies, n, conc)
+    served = []
+    for p in procs:
+        p.terminate()
+        out, _ = p.communicate(timeout=30)
+        for line in out.splitlines():
+            try:
+                served.append(json.loads(line))
+            except ValueError:
+                pass
+    stats["workers"] = served
+    stats["all_workers_served"] = (
+        len(served) == n_workers and all(w["served"] > 0 for w in served))
+    return stats
+
+
+def main():
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        serve_worker(int(sys.argv[2]))
+        return
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    conc = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    srv, batcher, nt, nc = build_server()
+    print(f"server on :{srv.port}; library {nt} templates / {nc} "
+          f"constraints", file=sys.stderr)
+    bodies = [make_body(i) for i in range(256)]
+    warmup(srv.port, bodies)
+
+    # lane 1: true per-request latency — one connection, no batch window
+    # (the batcher still runs but a lone request never waits: the window
+    # opens when the first request of a batch arrives)
+    print("lane n1 (sequential, N=1)...", file=sys.stderr)
+    lane_n1 = run_load(srv.port, bodies, min(n, 400), 1)
+    # lane 2: moderate concurrency (a small cluster's admission load)
+    print("lane conc8...", file=sys.stderr)
+    lane_c8 = run_load(srv.port, bodies, n, 8)
+    # lane 3: saturation (r2-comparable: 64 connections)
+    print(f"lane conc{conc}...", file=sys.stderr)
+    lane_sat = run_load(srv.port, bodies, n, conc)
+    batcher.stop()
+    srv.stop()
+    # lane 4: SO_REUSEPORT multi-process serving
+    print("lane multi-worker (SO_REUSEPORT x2)...", file=sys.stderr)
+    lane_mw = multi_worker_lane(bodies, n, conc, n_workers=2)
+
+    out = {
+        "metric": "webhook serving load",
+        "host_cpus": os.cpu_count(),
         "batch_window_ms": 2.0,
+        "n1": lane_n1,
+        "conc8": lane_c8,
+        f"conc{conc}": lane_sat,
+        "multiworker2": lane_mw,
         "server": "stdlib ThreadingHTTPServer (thread-per-connection; the "
                   "Batcher coalesces concurrent reviews so handler threads "
                   "block on the shared device pass, not on per-request "
-                  "evaluation)",
+                  "evaluation); SO_REUSEPORT worker processes for "
+                  "multi-core hosts (--webhook-workers)",
+        "note": "this bench host has ONE core: saturation latency is "
+                "queueing delay (Little's law), and worker processes "
+                "cannot add throughput here — the n1/conc8 lanes plus "
+                "all_workers_served are the meaningful signals",
     }
     print(json.dumps(out, indent=1))
     root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
     with open(os.path.join(root, "WEBHOOK_LOAD.json"), "w") as f:
         f.write(json.dumps(out) + "\n")
-    batcher.stop()
-    srv.stop()
 
 
 if __name__ == "__main__":

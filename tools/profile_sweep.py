@@ -60,6 +60,9 @@ def main(n=32768, chunk=32768):
     batch = Flattener(schema, tpu.vocab).flatten(objs, pad_n=pad_n)
     t_flatten = time.perf_counter() - t0
 
+    from gatekeeper_tpu.parallel.sharded import (pack_flat_tables,
+                                                 pack_transfer_cols)
+
     t0 = time.perf_counter()
     cols = pack_batch_cols(batch)
     needs = {}
@@ -75,38 +78,42 @@ def main(n=32768, chunk=32768):
     for kind in kinds:
         prog = tpu._programs[kind]
         kcons = by_kind[kind]
-        table = build_param_table(prog.program, kcons, tpu.vocab)
-        tables.append(shard_param_table(table, ev.mesh,
-                                        shard_constraints=False))
+        tables.append(build_param_table(prog.program, kcons, tpu.vocab))
         mask_rows.append(masks_mod.constraint_masks(
             kcons, batch, tpu.vocab, objs, any_generate_name=any_gen))
+    table_cols = {}
     for kind in kinds:
         for tk, tv in vocab_tables(tpu._programs[kind].program,
                                    tpu.vocab).items():
-            cols[tk] = tv
+            table_cols[tk] = tv
         for tk, tv in tpu.inventory_cols(kind)[0].items():
-            cols[tk] = tv
+            table_cols[tk] = tv
+    cols_bufs, cols_layout = pack_transfer_cols(cols, pad_n)
+    tables_bufs, tables_layout = pack_flat_tables(tables)
     t_tables = time.perf_counter() - t0
 
-    n_arrays = sum(1 for v in cols.values() if not isinstance(v, dict)) + \
-        sum(len(v) for v in cols.values() if isinstance(v, dict))
-    total_mb = 0.0
-    for v in cols.values():
-        if isinstance(v, dict):
-            total_mb += sum(x.nbytes for x in v.values()) / 1e6
-        else:
-            total_mb += v.nbytes / 1e6
+    n_arrays = len(cols_bufs) + len(tables_bufs) + len(table_cols) + 1
+    total_mb = sum(b.nbytes for b in cols_bufs.values()) / 1e6
     t0 = time.perf_counter()
-    sharded_cols = shard_batch_arrays(cols, ev.mesh, ev._table_dev_cache)
+    cols_bufs_dev = {
+        dt: jax.device_put(b, NamedSharding(ev.mesh, P("data", None)))
+        for dt, b in cols_bufs.items()}
+    tables_bufs_dev = {
+        dt: jax.device_put(b, NamedSharding(ev.mesh, P(None)))
+        for dt, b in tables_bufs.items()}
+    table_cols_dev = shard_batch_arrays(table_cols, ev.mesh,
+                                        ev._table_dev_cache)
     mask = np.concatenate(mask_rows, axis=0)
     mask_dev = jax.device_put(mask, NamedSharding(ev.mesh, P(None, "data")))
-    jax.block_until_ready(sharded_cols)
+    jax.block_until_ready(cols_bufs_dev)
+    jax.block_until_ready(tables_bufs_dev)
+    jax.block_until_ready(table_cols_dev)
     jax.block_until_ready(mask_dev)
     t_h2d = time.perf_counter() - t0
 
-    fn = ev._sweep_fn(kinds, 20, False)
+    fn = ev._sweep_fn(kinds, 20, False, cols_layout, tables_layout)
     t0 = time.perf_counter()
-    result = fn(tuple(tables), sharded_cols, mask_dev)
+    result = fn(tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev)
     jax.block_until_ready(result)
     t_device = time.perf_counter() - t0
 
@@ -115,7 +122,7 @@ def main(n=32768, chunk=32768):
     t_d2h = time.perf_counter() - t0
 
     log(f"phases for chunk={chunk} ({len(kinds)} kinds, "
-        f"{n_arrays} device arrays, {total_mb:.1f} MB H2D):")
+        f"{n_arrays} device transfers, {total_mb:.1f} MB H2D):")
     log(f"  flatten:       {t_flatten*1000:8.1f} ms")
     log(f"  tables+masks:  {t_tables*1000:8.1f} ms")
     log(f"  H2D:           {t_h2d*1000:8.1f} ms")
