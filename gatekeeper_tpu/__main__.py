@@ -88,7 +88,63 @@ def main(argv=None) -> int:
     p.add_argument("--process-id", type=int, default=0)
     p.add_argument("--once", action="store_true",
                    help="run one audit sweep and exit (no servers)")
+    p.add_argument("--webhook-workers", type=int, default=1,
+                   help="serve the webhook from N processes sharing one "
+                        "port via SO_REUSEPORT (the kernel load-balances "
+                        "connections; each worker is a full replica of "
+                        "the serving stack).  The multi-core answer to "
+                        "the reference's goroutine-per-request model "
+                        "(policy.go:116-120)")
+    p.add_argument("--reuse-port", action="store_true",
+                   help="bind the webhook port with SO_REUSEPORT (set "
+                        "automatically for --webhook-workers children)")
     args = p.parse_args(argv)
+
+    worker_procs: list = []
+    if args.webhook_workers > 1 and args.once:
+        print("--webhook-workers ignored with --once (no servers run)",
+              file=sys.stderr)
+        args.webhook_workers = 1
+    if args.webhook_workers > 1:
+        if args.port == 0:
+            p.error("--webhook-workers needs an explicit --port "
+                    "(ephemeral ports cannot be shared)")
+        if args.certs_dir:
+            # generate serving certs BEFORE spawning workers: N processes
+            # racing first-boot generation would overwrite each other's
+            # key/cert pairs (mismatched tls.crt/tls.key)
+            import os
+
+            from gatekeeper_tpu.webhook.certs import generate_certs
+
+            if not os.path.exists(os.path.join(args.certs_dir, "tls.crt")):
+                generate_certs(args.certs_dir)
+        import subprocess
+
+        child_argv = list(argv) if argv is not None else sys.argv[1:]
+        # strip the workers flag (children must not fork grandchildren)
+        # and the parent's --operation set (children serve webhooks ONLY
+        # — exactly one audit/controller process per --operation split,
+        # as in the reference Deployment)
+        stripped: list = []
+        skip = False
+        for a in child_argv:
+            if skip:
+                skip = False
+                continue
+            if a in ("--webhook-workers", "--operation"):
+                skip = True
+                continue
+            if a.startswith(("--webhook-workers=", "--operation=")):
+                continue
+            stripped.append(a)
+        child = [a for a in stripped if a != "--once"]
+        child += ["--reuse-port", "--operation", "webhook",
+                  "--operation", "mutation-webhook"]
+        for i in range(args.webhook_workers - 1):
+            worker_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "gatekeeper_tpu"] + child))
+        args.reuse_port = True
 
     if args.coordinator:
         from gatekeeper_tpu.parallel.distributed import init_distributed
@@ -307,6 +363,7 @@ def main(argv=None) -> int:
             readiness_check=mgr.tracker.satisfied,
             readiness_stats=mgr.tracker.stats,
             metrics=metrics,
+            reuse_port=args.reuse_port,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
         if args.certs_dir:
@@ -333,6 +390,8 @@ def main(argv=None) -> int:
         print(f"signal {signum}: shutting down"
               + (f" after {args.shutdown_delay:.0f}s drain"
                  if args.shutdown_delay else ""), file=sys.stderr)
+        for wp in worker_procs:  # propagate before our own drain
+            wp.terminate()
         if args.shutdown_delay:
             time.sleep(args.shutdown_delay)
         stopping.set()
@@ -353,6 +412,13 @@ def main(argv=None) -> int:
         batcher.stop()
         if server:
             server.stop()
+        for wp in worker_procs:
+            wp.terminate()
+        for wp in worker_procs:
+            try:
+                wp.wait(timeout=5)
+            except Exception:
+                wp.kill()
     return 0
 
 

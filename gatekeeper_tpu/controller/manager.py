@@ -228,9 +228,16 @@ class Manager:
             # kind's constraint expectations (they can never be observed)
             self._prune_constraints_of(deep_get(
                 event.obj, ("spec", "crd", "spec", "names", "kind"), ""))
-            self.tracker.try_cancel("templates", name)
+            cancelled = self.tracker.try_cancel("templates", name)
             self._template_errors[name] = str(e)
             self._set_status(event.obj, error=str(e))
+            if not cancelled:
+                # retry budget remains (--readiness-retries > 0 / -1):
+                # requeue with backoff until the budget is spent or the
+                # template compiles — without this, nothing re-triggers
+                # reconcile and /readyz wedges forever (the reference
+                # controller requeues failing reconciles)
+                self._requeue_template(name)
             return
         self._template_errors.pop(name, None)
         self.tracker.observe("templates", name)
@@ -258,6 +265,32 @@ class Manager:
         """The kind's constraint expectations die with its template."""
         if kind:
             self.tracker.prune("constraints", lambda k: k[0] == kind)
+
+    def _requeue_template(self, name: str, delay_s: float = 1.0) -> None:
+        """Re-reconcile a failing template after a backoff, reading the
+        CURRENT object (a delete or a fixed re-apply in the meantime
+        wins).  Each retry doubles the delay up to 30s; the retry chain
+        dies when the template compiles, is deleted, or try_cancel spends
+        the readiness budget."""
+        import threading as _threading
+
+        def fire():
+            cur = self.cluster.get(TEMPLATES_GVK, "", name)
+            if cur is None or name not in self._template_errors:
+                return  # deleted or fixed meanwhile
+            try:
+                self.client.add_template(cur)
+            except Exception as e:
+                if not self.tracker.try_cancel("templates", name):
+                    self._template_errors[name] = str(e)
+                    self._requeue_template(name, min(delay_s * 2, 30.0))
+                return
+            self._template_errors.pop(name, None)
+            self.tracker.observe("templates", name)
+
+        t = _threading.Timer(delay_s, fire)
+        t.daemon = True
+        t.start()
 
     def _reconcile_constraint(self, event: Event) -> None:
         if event.type == DELETED:

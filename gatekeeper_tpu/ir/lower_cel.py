@@ -139,6 +139,16 @@ class SParamElem(SVal):
 
 
 @dataclass(frozen=True)
+class SMapKey(SVal):
+    """The current macro item's MAP KEY: CEL macros over maps iterate
+    keys, and the flattener's ragged axes carry an aligned MapKeyColumn
+    (sid per value item, -1 for list-backed items) — so a key-predicate
+    body lowers to string ops over that column."""
+
+    axis: Axis
+
+
+@dataclass(frozen=True)
 class SLit(SVal):
     value: Any
 
@@ -209,6 +219,58 @@ def _deref_req(ast, var: str) -> tuple:
     # (an empty source decides without evaluating the body)
     d = _count_var_derefs(ast, var, False, skip_macro_bodies=True) > 0
     return d, d
+
+
+def _str_method_req(ast, var: str) -> tuple:
+    """(t_req, f_req): whether deciding the body's exactly-true /
+    exactly-false outcome entails evaluating a STRING METHOD whose
+    target is the bare ``var`` (k.startsWith(p) etc.) — on an int (a
+    list index in a two-variable macro) that method call errors, so
+    requiring it in both outcomes makes a non-empty list reduce to the
+    error outcome.  Same combinator algebra as :func:`_deref_req`
+    (vacuous outcomes count as requiring)."""
+    if isinstance(ast, C.Lit):
+        if ast.value is True:
+            return False, True
+        if ast.value is False:
+            return True, False
+        return True, True
+    if isinstance(ast, C.Unary) and ast.op == "!":
+        t, f = _str_method_req(ast.operand, var)
+        return f, t
+    if isinstance(ast, C.Binary) and ast.op in ("&&", "||"):
+        lt, lf = _str_method_req(ast.lhs, var)
+        rt, rf = _str_method_req(ast.rhs, var)
+        if ast.op == "&&":
+            return (lt or rt), (lf and rf)
+        return (lt and rt), (lf or rf)
+    if isinstance(ast, C.Ternary):
+        ct, cf = _str_method_req(ast.cond, var)
+        at, af = _str_method_req(ast.then, var)
+        bt, bf = _str_method_req(ast.other, var)
+        return ((ct or at) and (cf or bt)), ((ct or af) and (cf or bf))
+    d = _has_str_method_on(ast, var)
+    return d, d
+
+
+def _has_str_method_on(ast, var: str) -> bool:
+    """A string method with bare ``var`` as target occurs anywhere in
+    this (leaf) expression's operands."""
+    if isinstance(ast, C.Call):
+        if ast.name in _STR_METHODS and isinstance(ast.target, C.Ident) \
+                and ast.target.name == var:
+            return True
+        ops = ([ast.target] if ast.target is not None else []) + \
+            list(ast.args)
+        return any(_has_str_method_on(a, var) for a in ops)
+    if isinstance(ast, C.Binary):
+        return _has_str_method_on(ast.lhs, var) or \
+            _has_str_method_on(ast.rhs, var)
+    if isinstance(ast, C.Unary):
+        return _has_str_method_on(ast.operand, var)
+    if isinstance(ast, (C.Select, C.Index)):
+        return False  # a deref of var is not a string method
+    return False
 
 
 def _count_var_derefs(ast, var: str, safe: bool,
@@ -321,9 +383,19 @@ class _CelLowerer:
             return N.ParamSid(sv.path[0])
         if isinstance(sv, SParamElem):
             return N.ParamElemSid()
+        if isinstance(sv, SMapKey):
+            return N.MapKeySid(self._map_key_col(sv.axis))
         if isinstance(sv, SLit) and isinstance(sv.value, str):
             return N.ConstSid(self.vocab.intern(sv.value))
         raise LowerError(f"not a string operand: {sv}")
+
+    def _map_key_col(self, axis: Axis):
+        from gatekeeper_tpu.ops.flatten import MapKeyCol
+
+        col = MapKeyCol(axis=axis)
+        if col not in self.schema.map_keys:
+            self.schema.map_keys.append(col)
+        return col
 
     def _is_str(self, sv: SVal) -> N.Expr:
         """Defined-string test for the false-polarity gates."""
@@ -332,8 +404,8 @@ class _CelLowerer:
         if isinstance(sv, SParam):
             self._note_param(sv.path[0], "str")
             return N.ParamPresent(sv.path[0])
-        if isinstance(sv, (SParamElem, SLit)):
-            return _TRUE
+        if isinstance(sv, (SParamElem, SLit, SMapKey)):
+            return _TRUE  # map keys are always defined strings
         raise LowerError(f"not a string operand: {sv}")
 
     def _defined(self, sv: SVal) -> N.Expr:
@@ -346,7 +418,7 @@ class _CelLowerer:
                 raise LowerError(f"nested param path {sv.path}")
             self.weak_params.add(sv.path[0])
             return N.ParamPresent(sv.path[0])
-        if isinstance(sv, (SParamElem, SLit)):
+        if isinstance(sv, (SParamElem, SLit, SMapKey)):
             return _TRUE
         raise LowerError(f"no definedness test for {sv}")
 
@@ -741,31 +813,12 @@ class _CelLowerer:
         return eq, _and(self._defined(lv), self._defined(rv), N.Not(eq))
 
     def _macro_pair(self, ast: C.Macro, env: dict) -> tuple:
-        if ast.var2 is not None:
-            raise LowerError("two-variable macro")
         target = self._as_list(self.value(ast.target, env))
         if isinstance(target, SList):
-            _check_no_bare_var(ast.body, ast.var)
-            sub_env = dict(env)
-            sub_env[ast.var] = SItem(target.axis, ())
-            tp, fp = self.bool_pair(ast.body, sub_env)
-            if not target.axis.segments:  # empty-list literal
-                if ast.name == "all":
-                    return _TRUE, _FALSE
-                if ast.name == "exists":
-                    return _FALSE, _TRUE
-                raise LowerError(f"macro {ast.name}")
-            ok = self._list_ok(target,
-                               allow_empty_map=len(target.parts) == 1)
-            if ast.name == "all":
-                return (_and(ok, N.Not(N.AnyAxis(target.axis, N.Not(tp)))),
-                        _and(ok, N.AnyAxis(target.axis, fp)))
-            if ast.name == "exists":
-                return (_and(ok, N.AnyAxis(target.axis, tp)),
-                        _and(ok,
-                             N.Not(N.AnyAxis(target.axis, N.Not(fp)))))
-            raise LowerError(f"macro {ast.name}")
+            return self._list_macro_pair(ast, target, env)
         if isinstance(target, SParamList):
+            if ast.var2 is not None:
+                raise LowerError("two-variable macro over a param list")
             sub_env = dict(env)
             sub_env[ast.var] = SParamElem(target.name)
             tp, fp = self.bool_pair(ast.body, sub_env)
@@ -781,6 +834,97 @@ class _CelLowerer:
                         N.Not(N.AnyParamList(target.name, N.Not(fp))))
             raise LowerError(f"macro {ast.name}")
         raise LowerError(f"macro over {target}")
+
+    def _axis_macro_reduce(self, name: str, axis, tp, fp, gate) -> tuple:
+        """(t, f) of a macro over one runtime-kind branch of an axis,
+        from the body's dual-polarity pair.  exists_one never
+        short-circuits, so BOTH its outcomes require every item defined."""
+        if name == "all":
+            return (_and(gate, N.Not(N.AnyAxis(axis, N.Not(tp)))),
+                    _and(gate, N.AnyAxis(axis, fp)))
+        if name == "exists":
+            return (_and(gate, N.AnyAxis(axis, tp)),
+                    _and(gate, N.Not(N.AnyAxis(axis, N.Not(fp)))))
+        if name == "exists_one":
+            defined = N.Not(N.AnyAxis(axis, _and(N.Not(tp), N.Not(fp))))
+            one = N.CountAxisIs(axis, tp, 1)
+            return (_and(gate, defined, one),
+                    _and(gate, defined, N.Not(one)))
+        raise LowerError(f"macro {name}")
+
+    def _list_macro_pair(self, ast: C.Macro, target: SList,
+                         env: dict) -> tuple:
+        """Macros over object-backed lists AND maps, kind-branched at
+        runtime: CEL iterates a LIST's values but a MAP's keys, and the
+        flattener's ragged axes carry both (value items + an aligned
+        MapKeyColumn), so one axis serves both semantics.
+
+        - list branch: var (or var2 of a two-variable macro) binds the
+          item value — the pre-existing lowering.
+        - map branch (single-part targets): var binds the KEY (SMapKey →
+          string ops over the MapKeyColumn); var2, when present, binds
+          the value item.  Only taken when the body lowers under the key
+          binding; otherwise non-empty maps gate to the error outcome,
+          exact only when the body must deref the variable
+          (_check_no_bare_var, as before).
+        """
+        if ast.name not in ("all", "exists", "exists_one"):
+            raise LowerError(f"macro {ast.name}")
+        axis = target.axis
+        if not axis.segments:  # empty-list literal
+            if ast.name == "all":
+                return _TRUE, _FALSE
+            return _FALSE, _TRUE  # exists / exists_one over []
+        # the reductions below read the axis count column even when the
+        # body never touches an item field (var-free / key-only bodies)
+        self._touch_axis(axis)
+        # map branch: body over keys (+ value items for two-variable)
+        map_t = map_f = None
+        if len(target.parts) == 1:
+            try:
+                menv = dict(env)
+                menv[ast.var] = SMapKey(axis)
+                if ast.var2 is not None:
+                    menv[ast.var2] = SItem(axis, ())
+                ktp, kfp = self.bool_pair(ast.body, menv)
+                is_map = N.KindIs(
+                    self._scalar_col(target.parts[0].path), K_MAP)
+                map_t, map_f = self._axis_macro_reduce(
+                    ast.name, axis, ktp, kfp, is_map)
+            except LowerError:
+                map_t = map_f = None
+        # list branch
+        if ast.var2 is None:
+            sub_env = dict(env)
+            sub_env[ast.var] = SItem(axis, ())
+            tp, fp = self.bool_pair(ast.body, sub_env)
+            if map_t is None:
+                # maps gate to error: exact only if the body errors on
+                # every string key (it must deref the variable)
+                _check_no_bare_var(ast.body, ast.var)
+                ok = self._list_ok(target,
+                                   allow_empty_map=len(target.parts) == 1)
+                return self._axis_macro_reduce(ast.name, axis, tp, fp, ok)
+            ok = self._list_ok(target, allow_empty_map=False)
+            lt, lf = self._axis_macro_reduce(ast.name, axis, tp, fp, ok)
+            return _or(lt, map_t), _or(lf, map_f)
+        # two-variable macro: over a map, (key, value); over a LIST, CEL
+        # binds (index, value) — the int index makes every string-method
+        # use of var error per item, so the list branch reduces to
+        # vacuous-if-empty / error-if-non-empty, sound only when both
+        # body outcomes require a string-method evaluation of var
+        if map_t is None:
+            raise LowerError("two-variable macro body does not lower "
+                             "under the key binding")
+        t_req, f_req = _str_method_req(ast.body, ast.var)
+        if not (t_req and f_req):
+            raise LowerError("two-variable macro body can decide without "
+                             "a string method on the key variable")
+        ok = self._list_ok(target, allow_empty_map=False)
+        empty = _and(ok, N.Not(N.AnyAxis(axis, _TRUE)))
+        if ast.name == "all":  # vacuous true on an empty list
+            return _or(empty, map_t), map_f
+        return map_t, _or(empty, map_f)  # exists/exists_one: vacuous false
 
     def _bind_elem_needles(self, expr: N.Expr, param: str) -> N.Expr:
         """Rewrite bare ParamElemSid StrPred needles to the table-backed

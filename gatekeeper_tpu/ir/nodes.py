@@ -289,6 +289,16 @@ class AnyAxis(Expr):
 
 
 @dataclass(frozen=True)
+class CountAxisIs(Expr):
+    """Exactly ``k`` items on the ragged axis satisfy inner (CEL
+    exists_one: count == 1 with no short-circuit)."""
+
+    axis: Axis
+    inner: Expr
+    k: int
+
+
+@dataclass(frozen=True)
 class NestedAny(Expr):
     """Per-parent-item ∃ over a nested pair axis: inside the parent's
     AnyAxis, true for parent slot p iff some pair j with parent_idx[j]==p

@@ -45,6 +45,12 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[(name, _labels_key(labels))] = value
 
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across all label sets (test/introspection)."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
     def observe(self, name: str, value: float,
                 labels: Optional[dict] = None) -> None:
         with self._lock:

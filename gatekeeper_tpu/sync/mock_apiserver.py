@@ -44,6 +44,23 @@ DEFAULT_RESOURCES = {
     "ModifySet": ("mutations.gatekeeper.sh", "v1", "modifyset", False),
     "AssignImage": ("mutations.gatekeeper.sh", "v1alpha1", "assignimage",
                     False),
+    # install-time kinds (deploy/gatekeeper-tpu.yaml applies these; a
+    # real apiserver serves them natively)
+    "CustomResourceDefinition": ("apiextensions.k8s.io", "v1",
+                                 "customresourcedefinitions", False),
+    "ServiceAccount": ("", "v1", "serviceaccounts", True),
+    "Secret": ("", "v1", "secrets", True),
+    "ClusterRole": ("rbac.authorization.k8s.io", "v1", "clusterroles",
+                    False),
+    "ClusterRoleBinding": ("rbac.authorization.k8s.io", "v1",
+                           "clusterrolebindings", False),
+    "Role": ("rbac.authorization.k8s.io", "v1", "roles", True),
+    "RoleBinding": ("rbac.authorization.k8s.io", "v1", "rolebindings",
+                    True),
+    "PodDisruptionBudget": ("policy", "v1", "poddisruptionbudgets", True),
+    "MutatingWebhookConfiguration": (
+        "admissionregistration.k8s.io", "v1",
+        "mutatingwebhookconfigurations", False),
 }
 
 
@@ -106,6 +123,21 @@ class MockApiServer:
     def put_object(self, obj: dict):
         """Upsert from the test side, notifying watchers."""
         kind = obj.get("kind", "")
+        if kind == "CustomResourceDefinition":
+            # a real apiserver starts serving a CRD's resource once the
+            # definition is accepted; mirror that so applying an install
+            # manifest (deploy/gatekeeper-tpu.yaml) makes its custom
+            # kinds immediately usable
+            spec = obj.get("spec") or {}
+            names = spec.get("names") or {}
+            storage_v = next(
+                (v.get("name") for v in spec.get("versions") or []
+                 if v.get("storage")), None)
+            if names.get("kind") and storage_v:
+                self.add_resource(
+                    names["kind"], spec.get("group", ""), storage_v,
+                    names.get("plural", names["kind"].lower()),
+                    spec.get("scope") == "Namespaced")
         key = (kind, obj.get("metadata", {}).get("namespace", ""),
                obj.get("metadata", {}).get("name", ""))
         with self._lock:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import json
+import socket
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -60,6 +61,7 @@ class WebhookServer:
         client_ca_file: Optional[str] = None,  # mTLS: require client certs
         tls_min_version: str = "1.3",  # reference --webhook-tls-min-version
         enable_profile: bool = False,  # pprof-equivalent /debug/profile
+        reuse_port: bool = False,  # SO_REUSEPORT multi-worker serving
     ):
         self.validation_handler = validation_handler
         self.mutation_handler = mutation_handler
@@ -228,6 +230,17 @@ class WebhookServer:
             # the socketserver default backlog of 5 resets bursts of
             # concurrent connects (the apiserver opens many at once)
             request_queue_size = 128
+
+            def server_bind(self):
+                if reuse_port:
+                    # SO_REUSEPORT: N worker processes bind the same
+                    # port and the kernel load-balances connections —
+                    # the multi-process serving story for hosts with
+                    # more cores than one GIL can use (the reference
+                    # scales with goroutines instead, policy.go:116)
+                    self.socket.setsockopt(socket.SOL_SOCKET,
+                                           socket.SO_REUSEPORT, 1)
+                super().server_bind()
 
         self._server = _Server((host, port), Handler)
         self._certfile, self._keyfile = certfile, keyfile

@@ -246,6 +246,17 @@ def test_poisoned_template_trips_breaker_serving_goes_ready():
     st = mgr.tracker.stats()["templates"]
     assert st["satisfied"] and st["cancelled"] == 1 and st["observed"] >= 1
 
+    # nothing external retriggers reconcile: the manager's own backoff
+    # requeue must spend the budget (a watch event only fires once —
+    # without the requeue, /readyz would wedge forever at budget > 0)
+    import time as _time
+
+    _, mgr3 = boot(3)
+    deadline = _time.time() + 15
+    while _time.time() < deadline and not mgr3.tracker.satisfied():
+        _time.sleep(0.2)
+    assert mgr3.tracker.satisfied(), mgr3.tracker.stats()["templates"]
+
 
 def test_metrics_render():
     m = MetricsRegistry()
