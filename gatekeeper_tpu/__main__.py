@@ -140,7 +140,11 @@ def main(argv=None) -> int:
             stripped.append(a)
         child = [a for a in stripped if a != "--once"]
         child += ["--reuse-port", "--operation", "webhook",
-                  "--operation", "mutation-webhook"]
+                  "--operation", "mutation-webhook",
+                  # only the parent runs cert rotation: N concurrent
+                  # renewals would interleave generate_certs writes into
+                  # mismatched tls.crt/tls.key pairs
+                  "--cert-rotation-check-s", "0"]
         for i in range(args.webhook_workers - 1):
             worker_procs.append(subprocess.Popen(
                 [sys.executable, "-m", "gatekeeper_tpu"] + child))
@@ -324,13 +328,26 @@ def main(argv=None) -> int:
     if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
         certfile = keyfile = None
         if args.certs_dir:
-            from gatekeeper_tpu.webhook.certs import generate_certs
             import os
 
-            if not os.path.exists(os.path.join(args.certs_dir, "tls.crt")):
-                generate_certs(args.certs_dir)
-            certfile = os.path.join(args.certs_dir, "tls.crt")
-            keyfile = os.path.join(args.certs_dir, "tls.key")
+            if kube_cluster is not None:
+                # live cluster: the cert-controller-equivalent bootstrap —
+                # chain lives in the cert Secret (one replica generates,
+                # all consume), caBundle injected into the webhook configs
+                from gatekeeper_tpu.webhook.certs import \
+                    ensure_cluster_certs
+
+                certfile, keyfile = ensure_cluster_certs(
+                    kube_cluster, args.certs_dir)
+                args.certs_dir = os.path.dirname(certfile)
+            else:
+                from gatekeeper_tpu.webhook.certs import generate_certs
+
+                if not os.path.exists(
+                        os.path.join(args.certs_dir, "tls.crt")):
+                    generate_certs(args.certs_dir)
+                certfile = os.path.join(args.certs_dir, "tls.crt")
+                keyfile = os.path.join(args.certs_dir, "tls.key")
         server = WebhookServer(
             client_ca_file=args.client_ca_file or None,
             tls_min_version=args.tls_min_version,
@@ -366,7 +383,10 @@ def main(argv=None) -> int:
             reuse_port=args.reuse_port,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
-        if args.certs_dir:
+        if args.certs_dir and args.cert_rotation_check_s > 0:
+            # check-s <= 0 disables rotation (SO_REUSEPORT worker
+            # children: only the parent rotates, or N processes would
+            # race renewal-time generation into mismatched pairs)
             import threading
 
             from gatekeeper_tpu.webhook.certs import rotation_loop
@@ -376,6 +396,7 @@ def main(argv=None) -> int:
                 target=rotation_loop,
                 args=(args.certs_dir, server, rot_stop,
                       args.cert_rotation_check_s),
+                kwargs={"cluster": kube_cluster},
                 daemon=True,
             ).start()
 

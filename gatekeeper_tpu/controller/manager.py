@@ -103,6 +103,7 @@ class Manager:
         self._constraint_watches: dict[str, callable] = {}  # kind -> cancel
         self._lock = threading.RLock()
         self._template_errors: dict[str, str] = {}
+        self._requeue_delay: dict[str, float] = {}  # backoff continuity
         # Config spec.validation.traces[] (per-request webhook tracing)
         self.validation_traces: list = []
 
@@ -237,7 +238,9 @@ class Manager:
                 # template compiles — without this, nothing re-triggers
                 # reconcile and /readyz wedges forever (the reference
                 # controller requeues failing reconciles)
-                self._requeue_template(name)
+                delay = self._requeue_delay.pop(name, 1.0)
+                self._requeue_delay[name] = min(delay * 2, 30.0)
+                self._requeue_template(name, delay)
             return
         self._template_errors.pop(name, None)
         self.tracker.observe("templates", name)
@@ -269,24 +272,24 @@ class Manager:
     def _requeue_template(self, name: str, delay_s: float = 1.0) -> None:
         """Re-reconcile a failing template after a backoff, reading the
         CURRENT object (a delete or a fixed re-apply in the meantime
-        wins).  Each retry doubles the delay up to 30s; the retry chain
-        dies when the template compiles, is deleted, or try_cancel spends
-        the readiness budget."""
+        wins).  The retry runs the FULL reconcile — on success the
+        constraint-kind watch, VAP management, status and metrics all
+        happen exactly as for a watch-event reconcile.  The failure path
+        doubles the delay (capped 30s) via _requeue_delay; the chain dies
+        when the template compiles, is deleted, or try_cancel spends the
+        readiness budget."""
         import threading as _threading
+
+        from gatekeeper_tpu.sync.source import MODIFIED, Event
 
         def fire():
             cur = self.cluster.get(TEMPLATES_GVK, "", name)
             if cur is None or name not in self._template_errors:
+                self._requeue_delay.pop(name, None)
                 return  # deleted or fixed meanwhile
-            try:
-                self.client.add_template(cur)
-            except Exception as e:
-                if not self.tracker.try_cancel("templates", name):
-                    self._template_errors[name] = str(e)
-                    self._requeue_template(name, min(delay_s * 2, 30.0))
-                return
-            self._template_errors.pop(name, None)
-            self.tracker.observe("templates", name)
+            self._reconcile_template(Event(MODIFIED, cur))
+            if name not in self._template_errors:
+                self._requeue_delay.pop(name, None)
 
         t = _threading.Timer(delay_s, fire)
         t.daemon = True

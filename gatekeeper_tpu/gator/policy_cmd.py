@@ -118,14 +118,32 @@ def _unpack_oci_layout(layout_dir: str, dest: str) -> None:
             _extract_tar(io.BytesIO(f.read()), dest)
 
 
+# Transport plug (reference: the ORAS client behind pkg/oci/oci.go:27).
+# A deployment with egress registers real fetchers here — e.g.
+#   REMOTE_TRANSPORTS["oci://"] = my_oras_pull  # (ref, dest) -> None
+# and fetch_bundle routes through them; this build ships only the
+# refusing stubs because the environment has no network egress.
+REMOTE_TRANSPORTS: dict = {}
+
+
+def _refuse_remote(ref: str, dest: str) -> None:
+    raise PolicyError(
+        f"remote artifact {ref!r} not supported in this build (no "
+        "network egress); mirror it locally"
+    )
+
+
+for _scheme in ("http://", "https://", "oci://"):
+    REMOTE_TRANSPORTS.setdefault(_scheme, _refuse_remote)
+
+
 def fetch_bundle(ref: str, catalog_dir: str, dest: str) -> None:
     """Materialize the bundle at ``ref`` (relative to the catalog) into
     ``dest`` so that dest/template.yaml exists."""
-    if ref.startswith(("http://", "https://", "oci://")):
-        raise PolicyError(
-            f"remote artifact {ref!r} not supported in this build (no "
-            "network egress); mirror it locally"
-        )
+    for scheme, fetch in REMOTE_TRANSPORTS.items():
+        if ref.startswith(scheme):
+            fetch(ref, dest)
+            return
     src = ref if os.path.isabs(ref) else os.path.join(catalog_dir, ref)
     if not os.path.exists(src):
         raise PolicyError(f"artifact {src!r} does not exist")

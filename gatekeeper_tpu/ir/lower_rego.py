@@ -205,6 +205,10 @@ class InventoryObjVal:
     instance: int
     apiver_var: str = ""  # named apiVersion var (regex-filterable)
     scope: str = "namespace"  # "namespace" | "cluster"
+    # the ns slot was pinned to the review object's namespace
+    # (data.inventory.namespace[namespace][...] with namespace :=
+    # input.review.object.metadata.namespace): the join is same-ns
+    ns_scoped: bool = False
 
 
 @dataclass(frozen=True)
@@ -223,6 +227,26 @@ class InventoryMetaVal:
 
     inv: InventoryObjVal
     slot: str  # "ns" | "apiver" | "name"
+
+
+@dataclass(frozen=True)
+class SelectorPairsVal:
+    """``[s | v := M[key]; s := concat(":", [key, v])]`` over the map at
+    ``base`` — the inner list of the flatten_selector idiom
+    (gatekeeper-library uniqueserviceselector)."""
+
+    base: object  # PathVal | InventoryFeatVal
+    is_sorted: bool = False
+
+
+@dataclass(frozen=True)
+class SelectorCanonVal:
+    """``concat(",", sort(pairs))`` — the canonical selector string.  An
+    equality between a review-side and an inventory-side canon fuses to
+    a selector-map join (N.InventoryUniqueJoin with transform
+    "selector_canon")."""
+
+    base: object  # PathVal | InventoryFeatVal
 
 
 @dataclass(frozen=True)
@@ -277,6 +301,7 @@ class _Lowerer:
         # recorded when iterating a bound item's sublist (c.ports[_]) so the
         # clause assembly can detect correlated parent/child existentials
         self._axis_parent: dict = {}
+        self._value_fn_stack: set = set()  # value-fn inlining recursion guard
 
     def _fresh_instance(self) -> int:
         self._instances += 1
@@ -394,17 +419,35 @@ class _Lowerer:
             if "join" not in rec:
                 raise LowerError("inventory entry without a join predicate")
             inv, feat_path, subject = rec["join"]
-            subj = self._sid_operand(subject)
+            transform = ""
             group = None
-            if isinstance(subject, (ItemVal, MapKeyVal)):
-                group = ("axis", subject.axis, subject.instance)
+            if isinstance(subject, SelectorCanonVal):
+                # selector-map join: subject is the review object's
+                # canonical selector column; the table side canonicalizes
+                # the same way (ns-qualified when the ref pinned the ns
+                # slot to the review namespace)
+                from gatekeeper_tpu.ops.flatten import CanonCol
+
+                base = subject.base
+                if base.path[:2] != OBJECT_ROOT:
+                    raise LowerError("selector canon outside review object")
+                cc = CanonCol(path=base.path[2:], ns_scoped=inv.ns_scoped)
+                if cc not in self.schema.canons:
+                    self.schema.canons.append(cc)
+                subj = N.CanonFeatSid(cc)
+                transform = "selector_canon"
+            else:
+                subj = self._sid_operand(subject)
+                if isinstance(subject, (ItemVal, MapKeyVal)):
+                    group = ("axis", subject.axis, subject.instance)
             ns_col = self._scalar_col(
                 PathVal(OBJECT_ROOT + ("metadata", "namespace")))
             name_col = self._scalar_col(
                 PathVal(OBJECT_ROOT + ("metadata", "name")))
             spec = N.InvTableSpec(inv.kind, feat_path,
                                   rec.get("apiver_regex", ""),
-                                  scope=inv.scope)
+                                  scope=inv.scope, transform=transform,
+                                  ns_scoped=inv.ns_scoped)
             add_pred(
                 N.InventoryUniqueJoin(spec, subj, ns_col, name_col,
                                       exclude_self=rec.get("exclude",
@@ -650,6 +693,23 @@ class _Lowerer:
                 term.args[1], ast.ArrayTerm
             ):
                 return self._abstract_concat(term, env)
+            if term.op == "concat" and len(term.args) == 2:
+                sep = self._abstract(term.args[0], env)
+                inner = self._abstract(term.args[1], env)
+                if isinstance(inner, SelectorPairsVal) \
+                        and inner.is_sorted \
+                        and isinstance(sep, ConstVal) \
+                        and sep.value == ",":
+                    # the outer join of the flatten_selector idiom; the
+                    # ','/':' separators are the canonical encoding
+                    # ops.flatten.selector_canon reproduces
+                    return SelectorCanonVal(inner.base)
+                return OpaqueVal("concat over non-array")
+            if term.op == "sort" and len(term.args) == 1:
+                inner = self._abstract(term.args[0], env)
+                if isinstance(inner, SelectorPairsVal):
+                    return SelectorPairsVal(inner.base, is_sorted=True)
+                return OpaqueVal("sort")
             if term.op in ("trim_prefix", "trim_suffix") and (
                 len(term.args) == 2
             ):
@@ -663,10 +723,113 @@ class _Lowerer:
                                             strip_prefix=affix.value)
                     return XformElemVal(inner, strip_suffix=affix.value)
                 return OpaqueVal(f"call {term.op}")
+            fn_rule = self.entry_mod.rules.get(term.op)
+            if fn_rule is not None:
+                out = self._abstract_value_fn(fn_rule, term, env)
+                if out is not None:
+                    return out
             return OpaqueVal(f"call {term.op}")
         if isinstance(term, ast.ArrayCompr):
+            sel = self._abstract_selector_compr(term, env)
+            if sel is not None:
+                return sel
             return self._abstract_bool_compr(term, env)
         return OpaqueVal(type(term).__name__)
+
+    def _abstract_selector_compr(self, term: ast.ArrayCompr, env: dict):
+        """Recognize ``[s | v := M[key]; s := concat(":", [key, v])]`` —
+        the per-pair list of the flatten_selector idiom — where ``M``
+        steps from a bound map location (review object or inventory
+        entry).  Returns SelectorPairsVal or None."""
+        if not (isinstance(term.term, ast.Var) and len(term.body) == 2):
+            return None
+        s_name = term.term.name
+        st1, st2 = term.body
+
+        def assign_parts(st):
+            if isinstance(st, ast.AssignStmt) and isinstance(
+                    st.target, ast.Var):
+                return st.target.name, st.term
+            if isinstance(st, ast.UnifyStmt) and isinstance(
+                    st.lhs, ast.Var):
+                return st.lhs.name, st.rhs
+            return None, None
+
+        v_name, ref = assign_parts(st1)
+        s2_name, cat = assign_parts(st2)
+        if v_name is None or s2_name != s_name:
+            return None
+        if not (isinstance(ref, ast.Ref) and isinstance(ref.head, ast.Var)
+                and ref.args):
+            return None
+        *subpath, last = ref.args
+        if not (isinstance(last, ast.Var) and last.name not in env):
+            return None
+        key_name = last.name
+        if not all(isinstance(p, ast.Scalar) and isinstance(p.value, str)
+                   for p in subpath):
+            return None
+        base = env.get(ref.head.name)
+        if isinstance(base, PathVal):
+            base = PathVal(base.path + tuple(p.value for p in subpath))
+        elif isinstance(base, InventoryFeatVal):
+            base = InventoryFeatVal(
+                base.inv, base.path + tuple(p.value for p in subpath))
+        elif isinstance(base, InventoryObjVal):
+            base = InventoryFeatVal(
+                base, tuple(p.value for p in subpath))
+        else:
+            return None
+        # s := concat(":", [key, v])
+        if not (isinstance(cat, ast.Call) and cat.op == "concat"
+                and len(cat.args) == 2
+                and isinstance(cat.args[0], ast.Scalar)
+                and cat.args[0].value == ":"
+                and isinstance(cat.args[1], ast.ArrayTerm)
+                and len(cat.args[1].items) == 2):
+            return None
+        i1, i2 = cat.args[1].items
+        if not (isinstance(i1, ast.Var) and i1.name == key_name
+                and isinstance(i2, ast.Var) and i2.name == v_name):
+            return None
+        return SelectorPairsVal(base)
+
+    def _abstract_value_fn(self, rule, term: ast.Call, env: dict):
+        """Targeted inlining of a VALUE-returning helper function (the
+        flatten_selector shape): one clause, all-Var params, a body of
+        pure assignments, a head value term.  Returns the abstract value
+        of the head under the inlined bindings, or None when the shape
+        doesn't fit (the caller falls through to Opaque)."""
+        if len(rule.clauses) != 1:
+            return None
+        clause = rule.clauses[0]
+        params = clause.args or ()
+        if clause.value is None or len(params) != len(term.args) \
+                or not all(isinstance(p, ast.Var) for p in params):
+            return None
+        if any(not isinstance(st, (ast.AssignStmt, ast.UnifyStmt))
+               for st in clause.body):
+            return None
+        if term.op in self._value_fn_stack:
+            return None  # recursion guard
+        self._value_fn_stack.add(term.op)
+        try:
+            fenv = {p.name: self._abstract(a, env)
+                    for p, a in zip(params, term.args)}
+            for st in clause.body:
+                if isinstance(st, ast.AssignStmt):
+                    tgt, val_t = st.target, st.term
+                else:
+                    tgt, val_t = st.lhs, st.rhs
+                if not isinstance(tgt, ast.Var):
+                    return None
+                fenv[tgt.name] = self._abstract(val_t, fenv)
+            out = self._abstract(clause.value, fenv)
+        finally:
+            self._value_fn_stack.discard(term.op)
+        if isinstance(out, OpaqueVal):
+            return None
+        return out
 
     def _abstract_concat(self, term: ast.Call, env: dict):
         sep = self._abstract(term.args[0], env)
@@ -855,14 +1018,28 @@ class _Lowerer:
         for a in slots:
             if slot_var(a) is None:
                 return OpaqueVal("inventory ref with non-var slot")
+        # a PRE-BOUND ns slot pinned to the review object's namespace is
+        # the same-namespace join idiom (uniqueserviceselector):
+        # namespace := input.review.object.metadata.namespace;
+        # other := data.inventory.namespace[namespace][...]
+        ns_scoped = False
+        if ns_a is not None and ns_a.name in env:
+            bound = env[ns_a.name]
+            if isinstance(bound, PathVal) and bound.path == OBJECT_ROOT + (
+                    "metadata", "namespace"):
+                ns_scoped = True
+            else:
+                return OpaqueVal("inventory slot var already bound")
         inv = InventoryObjVal(kind_a.value, self._fresh_instance(),
                               apiver_var=(""
                                           if av_a.name.startswith("$w")
                                           else av_a.name),
-                              scope=scope)
+                              scope=scope, ns_scoped=ns_scoped)
         for a, slot in ((ns_a, "ns"), (av_a, "apiver"), (name_a, "name")):
             if a is not None and not a.name.startswith("$w"):
                 if a.name in env:
+                    if slot == "ns" and ns_scoped:
+                        continue  # stays bound to the review-object path
                     return OpaqueVal("inventory slot var already bound")
                 env[a.name] = InventoryMetaVal(inv, slot)
         base = InventoryFeatVal(inv, ())
@@ -1193,6 +1370,18 @@ class _Lowerer:
             return self._lower_count_cmp(op, lhs_t.args[0], rhs_t.value, env)
         lhs = self._abstract(lhs_t, env)
         rhs = self._abstract(rhs_t, env)
+        for a, b in ((lhs, rhs), (rhs, lhs)):
+            if isinstance(a, SelectorCanonVal) and isinstance(
+                    a.base, InventoryFeatVal):
+                # canonical-selector equality against an inventory map:
+                # the selector-map join (uniqueserviceselector)
+                if op != "equal":
+                    raise LowerError("non-equality selector comparison")
+                if not (isinstance(b, SelectorCanonVal)
+                        and isinstance(b.base, PathVal)):
+                    raise LowerError(
+                        "selector join needs a review-side canon")
+                raise _InvJoinSignal(a.base.inv, a.base.path, b)
         for a, b in ((lhs, rhs), (rhs, lhs)):
             if isinstance(a, InventoryFeatVal):
                 if op != "equal":

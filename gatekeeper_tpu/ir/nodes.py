@@ -147,10 +147,18 @@ class InvTableSpec:
     join_path: tuple  # e.g. ("spec", "rules", "*", "host")
     apiver_regex: str = ""  # "" = any apiVersion
     scope: str = "namespace"  # "namespace" | "cluster" (inventory root)
+    # "selector_canon": join on the canonical 'k:v,...' encoding of the
+    # map at join_path (ops.flatten.selector_canon) instead of its raw
+    # string values — the flatten_selector idiom
+    transform: str = ""
+    # prefix join values with the entry's namespace (same-namespace
+    # joins: data.inventory.namespace[<review ns>][...])
+    ns_scoped: bool = False
 
     def key(self) -> str:
         return (f"{self.kind}|{'.'.join(self.join_path)}|"
-                f"{self.apiver_regex}|{self.scope}")
+                f"{self.apiver_regex}|{self.scope}|{self.transform}|"
+                f"{int(self.ns_scoped)}")
 
 
 @dataclass(frozen=True)
@@ -240,6 +248,14 @@ class KeySetContains(Expr):
 
     keyset: KeySetCol
     needle: Expr  # sid-valued
+
+
+@dataclass(frozen=True)
+class CanonFeatSid(Expr):
+    """sid of the review object's canonical selector encoding (the
+    CanonCol column) — the subject side of a selector-map join."""
+
+    col: "object"  # ops.flatten.CanonCol
 
 
 @dataclass(frozen=True)

@@ -636,7 +636,13 @@ def pack_batch_cols(batch: ColumnBatch) -> dict:
         cols[col_key(spec)] = {"sid": col.sid}
     for spec, col in batch.parent_idx.items():
         cols[col_key(spec)] = {"idx": col.idx}
+    for spec, sids in batch.canons.items():
+        cols[canon_key(spec)] = {"sid": sids}
     return cols
+
+
+def canon_key(col) -> str:
+    return f"canon:{'.'.join(col.path)}|{int(col.ns_scoped)}"
 
 
 def walk_join_values(obj, join_path) -> list:
@@ -716,6 +722,22 @@ def build_inventory_tables(program: N.Program, data_tree: dict,
                         vocab.intern(ons) if isinstance(ons, str) else -2,
                         vocab.intern(onm) if isinstance(onm, str) else -2,
                     )
+                    if spec.transform == "selector_canon":
+                        from gatekeeper_tpu.ops.flatten import \
+                            selector_canon
+
+                        node_val = obj
+                        for part in spec.join_path:
+                            node_val = node_val.get(part) \
+                                if isinstance(node_val, dict) else None
+                        canon = selector_canon(node_val)
+                        if spec.ns_scoped:
+                            if not isinstance(ns, str) or not ns:
+                                continue
+                            canon = ns + "\x00" + canon
+                        owners_by_sid.setdefault(
+                            vocab.intern(canon), set()).add(owner)
+                        continue
                     for v in walk_join_values(obj, spec.join_path):
                         if isinstance(v, str):
                             owners_by_sid.setdefault(
@@ -882,6 +904,13 @@ def _eval_sidlike(ctx: _Ctx, e: N.Expr):
             kind == K_STR,
             kind > 0,
         )
+    if isinstance(e, N.CanonFeatSid):
+        a = ctx.cols.get(canon_key(e.col))
+        if a is None:
+            raise LowerError(f"canon column {e.col} not in batch")
+        sid = _expand_for_ctx(ctx, a["sid"], False)
+        ok = sid >= 0  # -2 = the canon idiom errors on this object
+        return sid, ok, ok
     if isinstance(e, N.ParamSid):
         ok = ctx.row[f"{e.name}__present"]
         return ctx.row[f"{e.name}__sid"], ok, ok

@@ -131,6 +131,36 @@ class ParentIdxColumn:
 
 
 @dataclass(frozen=True)
+class CanonCol:
+    """sid of the canonical selector encoding of the map at ``path``:
+    the ','-joined sort of 'key:value' pairs of a str->str map — the
+    flatten_selector idiom of referential selector-join policies
+    (gatekeeper-library uniqueserviceselector), optionally
+    namespace-qualified (ns + NUL + canon) for same-namespace joins.
+    sid -2 = the idiom errors on this object (non-string pair values /
+    array) or, when ns-qualified, the namespace is absent."""
+
+    path: tuple
+    ns_scoped: bool = False
+
+
+def selector_canon(value) -> str:
+    """The flatten_selector encoding.  OPA's default (non-strict)
+    builtin-error semantics make ``concat(":", [key, v])`` UNDEFINED for
+    non-string pairs — the comprehension skips that binding — so the
+    encoding is best-effort over the string pairs and total ("" for
+    scalars, arrays, absent).  Shared by the review-side column fill and
+    the inventory-side table builder — they must agree exactly."""
+    parts = []
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if isinstance(k, str) and isinstance(v, str):
+                parts.append(f"{k}:{v}")
+    # arrays iterate with integer keys: every concat is undefined
+    return ",".join(sorted(parts))
+
+
+@dataclass(frozen=True)
 class RaggedKeySetCol:
     """Per-axis-item key sets: the keys of the map at ``subpath`` under
     each item (e.g. the field names of every container — backs dynamic
@@ -148,6 +178,7 @@ class Schema:
     ragged_keysets: list = field(default_factory=list)
     map_keys: list = field(default_factory=list)
     parent_idx: list = field(default_factory=list)
+    canons: list = field(default_factory=list)
 
     def merge(self, other: "Schema") -> None:
         for s in other.scalars:
@@ -168,6 +199,9 @@ class Schema:
         for pi in getattr(other, "parent_idx", []):
             if pi not in self.parent_idx:
                 self.parent_idx.append(pi)
+        for cc in getattr(other, "canons", []):
+            if cc not in self.canons:
+                self.canons.append(cc)
 
     def axes(self) -> list:
         out = []
@@ -231,6 +265,7 @@ class ColumnBatch:
     ragged_keysets: dict = field(default_factory=dict)
     map_keys: dict = field(default_factory=dict)
     parent_idx: dict = field(default_factory=dict)
+    canons: dict = field(default_factory=dict)  # CanonCol -> sid [N] int32
     # identity columns for match masks
     group_sid: np.ndarray = None
     kind_sid: np.ndarray = None
@@ -426,6 +461,7 @@ class Flattener:
             if reviews is None:
                 reviews = [_synth_review(o) for o in objects]
             self._fill_review_cols(batch, review_cols, reviews)
+        self._fill_canons(batch, objects)
         for mk in getattr(self.schema, "map_keys", []):
             if mk in batch.map_keys:
                 continue  # the native flattener already extracted it
@@ -578,7 +614,41 @@ class Flattener:
                 [c for c in schema.scalars
                  if c.path[:1] == ("__review__",)],
                 reviews)
+        self._fill_canons(batch, raws)
         return batch
+
+    def _fill_canons(self, batch: ColumnBatch, objects) -> None:
+        """Canonical-selector sid columns (CanonCol) — computed host-side
+        in Python for both lanes (the encoding is a per-object string
+        build over a small map; in the raw-JSON lane this materializes
+        each object's dict, a cost paid only when a selector-join
+        template is loaded)."""
+        for cc in getattr(self.schema, "canons", []):
+            if cc in batch.canons:
+                continue
+            sids = np.full(batch.n, -2, np.int32)
+            for i, obj in enumerate(objects):
+                if isinstance(obj, (bytes, bytearray, memoryview)):
+                    # flatten_raw's plain-bytes lane
+                    try:
+                        obj = json.loads(bytes(obj))
+                    except ValueError:
+                        continue
+                    if not isinstance(obj, dict):
+                        continue
+                val = obj
+                for part in cc.path:
+                    val = val.get(part) if isinstance(val, dict) else None
+                canon = selector_canon(val)
+                if cc.ns_scoped:
+                    meta = obj.get("metadata")
+                    ns = meta.get("namespace") if isinstance(meta, dict) \
+                        else None
+                    if not isinstance(ns, str):
+                        continue  # ns assignment fails: rule yields nothing
+                    canon = ns + "\x00" + canon
+                sids[i] = self.vocab.intern(canon)
+            batch.canons[cc] = sids
 
     def _fill_review_cols(self, batch: ColumnBatch, specs, reviews) -> None:
         """(Re)fill __review__-rooted scalar columns from review docs —

@@ -79,6 +79,95 @@ def webhook_configuration(ca_bundle: str, url: str) -> dict:
     }
 
 
+WEBHOOK_CONFIG_NAMES = (
+    ("ValidatingWebhookConfiguration",
+     "gatekeeper-validating-webhook-configuration"),
+    ("MutatingWebhookConfiguration",
+     "gatekeeper-mutating-webhook-configuration"),
+)
+
+
+def ensure_cluster_certs(cluster, certs_dir: str,
+                         namespace: str = "gatekeeper-system",
+                         secret_name: str = "gatekeeper-webhook-server-cert",
+                         service: str = "gatekeeper-webhook-service",
+                         webhook_configs=WEBHOOK_CONFIG_NAMES) -> tuple:
+    """Cert bootstrap against a live cluster — the cert-controller
+    equivalent (reference module open-policy-agent/cert-controller, wired
+    main.go:288-315): consume the serving chain from the cert Secret; if
+    it's empty, ONE replica generates and publishes it (last-writer-wins,
+    then every replica re-reads, so all replicas converge on the stored
+    chain) and injects caBundle into the webhook configurations.
+
+    Returns (certfile, keyfile).  Files are written to ``certs_dir``,
+    falling back to a scratch dir when the mount is read-only (Secret
+    volumes always are — kubelet propagation isn't needed since the
+    chain comes from the API)."""
+    import tempfile as _tempfile
+
+    secret_gvk = ("", "v1", "Secret")
+    sec = cluster.get(secret_gvk, namespace, secret_name)
+    data = (sec or {}).get("data") or {}
+    if not data.get("tls.crt"):
+        scratch = _tempfile.mkdtemp(prefix="gk-certgen-")
+        generate_certs(scratch, service=service, namespace=namespace)
+
+        def b64(p):
+            with open(os.path.join(scratch, p), "rb") as f:
+                return base64.b64encode(f.read()).decode()
+
+        cluster.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": secret_name, "namespace": namespace,
+                         "labels": {"gatekeeper.sh/system": "yes"}},
+            "type": "kubernetes.io/tls",
+            "data": {"tls.crt": b64("tls.crt"), "tls.key": b64("tls.key"),
+                     "ca.crt": b64("ca.crt")},
+        })
+        # re-read: a racing replica's write wins deterministically for
+        # everyone (all serve the STORED chain, one consistent CA)
+        sec = cluster.get(secret_gvk, namespace, secret_name)
+        data = (sec or {}).get("data") or {}
+    # materialize the stored chain locally for the TLS context
+    out_dir = certs_dir
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        probe = os.path.join(out_dir, ".rw-probe")
+        with open(probe, "w"):
+            pass
+        os.unlink(probe)
+    except OSError:
+        out_dir = _tempfile.mkdtemp(prefix="gk-certs-")
+    for fname in ("tls.crt", "tls.key", "ca.crt"):
+        blob = base64.b64decode(data.get(fname, ""))
+        with open(os.path.join(out_dir, fname), "wb") as f:
+            f.write(blob)
+    inject_ca_bundle(cluster, data.get("ca.crt", ""), webhook_configs)
+    return (os.path.join(out_dir, "tls.crt"),
+            os.path.join(out_dir, "tls.key"))
+
+
+def inject_ca_bundle(cluster, ca_bundle: str,
+                     webhook_configs=WEBHOOK_CONFIG_NAMES) -> None:
+    """Set clientConfig.caBundle on every webhook of the named
+    configurations (the cert-controller's CABundle injection)."""
+    if not ca_bundle:
+        return
+    for kind, name in webhook_configs:
+        cfg = cluster.get(("admissionregistration.k8s.io", "v1", kind),
+                          "", name)
+        if cfg is None:
+            continue
+        changed = False
+        for wh in cfg.get("webhooks") or []:
+            cc = wh.setdefault("clientConfig", {})
+            if cc.get("caBundle") != ca_bundle:
+                cc["caBundle"] = ca_bundle
+                changed = True
+        if changed:
+            cluster.apply(cfg)
+
+
 def cert_expires_within(cert_path: str, seconds: float) -> bool:
     """True if the certificate at ``cert_path`` expires within ``seconds``
     (or can't be read) — drives the rotation loop."""
@@ -97,15 +186,32 @@ def cert_expires_within(cert_path: str, seconds: float) -> bool:
 
 def rotation_loop(certs_dir: str, server, stop_event,
                   check_interval_s: float = 3600.0,
-                  renew_before_s: float = 90 * 24 * 3600.0):
+                  renew_before_s: float = 90 * 24 * 3600.0,
+                  cluster=None):
     """Background cert rotation (reference: open-policy-agent/cert-controller
     rotator.go wired at main.go:342): regenerate the chain when it nears
-    expiry and hot-reload the serving context."""
+    expiry and hot-reload the serving context.  With ``cluster`` (live
+    apiserver mode) the renewal republishes the Secret + caBundle so every
+    replica converges on the new chain."""
     import os
 
     crt = os.path.join(certs_dir, "tls.crt")
     while not stop_event.wait(check_interval_s):
         if cert_expires_within(crt, renew_before_s):
-            generate_certs(certs_dir)
+            if cluster is not None:
+                # wipe + re-bootstrap through the Secret (one replica
+                # wins; the others pick the stored chain up on their own
+                # next expiry check via ensure_cluster_certs)
+                try:
+                    cluster.delete({
+                        "apiVersion": "v1", "kind": "Secret",
+                        "metadata": {
+                            "name": "gatekeeper-webhook-server-cert",
+                            "namespace": "gatekeeper-system"}})
+                except Exception:
+                    pass
+                ensure_cluster_certs(cluster, certs_dir)
+            else:
+                generate_certs(certs_dir)
             if server is not None:
                 server.reload_certs()

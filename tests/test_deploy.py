@@ -156,3 +156,60 @@ def test_namespace_self_exemption_label(docs):
     args = [c for c in cm["spec"]["template"]["spec"]["containers"]
             if c["name"] == "manager"][0]["args"]
     assert "--exempt-namespace=gatekeeper-system" in args
+
+
+def test_cluster_cert_bootstrap_and_ca_injection(tmp_path):
+    """ensure_cluster_certs (cert-controller equivalent): the first
+    replica generates + publishes the Secret and injects caBundle into
+    the shipped webhook configurations; a second replica consumes the
+    SAME stored chain (one consistent CA across replicas); a read-only
+    certs dir falls back to a scratch dir."""
+    import base64
+
+    from gatekeeper_tpu.webhook.certs import ensure_cluster_certs
+
+    with open(DEPLOY) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    srv = MockApiServer().start()
+    try:
+        kc = KubeCluster(KubeConfig(server=srv.url))
+        try:
+            for doc in docs:
+                kc.apply(doc)
+            d1 = tmp_path / "replica1"
+            crt1, key1 = ensure_cluster_certs(kc, str(d1))
+            assert crt1.endswith("tls.crt") and os.path.exists(crt1)
+            sec = kc.get(("", "v1", "Secret"), "gatekeeper-system",
+                         "gatekeeper-webhook-server-cert")
+            assert sec["data"]["tls.crt"]
+            ca = sec["data"]["ca.crt"]
+            # caBundle injected into every webhook of both configs
+            for kind, name in (
+                    ("ValidatingWebhookConfiguration",
+                     "gatekeeper-validating-webhook-configuration"),
+                    ("MutatingWebhookConfiguration",
+                     "gatekeeper-mutating-webhook-configuration")):
+                cfg = kc.get(("admissionregistration.k8s.io", "v1", kind),
+                             "", name)
+                for wh in cfg["webhooks"]:
+                    assert wh["clientConfig"]["caBundle"] == ca
+            # replica 2: consumes the stored chain, no regeneration
+            d2 = tmp_path / "replica2"
+            crt2, _ = ensure_cluster_certs(kc, str(d2))
+            with open(crt1, "rb") as f1, open(crt2, "rb") as f2:
+                assert f1.read() == f2.read()
+            assert base64.b64decode(sec["data"]["tls.crt"]) == \
+                open(crt1, "rb").read()
+            # unwritable certs dir (chmod can't stop a root test runner:
+            # use a path under a regular FILE so makedirs raises):
+            # scratch-dir fallback
+            blocker = tmp_path / "blocker"
+            blocker.write_text("")
+            ro = blocker / "certs"
+            crt3, _ = ensure_cluster_certs(kc, str(ro))
+            assert not crt3.startswith(str(ro))
+            assert os.path.exists(crt3)
+        finally:
+            kc.close()
+    finally:
+        srv.stop()

@@ -115,3 +115,26 @@ def test_traversal_bundle_refused(tmp_path):
         tf.addfile(info, fileobj=None)
     with pytest.raises(policy_cmd.PolicyError, match="unsafe path"):
         policy_cmd.fetch_bundle(str(evil), ".", str(tmp_path / "dest"))
+
+
+def test_remote_transport_plug(tmp_path):
+    """The transport seam (reference ORAS client, pkg/oci/oci.go:27): a
+    deployment with egress registers a fetcher per scheme and
+    fetch_bundle routes remote refs through it."""
+    calls = []
+
+    def fake_oras(ref, dest):
+        calls.append(ref)
+        os.makedirs(dest, exist_ok=True)
+        with open(os.path.join(dest, "template.yaml"), "w") as f:
+            f.write("kind: ConstraintTemplate\n")
+
+    old = policy_cmd.REMOTE_TRANSPORTS["oci://"]
+    policy_cmd.REMOTE_TRANSPORTS["oci://"] = fake_oras
+    try:
+        dest = tmp_path / "bundle"
+        policy_cmd.fetch_bundle("oci://reg.example/p:1.0", ".", str(dest))
+        assert calls == ["oci://reg.example/p:1.0"]
+        assert (dest / "template.yaml").exists()
+    finally:
+        policy_cmd.REMOTE_TRANSPORTS["oci://"] = old

@@ -1346,3 +1346,78 @@ def test_new_library_differential_adversarial():
         assert set(raw_counts) == set(want_counts), (
             f"raw-grid divergence on object {oi}: {objects[oi]}\n"
             f"raw={sorted(raw_counts)} want={sorted(want_counts)}")
+
+
+def test_referential_unique_service_selector():
+    """Selector-map join (VERDICT r2 missing #3): the flatten_selector
+    idiom lowers to a canonical-selector column + ns-qualified
+    owner-count table (N.InvTableSpec transform='selector_canon',
+    ns_scoped) with identical() self-exclusion."""
+    import os
+
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    lib = os.path.join(os.path.dirname(__file__), "..", "library",
+                       "general", "uniqueserviceselector")
+    tpu = TpuDriver(batch_bucket=8)
+    from gatekeeper_tpu.apis.templates import ConstraintTemplate
+
+    tpu.add_template(ConstraintTemplate.from_unstructured(
+        load_yaml_file(os.path.join(lib, "template.yaml"))[0]))
+    assert "K8sUniqueServiceSelector" in tpu.lowered_kinds(), \
+        tpu.fallback_kinds()
+    con = Constraint.from_unstructured(load_yaml_file(
+        os.path.join(lib, "samples", "constraint.yaml"))[0])
+    tpu.add_constraint(con)
+
+    def svc(name, ns, selector):
+        doc = {"apiVersion": "v1", "kind": "Service",
+               "metadata": {"name": name, "namespace": ns},
+               "spec": {"ports": [{"port": 443}]}}
+        if selector is not None:
+            doc["spec"]["selector"] = selector
+        return doc
+
+    for obj in [svc("a", "default", {"app": "x", "tier": "web"}),
+                svc("b", "prod", {"app": "x", "tier": "web"}),
+                svc("nosel", "default", None)]:
+        tpu.add_data("admission.k8s.gatekeeper.sh",
+                     ["namespace", obj["metadata"]["namespace"],
+                      "v1", "Service", obj["metadata"]["name"]], obj)
+
+    reviews_objs = [
+        # same selector as a, same namespace (key order must not matter)
+        svc("new", "default", {"tier": "web", "app": "x"}),
+        # same selector but DIFFERENT namespace than a: only b matches,
+        # and b is in prod -> violation only for prod
+        svc("new2", "prod", {"app": "x", "tier": "web"}),
+        # same selector, a namespace with no synced services
+        svc("new3", "staging", {"app": "x", "tier": "web"}),
+        # unique selector
+        svc("new4", "default", {"app": "y"}),
+        # IS service a (self-exclusion)
+        svc("a", "default", {"app": "x", "tier": "web"}),
+        # selector-less matches the selector-less inventory entry
+        # (upstream flatten_selector of a missing selector is "")
+        svc("new5", "default", None),
+        # non-string selector value: OPA's non-strict builtin error makes
+        # the pair UNDEFINED (skipped) -> canon "" matches selector-less
+        svc("new6", "default", {"app": True}),
+        # no namespace: the namespace assignment fails -> no violation
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": "new7"},
+         "spec": {"selector": {"app": "x", "tier": "web"}}},
+    ]
+    got = _verdicts(tpu, con, reviews_objs)
+    target = K8sValidationTarget()
+    for obj, g in zip(reviews_objs, got):
+        review = target.handle_review(AugmentedUnstructured(object=obj))
+        want = len(tpu._interp.query(TARGET, [con], review).results)
+        assert g == want, (obj, g, want)
+    assert got == [1, 1, 0, 0, 0, 1, 1, 0]
+
+    # data mutation invalidates the table: removing service a clears the
+    # default-namespace conflict
+    tpu.remove_data("admission.k8s.gatekeeper.sh",
+                    ["namespace", "default", "v1", "Service", "a"])
+    assert _verdicts(tpu, con, [reviews_objs[0]]) == [0]
