@@ -47,24 +47,87 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, ("data", "model"))
 
 
-def pack_transfer_cols(cols: dict, pad_n: int) -> tuple:
+def col_stats_update(stats: dict, cols: dict) -> None:
+    """Accumulate corpus-wide per-column (min, max, const-value) over the
+    per-object transfer columns of one chunk.  Consumed by
+    :func:`pack_transfer_cols` to pick narrow wire dtypes and elide
+    corpus-constant columns with a layout that is STABLE across every
+    chunk of the run (layout is part of the jit key — a data-dependent
+    per-chunk layout would retrace the fused sweep mid-run)."""
+    for key in cols:
+        if key.startswith(("fn:", "st:", "inv:")):
+            continue
+        val = cols[key]
+        items = sorted(val.items()) if isinstance(val, dict) \
+            else [(None, val)]
+        for sub, a in items:
+            a = np.asarray(a)
+            if a.size == 0:
+                continue
+            amn = a.min().item()
+            amx = a.max().item()
+            prev = stats.get((key, sub))
+            if prev is None:
+                stats[(key, sub)] = (amn, amx, amn if amn == amx else None)
+            else:
+                mn, mx, cv = prev
+                stats[(key, sub)] = (
+                    min(mn, amn), max(mx, amx),
+                    cv if (cv is not None and amn == amx == cv) else None)
+
+
+def _wire_dtype(dt: str, mn: float, mx: float) -> tuple:
+    """(store_dtype_str, bias) for a column whose corpus range is
+    [mn, mx].  Integer columns with mn >= -1 ride unsigned narrow types
+    with a +1 bias (missing-value sentinel -1 -> 0); everything else
+    travels as-is."""
+    if dt in ("<i4", "<i8") and mn >= -1:
+        if mx + 1 <= 0xFF:
+            return "|u1", 1
+        if mx + 1 <= 0xFFFF:
+            return "<u2", 1
+    return dt, 0
+
+
+def pack_transfer_cols(cols: dict, pad_n: int,
+                       stats: Optional[dict] = None) -> tuple:
     """Pack every per-object column into ONE [pad_n, W] buffer per dtype.
 
     Tunneled TPU backends pay ~10ms fixed cost per transfer command, so a
     sweep chunk's ~150 column arrays must travel as a handful of
-    device_puts (the arrays themselves are only a few MB).  Packing along
-    axis 1 keeps each object's values together, so 'data'-axis sharding
-    of the buffers is exactly the sharding the unpacked columns had.
-    Grouping by dtype keeps the in-jit unpack to plain same-type slices —
-    a byte-level single-buffer variant measured 6x SLOWER end-to-end on
-    TPU (narrow uint8 strips + bitcasts relayout horribly on the 128-lane
-    tile grid).
+    device_puts.  Packing along axis 1 keeps each object's values
+    together, so 'data'-axis sharding of the buffers is exactly the
+    sharding the unpacked columns had.  Grouping by dtype keeps the
+    in-jit unpack to plain same-type slices — a byte-level single-buffer
+    variant measured 6x SLOWER end-to-end on TPU (narrow uint8 strips +
+    bitcasts relayout horribly on the 128-lane tile grid).
 
-    Returns ({dtype_str: buf [pad_n, W_dtype]}, layout) where layout is a
-    static tuple of (key, subkey, dtype_str, elem_offset, tail_shape,
-    elem_width) consumed by :func:`unpack_transfer_cols` inside the
-    jitted sweep.  Table columns (fn:/st:/inv: — shared, device-cached)
-    are excluded.
+    ``stats`` ({(key, sub): (min, max, const|None)} from
+    :func:`col_stats_update` over the whole corpus) enables the two wire
+    optimizations the ~30MB/s tunnel link forces (measured: H2D is the
+    sweep bottleneck at 42 library templates, ~2KB/object of int32):
+
+    - **dtype narrowing**: vocab-id/count/index columns store as
+      uint8/uint16 with a +1 bias when the corpus range fits (vocab ids
+      are ~36k for a 100k-object cluster -> uint16 halves the payload);
+      widened back to the original dtype on device where casts fuse.
+    - **constant elision**: columns constant across the corpus (absent
+      fields: seLinuxOptions, procMount... on clusters that never set
+      them) ship as a scalar in the static layout and materialize as a
+      broadcast on device.
+
+    Both decisions come from corpus stats so the layout — part of the
+    jit key — is identical for every chunk; a chunk that exceeds the
+    recorded range (cluster drift between audit runs) falls back to a
+    wider dtype for that column, costing one retrace, never wrong
+    results.
+
+    Returns ({dtype_str: buf [pad_n, W_dtype]}, layout) where layout is
+    a static tuple of (key, subkey, store_dtype, elem_offset, tail_shape,
+    elem_width, orig_dtype, bias_or_const) consumed by
+    :func:`unpack_transfer_cols` inside the jitted sweep; store_dtype
+    "const" marks an elided column whose value rides in the last slot.
+    Table columns (fn:/st:/inv: — shared, device-cached) are excluded.
     """
     parts: dict = {}
     widths: dict = {}
@@ -77,26 +140,51 @@ def pack_transfer_cols(cols: dict, pad_n: int) -> tuple:
         for sub, a in items:
             a = np.ascontiguousarray(a)
             dt = a.dtype.str
-            w = int(np.prod(a.shape[1:], dtype=np.int64)) \
-                if a.ndim > 1 else 1
-            off = widths.get(dt, 0)
-            parts.setdefault(dt, []).append(a.reshape(pad_n, w))
-            layout.append((key, sub, dt, off, a.shape[1:], w))
-            widths[dt] = off + w
+            tail = a.shape[1:]
+            st = stats.get((key, sub)) if stats is not None else None
+            if st is not None and (st[2] is not None
+                                   or dt in ("<i4", "<i8")) and a.size:
+                amn = a.min().item()
+                amx = a.max().item()
+                if st[2] is not None and amn == amx == st[2]:
+                    # corpus-constant and this chunk agrees: elide
+                    layout.append((key, sub, "const", 0, tail, 0, dt,
+                                   st[2]))
+                    continue
+                wdt, bias = _wire_dtype(dt, min(st[0], amn),
+                                        max(st[1], amx))
+            else:
+                wdt, bias = dt, 0
+            if bias:
+                a = (a + bias).astype(np.dtype(wdt))
+            w = int(np.prod(tail, dtype=np.int64)) if a.ndim > 1 else 1
+            off = widths.get(wdt, 0)
+            parts.setdefault(wdt, []).append(a.reshape(pad_n, w))
+            layout.append((key, sub, wdt, off, tail, w, dt, bias))
+            widths[wdt] = off + w
     bufs = {dt: np.concatenate(ps, axis=1) for dt, ps in parts.items()}
     return bufs, tuple(layout)
 
 
-def unpack_transfer_cols(bufs: dict, layout: tuple) -> dict:
+def unpack_transfer_cols(bufs: dict, layout: tuple, pad_n: int) -> dict:
     """Rebuild the cols dict from dtype-grouped buffers inside jit:
-    static same-dtype slices, fused by XLA (no data movement beyond the
-    transfers that brought the buffers)."""
+    static same-dtype slices + widening casts + constant broadcasts, all
+    fused by XLA (no data movement beyond the transfers that brought the
+    buffers)."""
     cols: dict = {}
-    for key, sub, dt, off, tail, w in layout:
-        buf = bufs[dt]
-        n = buf.shape[0]
-        arr = jax.lax.slice_in_dim(buf, off, off + w, axis=1)
-        arr = arr.reshape((n,) + tail)
+    for key, sub, wdt, off, tail, w, dt, extra in layout:
+        odt = jax.dtypes.canonicalize_dtype(np.dtype(dt))
+        if wdt == "const":
+            arr = jnp.full((pad_n,) + tail, extra, dtype=odt)
+        else:
+            buf = bufs[wdt]
+            n = buf.shape[0]
+            arr = jax.lax.slice_in_dim(buf, off, off + w, axis=1)
+            arr = arr.reshape((n,) + tail)
+            if wdt != dt:
+                arr = arr.astype(odt)
+            if extra:
+                arr = arr - extra
         if sub is None:
             cols[key] = arr
         else:
@@ -236,9 +324,24 @@ class ShardedEvaluator:
         self._sweep_fns: dict = {}
         self._table_dev_cache: dict = {}  # key -> (host_array, dev_array)
         self._param_dev_cache: dict = {}  # digest -> dev uint8 buffer
+        # corpus-wide per-column (min, max, const) from warm_pass: drives
+        # wire-dtype narrowing + constant elision in pack_transfer_cols
+        self._col_stats: dict = {}
+
+    def _needs_union(self, kinds) -> dict:
+        """Union of array fields any lowered program reads — the
+        transfer-slimming key shared by warm_pass (col stats) and
+        sweep_submit (packing); one definition so the stats keys always
+        match the packed columns."""
+        needs: dict = {}
+        for kind in sorted(kinds):
+            for ck, fields in needed_fields(
+                    self.driver._programs[kind].program).items():
+                needs.setdefault(ck, set()).update(fields)
+        return needs
 
     def _sweep_fn(self, kinds: tuple, k: int, return_bits: bool,
-                  cols_layout: tuple, tables_layout: tuple):
+                  cols_layout: tuple, tables_layout: tuple, pad_n: int):
         """One fused jitted program for the whole sweep: every template's
         verdict grid + mask + top-k + totals, returning ONE packed int32
         array [C_total, 2k+1] = [idx(k) | valid(k) | count].
@@ -249,14 +352,14 @@ class ShardedEvaluator:
         slices/bitcasts fuse to nothing), and the chunk result leaves in
         one packed transfer.
         """
-        key = (kinds, k, return_bits, cols_layout, tables_layout)
+        key = (kinds, k, return_bits, cols_layout, tables_layout, pad_n)
         fn = self._sweep_fns.get(key)
         if fn is not None:
             return fn
         builders = [self.driver._programs[kind]._build() for kind in kinds]
 
         def fused(tables_buf, cols_buf, table_cols: dict, mask):
-            cols = unpack_transfer_cols(cols_buf, cols_layout)
+            cols = unpack_transfer_cols(cols_buf, cols_layout, pad_n)
             cols.update(table_cols)
             tables = unpack_flat_tables(tables_buf, tables_layout,
                                         len(kinds))
@@ -300,13 +403,20 @@ class ShardedEvaluator:
         for kind in lowered:
             schema.merge(self.driver._programs[kind].program.schema)
         fl = Flattener(schema, self.driver.vocab)
+        needs = self._needs_union(lowered)
         buckets: dict = {}
         for i in range(0, len(objects), chunk_size):
             ch = objects[i:i + chunk_size]
             # EVERY chunk interns (the compile below must see the final
             # vocab, or the timed run's first chunk crosses a vocab
-            # bucket and retraces mid-sweep); columns are discarded
-            fl.flatten(ch, pad_n=self._pad(len(ch)))
+            # bucket and retraces mid-sweep) AND feeds the corpus column
+            # stats so every timed chunk packs with one stable
+            # narrowed/elided wire layout (layout is part of the jit key;
+            # per-chunk layouts would retrace the fused sweep mid-run)
+            batch = fl.flatten(ch, pad_n=self._pad(len(ch)))
+            col_stats_update(
+                self._col_stats,
+                slim_cols(pack_batch_cols(batch), needs))
             buckets.setdefault(self._pad(len(ch)), ch)
         for ch in buckets.values():
             self.sweep_warm(constraints, ch, return_bits)
@@ -364,12 +474,7 @@ class ShardedEvaluator:
 
         cols = pack_batch_cols(batch)
         # transfer slimming: ship only the array fields some program reads
-        needs: dict = {}
-        for kind in sorted(lowered):
-            for ck, fields in needed_fields(
-                    self.driver._programs[kind].program).items():
-                needs.setdefault(ck, set()).update(fields)
-        cols = slim_cols(cols, needs)
+        cols = slim_cols(cols, self._needs_union(lowered))
 
         if batch.has_generate_name is not None:
             # native JSON lane: presence came back as a column — avoids
@@ -410,7 +515,8 @@ class ShardedEvaluator:
         # packed param tables (replicated, device-cached on content — the
         # constraint set rarely changes chunk-over-chunk), shared vocab/
         # inventory tables (device-cached on content), and the mask.
-        cols_bufs, cols_layout = pack_transfer_cols(cols, pad_n)
+        cols_bufs, cols_layout = pack_transfer_cols(
+            cols, pad_n, stats=self._col_stats or None)
         cols_bufs_dev = {
             dt: jax.device_put(b, NamedSharding(self.mesh,
                                                 P("data", None)))
@@ -433,7 +539,7 @@ class ShardedEvaluator:
             mask, NamedSharding(self.mesh, P(None, "data"))
         )
         result = self._sweep_fn(kinds, k, return_bits, cols_layout,
-                                tables_layout)(
+                                tables_layout, pad_n)(
             tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev
         )
         return _PendingSweep(result, kinds, offsets, by_kind, n, return_bits)
