@@ -47,13 +47,17 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, ("data", "model"))
 
 
+_DICT_CAP = 254  # distinct values above this: no u1 dictionary remap
+
+
 def col_stats_update(stats: dict, cols: dict) -> None:
-    """Accumulate corpus-wide per-column (min, max, const-value) over the
-    per-object transfer columns of one chunk.  Consumed by
-    :func:`pack_transfer_cols` to pick narrow wire dtypes and elide
-    corpus-constant columns with a layout that is STABLE across every
-    chunk of the run (layout is part of the jit key — a data-dependent
-    per-chunk layout would retrace the fused sweep mid-run)."""
+    """Accumulate corpus-wide per-column (min, max, const-value,
+    distinct-values) over the per-object transfer columns of one chunk.
+    Consumed by :func:`pack_transfer_cols` to pick narrow wire dtypes,
+    elide corpus-constant columns, and dictionary-remap low-cardinality
+    columns — with a layout that is STABLE across every chunk of the run
+    (layout is part of the jit key — a data-dependent per-chunk layout
+    would retrace the fused sweep mid-run)."""
     for key in cols:
         if key.startswith(("fn:", "st:", "inv:")):
             continue
@@ -66,22 +70,48 @@ def col_stats_update(stats: dict, cols: dict) -> None:
                 continue
             amn = a.min().item()
             amx = a.max().item()
+            vals: Optional[frozenset] = None
+            if a.dtype.str in ("<i4", "<i8"):
+                # distinct-set tracking for the u1 dictionary remap
+                # (low-cardinality wide-range columns, e.g. label-key
+                # sids); capped — a high-cardinality column drops out
+                u = np.unique(a)
+                if len(u) <= _DICT_CAP:
+                    vals = frozenset(int(x) for x in u)
+            # float columns holding only integral values (ports,
+            # replica counts) can ride integer wire dtypes
+            intf = (a.dtype.str == "<f4"
+                    and bool(np.all(a == np.trunc(a))))
             prev = stats.get((key, sub))
             if prev is None:
-                stats[(key, sub)] = (amn, amx, amn if amn == amx else None)
+                stats[(key, sub)] = (amn, amx,
+                                     amn if amn == amx else None, vals,
+                                     intf)
             else:
-                mn, mx, cv = prev
+                mn, mx, cv = prev[0], prev[1], prev[2]
+                pv = prev[3] if len(prev) > 3 else None
+                if pv is None or vals is None:
+                    vals = None  # some chunk already overflowed the cap
+                else:
+                    vals = pv | vals
+                    if len(vals) > _DICT_CAP:
+                        vals = None
                 stats[(key, sub)] = (
                     min(mn, amn), max(mx, amx),
-                    cv if (cv is not None and amn == amx == cv) else None)
+                    cv if (cv is not None and amn == amx == cv) else None,
+                    vals,
+                    intf and (len(prev) < 5 or prev[4]))
 
 
 def _wire_dtype(dt: str, mn: float, mx: float) -> tuple:
     """(store_dtype_str, bias) for a column whose corpus range is
     [mn, mx].  Integer columns with mn >= -1 ride unsigned narrow types
-    with a +1 bias (missing-value sentinel -1 -> 0); everything else
-    travels as-is."""
-    if dt in ("<i4", "<i8") and mn >= -1:
+    with a +1 bias (missing-value sentinel -1 -> 0); "|n1" marks a
+    nibble (two values per byte — type-tag columns span ~7 values);
+    everything else travels as-is."""
+    if dt in ("<i4", "<i8", "|i1") and mn >= -1:
+        if mx + 1 <= 0xF:
+            return "|n1", 1
         if mx + 1 <= 0xFF:
             return "|u1", 1
         if mx + 1 <= 0xFFFF:
@@ -142,8 +172,11 @@ def pack_transfer_cols(cols: dict, pad_n: int,
             dt = a.dtype.str
             tail = a.shape[1:]
             st = stats.get((key, sub)) if stats is not None else None
-            if st is not None and (st[2] is not None
-                                   or dt in ("<i4", "<i8")) and a.size:
+            dict_vals = None
+            narrowable = dt in ("<i4", "<i8", "|i1") or (
+                dt == "<f4" and st is not None and len(st) > 4 and st[4])
+            if st is not None and (st[2] is not None or narrowable) \
+                    and a.size:
                 amn = a.min().item()
                 amx = a.max().item()
                 if st[2] is not None and amn == amx == st[2]:
@@ -151,17 +184,58 @@ def pack_transfer_cols(cols: dict, pad_n: int,
                     layout.append((key, sub, "const", 0, tail, 0, dt,
                                    st[2]))
                     continue
-                wdt, bias = _wire_dtype(dt, min(st[0], amn),
-                                        max(st[1], amx))
+                eff_mn = min(st[0], amn)
+                eff_mx = max(st[1], amx)
+                if not narrowable:
+                    wdt, bias = dt, 0
+                elif dt == "<f4":
+                    # integral-float column (ports): integer wire dtype.
+                    # The chunk must re-verify integrality (a drifted
+                    # non-integral chunk would otherwise truncate —
+                    # range drift falls back, value drift must too) and
+                    # a no-fit range keeps the float dtype (falling
+                    # through to "<i4" would store floats uncast in the
+                    # int parts bucket).
+                    wdt, bias = _wire_dtype("<i4", eff_mn, eff_mx)
+                    if wdt == "<i4" or not bool(np.all(a == np.trunc(a))):
+                        wdt, bias = dt, 0
+                else:
+                    wdt, bias = _wire_dtype(dt, eff_mn, eff_mx)
+                dct = st[3] if len(st) > 3 else None
+                if dct is not None and wdt not in ("|u1", "|n1"):
+                    # u1 dictionary remap: wide-range low-cardinality
+                    # column (e.g. label-key sids) stores dictionary
+                    # indices; the sorted dictionary rides the static
+                    # layout and is gathered from a baked constant on
+                    # device.  Chunk values outside the corpus
+                    # dictionary (cluster drift) fall back to the plain
+                    # narrowed dtype — one retrace, never wrong results.
+                    dv = np.array(sorted(dct), np.int64)
+                    idx = np.searchsorted(dv, a.ravel())
+                    idx_c = np.minimum(idx, len(dv) - 1)
+                    if bool(np.all(dv[idx_c] == a.ravel())):
+                        a = idx_c.astype(np.uint8).reshape(a.shape)
+                        wdt, bias = "|u1", 0
+                        dict_vals = tuple(int(x) for x in dv)
             else:
                 wdt, bias = dt, 0
-            if bias:
-                a = (a + bias).astype(np.dtype(wdt))
             w = int(np.prod(tail, dtype=np.int64)) if a.ndim > 1 else 1
+            if wdt == "|n1" and w % 2:
+                wdt = "|u1"  # nibble pairs need an even element count
+            if wdt == "|n1":
+                b = (a + bias).astype(np.uint8).reshape(pad_n, w)
+                a = b[:, 0::2] | (b[:, 1::2] << 4)
+                store_w = w // 2
+            elif bias:
+                a = (a + bias).astype(np.dtype(wdt))
+                store_w = w
+            else:
+                store_w = w
             off = widths.get(wdt, 0)
-            parts.setdefault(wdt, []).append(a.reshape(pad_n, w))
-            layout.append((key, sub, wdt, off, tail, w, dt, bias))
-            widths[wdt] = off + w
+            parts.setdefault(wdt, []).append(a.reshape(pad_n, store_w))
+            layout.append((key, sub, wdt, off, tail, w, dt,
+                           dict_vals if dict_vals is not None else bias))
+            widths[wdt] = off + store_w
     bufs = {dt: np.concatenate(ps, axis=1) for dt, ps in parts.items()}
     return bufs, tuple(layout)
 
@@ -176,15 +250,30 @@ def unpack_transfer_cols(bufs: dict, layout: tuple, pad_n: int) -> dict:
         odt = jax.dtypes.canonicalize_dtype(np.dtype(dt))
         if wdt == "const":
             arr = jnp.full((pad_n,) + tail, extra, dtype=odt)
+        elif wdt == "|n1":
+            buf = bufs[wdt]
+            n = buf.shape[0]
+            arr = jax.lax.slice_in_dim(buf, off, off + w // 2, axis=1)
+            lo = arr & np.uint8(0xF)
+            hi = arr >> np.uint8(4)
+            arr = jnp.stack([lo, hi], axis=-1).reshape((n, w))
+            arr = arr.reshape((n,) + tail).astype(odt)
+            if extra:
+                arr = arr - extra
         else:
             buf = bufs[wdt]
             n = buf.shape[0]
             arr = jax.lax.slice_in_dim(buf, off, off + w, axis=1)
             arr = arr.reshape((n,) + tail)
-            if wdt != dt:
-                arr = arr.astype(odt)
-            if extra:
-                arr = arr - extra
+            if isinstance(extra, tuple):
+                # dictionary remap: gather original values from the
+                # baked (tiny, layout-static) dictionary constant
+                arr = jnp.asarray(np.array(extra, dtype=odt))[arr]
+            else:
+                if wdt != dt:
+                    arr = arr.astype(odt)
+                if extra:
+                    arr = arr - extra
         if sub is None:
             cols[key] = arr
         else:
