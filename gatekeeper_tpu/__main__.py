@@ -88,6 +88,12 @@ def main(argv=None) -> int:
     p.add_argument("--process-id", type=int, default=0)
     p.add_argument("--once", action="store_true",
                    help="run one audit sweep and exit (no servers)")
+    p.add_argument("--webhook-small-batch", type=int, default=None,
+                   help="admission batches this size or smaller take the "
+                        "per-review interpreter lane instead of the "
+                        "device verdict grid (default 8 — the measured "
+                        "grid-launch crossover; the lanes agree "
+                        "bit-for-bit)")
     p.add_argument("--webhook-workers", type=int, default=1,
                    help="serve the webhook from N processes sharing one "
                         "port via SO_REUSEPORT (the kernel load-balances "
@@ -323,9 +329,31 @@ def main(argv=None) -> int:
         def namespace_lookup(name):
             return cluster.get(("", "v1", "Namespace"), "", name)
 
-    batcher = Batcher(client, stats=args.log_stats_admission).start()
+    batcher = Batcher(client, stats=args.log_stats_admission,
+                      small_batch=args.webhook_small_batch).start()
     server = None
     if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
+        # warm every grid-lane pad bucket before serving: readiness
+        # already gates traffic (the reference's warm-cache contract,
+        # readiness/setup.go:28-41) and a lazily-compiled batch shape
+        # would otherwise stall the first saturated admission burst for
+        # seconds
+        if client.templates():
+            from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+            from gatekeeper_tpu.target.review import AugmentedUnstructured
+
+            _pod = {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "warmup", "namespace": "default"},
+                    "spec": {"containers": [
+                        {"name": "c", "image": "warmup"}]}}
+            _warm = [AugmentedUnstructured(object=dict(_pod),
+                                           source=SOURCE_ORIGINAL)
+                     for _ in range(batcher.max_batch)]
+            n = batcher.small_batch + 1
+            while n <= batcher.max_batch:
+                client.review_batch(_warm[:n])
+                n *= 2
+            client.review_batch(_warm)
         certfile = keyfile = None
         if args.certs_dir:
             import os

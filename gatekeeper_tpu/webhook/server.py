@@ -78,6 +78,12 @@ class WebhookServer:
             # mid-reuse (every response sets Content-Length, as 1.1
             # persistence requires)
             protocol_version = "HTTP/1.1"
+            # the stdlib writes a response as two send()s (header block,
+            # body); with Nagle on, the body segment stalls on the
+            # client's delayed ACK — a measured fixed +40ms on EVERY
+            # admission reply (3.8ms handler, 48ms observed end-to-end).
+            # socketserver consumes this on the handler class.
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # quiet
                 pass

@@ -238,7 +238,10 @@ def test_json_patch_generator():
 
 def test_batcher_coalesces_requests():
     client = make_client()
-    batcher = Batcher(client, window_s=0.02, max_batch=16).start()
+    # small_batch=1 pins the review_batch grid lane (the auto default
+    # would route an 8-request batch through the interpreter lane)
+    batcher = Batcher(client, window_s=0.02, max_batch=16,
+                      small_batch=1).start()
     try:
         handler = ValidationHandler(client, batcher=batcher)
         results = {}

@@ -44,6 +44,16 @@ def build_server():
     nt, nc = load_library(client)
     batcher = Batcher(client, window_s=0.002, max_batch=64).start()
     handler = ValidationHandler(client, batcher=batcher)
+    # warm EVERY grid-lane pad bucket (9->16, 17->32, 33->64): shapes
+    # otherwise compile lazily inside the first saturated lane
+    # (seconds-long P99 spikes that say nothing about steady state)
+    from gatekeeper_tpu.target.review import AugmentedUnstructured
+    from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+    warm = [AugmentedUnstructured(
+        object=json.loads(make_body(i))["request"]["object"],
+        source=SOURCE_ORIGINAL) for i in range(64)]
+    for n in (9, 17, 33, 64):
+        client.review_batch(warm[:n])
     srv = WebhookServer(validation_handler=handler, port=0,
                         readiness_check=lambda: True).start()
     return srv, batcher, nt, nc
