@@ -349,7 +349,7 @@ def main(argv=None) -> int:
             _warm = [AugmentedUnstructured(object=dict(_pod),
                                            source=SOURCE_ORIGINAL)
                      for _ in range(batcher.max_batch)]
-            n = batcher.small_batch + 1
+            n = max(1, batcher.small_batch + 1)
             while n <= batcher.max_batch:
                 client.review_batch(_warm[:n])
                 n *= 2

@@ -447,6 +447,11 @@ class TpuDriver:
                 "name": r.request.name,
                 "namespace": r.request.namespace,
                 "userInfo": r.request.user_info,
+                # UPDATE-delta policies (upstream noupdateserviceaccount)
+                # compare object fields against oldObject fields; absent
+                # outside UPDATE/DELETE, so such rules stay vacuous on
+                # CREATE and in audit sweeps — same as the interpreter
+                "oldObject": r.request.old_object,
             }
             for r in reviews
         ]

@@ -91,13 +91,24 @@ def run_bench(objs, engine: str, iterations: int) -> BenchResult:
             continue
         client.add_constraint(c)
     r.setup_constraints_s = time.perf_counter() - t0
+    from gatekeeper_tpu.gator import reader as _reader
+
     t0 = time.perf_counter()
     for d in data:
-        client.add_data(d)
+        if not _reader.is_admission_review(d):
+            client.add_data(d)
     r.setup_data_s = time.perf_counter() - t0
 
-    reviews = [AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
-               for o in data]
+    from gatekeeper_tpu.target.review import AugmentedReview
+    from gatekeeper_tpu.webhook.policy import parse_admission_review
+
+    reviews = [
+        (AugmentedReview(admission_request=parse_admission_review(o),
+                         is_admission=True)
+         if _reader.is_admission_review(o)
+         else AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL))
+        for o in data
+    ]
     latencies = []
     violations = 0
 

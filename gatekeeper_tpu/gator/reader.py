@@ -28,6 +28,15 @@ def is_expansion_template(obj: dict) -> bool:
     return kind == "ExpansionTemplate" and group == "expansion.gatekeeper.sh"
 
 
+def is_admission_review(obj: dict) -> bool:
+    """AdmissionReview fixture objects review the embedded request
+    (operation/oldObject/userInfo intact) instead of a bare object —
+    how upstream gator exercises UPDATE/DELETE-delta policies
+    (reference: pkg/gator/reader read paths)."""
+    group, _, kind = gvk_of(obj)
+    return kind == "AdmissionReview" and group == "admission.k8s.io"
+
+
 def read_sources(
     filenames: Iterable[str] = (), images: Iterable[str] = (), use_stdin: bool = False
 ) -> list[dict]:

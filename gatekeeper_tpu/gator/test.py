@@ -82,36 +82,19 @@ def test(
         if reader.is_constraint(obj):
             client.add_constraint(obj)
     for obj in objs:
-        client.add_data(obj)
+        if not reader.is_admission_review(obj):
+            client.add_data(obj)
 
     from gatekeeper_tpu.expansion.expander import Expander
 
     expander = Expander(objs)
 
     responses = GatorResponses()
-    for obj in objs:
-        ns = expander.namespace_for(obj)
-        au = AugmentedUnstructured(object=obj, namespace=ns,
-                                   source=SOURCE_ORIGINAL)
-        review = client.review(
-            au, enforcement_point=GATOR_EP, tracing=tracing, stats=stats
-        )
-        for resultant in expander.expand(obj):
-            r_au = AugmentedUnstructured(
-                object=resultant.obj, namespace=ns, source=SOURCE_GENERATED
-            )
-            r_review = client.review(
-                r_au, enforcement_point=GATOR_EP, tracing=tracing, stats=stats
-            )
-            from gatekeeper_tpu.expansion import aggregate
 
-            aggregate.override_enforcement_action(
-                resultant.enforcement_action, r_review
-            )
-            aggregate.aggregate_responses(
-                resultant.template_name, review, r_review
-            )
-
+    def fold_review(review, obj):
+        """Fold one client review into the aggregate response set — the
+        single copy shared by the bare-object and AdmissionReview
+        paths (results, traces, stats)."""
         for target_name, resp in review.by_target.items():
             t_resp = responses.by_target.setdefault(
                 target_name, GatorResponse(target=target_name)
@@ -134,4 +117,45 @@ def test(
                     else resp.trace
                 )
         responses.stats_entries.extend(review.stats_entries)
+
+    for obj in objs:
+        if reader.is_admission_review(obj):
+            # review the embedded AdmissionRequest (operation, oldObject,
+            # userInfo — the webhook's view), with the namespace resolved
+            # from the fixture set exactly like the bare-object path;
+            # expansion operates on bare objects, not requests
+            from gatekeeper_tpu.target.review import AugmentedReview
+            from gatekeeper_tpu.webhook.policy import parse_admission_review
+
+            req = parse_admission_review(obj)
+            ns = expander.namespace_for(req.object or req.old_object or {})
+            review = client.review(
+                AugmentedReview(admission_request=req, namespace=ns,
+                                is_admission=True),
+                enforcement_point=GATOR_EP, tracing=tracing, stats=stats)
+            fold_review(review, obj)
+            continue
+        ns = expander.namespace_for(obj)
+        au = AugmentedUnstructured(object=obj, namespace=ns,
+                                   source=SOURCE_ORIGINAL)
+        review = client.review(
+            au, enforcement_point=GATOR_EP, tracing=tracing, stats=stats
+        )
+        for resultant in expander.expand(obj):
+            r_au = AugmentedUnstructured(
+                object=resultant.obj, namespace=ns, source=SOURCE_GENERATED
+            )
+            r_review = client.review(
+                r_au, enforcement_point=GATOR_EP, tracing=tracing, stats=stats
+            )
+            from gatekeeper_tpu.expansion import aggregate
+
+            aggregate.override_enforcement_action(
+                resultant.enforcement_action, r_review
+            )
+            aggregate.aggregate_responses(
+                resultant.template_name, review, r_review
+            )
+
+        fold_review(review, obj)
     return responses

@@ -210,6 +210,24 @@ def _run_case(client: Client, case: dict, base: str, expander_objs):
     for obj in inventory:
         client.add_data(obj)
     try:
+        from gatekeeper_tpu.gator import reader
+
+        if reader.is_admission_review(under_test):
+            # AdmissionReview fixture: review the embedded request
+            # (operation/oldObject/userInfo — the webhook's view) with
+            # the namespace resolved from the fixture set; no expansion,
+            # which operates on bare objects
+            from gatekeeper_tpu.target.review import AugmentedReview
+            from gatekeeper_tpu.webhook.policy import parse_admission_review
+
+            req = parse_admission_review(under_test)
+            expander = Expander([*inventory, *expander_objs])
+            ns = expander.namespace_for(req.object or req.old_object or {})
+            return client.review(
+                AugmentedReview(admission_request=req, namespace=ns,
+                                is_admission=True),
+                enforcement_point=GATOR_EP,
+            ).results()
         # namespaces resolved gator-style from object+inventory+expansion set
         expander = Expander([under_test, *inventory, *expander_objs])
         ns = expander.namespace_for(under_test)

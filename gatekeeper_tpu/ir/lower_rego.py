@@ -50,6 +50,26 @@ from gatekeeper_tpu.ops.flatten import (
 
 OBJECT_ROOT = ("review", "object")  # input.review.object
 
+# k8s-API scalar-typed leaf fields: feature-to-feature equality lowers only
+# when BOTH sides end in one of these (N.FeatEqFeat is exact for scalars
+# but treats composites as shallow-unequal, so arbitrary paths — e.g.
+# metadata.labels vs oldObject's — keep the exact interpreter fallback)
+_SCALAR_TYPED_LEAVES = frozenset({
+    "serviceAccountName", "serviceAccount", "nodeName", "schedulerName",
+    "priorityClassName", "runtimeClassName", "restartPolicy", "dnsPolicy",
+    "storageClassName", "hostNetwork", "hostPID", "hostIPC", "image",
+    "name", "namespace", "operation", "username", "uid", "apiVersion",
+    "type", "path", "host",
+})
+
+
+def _scalar_typed_path(v) -> bool:
+    if isinstance(v, PathVal):
+        return bool(v.path) and v.path[-1] in _SCALAR_TYPED_LEAVES
+    if isinstance(v, ItemVal):
+        return bool(v.subpath) and v.subpath[-1] in _SCALAR_TYPED_LEAVES
+    return False
+
 
 # --- abstract values ------------------------------------------------------
 
@@ -1428,8 +1448,28 @@ class _Lowerer:
             )
 
         if _is_feature(lhs) and _is_feature(rhs):
-            # feature-to-feature: exact semantics would need lexical string
-            # order / composite comparison on device — interpreter fallback
+            if (op in ("equal", "neq")
+                    and not isinstance(lhs, StrFnVal)
+                    and not isinstance(rhs, StrFnVal)
+                    and _scalar_typed_path(lhs)
+                    and _scalar_typed_path(rhs)):
+                # feature-to-feature (in)equality: full scalar semantics
+                # on device (object vs oldObject fields — upstream
+                # noupdateserviceaccount).  Gated on BOTH paths ending in
+                # a known schema-scalar field name: FeatEqFeat treats
+                # composite operands as shallow-unequal, so arbitrary
+                # paths (metadata.labels vs oldObject labels) must keep
+                # the exact interpreter fallback
+                def _fcol(v):
+                    return (self._scalar_col(v) if isinstance(v, PathVal)
+                            else self._ragged_col(v))
+
+                return N.FeatEqFeat(_fcol(lhs), _fcol(rhs),
+                                    negate=(op == "neq")), axis
+            # ordered comparison / string-function operands / paths not
+            # provably scalar: exact semantics would need lexical string
+            # order, parsed quantities, or deep composite comparison on
+            # device — interpreter fallback
             raise LowerError("feature-to-feature comparison")
         str_side = self._is_stringy(lhs) or self._is_stringy(rhs)
         if str_side:
@@ -1791,7 +1831,7 @@ class _Lowerer:
             col = ScalarCol(path=val.path[2:])
         elif val.path[:1] == ("review",) and val.path[1:2] and (
             val.path[1] in ("kind", "operation", "name", "namespace",
-                            "userInfo")
+                            "userInfo", "oldObject")
         ):
             # review-level scalars columnized from the review document (only
             # the fields the batch paths populate — anything else must fall

@@ -235,6 +235,23 @@ class EqStr(Expr):
 
 
 @dataclass(frozen=True)
+class FeatEqFeat(Expr):
+    """Equality of two feature VALUES (object.spec.x == oldObject.spec.x)
+    with full scalar semantics: both defined, kinds match, numbers
+    compare numerically, strings by sid, true/false/null by kind alone.
+    Composite operands (maps/lists) compare shallowly UNEQUAL — the
+    shipped templates compare schema-typed scalar fields (e.g.
+    serviceAccountName, upstream noupdateserviceaccount), where the
+    apiserver guarantees scalars; a deep-equal composite pair would
+    diverge from the interpreter.  ``negate`` follows Rego !=: defined
+    operands of different kinds are defined-unequal (true)."""
+
+    lhs: FeatCol
+    rhs: FeatCol
+    negate: bool = False
+
+
+@dataclass(frozen=True)
 class InStrList(Expr):
     """value ∈ string-list parameter."""
 

@@ -585,6 +585,9 @@ def needed_fields(program: N.Program) -> dict:
             add(node.col, "kind", "num")
         elif isinstance(node, N.FeatSid):
             add(node.col, "kind", "sid")
+        elif isinstance(node, N.FeatEqFeat):
+            add(node.lhs, "kind", "num", "sid")
+            add(node.rhs, "kind", "num", "sid")
         elif isinstance(node, N.CountNum):
             add(node.col, "kind", "sid")
         elif isinstance(node, (N.KeySetContains, N.RaggedKeySetContains)):
@@ -996,6 +999,32 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         if e.negate:
             # Rego: 5 != "x" is TRUE (defined inequality across types)
             return lpres & rpres & jnp.logical_not(eq_true)
+        return eq_true
+    if isinstance(e, N.FeatEqFeat):
+        la = _feat_arrays(ctx, e.lhs)
+        ra = _feat_arrays(ctx, e.rhs)
+        lrag = isinstance(e.lhs, RaggedCol)
+        rrag = isinstance(e.rhs, RaggedCol)
+        lk = _expand_for_ctx(ctx, la["kind"], lrag)
+        rk = _expand_for_ctx(ctx, ra["kind"], rrag)
+        # value check per kind: numbers numerically, strings by sid,
+        # true/false/null by the kind tag alone; composites shallowly
+        # unequal (see the node's exactness note)
+        val_eq = jnp.where(
+            lk == K_NUM,
+            _expand_for_ctx(ctx, la["num"], lrag)
+            == _expand_for_ctx(ctx, ra["num"], rrag),
+            jnp.where(
+                lk == K_STR,
+                _expand_for_ctx(ctx, la["sid"], lrag)
+                == _expand_for_ctx(ctx, ra["sid"], rrag),
+                (lk != K_MAP) & (lk != K_OTHER),
+            ),
+        )
+        defined = (lk > 0) & (rk > 0)
+        eq_true = defined & (lk == rk) & val_eq
+        if e.negate:
+            return defined & jnp.logical_not(eq_true)
         return eq_true
     if isinstance(e, N.InStrList):
         nv, nok, _npres = _eval_sidlike(ctx, e.needle)

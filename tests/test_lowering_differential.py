@@ -1421,3 +1421,57 @@ def test_referential_unique_service_selector():
     tpu.remove_data("admission.k8s.gatekeeper.sh",
                     ["namespace", "default", "v1", "Service", "a"])
     assert _verdicts(tpu, con, [reviews_objs[0]]) == [0]
+
+
+def test_feat_eq_feat_update_delta_differential():
+    """object-vs-oldObject scalar comparison (FeatEqFeat): the device
+    grid must agree with the interpreter across scalar kinds, absence,
+    operations, and the allowed-user exemption (upstream
+    noupdateserviceaccount).  Composite values are excluded by contract
+    (the node's docstring: apiserver-typed scalar fields only)."""
+    from gatekeeper_tpu.target.review import AdmissionRequest
+
+    tpu = TpuDriver()
+    tpu.add_template(_template(
+        "library/general/noupdateserviceaccount/template.yaml"))
+    con = _constraint(
+        "library/general/noupdateserviceaccount/samples/constraint.yaml")
+    tpu.add_constraint(con)
+    assert "K8sNoUpdateServiceAccount" in tpu.lowered_kinds()
+
+    rng = random.Random(7)
+    values = ["web-sa", "other-sa", "", 3, 3.0, 7, True, False, None,
+              "MISSING"]
+    users = ["alice",
+             "system:serviceaccount:kube-system:replicaset-controller"]
+    reviews = []
+    for i in range(240):
+        def pod(v):
+            spec = {"containers": [{"name": "c", "image": "nginx"}]}
+            if v != "MISSING":
+                spec["serviceAccountName"] = v
+            return {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"p{i}", "namespace": "default"},
+                    "spec": spec}
+
+        req = AdmissionRequest(
+            uid=f"u{i}",
+            kind={"group": "", "version": "v1", "kind": "Pod"},
+            operation=rng.choice(["UPDATE", "UPDATE", "CREATE"]),
+            user_info={"username": rng.choice(users)},
+            object=pod(rng.choice(values)),
+            old_object=(pod(rng.choice(values))
+                        if rng.random() < 0.9 else None),
+        )
+        reviews.append(K8sValidationTarget().handle_review(req))
+
+    got = tpu.query_batch(TARGET, [con], reviews)
+    interp = tpu._interp
+    for oi, review in enumerate(reviews):
+        expected = interp.query(TARGET, [con], review).results
+        key = lambda r: (r.constraint["metadata"]["name"], r.msg)
+        assert sorted(map(key, got[oi].results)) == \
+            sorted(map(key, expected)), (
+            f"divergence on review {oi}: "
+            f"op={review.request.operation} "
+            f"new={review.request.object} old={review.request.old_object}")

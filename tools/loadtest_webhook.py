@@ -51,9 +51,12 @@ def build_server():
     from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
     warm = [AugmentedUnstructured(
         object=json.loads(make_body(i))["request"]["object"],
-        source=SOURCE_ORIGINAL) for i in range(64)]
-    for n in (9, 17, 33, 64):
+        source=SOURCE_ORIGINAL) for i in range(batcher.max_batch)]
+    n = max(1, batcher.small_batch + 1)
+    while n <= batcher.max_batch:
         client.review_batch(warm[:n])
+        n *= 2
+    client.review_batch(warm)
     srv = WebhookServer(validation_handler=handler, port=0,
                         readiness_check=lambda: True).start()
     return srv, batcher, nt, nc
