@@ -1,0 +1,110 @@
+"""Pallas TPU kernel for the audit sweep's verdict epilogue.
+
+The device side of a sweep chunk ends with, per constraint row of the
+[C, N] verdict grid: the FIRST k violating object indices
+(lowest-index-first — the reference's bounded max-heap LimitQueue,
+pkg/audit/manager.go:161-202) and the exact violation count.  The XLA
+path (parallel/sharded.topk_violations) expresses this as
+``jax.lax.top_k`` over an index-scored grid — a full per-row sort-like
+selection.  This kernel instead fuses count + first-k selection into ONE
+VMEM pass per 8-constraint row block: counts are a row sum, and the
+first-k indices come from k iterations of vectorized min+mask-out
+(O(k*N) VPU work, no sort), all from the same resident block.
+
+Layout: row blocks are 8 sublanes x N lanes; C pads to a multiple of 8.
+The single output row block is 128 lanes wide: lanes 0..k-1 carry the
+selected indices (sentinel N = no more violations), lane k the count.
+``topk_violations_pallas`` agrees with ``topk_violations`` under the
+valid-mask (tests/test_pallas_topk.py); callers fall back to the XLA
+twin off-TPU (CPU meshes, interpreters).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_ROWS = 8      # constraint rows per program (f32/i32 sublane tile)
+_KPAD = 128    # output lane tile; k < _KPAD
+
+
+def _epilogue_kernel(k: int, grid_ref, out_ref):
+    block = grid_ref[:].astype(jnp.int32)  # [_ROWS, N]
+    n = block.shape[1]
+    cnt = jnp.sum(block, axis=1, dtype=jnp.int32)  # [_ROWS]
+    idxs = jax.lax.broadcasted_iota(jnp.int32, block.shape, 1)
+    cand = jnp.where(block != 0, idxs, n)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _KPAD), 1)
+
+    def body(j, state):
+        cand, out = state
+        m = jnp.min(cand, axis=1)  # [_ROWS] lowest remaining violation
+        out = jnp.where(lanes == j, m[:, None], out)
+        return jnp.where(cand == m[:, None], n, cand), out
+
+    out0 = jnp.full((_ROWS, _KPAD), n, jnp.int32)
+    _, out = jax.lax.fori_loop(0, k, body, (cand, out0))
+    out = jnp.where(lanes == k, cnt[:, None], out)
+    out_ref[:] = out
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _epilogue(grid: jnp.ndarray, k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    c, n = grid.shape
+    c_pad = -(-c // _ROWS) * _ROWS
+    if c_pad != c:
+        grid = jnp.pad(grid, ((0, c_pad - c), (0, 0)))
+    # interpret mode runs the kernel as plain JAX off-TPU (CPU test
+    # meshes) — the production fallback is the XLA twin, but the
+    # differential tests exercise THIS kernel's logic everywhere
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        functools.partial(_epilogue_kernel, k),
+        grid=(c_pad // _ROWS,),
+        in_specs=[
+            pl.BlockSpec((_ROWS, n), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_ROWS, _KPAD), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((c_pad, _KPAD), jnp.int32),
+        interpret=interpret,
+    )(grid)
+    return out[:c, :k], out[:c, k]
+
+
+def pallas_supported() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def topk_violations_counts_pallas(verdicts: jnp.ndarray, k: int):
+    """(idx [C,k] i32, valid [C,k] bool, counts [C] i32) — the fused
+    epilogue, counts included from the same VMEM pass.  Runs under the
+    caller's jit so the fused sweep stays one dispatch.  Invalid slots
+    carry idx 0 (the XLA twin's invalid-slot indices are arbitrary sort
+    leftovers; consumers gate on ``valid``).  k beyond the 128-lane
+    output tile falls back to the XLA twin."""
+    c, n = verdicts.shape
+    k = min(k, n)
+    if k >= _KPAD:
+        from gatekeeper_tpu.parallel.sharded import topk_violations
+
+        idx, valid = topk_violations(verdicts, k)
+        return idx, valid, jnp.sum(verdicts, axis=1, dtype=jnp.int32)
+    idx, cnt = _epilogue(verdicts, k)
+    valid = idx < n
+    return jnp.where(valid, idx, 0), valid, cnt
+
+
+def topk_violations_pallas(verdicts: jnp.ndarray, k: int):
+    """Drop-in twin of parallel.sharded.topk_violations (no counts)."""
+    idx, valid, _cnt = topk_violations_counts_pallas(verdicts, k)
+    return idx, valid

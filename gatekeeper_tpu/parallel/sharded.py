@@ -447,6 +447,19 @@ class ShardedEvaluator:
             return fn
         builders = [self.driver._programs[kind]._build() for kind in kinds]
 
+        # epilogue: the Pallas fused first-k/count kernel measures 2.1x
+        # the XLA top_k twin on-chip (PALLAS_BENCH.json) but a pallas
+        # call can't consume a sharded operand — any multi-chip mesh
+        # (data-sharded N or model-sharded C) and CPU test meshes keep
+        # the XLA path, whose top-k all-gathers across shards
+        if self.mesh.size == 1:
+            from gatekeeper_tpu.ops.pallas_topk import (
+                pallas_supported, topk_violations_counts_pallas)
+
+            use_pallas = pallas_supported()
+        else:
+            use_pallas = False
+
         def fused(tables_buf, cols_buf, table_cols: dict, mask):
             cols = unpack_transfer_cols(cols_buf, cols_layout, pad_n)
             cols.update(table_cols)
@@ -454,8 +467,11 @@ class ShardedEvaluator:
                                         len(kinds))
             grids = [b(t, cols) for b, t in zip(builders, tables)]
             grid = jnp.concatenate(grids, axis=0) & mask
-            idx, valid = topk_violations(grid, k)
-            counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+            if use_pallas:
+                idx, valid, counts = topk_violations_counts_pallas(grid, k)
+            else:
+                idx, valid = topk_violations(grid, k)
+                counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
             packed = jnp.concatenate(
                 [idx, valid.astype(jnp.int32), counts[:, None]], axis=1
             )

@@ -1,0 +1,64 @@
+"""The Pallas verdict-epilogue kernel must agree with the XLA twin
+(parallel.sharded.topk_violations) under the valid-mask, for every grid
+shape class the sweep produces.  Off-TPU the kernel runs in interpret
+mode — same kernel logic, plain-JAX execution."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from gatekeeper_tpu.ops.pallas_topk import (topk_violations_counts_pallas,
+                                            topk_violations_pallas)
+from gatekeeper_tpu.parallel.sharded import topk_violations
+
+
+def _agree(verdicts: np.ndarray, k: int):
+    g = jnp.asarray(verdicts)
+    xi, xv = topk_violations(g, k)
+    pi, pv, pc = topk_violations_counts_pallas(g, k)
+    xi, xv = np.asarray(xi), np.asarray(xv)
+    pi, pv = np.asarray(pi), np.asarray(pv)
+    assert np.array_equal(xv, pv), "valid masks differ"
+    assert np.array_equal(np.where(xv, xi, -1), np.where(pv, pi, -1)), \
+        "selected indices differ under the valid mask"
+    # the kernel's fused count lane must be the exact row sums
+    assert np.array_equal(np.asarray(pc), verdicts.sum(axis=1))
+
+
+def test_dense_sparse_empty_rows():
+    rng = np.random.default_rng(0)
+    v = rng.random((46, 4096)) < 0.01      # sparse
+    v[3] = False                            # empty row
+    v[7] = True                             # full row
+    v[11, -1] = True                        # lone hit at the tail
+    _agree(v, 20)
+
+
+def test_k_larger_than_hits_and_row():
+    rng = np.random.default_rng(1)
+    v = rng.random((5, 64)) < 0.2
+    _agree(v, 20)   # k < n but > hits in most rows
+    _agree(v, 64)   # k == n
+
+
+def test_k_beyond_lane_tile_falls_back():
+    rng = np.random.default_rng(3)
+    v = rng.random((4, 512)) < 0.3
+    _agree(v, 128)  # k >= _KPAD: routes through the XLA twin
+    _agree(v, 200)
+
+
+def test_row_padding_to_sublane_tile():
+    rng = np.random.default_rng(2)
+    for c in (1, 7, 8, 9, 46):
+        v = rng.random((c, 512)) < 0.05
+        _agree(v, 20)
+
+
+def test_first_k_are_lowest_indices():
+    v = np.zeros((2, 256), bool)
+    hits = [5, 17, 99, 100, 255]
+    v[0, hits] = True
+    idx, valid = topk_violations_pallas(jnp.asarray(v), 3)
+    assert np.asarray(idx)[0, :3].tolist() == hits[:3]
+    assert np.asarray(valid)[0].tolist() == [True, True, True]
+    assert not np.asarray(valid)[1].any()

@@ -1,0 +1,64 @@
+"""Measure the Pallas verdict-epilogue kernel against the XLA top_k twin
+on the live device, at the sweep's real shapes.
+
+    python tools/bench_pallas.py [C] [N] [k]
+
+Both paths run under one jit (as the fused sweep calls them), timed over
+repeated dispatches with block_until_ready.  Writes PALLAS_BENCH.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(c=46, n=32768, k=20, iters=50):
+    from gatekeeper_tpu.ops.pallas_topk import topk_violations_pallas
+    from gatekeeper_tpu.parallel.sharded import topk_violations
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    grid = jnp.asarray(rng.random((c, n)) < 0.05)
+
+    def packed(fn):
+        @jax.jit
+        def run(g):
+            idx, valid = fn(g, k)
+            counts = jnp.sum(g, axis=1, dtype=jnp.int32)
+            return jnp.concatenate(
+                [idx, valid.astype(jnp.int32), counts[:, None]], axis=1)
+        return run
+
+    out = {"C": c, "N": n, "k": k, "iters": iters,
+           "platform": jax.devices()[0].platform}
+    results = {}
+    for name, fn in (("xla_topk", topk_violations),
+                     ("pallas", topk_violations_pallas)):
+        run = packed(fn)
+        r = run(grid)
+        jax.block_until_ready(r)  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = run(grid)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / iters
+        results[name] = dt * 1e6
+        print(f"{name}: {dt*1e6:.0f} us/call", file=sys.stderr)
+    out["us_per_call"] = results
+    out["speedup_pallas_vs_xla"] = round(
+        results["xla_topk"] / results["pallas"], 3)
+    with open(os.path.join(os.path.dirname(__file__), "..",
+                           "PALLAS_BENCH.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:5]))
