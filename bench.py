@@ -98,11 +98,10 @@ def build_client():
     return client, tpu, nt, nc
 
 
-def setup(n: int):
-    """Shared bench preamble: accelerator probe (with CPU fallback), client
-    + library build, synthetic workload generation, referential inventory
-    sync.  Returns (jax, client, tpu, nt, nc, objects, cpu_fallback,
-    gen_s, inv_s)."""
+def setup_platform_and_client():
+    """Shared preamble for every bench lane: accelerator probe (with CPU
+    fallback) + client/library build.  Returns (jax, client, tpu, nt, nc,
+    cpu_fallback)."""
     import os
 
     cpu_fallback = False
@@ -121,13 +120,19 @@ def setup(n: int):
         # the hook only pins from env; ensure the override sticks even if
         # another import already touched jax config
         jax.config.update("jax_platforms", "cpu")
-
-    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
-
     log(f"devices: {jax.devices()}")
     client, tpu, nt, nc = build_client()
     log(f"library loaded: {nt} templates ({len(tpu.lowered_kinds())} on the "
         f"device verdict path), {nc} constraints")
+    return jax, client, tpu, nt, nc, cpu_fallback
+
+
+def setup(n: int):
+    """setup_platform_and_client + synthetic workload generation +
+    referential inventory sync.  Returns (jax, client, tpu, nt, nc,
+    objects, cpu_fallback, gen_s, inv_s)."""
+    jax, client, tpu, nt, nc, cpu_fallback = setup_platform_and_client()
+    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
     t0 = time.perf_counter()
     log(f"generating {n} synthetic cluster objects...")
     objects = make_cluster_objects(n)
@@ -155,9 +160,18 @@ def setup(n: int):
     return jax, client, tpu, nt, nc, objects, cpu_fallback, gen_s, inv_s
 
 
-def sweep_main(n: int = 1_000_000, chunk: int = 32_768):
+def sweep_main(n: int = 1_000_000, chunk: int = 32_768,
+               submit_window: int = 4):
     """BASELINE config #6: the N-object audit sweep, measured (not
-    extrapolated).  Writes SWEEP1M.json with elapsed + phase breakdown.
+    extrapolated), at O(chunk) host memory.  Writes SWEEP1M.json with
+    elapsed + phase breakdown + peak RSS.
+
+    The corpus spills to a JSONL file at generation time (the reference's
+    disk list-cache, pkg/audit/manager.go:502-561: list pages spill to
+    disk and review streams file-by-file); the warm pass and the timed
+    sweep both STREAM it — no pass ever holds more than
+    ``submit_window + 1`` chunks of objects, so peak RSS is bounded by
+    vocab/table state + in-flight chunks instead of the whole corpus.
 
     Per-constraint violating-object counts come from the device count
     reduction (exact per (constraint, object) pair); kept top-20
@@ -167,32 +181,60 @@ def sweep_main(n: int = 1_000_000, chunk: int = 32_768):
     import json as _json
     import os
     import resource
+    import tempfile
 
-    jax, client, tpu, nt, nc, objects, cpu_fallback, gen_s, inv_s = \
-        setup(n)
+    jax, client, tpu, nt, nc, cpu_fallback = setup_platform_and_client()
+    from gatekeeper_tpu.utils.synthetic import iter_cluster_objects
+
+    spill = os.path.join(tempfile.gettempdir(), f"sweep_corpus_{n}.jsonl")
+    t0 = time.perf_counter()
+    n_ing = 0
+    log(f"generating {n} objects to disk spill {spill} (streaming)...")
+    with open(spill, "wb") as f:
+        for o in iter_cluster_objects(n):
+            if o.get("kind") == "Ingress":
+                client.add_data(o)  # referential inventory sync
+                n_ing += 1
+            f.write(_json.dumps(o, separators=(",", ":")).encode())
+            f.write(b"\n")
+    gen_s = time.perf_counter() - t0
+    log(f"generation+spill: {gen_s:.1f}s ({n_ing} Ingresses synced; "
+        f"{os.path.getsize(spill) / 1e9:.2f}GB on disk)")
+
+    from gatekeeper_tpu.utils.rawjson import RawJSON
+
+    def lister():
+        with open(spill, "rb") as f:
+            for line in f:
+                yield RawJSON(line.rstrip(b"\n"))
+
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
     evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
-                      exact_totals=False)
-    mgr = AuditManager(client, lister=lambda: iter(objects), config=cfg,
+                      exact_totals=False, submit_window=submit_window)
+    mgr = AuditManager(client, lister=lister, config=cfg,
                        evaluator=evaluator)
     # fetch-free warmup: interns every name (vocab reaches its final
     # bucket) and compiles all chunk shapes WITHOUT a single device->host
     # fetch, so the timed run's uploads still ride full tunnel bandwidth
-    # (the backend permanently degrades H2D ~40x after a process's first
-    # fetch — see AuditConfig.submit_window)
-    log("warmup (vocab pass + per-bucket jit compile, fetch-free)...")
+    log("warmup (streaming vocab pass + per-group jit compile)...")
     t_w = time.perf_counter()
-    evaluator.warm_pass(client.constraints(), objects, chunk,
+    evaluator.warm_pass(client.constraints(), lister(), chunk,
                         return_bits=cfg.exact_totals)
     log(f"warmup: {time.perf_counter() - t_w:.1f}s")
 
-    log(f"timed {n}-object sweep (chunk={chunk})...")
+    log(f"timed {n}-object sweep (chunk={chunk}, "
+        f"window={submit_window})...")
+    evaluator.perf_reset()
+    mgr.perf = {}
     t0 = time.perf_counter()
     run = mgr.audit()
     elapsed = time.perf_counter() - t0
+    phases = {k: round(v, 2) for k, v in evaluator.perf.items()}
+    phases.update({k: round(v, 2) for k, v in mgr.perf.items()})
+    phases["wire_mb"] = round(phases.pop("wire_bytes", 0.0) / 1e6, 1)
     # sum over constraints of violating-object counts: an object violating
     # k constraints contributes k (a violation count, not distinct objects)
     violations = sum(run.total_violations.values())
@@ -201,6 +243,7 @@ def sweep_main(n: int = 1_000_000, chunk: int = 32_768):
     log(f"sweep: {elapsed:.2f}s for {n} objects x {nc} constraints "
         f"({violations} constraint violations, {kept} kept) "
         f"-> {n / elapsed:,.0f} reviews/s; peak RSS {rss_gb:.1f}GB")
+    log(f"phases: {phases}")
     out = {
         "metric": "1M-object library audit sweep",
         "platform": jax.devices()[0].platform,
@@ -211,9 +254,11 @@ def sweep_main(n: int = 1_000_000, chunk: int = 32_768):
         "violations": violations,
         "kept_rendered": kept,
         "generation_s": round(gen_s, 2),
-        "inventory_sync_s": round(inv_s, 2),
         "peak_rss_gb": round(rss_gb, 2),
         "chunk_size": chunk,
+        "submit_window": submit_window,
+        "streaming": "disk JSONL spill; O(chunk) host memory",
+        "phase_s": phases,
         "target": "<10s on v5e-4 (x4 chips: data-parallel chunks shard "
                   "across ICI; single-chip time / 4 is the honest "
                   "extrapolation only for the device phase — host flatten "
@@ -224,6 +269,10 @@ def sweep_main(n: int = 1_000_000, chunk: int = 32_768):
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "SWEEP1M.json"), "w") as f:
         f.write(_json.dumps(out) + "\n")
+    try:
+        os.unlink(spill)
+    except OSError:
+        pass
     print(_json.dumps(out))
 
 
@@ -281,14 +330,30 @@ def main():
     # varies ±15% minute-to-minute (BENCH_TPU.json note), so a single
     # sample can land in a dip; the faster pass is the steady-state
     # measurement (both are logged)
+    # methodology (ADVICE r3): two passes, BEST reported as the headline
+    # (the tunneled link's throughput varies ±15% minute-to-minute); both
+    # pass times and the median go into the JSON artifact so rounds stay
+    # comparable
     log("timed audit sweep (best of 2 passes)...")
     elapsed = None
+    pass_times = []
+    phases = {}
     for p in range(2):
+        evaluator.perf_reset()
+        mgr.perf = {}
         t0 = time.perf_counter()
         run = mgr.audit()
         dt = time.perf_counter() - t0
         log(f"  pass {p + 1}: {dt:.3f}s")
-        elapsed = dt if elapsed is None else min(elapsed, dt)
+        pass_times.append(round(dt, 3))
+        if elapsed is None or dt < elapsed:
+            elapsed = dt
+            phases = {k: round(v, 3) for k, v in evaluator.perf.items()}
+            phases.update(
+                {k: round(v, 3) for k, v in mgr.perf.items()})
+            phases["wire_mb"] = round(
+                phases.pop("wire_bytes", 0.0) / 1e6, 1)
+    log(f"  phase breakdown (best pass): {phases}")
     violations = sum(run.total_violations.values())
     total_kept = sum(len(v) for v in run.kept.values())
     reviews_per_s = n / elapsed
@@ -308,6 +373,9 @@ def main():
         "vs_baseline": round(reviews_per_s / 100_000, 4),
         "platform": jax.devices()[0].platform,
         "legacy_3template_reviews_per_s": round(legacy_rate, 1),
+        "pass_times_s": pass_times,
+        "methodology": "best of 2 passes (both listed); phases from best",
+        "phase_s": phases,
     }
     if cpu_fallback:
         # metric name stays stable for consumers; the flag marks the result

@@ -102,6 +102,9 @@ class AuditManager:
         self.event_sink = event_sink
         self.log_violations = log_violations
         self._stop = threading.Event()
+        # per-phase seconds for the host-side fold/render of device sweeps
+        # (the evaluator tracks its own flatten/masks/wire/dispatch/collect)
+        self.perf: dict = {}
 
     # --- loop (reference: auditManagerLoop, manager.go:831) -------------
     def run_forever(self):
@@ -140,24 +143,66 @@ class AuditManager:
         # while the host keeps flattening).  The deep window front-loads
         # every host->device upload before the process's first
         # device->host fetch — see AuditConfig.submit_window.
+        #
+        # kind-bucketed routing (device path): objects stream into
+        # per-kind-group chunks (parallel/sharded.make_kind_router — the
+        # match-kinds prefilter of manager.go:427-483 applied per
+        # template), so a Service chunk never flattens/ships/evaluates
+        # container columns, and objects no template can match skip the
+        # device entirely.
         from collections import deque
 
-        window: deque = deque()  # (submitted, objects)
-        chunk: list[dict] = []
-        for obj in self.lister():
-            if kind_filter is not None:
-                _, _, k = gvk_of(obj)
-                if k not in kind_filter:
+        window: deque = deque()  # (submitted, objects, constraint subset)
+        use_router = (
+            self.evaluator is not None
+            and getattr(self.evaluator, "renders", False) is False
+            and next((d for d in self.client.drivers
+                      if hasattr(d, "query_batch")), None) is not None
+        )
+        if use_router:
+            from gatekeeper_tpu.parallel.sharded import make_kind_router
+            from gatekeeper_tpu.utils.rawjson import peek_kind
+
+            router = make_kind_router(constraints)
+            bufs: dict = {}  # group -> pending chunk
+            for obj in self.lister():
+                k = peek_kind(obj)
+                if kind_filter is not None and k not in kind_filter:
                     continue
-            chunk.append(obj)
-            run.total_objects += 1
-            if len(chunk) >= self.config.chunk_size:
+                run.total_objects += 1
+                g = router(k)
+                if not g:
+                    continue  # no template's match reaches this kind
+                buf = bufs.setdefault(g, [])
+                buf.append(obj)
+                if len(buf) >= self.config.chunk_size:
+                    self._pipeline_step(
+                        window, buf,
+                        [c for c in constraints if c.kind in g],
+                        kept, totals, limit)
+                    bufs[g] = []
+            for g, buf in bufs.items():
+                if buf:
+                    self._pipeline_step(
+                        window, buf,
+                        [c for c in constraints if c.kind in g],
+                        kept, totals, limit)
+        else:
+            chunk: list[dict] = []
+            for obj in self.lister():
+                if kind_filter is not None:
+                    _, _, k = gvk_of(obj)
+                    if k not in kind_filter:
+                        continue
+                chunk.append(obj)
+                run.total_objects += 1
+                if len(chunk) >= self.config.chunk_size:
+                    self._pipeline_step(window, chunk, constraints, kept,
+                                        totals, limit)
+                    chunk = []
+            if chunk:
                 self._pipeline_step(window, chunk, constraints, kept,
                                     totals, limit)
-                chunk = []
-        if chunk:
-            self._pipeline_step(window, chunk, constraints, kept, totals,
-                                limit)
         while window:
             self._pipeline_step(window, None, constraints, kept, totals,
                                 limit)
@@ -207,13 +252,18 @@ class AuditManager:
                     constraints, next_chunk,
                     return_bits=self.config.exact_totals),
                 next_chunk,
+                constraints,  # the chunk's (possibly routed) subset
             ))
         if window and (next_chunk is None
                        or len(window) > max(1, self.config.submit_window)):
             pending = window.popleft()
             swept = self.evaluator.sweep_collect(pending[0])
-            self._process_swept(swept, pending[1], constraints, kept, totals,
+            t0 = time.perf_counter()
+            self._process_swept(swept, pending[1], pending[2], kept, totals,
                                 limit)
+            self.perf["fold_render"] = (
+                self.perf.get("fold_render", 0.0)
+                + time.perf_counter() - t0)
 
     def _audit_chunk(self, objects, constraints, kept, totals, limit):
         """No-evaluator path: every constraint goes through its template's

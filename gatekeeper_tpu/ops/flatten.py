@@ -179,6 +179,10 @@ class Schema:
     map_keys: list = field(default_factory=list)
     parent_idx: list = field(default_factory=list)
     canons: list = field(default_factory=list)
+    # axes whose COUNTS must materialize even when no column rides them —
+    # prefix-deduped axes (dedup_schema) still gate reductions by their
+    # own count
+    extra_axes: list = field(default_factory=list)
 
     def merge(self, other: "Schema") -> None:
         for s in other.scalars:
@@ -202,6 +206,9 @@ class Schema:
         for cc in getattr(other, "canons", []):
             if cc not in self.canons:
                 self.canons.append(cc)
+        for ax in getattr(other, "extra_axes", []):
+            if ax not in self.extra_axes:
+                self.extra_axes.append(ax)
 
     def axes(self) -> list:
         out = []
@@ -218,7 +225,116 @@ class Schema:
             for a in (pi.axis, pi.parent):
                 if a not in out:
                     out.append(a)
+        for a in getattr(self, "extra_axes", []):
+            if a not in out:
+                out.append(a)
         return out
+
+
+def _is_seg_prefix(a: Axis, b: Axis) -> bool:
+    return (len(a.segments) < len(b.segments)
+            and b.segments[: len(a.segments)] == a.segments)
+
+
+def _pi_aligned(child: Axis, parent: Axis) -> bool:
+    """The parent-ordinal walk (_axis_items_with_parent) pairs child and
+    parent segments one-for-one, each child segment extending its parent
+    segment by exactly one subpath part."""
+    if len(child.segments) != len(parent.segments):
+        return False
+    for cseg, pseg in zip(child.segments, parent.segments):
+        if len(cseg) != len(pseg) + 1 or cseg[: len(pseg)] != pseg:
+            return False
+    return True
+
+
+def dedup_schema(schema: Schema) -> tuple:
+    """(exec_schema, alias) — axis-union prefix dedup.
+
+    Union axes enumerate items segment-by-segment (``_axis_items``), so an
+    axis that is a strict segment-prefix of another axis yields exactly the
+    FIRST count-of-prefix items of the wider axis's enumeration.  Every
+    ragged-family column on a prefix axis can therefore read the wider
+    axis's arrays under its own count gate — e.g. ``containers``,
+    ``containers|initContainers`` and the all-three union each requested
+    separate image/name/... columns (3x extraction + transfer of the same
+    values); after dedup only the widest union extracts/ships, and narrow
+    specs alias to it (``alias``: orig spec -> exec spec).  Deduped axes
+    keep materializing their own counts via ``Schema.extra_axes``.
+
+    ParentIdx carve-out: a child axis whose widest extension does not pair
+    segment-for-segment with its parent's widest extension is excluded
+    from remapping (its pair-ordinal values would not transfer)."""
+    col_axes: list = []
+    for r in schema.raggeds:
+        if r.axis not in col_axes:
+            col_axes.append(r.axis)
+    for rk in schema.ragged_keysets:
+        if rk.axis not in col_axes:
+            col_axes.append(rk.axis)
+    for mk in schema.map_keys:
+        if mk.axis not in col_axes:
+            col_axes.append(mk.axis)
+    for pi in schema.parent_idx:
+        if pi.axis not in col_axes:
+            col_axes.append(pi.axis)
+    all_axes = schema.axes()
+    widest: dict = {}
+    for a in col_axes:
+        cands = [b for b in all_axes if _is_seg_prefix(a, b)]
+        if cands:
+            widest[a] = max(cands,
+                            key=lambda b: (len(b.segments), b.key()))
+    # ParentIdx alignment: drop child axes whose remap breaks pairing.
+    # Iterated to a fixed point — popping one axis can invalidate a pair
+    # validated earlier against its widened form (chained parent_idx
+    # specs [(A,P),(P,Q)]: popping P must re-check A's pair against the
+    # UNwidened P).
+    changed = True
+    while changed:
+        changed = False
+        for pi in schema.parent_idx:
+            nc = widest.get(pi.axis, pi.axis)
+            np_ = widest.get(pi.parent, pi.parent)
+            if pi.axis in widest and not _pi_aligned(nc, np_):
+                widest.pop(pi.axis, None)
+                changed = True
+    if not widest:
+        return schema, {}
+    exec_s = Schema()
+    exec_s.scalars = list(schema.scalars)
+    exec_s.keysets = list(schema.keysets)
+    exec_s.canons = list(getattr(schema, "canons", []))
+    exec_s.extra_axes = list(getattr(schema, "extra_axes", []))
+    alias: dict = {}
+
+    def put(lst, orig, new):
+        if new not in lst:
+            lst.append(new)
+        if new != orig:
+            alias[orig] = new
+            if orig.axis not in exec_s.extra_axes:
+                exec_s.extra_axes.append(orig.axis)
+
+    for r in schema.raggeds:
+        put(exec_s.raggeds, r,
+            RaggedCol(widest.get(r.axis, r.axis), r.subpath)
+            if r.axis in widest else r)
+    for rk in schema.ragged_keysets:
+        put(exec_s.ragged_keysets, rk,
+            RaggedKeySetCol(widest.get(rk.axis, rk.axis), rk.subpath)
+            if rk.axis in widest else rk)
+    for mk in schema.map_keys:
+        put(exec_s.map_keys, mk,
+            MapKeyCol(widest[mk.axis]) if mk.axis in widest else mk)
+    for pi in schema.parent_idx:
+        if pi.axis in widest or pi.parent in widest:
+            put(exec_s.parent_idx, pi,
+                ParentIdxCol(widest.get(pi.axis, pi.axis),
+                             widest.get(pi.parent, pi.parent)))
+        else:
+            put(exec_s.parent_idx, pi, pi)
+    return exec_s, alias
 
 
 # --- flattened batch ------------------------------------------------------
@@ -396,10 +512,113 @@ def round_up(n: int, bucket: int = 8) -> int:
 
 class Flattener:
     def __init__(self, schema: Schema, vocab: Optional[Vocab] = None,
-                 use_native: bool = True):
-        self.schema = schema
+                 use_native: bool = True, bucket: int = 8,
+                 width_targets: Optional[dict] = None):
+        # prefix-axis dedup: extraction runs over the exec schema; the
+        # requested (orig) specs are aliased onto the exec columns after
+        # flatten (same numpy arrays — identity the wire packer dedups on)
+        self.orig_schema = schema
+        self.schema, self.alias = dedup_schema(schema)
         self.vocab = vocab or Vocab()
         self.use_native = use_native
+        # ragged pad bucket: 8 for ad-hoc batches (webhook lanes); sweep
+        # callers pass 2 + corpus-stable width_targets so padding tracks
+        # the corpus max instead of 8-wide minimums (wire + flatten cut)
+        self.bucket = bucket
+        # width_targets: {("ax", axis_key): M, ("rks_l", key): L,
+        #  ("ks_l", key): L} corpus maxes from the warm pass; arrays pad UP
+        # to round_up(target, bucket) so every chunk shares one jit layout
+        # (a chunk exceeding a target keeps its wider shape: one retrace,
+        # never wrong results)
+        self.width_targets = width_targets
+
+    def _apply_alias(self, batch: ColumnBatch) -> ColumnBatch:
+        for orig, new in self.alias.items():
+            if isinstance(orig, RaggedCol) and new in batch.raggeds:
+                batch.raggeds[orig] = batch.raggeds[new]
+            elif isinstance(orig, RaggedKeySetCol) \
+                    and new in batch.ragged_keysets:
+                batch.ragged_keysets[orig] = batch.ragged_keysets[new]
+            elif isinstance(orig, MapKeyCol) and new in batch.map_keys:
+                batch.map_keys[orig] = batch.map_keys[new]
+            elif isinstance(orig, ParentIdxCol) and new in batch.parent_idx:
+                batch.parent_idx[orig] = batch.parent_idx[new]
+        return batch
+
+    def _axis_target(self, axis: Axis) -> Optional[int]:
+        if self.width_targets is None:
+            return None
+        t = self.width_targets.get(("ax", axis.key()))
+        return None if t is None else round_up(t, self.bucket)
+
+    def _stabilize(self, batch: ColumnBatch) -> ColumnBatch:
+        """Pad ragged-family widths up to the corpus-stable targets."""
+        if self.width_targets is None:
+            return batch
+
+        def pad2(a, m, fill):
+            if a.shape[1] >= m:
+                return a
+            out = np.full((a.shape[0], m) + a.shape[2:], fill, a.dtype)
+            out[:, : a.shape[1]] = a
+            return out
+
+        for spec, col in batch.raggeds.items():
+            m = self._axis_target(spec.axis)
+            if m is not None and col.kind.shape[1] < m:
+                batch.raggeds[spec] = RaggedColumn(
+                    pad2(col.kind, m, 0), pad2(col.num, m, 0.0),
+                    pad2(col.sid, m, -1))
+        for spec, col in batch.map_keys.items():
+            m = self._axis_target(spec.axis)
+            if m is not None and col.sid.shape[1] < m:
+                batch.map_keys[spec] = MapKeyColumn(pad2(col.sid, m, -1))
+        for spec, col in batch.parent_idx.items():
+            m = self._axis_target(spec.axis)
+            if m is not None and col.idx.shape[1] < m:
+                batch.parent_idx[spec] = ParentIdxColumn(
+                    pad2(col.idx, m, -1))
+        for spec, col in batch.ragged_keysets.items():
+            m = self._axis_target(spec.axis)
+            lt = self.width_targets.get(("rks_l", spec))
+            l = None if lt is None else round_up(lt, self.bucket)
+            sid, cnt = col.sid, col.count
+            if l is not None and sid.shape[2] < l:
+                new = np.full(sid.shape[:2] + (l,), -1, sid.dtype)
+                new[:, :, : sid.shape[2]] = sid
+                sid = new
+            if m is not None and sid.shape[1] < m:
+                sid = pad2(sid, m, -1)
+                cnt = pad2(cnt[:, :, None], m, 0)[:, :, 0] \
+                    if cnt.ndim == 2 and cnt.shape[1] < m else cnt
+            if sid is not col.sid or cnt is not col.count:
+                if cnt.shape[1] < sid.shape[1]:
+                    nc = np.zeros(sid.shape[:2], cnt.dtype)
+                    nc[:, : cnt.shape[1]] = cnt
+                    cnt = nc
+                batch.ragged_keysets[spec] = RaggedKeySetColumn(sid, cnt)
+        for spec, col in batch.keysets.items():
+            lt = self.width_targets.get(("ks_l", spec))
+            l = None if lt is None else round_up(lt, self.bucket)
+            if l is not None and col.sid.shape[1] < l:
+                batch.keysets[spec] = KeySetColumn(
+                    pad2(col.sid, l, -1), col.count)
+        return batch
+
+    def record_widths(self, batch: ColumnBatch, targets: dict) -> None:
+        """Accumulate corpus width maxes from one (warm-pass) chunk into
+        ``targets`` — the dict later handed back as ``width_targets``."""
+        for axis, cnt in batch.axis_counts.items():
+            k = ("ax", axis.key())
+            targets[k] = max(targets.get(k, 1), int(cnt.max(initial=0)))
+        for spec, col in batch.ragged_keysets.items():
+            k = ("rks_l", spec)
+            targets[k] = max(targets.get(k, 1),
+                             int(col.count.max(initial=0)))
+        for spec, col in batch.keysets.items():
+            k = ("ks_l", spec)
+            targets[k] = max(targets.get(k, 1),
+                             int(col.count.max(initial=0)))
 
     def flatten(self, objects: Sequence[dict],
                 pad_n: Optional[int] = None,
@@ -446,7 +665,9 @@ class Flattener:
             schema.ragged_keysets = list(ragged_keysets)
             schema.map_keys = list(map_key_cols)
             schema.parent_idx = list(parent_idx_cols)
-        inner = Flattener(schema, self.vocab, self.use_native)
+            schema.extra_axes = list(getattr(self.schema, "extra_axes", []))
+        inner = Flattener(schema, self.vocab, self.use_native,
+                          bucket=self.bucket)
         mod = None
         if inner.use_native:
             from gatekeeper_tpu.ops import native
@@ -466,7 +687,8 @@ class Flattener:
             if mk in batch.map_keys:
                 continue  # the native flattener already extracted it
             n = batch.n
-            m = round_up(int(batch.axis_counts[mk.axis].max(initial=0)))
+            m = round_up(int(batch.axis_counts[mk.axis].max(initial=0)),
+                         self.bucket)
             sid = np.full((n, m), -1, np.int32)
             for i, obj in enumerate(objects):
                 for j, (key, _item) in enumerate(
@@ -479,28 +701,31 @@ class Flattener:
                 (parent_idx_cols or ragged_keysets):
             p_specs = [
                 (pic.axis.segments, pic.parent.segments,
-                 round_up(int(batch.axis_counts[pic.axis].max(initial=0))))
+                 round_up(int(batch.axis_counts[pic.axis].max(initial=0)),
+                          self.bucket))
                 for pic in parent_idx_cols
             ]
             rk_specs = [
                 (rk.axis.segments, tuple(rk.subpath),
-                 round_up(int(batch.axis_counts[rk.axis].max(initial=0))))
+                 round_up(int(batch.axis_counts[rk.axis].max(initial=0)),
+                          self.bucket))
                 for rk in ragged_keysets
             ]
             extras = mod.extract_extras(
                 list(objects), p_specs, rk_specs,
                 self.vocab._to_id, self.vocab._to_str,
-                batch.n, 8,
+                batch.n, self.bucket,
             )
             for pic, idx in zip(parent_idx_cols, extras["parent_idx"]):
                 batch.parent_idx[pic] = ParentIdxColumn(idx)
             for rk, (sid, count) in zip(ragged_keysets,
                                         extras["ragged_keysets"]):
                 batch.ragged_keysets[rk] = RaggedKeySetColumn(sid, count)
-            return batch
+            return self._apply_alias(self._stabilize(batch))
         for pic in parent_idx_cols:
             n = batch.n
-            m = round_up(int(batch.axis_counts[pic.axis].max(initial=0)))
+            m = round_up(int(batch.axis_counts[pic.axis].max(initial=0)),
+                         self.bucket)
             idx = np.full((n, m), -1, np.int32)
             for i, obj in enumerate(objects):
                 pairs = _axis_items_with_parent(obj, pic.axis, pic.parent)
@@ -509,7 +734,8 @@ class Flattener:
             batch.parent_idx[pic] = ParentIdxColumn(idx)
         for rk in ragged_keysets:
             n = batch.n
-            m = round_up(int(batch.axis_counts[rk.axis].max(initial=0)))
+            m = round_up(int(batch.axis_counts[rk.axis].max(initial=0)),
+                         self.bucket)
             per_obj = [_axis_items(o, rk.axis) for o in objects]
             key_lists = []
             maxl = 0
@@ -525,7 +751,7 @@ class Flattener:
                     row.append(keys)
                     maxl = max(maxl, len(keys))
                 key_lists.append(row)
-            l = round_up(maxl)
+            l = round_up(maxl, self.bucket)
             sid = np.full((n, m, l), -1, np.int32)
             count = np.zeros((n, m), np.int32)
             for i, row in enumerate(key_lists):
@@ -534,7 +760,7 @@ class Flattener:
                     for q, k in enumerate(keys):
                         sid[i, j, q] = self.vocab.intern(k)
             batch.ragged_keysets[rk] = RaggedKeySetColumn(sid, count)
-        return batch
+        return self._apply_alias(self._stabilize(batch))
 
     def flatten_raw(self, raws: Sequence,
                     pad_n: Optional[int] = None,
@@ -584,7 +810,7 @@ class Flattener:
             self.vocab._to_id,
             self.vocab._to_str,
             int(pad_n or len(items)),
-            8,  # ragged bucket, matches round_up()
+            self.bucket,  # ragged bucket, matches round_up()
             nthreads,
         )
         n = max(pad_n or 0, len(items))
@@ -615,7 +841,7 @@ class Flattener:
                  if c.path[:1] == ("__review__",)],
                 reviews)
         self._fill_canons(batch, raws)
-        return batch
+        return self._apply_alias(self._stabilize(batch))
 
     def _fill_canons(self, batch: ColumnBatch, objects) -> None:
         """Canonical-selector sid columns (CanonCol) — computed host-side
@@ -719,7 +945,7 @@ class Flattener:
             self.vocab._to_id,
             self.vocab._to_str,
             int(pad_n or len(objects)),
-            8,  # ragged bucket, matches round_up()
+            self.bucket,  # ragged bucket, matches round_up()
         )
         n = max(pad_n or 0, len(objects))
         batch = ColumnBatch(n=n, scalars={}, raggeds={}, axis_counts={},
@@ -787,7 +1013,8 @@ class Flattener:
 
         for spec in self.schema.raggeds:
             per_obj = axis_items[spec.axis]
-            m = round_up(max((len(x) for x in per_obj), default=0))
+            m = round_up(max((len(x) for x in per_obj), default=0),
+                         self.bucket)
             kind = np.zeros((n, m), np.int8)
             num = np.zeros((n, m), np.float32)
             sid = np.full((n, m), -1, np.int32)
@@ -810,7 +1037,8 @@ class Flattener:
                         if ok and isinstance(val, dict) else [])
                 per_obj_keys.append(keys)
             per_obj_keys += [[] for _ in range(n - n_real)]
-            l = round_up(max((len(k) for k in per_obj_keys), default=0))
+            l = round_up(max((len(k) for k in per_obj_keys), default=0),
+                         self.bucket)
             sid = np.full((n, l), -1, np.int32)
             count = np.zeros(n, np.int32)
             for i, keys in enumerate(per_obj_keys):

@@ -21,6 +21,7 @@ LimitQueue merge at pkg/audit/manager.go:886-945).
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -28,9 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gatekeeper_tpu.ir.program import (build_param_table, needed_fields,
-                                        pack_batch_cols, slim_cols,
-                                        vocab_tables)
+from gatekeeper_tpu.ir.program import (build_param_table, col_key,
+                                        needed_fields, pack_batch_cols,
+                                        slim_cols, vocab_tables)
 from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab
 
 
@@ -103,6 +104,37 @@ def col_stats_update(stats: dict, cols: dict) -> None:
                     intf and (len(prev) < 5 or prev[4]))
 
 
+_PAD_BY_SUB = {"kind": 0, "num": 0.0, "sid": -1, "idx": -1, "count": 0}
+
+
+def merge_pad_stats(stats: dict) -> None:
+    """Fold the ragged-family PAD values into corpus column stats.
+
+    The warm-pass scan flattens chunks at their own (narrow) widths; the
+    timed run pads every chunk up to the corpus-stable width targets,
+    which can introduce pad values a scanned chunk never contained.
+    Merging the pad value unconditionally keeps the stats a superset of
+    every stabilized chunk's value set, so the narrowed/elided wire
+    layout stays identical across all timed chunks (a layout that
+    depended on a chunk's incidental lack of padding would retrace
+    mid-sweep)."""
+    for (key, sub), st in list(stats.items()):
+        if not key.startswith(("rg:", "rks:", "mk:", "pi:", "ks:")):
+            continue
+        pad = _PAD_BY_SUB.get(sub)
+        if pad is None:
+            continue
+        mn, mx, cv = st[0], st[1], st[2]
+        vals = st[3] if len(st) > 3 else None
+        intf = st[4] if len(st) > 4 else False
+        ncv = cv if cv == pad else None
+        if vals is not None:
+            vals = vals | {int(pad)} if not isinstance(pad, float) else vals
+            if len(vals) > _DICT_CAP:
+                vals = None
+        stats[(key, sub)] = (min(mn, pad), max(mx, pad), ncv, vals, intf)
+
+
 def _wire_dtype(dt: str, mn: float, mx: float) -> tuple:
     """(store_dtype_str, bias) for a column whose corpus range is
     [mn, mx].  Integer columns with mn >= -1 ride unsigned narrow types
@@ -162,12 +194,21 @@ def pack_transfer_cols(cols: dict, pad_n: int,
     parts: dict = {}
     widths: dict = {}
     layout: list = []
+    seen: dict = {}  # id(array) -> (key, sub): identity alias dedup
     for key in sorted(k for k in cols
                       if not k.startswith(("fn:", "st:", "inv:"))):
         val = cols[key]
         items = sorted(val.items()) if isinstance(val, dict) \
             else [(None, val)]
         for sub, a in items:
+            ref = seen.get(id(a))
+            if ref is not None:
+                # same numpy array under two keys (prefix-axis dedup,
+                # ops/flatten.dedup_schema): ship once, alias on device
+                layout.append((key, sub, "alias", 0, (), 0, a.dtype.str,
+                               ref))
+                continue
+            seen[id(a)] = (key, sub)
             a = np.ascontiguousarray(a)
             dt = a.dtype.str
             tail = a.shape[1:]
@@ -246,7 +287,11 @@ def unpack_transfer_cols(bufs: dict, layout: tuple, pad_n: int) -> dict:
     fused by XLA (no data movement beyond the transfers that brought the
     buffers)."""
     cols: dict = {}
+    aliases: list = []
     for key, sub, wdt, off, tail, w, dt, extra in layout:
+        if wdt == "alias":
+            aliases.append((key, sub, extra))
+            continue
         odt = jax.dtypes.canonicalize_dtype(np.dtype(dt))
         if wdt == "const":
             arr = jnp.full((pad_n,) + tail, extra, dtype=odt)
@@ -278,6 +323,12 @@ def unpack_transfer_cols(bufs: dict, layout: tuple, pad_n: int) -> dict:
             cols[key] = arr
         else:
             cols.setdefault(key, {})[sub] = arr
+    for key, sub, (rkey, rsub) in aliases:
+        src = cols[rkey] if rsub is None else cols[rkey][rsub]
+        if sub is None:
+            cols[key] = src
+        else:
+            cols.setdefault(key, {})[sub] = src
     return cols
 
 
@@ -387,6 +438,60 @@ def topk_violations(verdicts: jnp.ndarray, k: int) -> tuple:
     return top_idx, top_scores > 0
 
 
+def relevant_template_kinds(constraints) -> dict:
+    """template (constraint) kind -> frozenset of object kinds its
+    constraints' ``spec.match.kinds`` can match, or None for wildcard
+    (any entry with kinds ``*``/absent, or no kinds matcher at all).
+
+    This is the reference's --audit-match-kind-only prefilter semantics
+    (pkg/audit/manager.go:427-483) applied per template: a SUPERSET by
+    construction (apiGroups and the other 7 matchers still gate on
+    device), so routing by it never changes verdicts."""
+    rel: dict = {}
+    for con in constraints:
+        ks: set = set()
+        wild = False
+        entries = (con.match or {}).get("kinds") or []
+        if not entries:
+            wild = True
+        for e in entries:
+            kk = e.get("kinds") or []
+            if not kk or "*" in kk:
+                wild = True
+            ks.update(k for k in kk if k != "*")
+        prev = rel.get(con.kind)
+        if wild or prev is None and con.kind in rel:
+            rel[con.kind] = None
+        elif prev is None and con.kind not in rel:
+            rel[con.kind] = frozenset(ks)
+        elif prev is not None:
+            rel[con.kind] = prev | frozenset(ks)
+    return rel
+
+
+def make_kind_router(constraints):
+    """obj kind -> frozenset of template kinds that could match it — the
+    kind-bucketed sweep router.  Objects whose group is empty cannot
+    violate anything (no template's match reaches their kind): the audit
+    skips them entirely, and grouped chunks only flatten/ship/evaluate
+    the group's schemas (a Service chunk never pays for container
+    columns)."""
+    rel = relevant_template_kinds(constraints)
+    wild = frozenset(t for t, ks in rel.items() if ks is None)
+    cache: dict = {}
+
+    def group_of(obj_kind: str) -> frozenset:
+        g = cache.get(obj_kind)
+        if g is None:
+            g = wild | frozenset(
+                t for t, ks in rel.items()
+                if ks is not None and obj_kind in ks)
+            cache[obj_kind] = g
+        return g
+
+    return group_of
+
+
 class _PendingSweep:
     __slots__ = ("result", "kinds", "offsets", "by_kind", "n", "return_bits")
 
@@ -416,17 +521,46 @@ class ShardedEvaluator:
         # corpus-wide per-column (min, max, const) from warm_pass: drives
         # wire-dtype narrowing + constant elision in pack_transfer_cols
         self._col_stats: dict = {}
+        # corpus-stable ragged widths from warm_pass (ops/flatten
+        # width_targets): sweep chunks pad to the corpus max on a bucket-2
+        # grid instead of 8-wide minimums
+        self._width_targets: dict = {}
+        self._bucket = 2
+        # per-phase wall-clock totals (seconds), reset via perf_reset():
+        # flatten / masks / wire_pack / dispatch (device_put + jit call) /
+        # collect (device->host) — published by bench.py
+        self.perf: dict = {}
 
-    def _needs_union(self, kinds) -> dict:
+    def _perf_add(self, phase: str, dt: float) -> None:
+        self.perf[phase] = self.perf.get(phase, 0.0) + dt
+
+    def perf_reset(self) -> None:
+        self.perf = {}
+
+    def _flattener(self, schema: Schema) -> Flattener:
+        return Flattener(schema, self.driver.vocab, bucket=self._bucket,
+                         width_targets=self._width_targets or None)
+
+    def _needs_union(self, kinds, alias: Optional[dict] = None) -> dict:
         """Union of array fields any lowered program reads — the
         transfer-slimming key shared by warm_pass (col stats) and
         sweep_submit (packing); one definition so the stats keys always
-        match the packed columns."""
+        match the packed columns.  ``alias`` (orig spec -> exec spec from
+        the Flattener's prefix-axis dedup) extends each aliased key's
+        needs onto its exec column so slimming keeps exactly the fields
+        some consumer reads through either name."""
         needs: dict = {}
         for kind in sorted(kinds):
             for ck, fields in needed_fields(
                     self.driver._programs[kind].program).items():
                 needs.setdefault(ck, set()).update(fields)
+        if alias:
+            for orig, new in alias.items():
+                ok, nk = col_key(orig), col_key(new)
+                if ok in needs or nk in needs:
+                    u = needs.get(ok, set()) | needs.get(nk, set())
+                    needs[ok] = u
+                    needs[nk] = u
         return needs
 
     def _sweep_fn(self, kinds: tuple, k: int, return_bits: bool,
@@ -460,11 +594,13 @@ class ShardedEvaluator:
         else:
             use_pallas = False
 
-        def fused(tables_buf, cols_buf, table_cols: dict, mask):
+        def fused(tables_buf, cols_buf, table_cols: dict, mask_bits):
             cols = unpack_transfer_cols(cols_buf, cols_layout, pad_n)
             cols.update(table_cols)
             tables = unpack_flat_tables(tables_buf, tables_layout,
                                         len(kinds))
+            mask = jnp.unpackbits(mask_bits, axis=1,
+                                  count=pad_n).astype(jnp.bool_)
             grids = [b(t, cols) for b, t in zip(builders, tables)]
             grid = jnp.concatenate(grids, axis=0) & mask
             if use_pallas:
@@ -487,44 +623,111 @@ class ShardedEvaluator:
         self._sweep_fns[key] = fn
         return fn
 
-    def warm_pass(self, constraints: Sequence, objects: Sequence,
-                  chunk_size: int, return_bits: bool = False) -> None:
+    def warm_pass(self, constraints: Sequence, objects,
+                  chunk_size: int, return_bits: bool = False,
+                  route: bool = True) -> None:
         """Full warmup with ZERO device->host fetches: intern the whole
         corpus's vocabulary host-side (so no chunk of the real run
         crosses a vocab bucket and recompiles mid-sweep), then compile +
-        execute one sweep per distinct pad bucket via
+        execute one sweep per distinct (kind group, pad bucket) via
         :meth:`sweep_warm`.  The timed run that follows measures the
         steady state, and — because nothing here fetched — its uploads
-        still run at full (pre-first-fetch) tunnel bandwidth."""
-        by_kind: dict[str, list] = {}
-        for con in constraints:
-            by_kind.setdefault(con.kind, []).append(con)
-        lowered = [k for k in by_kind
-                   if k in self.driver._programs
-                   and self.driver.inventory_exact(k)]
-        if not lowered:
-            return
-        schema = Schema()
-        for kind in lowered:
-            schema.merge(self.driver._programs[kind].program.schema)
-        fl = Flattener(schema, self.driver.vocab)
-        needs = self._needs_union(lowered)
-        buckets: dict = {}
-        for i in range(0, len(objects), chunk_size):
-            ch = objects[i:i + chunk_size]
+        still run at full (pre-first-fetch) tunnel bandwidth.
+
+        ``objects`` may be any iterable (including a one-shot generator):
+        chunks are scanned AS THEY FILL and released, so a streaming 1M
+        corpus warms at O(chunk) memory; only one representative chunk
+        per (group, pad bucket) is retained for the compile sweeps.
+
+        ``route`` mirrors the audit manager's kind-bucketed routing
+        (make_kind_router): objects stream into per-group chunks so each
+        group warms its own (slimmer) schema/layout/sweep fn."""
+        from gatekeeper_tpu.utils.rawjson import peek_kind
+
+        # per-group compile state, built lazily on each group's first chunk
+        state: dict = {}  # g -> (cons_g, flattener, needs) or None
+        buckets: dict = {}  # (g, pad) -> (cons_g, representative chunk)
+
+        def group_state(g):
+            if g in state:
+                return state[g]
+            cons_g = [c for c in constraints if c.kind in g]
+            by_kind: dict[str, list] = {}
+            for con in cons_g:
+                by_kind.setdefault(con.kind, []).append(con)
+            lowered = [k for k in by_kind
+                       if k in self.driver._programs
+                       and self.driver.inventory_exact(k)]
+            if not lowered:
+                state[g] = None
+                return None
+            # register the group's param-table needles/strings BEFORE any
+            # compile: string-pred matrices are [T, V] with T = needles
+            # registered so far — a group compiled before a later group's
+            # build_param_table would bake a smaller T and recompile on
+            # the first timed pass
+            for kind in lowered:
+                build_param_table(
+                    self.driver._programs[kind].program,
+                    by_kind[kind], self.driver.vocab)
+            schema = Schema()
+            for kind in lowered:
+                schema.merge(self.driver._programs[kind].program.schema)
+            fl = Flattener(schema, self.driver.vocab,
+                           bucket=self._bucket)
+            st = (cons_g, fl, self._needs_union(lowered, fl.alias))
+            state[g] = st
+            return st
+
+        def scan_chunk(g, ch):
+            st = group_state(g)
+            if st is None:
+                return
+            cons_g, fl, needs = st
             # EVERY chunk interns (the compile below must see the final
             # vocab, or the timed run's first chunk crosses a vocab
-            # bucket and retraces mid-sweep) AND feeds the corpus column
-            # stats so every timed chunk packs with one stable
-            # narrowed/elided wire layout (layout is part of the jit key;
-            # per-chunk layouts would retrace the fused sweep mid-run)
+            # bucket and retraces mid-sweep), feeds the corpus column
+            # stats (stable narrowed/elided wire layout — layout is part
+            # of the jit key; per-chunk layouts would retrace the fused
+            # sweep mid-run) AND records corpus ragged-width maxes (the
+            # timed run pads every chunk to these targets)
             batch = fl.flatten(ch, pad_n=self._pad(len(ch)))
+            fl.record_widths(batch, self._width_targets)
             col_stats_update(
                 self._col_stats,
                 slim_cols(pack_batch_cols(batch), needs))
-            buckets.setdefault(self._pad(len(ch)), ch)
-        for ch in buckets.values():
-            self.sweep_warm(constraints, ch, return_bits)
+            buckets.setdefault((g, self._pad(len(ch))), (cons_g, ch))
+
+        if route:
+            router = make_kind_router(constraints)
+            bufs: dict = {}
+            for obj in objects:
+                g = router(peek_kind(obj))
+                if not g:
+                    continue
+                buf = bufs.setdefault(g, [])
+                buf.append(obj)
+                if len(buf) >= chunk_size:
+                    scan_chunk(g, buf)
+                    bufs[g] = []
+            for g, buf in bufs.items():
+                if buf:
+                    scan_chunk(g, buf)
+        else:
+            g_all = frozenset(c.kind for c in constraints)
+            buf = []
+            for obj in objects:
+                buf.append(obj)
+                if len(buf) >= chunk_size:
+                    scan_chunk(g_all, buf)
+                    buf = []
+            if buf:
+                scan_chunk(g_all, buf)
+        # the scan flattened at chunk-local widths; the timed run pads to
+        # the corpus targets — fold pad values in so the layout holds
+        merge_pad_stats(self._col_stats)
+        for cons_g, ch in buckets.values():
+            self.sweep_warm(cons_g, ch, return_bits)
 
     def sweep_warm(self, constraints: Sequence, objects: Sequence[dict],
                    return_bits: bool = False) -> None:
@@ -572,14 +775,16 @@ class ShardedEvaluator:
             schema.merge(self.driver._programs[kind].program.schema)
         n = len(objects)
         pad_n = self._pad(n)
-        batch = Flattener(schema, self.driver.vocab).flatten(objects, pad_n=pad_n)
+        t0 = time.perf_counter()
+        fl = self._flattener(schema)
+        batch = fl.flatten(objects, pad_n=pad_n)
+        self._perf_add("flatten", time.perf_counter() - t0)
 
         from gatekeeper_tpu.ir import masks as masks_mod
-        from gatekeeper_tpu.ir.program import col_key, axis_key
 
         cols = pack_batch_cols(batch)
         # transfer slimming: ship only the array fields some program reads
-        cols = slim_cols(cols, self._needs_union(lowered))
+        cols = slim_cols(cols, self._needs_union(lowered, fl.alias))
 
         if batch.has_generate_name is not None:
             # native JSON lane: presence came back as a column — avoids
@@ -595,6 +800,7 @@ class ShardedEvaluator:
         mask_rows = []
         offsets = {}
         c_off = 0
+        t0 = time.perf_counter()
         for kind in kinds:
             prog = self.driver._programs[kind]
             cons = by_kind[kind]
@@ -608,6 +814,7 @@ class ShardedEvaluator:
             ))
             offsets[kind] = (c_off, c_off + len(cons))
             c_off += len(cons)
+        self._perf_add("masks", time.perf_counter() - t0)
         table_cols: dict = {}
         for kind in kinds:
             for tk, tv in vocab_tables(
@@ -620,8 +827,14 @@ class ShardedEvaluator:
         # packed param tables (replicated, device-cached on content — the
         # constraint set rarely changes chunk-over-chunk), shared vocab/
         # inventory tables (device-cached on content), and the mask.
+        t0 = time.perf_counter()
         cols_bufs, cols_layout = pack_transfer_cols(
             cols, pad_n, stats=self._col_stats or None)
+        self._perf_add("wire_pack", time.perf_counter() - t0)
+        self._perf_add(
+            "wire_bytes",
+            sum(b.nbytes for b in cols_bufs.values()) + c_off * pad_n // 8)
+        t0 = time.perf_counter()
         cols_bufs_dev = {
             dt: jax.device_put(b, NamedSharding(self.mesh,
                                                 P("data", None)))
@@ -630,16 +843,23 @@ class ShardedEvaluator:
         pkey = (tables_layout,
                 tuple(sorted((dt, b.tobytes())
                              for dt, b in tables_bufs.items())))
-        tables_bufs_dev = self._param_dev_cache.get(pkey)
+        tables_bufs_dev = self._param_dev_cache.pop(pkey, None)
         if tables_bufs_dev is None:
-            self._param_dev_cache.clear()  # constraint set changed
             tables_bufs_dev = {
                 dt: jax.device_put(b, NamedSharding(self.mesh, P(None)))
                 for dt, b in tables_bufs.items()}
-            self._param_dev_cache[pkey] = tables_bufs_dev
+        # bounded LRU (re-insert = recent): kind-bucketed sweeps cycle one
+        # entry per group; a clear-on-miss would evict every other group
+        # on each rotation
+        self._param_dev_cache[pkey] = tables_bufs_dev
+        while len(self._param_dev_cache) > 32:
+            self._param_dev_cache.pop(next(iter(self._param_dev_cache)))
         table_cols_dev = shard_batch_arrays(table_cols, self.mesh,
                                             self._table_dev_cache)
-        mask = np.concatenate(mask_rows, axis=0)
+        # bit-packed match mask: [C, pad_n/8] uint8 on the wire (8x fewer
+        # bytes than bool [C, N]); unpacked to bool inside the jitted
+        # sweep where the expansion fuses into the grid AND
+        mask = np.packbits(np.concatenate(mask_rows, axis=0), axis=1)
         mask_dev = jax.device_put(
             mask, NamedSharding(self.mesh, P(None, "data"))
         )
@@ -647,6 +867,7 @@ class ShardedEvaluator:
                                 tables_layout, pad_n)(
             tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev
         )
+        self._perf_add("dispatch", time.perf_counter() - t0)
         return _PendingSweep(result, kinds, offsets, by_kind, n, return_bits)
 
     def sweep_collect(self, pending):
@@ -656,6 +877,7 @@ class ShardedEvaluator:
             return {}
         if isinstance(pending, dict):  # empty submit
             return pending
+        t0 = time.perf_counter()
         if pending.return_bits:
             packed_np = np.asarray(pending.result[0])
             bits_np = np.asarray(pending.result[1])
@@ -676,6 +898,7 @@ class ShardedEvaluator:
             kb = bits_np[lo:hi] if bits_np is not None else None
             out[kind] = (pending.by_kind[kind], idx_np, valid_np, counts_np,
                          kb)
+        self._perf_add("collect", time.perf_counter() - t0)
         return out
 
     def _pad(self, n: int) -> int:

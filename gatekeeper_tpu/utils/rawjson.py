@@ -142,6 +142,71 @@ def _restore_loaded(raw: bytes, state: dict) -> "RawJSON":
     return r
 
 
+import re as _re
+
+# head fast path: K8s serializations open with apiVersion/kind (in either
+# order) — one anchored match on the first bytes resolves the top-level
+# kind with no depth scan at all
+_HEAD_KIND = _re.compile(
+    rb'^\{"(?:apiVersion":"[^"\\]*",")?kind":"([^"\\]*)"')
+_KIND_VAL = _re.compile(rb'\s*:\s*"([^"\\]*)"')
+
+
+def peek_kind(obj) -> str:
+    """Top-level ``kind`` of a K8s object WITHOUT materializing a RawJSON.
+
+    The audit kind router classifies every listed object; going through
+    ``obj.get("kind")`` would parse all N objects and push every chunk of
+    the sweep onto the re-serialization path (a full json.dumps per
+    object per chunk).  For an unloaded RawJSON this scans the raw bytes:
+    find a ``"kind"`` key occurrence, verify by prefix scan that it sits
+    at object depth 1 outside any string, then read its string value —
+    K8s serializations carry kind in the first bytes, so the verify scan
+    is ~a dozen bytes.  Falls back to the parse when the scan is
+    inconclusive (escaped value, non-string kind)."""
+    if not isinstance(obj, RawJSON) or obj._loaded:
+        v = obj.get("kind")
+        return v if isinstance(v, str) else ""
+    raw = obj.raw
+    m = _HEAD_KIND.match(raw)
+    if m:
+        try:
+            return m.group(1).decode("utf-8")
+        except UnicodeDecodeError:
+            pass
+    pos = 0
+    while True:
+        pos = raw.find(b'"kind"', pos)
+        if pos < 0:
+            return ""  # no "kind" bytes at all: the key cannot exist
+        depth = 0
+        instr = False
+        esc = False
+        for b in memoryview(raw)[:pos]:
+            if esc:
+                esc = False
+            elif b == 0x5C:  # backslash
+                esc = True
+            elif b == 0x22:  # quote
+                instr = not instr
+            elif not instr:
+                if b == 0x7B or b == 0x5B:  # { [
+                    depth += 1
+                elif b == 0x7D or b == 0x5D:  # } ]
+                    depth -= 1
+        if depth == 1 and not instr:
+            m = _KIND_VAL.match(raw, pos + 6)
+            if m:
+                try:
+                    return m.group(1).decode("utf-8")
+                except UnicodeDecodeError:
+                    break  # fall through to the exact parse
+            break  # escaped or non-string value: exact parse
+        pos += 6
+    v = obj.get("kind")  # exact fallback (materializes this one object)
+    return v if isinstance(v, str) else ""
+
+
 def as_raw(obj) -> "RawJSON":
     """Wrap a dict (serializing once) or bytes into a RawJSON."""
     if isinstance(obj, RawJSON):

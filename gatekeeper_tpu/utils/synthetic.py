@@ -220,27 +220,33 @@ def _binding(rng: random.Random, i: int, ns: str) -> dict:
     return obj
 
 
-def make_cluster_objects(n: int, seed: int = 0) -> list[dict]:
-    """``n`` objects: ~70% Pods, 8% Services, 8% Ingresses, 5%
-    Deployments, 5% Namespaces, 4% RBAC bindings."""
+def iter_cluster_objects(n: int, seed: int = 0):
+    """Streaming generator behind :func:`make_cluster_objects` — the
+    O(chunk)-memory audit path consumes objects one at a time instead of
+    materializing a 1M-object list (reference analog: paged List +
+    disk spill, pkg/audit/manager.go:502-561)."""
     rng = random.Random(seed)
-    out = []
     for i in range(n):
         ns = f"ns-{rng.randrange(40)}"
         r = rng.random()
         if r < 0.70:
-            out.append(_pod(rng, i, ns))
+            yield _pod(rng, i, ns)
         elif r < 0.78:
-            out.append(_service(rng, i, ns))
+            yield _service(rng, i, ns)
         elif r < 0.86:
-            out.append(_ingress(rng, i, ns))
+            yield _ingress(rng, i, ns)
         elif r < 0.91:
-            out.append(_deployment(rng, i, ns))
+            yield _deployment(rng, i, ns)
         elif r < 0.96:
-            out.append(_namespace(rng, i))
+            yield _namespace(rng, i)
         else:
-            out.append(_binding(rng, i, ns))
-    return out
+            yield _binding(rng, i, ns)
+
+
+def make_cluster_objects(n: int, seed: int = 0) -> list[dict]:
+    """``n`` objects: ~70% Pods, 8% Services, 8% Ingresses, 5%
+    Deployments, 5% Namespaces, 4% RBAC bindings."""
+    return list(iter_cluster_objects(n, seed))
 
 
 def library_dir() -> str:

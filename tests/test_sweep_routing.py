@@ -1,0 +1,182 @@
+"""Round-4 sweep-path pins: prefix-axis dedup, kind-bucketed routing,
+bit-packed masks, width stabilization, and peek_kind.
+
+The load-bearing invariant for all of it: the routed, deduped, narrowed
+sweep must produce BIT-IDENTICAL verdicts/totals/kept to the exact
+interpreter and to the unrouted device path.
+"""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.ops.flatten import (Axis, Flattener, RaggedCol, Schema,
+                                        dedup_schema)
+from gatekeeper_tpu.utils.rawjson import RawJSON, as_raw, peek_kind
+
+
+def test_dedup_schema_prefix_chain():
+    a1 = Axis(((("spec", "containers"),),))
+    a2 = Axis(((("spec", "containers"),), (("spec", "initContainers"),)))
+    a3 = Axis(((("spec", "containers"),), (("spec", "initContainers"),),
+               (("spec", "ephemeralContainers"),)))
+    s = Schema()
+    s.raggeds = [RaggedCol(a1, ("image",)), RaggedCol(a2, ("image",)),
+                 RaggedCol(a3, ("image",)), RaggedCol(a2, ("name",))]
+    exec_s, alias = dedup_schema(s)
+    # every ragged collapses onto the widest axis
+    assert all(r.axis == a3 for r in exec_s.raggeds)
+    assert len(exec_s.raggeds) == 2  # image + name, once each
+    assert alias[RaggedCol(a1, ("image",))] == RaggedCol(a3, ("image",))
+    assert alias[RaggedCol(a2, ("name",))] == RaggedCol(a3, ("name",))
+    # deduped axes keep their counts via extra_axes
+    assert a1 in exec_s.extra_axes and a2 in exec_s.extra_axes
+
+
+def test_dedup_flatten_aliases_same_arrays():
+    a1 = Axis(((("spec", "containers"),),))
+    a3 = Axis(((("spec", "containers"),), (("spec", "initContainers"),)))
+    s = Schema()
+    s.raggeds = [RaggedCol(a1, ("image",)), RaggedCol(a3, ("image",))]
+    fl = Flattener(s, use_native=False)
+    objs = [
+        {"kind": "Pod",
+         "spec": {"containers": [{"image": "a"}, {"image": "b"}],
+                  "initContainers": [{"image": "c"}]}},
+        {"kind": "Pod", "spec": {"containers": [{"image": "d"}]}},
+    ]
+    batch = fl.flatten(objs, pad_n=2)
+    narrow = batch.raggeds[RaggedCol(a1, ("image",))]
+    wide = batch.raggeds[RaggedCol(a3, ("image",))]
+    assert narrow.sid is wide.sid  # identity alias: zero extra extraction
+    # prefix property: the narrow axis's items are the first c1 of the
+    # wide enumeration, gated by the narrow count
+    c1 = batch.axis_counts[a1]
+    assert list(c1[:2]) == [2, 1]
+    v = fl.vocab
+    assert v.string(int(wide.sid[0, 0])) == "a"
+    assert v.string(int(wide.sid[0, 1])) == "b"
+    assert v.string(int(wide.sid[0, 2])) == "c"  # beyond narrow count
+
+
+def test_peek_kind_no_materialization():
+    r = as_raw({"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "x"}})
+    assert peek_kind(r) == "Pod"
+    assert not r._loaded  # the whole point
+    # nested kind before top-level, odd orders, strings containing "kind"
+    cases = [
+        ({"metadata": {"ownerReferences": [{"kind": "RS"}]},
+          "kind": "Pod"}, "Pod"),
+        ({"msg": 'x "kind" y', "kind": "Odd"}, "Odd"),
+        ({"kind": 5}, ""),
+        ({}, ""),
+        ({"kind": "Service", "apiVersion": "v1"}, "Service"),
+    ]
+    for obj, want in cases:
+        assert peek_kind(as_raw(obj)) == want, obj
+    # loaded instances answer from dict state
+    r2 = as_raw({"kind": "Pod"})
+    r2["kind"] = "Mutated"
+    assert peek_kind(r2) == "Mutated"
+
+
+@pytest.fixture(scope="module")
+def library_client():
+    from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.utils.synthetic import load_library
+
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+    load_library(client)
+    return client, tpu
+
+
+def test_routed_audit_matches_unrouted(library_client):
+    """Kind-bucketed routing must be invisible: EXACT totals equality vs
+    the unrouted device sweep (both count violating objects), and
+    per-violating-object agreement vs the pure interpreter."""
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+    from gatekeeper_tpu.parallel.sharded import (ShardedEvaluator,
+                                                 make_mesh)
+    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
+
+    client, tpu = library_client
+    objects = make_cluster_objects(512, seed=11)
+    for o in objects:
+        if o.get("kind") == "Ingress":
+            client.add_data(o)
+
+    def run_with(evaluator, raws):
+        cfg = AuditConfig(violations_limit=5, chunk_size=128,
+                          exact_totals=False)
+        mgr = AuditManager(client, lister=lambda: iter(raws), config=cfg,
+                           evaluator=evaluator)
+        return mgr.audit()
+
+    raws = [as_raw(o) for o in objects]
+    ev = ShardedEvaluator(tpu, make_mesh(1), violations_limit=5)
+    ev.warm_pass(client.constraints(), raws, 128)
+    routed = run_with(ev, raws)
+
+    # unrouted device sweep over the same corpus: one evaluator, full
+    # constraint set per chunk — totals must match the routed run EXACTLY
+    # (same violating-object counting on both lanes)
+    ev2 = ShardedEvaluator(tpu, make_mesh(1), violations_limit=5)
+    ev2.warm_pass(client.constraints(), raws, 128, route=False)
+    unrouted_totals: dict = {}
+    cons = client.constraints()
+    for i in range(0, len(raws), 128):
+        swept = ev2.sweep(cons, raws[i:i + 128])
+        for kind, (kcons, _i2, _v2, counts, _b) in swept.items():
+            for ci, con in enumerate(kcons):
+                k = con.key()
+                unrouted_totals[k] = (unrouted_totals.get(k, 0)
+                                      + int(counts[ci]))
+    for key, total in routed.total_violations.items():
+        assert total == unrouted_totals.get(key, 0), (
+            key, total, unrouted_totals.get(key, 0))
+
+    # interpreter ground truth: the routed run's violating-object SET per
+    # constraint must equal the exact engine's (totals differ by
+    # multiplicity — interp counts results — so compare object identity
+    # via kept sets under a limit big enough to be exhaustive here)
+    interp = run_with(None, [as_raw(o) for o in objects])
+    assert routed.total_objects == interp.total_objects == 512
+    for key, vs in routed.kept.items():
+        got = {(v.kind, v.name, v.message) for v in vs}
+        want = {(v.kind, v.name, v.message) for v in interp.kept[key]}
+        if len(interp.kept[key]) < 5 and len(vs) < 5:
+            # neither lane hit the limit: the kept sets are exhaustive
+            # and must agree exactly
+            assert got == want, (key, got ^ want)
+        else:
+            # a lane truncated at the limit: every routed render must
+            # still be a violation the exact engine produces
+            assert got <= want or want <= got, (key, got ^ want)
+
+
+def test_mask_bitpack_roundtrip():
+    from gatekeeper_tpu.parallel.sharded import (pack_transfer_cols,
+                                                 unpack_transfer_cols)
+    import jax
+
+    # identity alias dedup: two keys sharing one array ship once
+    a = np.arange(32, dtype=np.int32).reshape(8, 4)
+    cols = {"rg:x:f": {"sid": a}, "rg:y:f": {"sid": a},
+            "sc:z": {"kind": np.ones(8, np.int8)}}
+    bufs, layout = pack_transfer_cols(cols, 8)
+    kinds = [e[2] for e in layout]
+    assert "alias" in kinds
+    out = unpack_transfer_cols(
+        {k: np.asarray(v) for k, v in bufs.items()}, layout, 8)
+    np.testing.assert_array_equal(np.asarray(out["rg:x:f"]["sid"]), a)
+    np.testing.assert_array_equal(np.asarray(out["rg:y:f"]["sid"]), a)
+    # total stored bytes: the aliased array must not ship twice
+    stored = sum(b.nbytes for b in bufs.values())
+    assert stored <= a.nbytes + 8 * 2  # one copy + the int8 col
