@@ -186,11 +186,36 @@ def sweep_main(n: int = 1_000_000, chunk: int = 32_768,
     jax, client, tpu, nt, nc, cpu_fallback = setup_platform_and_client()
     from gatekeeper_tpu.utils.synthetic import iter_cluster_objects
 
-    spill = os.path.join(tempfile.gettempdir(), f"sweep_corpus_{n}.jsonl")
+    # unique, safely-created spill (mkstemp): a fixed predictable path in
+    # the shared tmp dir clobbers under concurrent runs and is a
+    # pre-creation/symlink hazard on multi-user hosts
+    spill_fd, spill = tempfile.mkstemp(
+        prefix=f"sweep_corpus_{n}_", suffix=".jsonl")
+    try:
+        return _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd,
+                            spill, n, chunk, submit_window)
+    finally:
+        # unlink unconditionally: an interrupted run must not leak a
+        # multi-GB uniquely-named spill per retry
+        try:
+            os.unlink(spill)
+        except OSError:
+            pass
+
+
+def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
+                 n, chunk, submit_window):
+    import json as _json
+    import os
+    import resource
+    import time
+
+    from gatekeeper_tpu.utils.synthetic import iter_cluster_objects
+
     t0 = time.perf_counter()
     n_ing = 0
     log(f"generating {n} objects to disk spill {spill} (streaming)...")
-    with open(spill, "wb") as f:
+    with os.fdopen(spill_fd, "wb") as f:
         for o in iter_cluster_objects(n):
             if o.get("kind") == "Ingress":
                 client.add_data(o)  # referential inventory sync
@@ -269,10 +294,6 @@ def sweep_main(n: int = 1_000_000, chunk: int = 32_768,
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "SWEEP1M.json"), "w") as f:
         f.write(_json.dumps(out) + "\n")
-    try:
-        os.unlink(spill)
-    except OSError:
-        pass
     print(_json.dumps(out))
 
 
@@ -326,19 +347,16 @@ def main():
                         return_bits=cfg.exact_totals)
     log(f"warmup: {time.perf_counter() - t0:.1f}s")
 
-    # two timed passes, best reported: the tunneled link's throughput
-    # varies ±15% minute-to-minute (BENCH_TPU.json note), so a single
-    # sample can land in a dip; the faster pass is the steady-state
-    # measurement (both are logged)
-    # methodology (ADVICE r3): two passes, BEST reported as the headline
-    # (the tunneled link's throughput varies ±15% minute-to-minute); both
-    # pass times and the median go into the JSON artifact so rounds stay
-    # comparable
-    log("timed audit sweep (best of 2 passes)...")
-    elapsed = None
+    # methodology (VERDICT r4 weak #3): FIVE timed passes, MEDIAN reported
+    # as the headline — a best-of-2 on a shared tunnel with ±15% session
+    # variance is not a defensible steady-state number.  All pass times +
+    # the IQR go into the JSON artifact; phases come from the median pass.
+    n_passes = 5
+    log(f"timed audit sweep (median of {n_passes} passes)...")
     pass_times = []
-    phases = {}
-    for p in range(2):
+    pass_phases = []
+    runs = []
+    for p in range(n_passes):
         evaluator.perf_reset()
         mgr.perf = {}
         t0 = time.perf_counter()
@@ -346,14 +364,20 @@ def main():
         dt = time.perf_counter() - t0
         log(f"  pass {p + 1}: {dt:.3f}s")
         pass_times.append(round(dt, 3))
-        if elapsed is None or dt < elapsed:
-            elapsed = dt
-            phases = {k: round(v, 3) for k, v in evaluator.perf.items()}
-            phases.update(
-                {k: round(v, 3) for k, v in mgr.perf.items()})
-            phases["wire_mb"] = round(
-                phases.pop("wire_bytes", 0.0) / 1e6, 1)
-    log(f"  phase breakdown (best pass): {phases}")
+        ph = {k: round(v, 3) for k, v in evaluator.perf.items()}
+        ph.update({k: round(v, 3) for k, v in mgr.perf.items()})
+        ph["wire_mb"] = round(ph.pop("wire_bytes", 0.0) / 1e6, 1)
+        pass_phases.append(ph)
+        runs.append(run)
+    order = sorted(range(n_passes), key=lambda i: pass_times[i])
+    med_i = order[n_passes // 2]
+    elapsed = pass_times[med_i]
+    phases = pass_phases[med_i]
+    run = runs[med_i]
+    iqr = round(pass_times[order[-(n_passes // 4 + 1)]]
+                - pass_times[order[n_passes // 4]], 3)
+    log(f"  median {elapsed:.3f}s, IQR {iqr:.3f}s")
+    log(f"  phase breakdown (median pass): {phases}")
     violations = sum(run.total_violations.values())
     total_kept = sum(len(v) for v in run.kept.values())
     reviews_per_s = n / elapsed
@@ -374,7 +398,9 @@ def main():
         "platform": jax.devices()[0].platform,
         "legacy_3template_reviews_per_s": round(legacy_rate, 1),
         "pass_times_s": pass_times,
-        "methodology": "best of 2 passes (both listed); phases from best",
+        "pass_iqr_s": iqr,
+        "methodology": f"median of {n_passes} passes (all listed); "
+                       "phases from median pass",
         "phase_s": phases,
     }
     if cpu_fallback:

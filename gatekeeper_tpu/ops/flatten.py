@@ -332,6 +332,13 @@ def dedup_schema(schema: Schema) -> tuple:
             put(exec_s.parent_idx, pi,
                 ParentIdxCol(widest.get(pi.axis, pi.axis),
                              widest.get(pi.parent, pi.parent)))
+            # put() retains only the CHILD axis; a parent axis referenced
+            # solely through this ParentIdxCol (no ragged column of its
+            # own) would otherwise lose its count column from
+            # Schema.axes(), a trace-time KeyError in the enclosing
+            # AnyAxis consumer
+            if pi.parent in widest and pi.parent not in exec_s.extra_axes:
+                exec_s.extra_axes.append(pi.parent)
         else:
             put(exec_s.parent_idx, pi, pi)
     return exec_s, alias
@@ -411,14 +418,29 @@ class ColumnBatch:
         return out
 
 
+# float32 saturation bound: numbers beyond the device dtype's range store
+# as ±inf EXPLICITLY (the same value the silent float64->float32 cast
+# produces, minus the RuntimeWarning).  Policy: order against in-range
+# numbers is preserved (inf > any finite threshold, matching the
+# interpreter's exact comparison for out-of-range magnitudes); EQUALITY of
+# two distinct out-of-range numbers is already beyond float32 — templates
+# needing exact wide-number equality take the interpreter lane.
+_F32_MAX = float(np.finfo(np.float32).max)
+
+
 def _classify(v: Any, vocab: Vocab):
     if isinstance(v, bool):
         return (K_TRUE if v else K_FALSE), 0.0, -1
     if isinstance(v, (int, float)):
         try:
-            return K_NUM, float(v), -1
+            f = float(v)
         except OverflowError:  # int beyond double range: saturate with sign
             return K_NUM, float("inf") if v > 0 else float("-inf"), -1
+        if f > _F32_MAX:
+            f = float("inf")
+        elif f < -_F32_MAX:
+            f = float("-inf")
+        return K_NUM, f, -1
     if isinstance(v, str):
         return K_STR, 0.0, vocab.intern(v)
     if v is None:
@@ -531,6 +553,9 @@ class Flattener:
         # (a chunk exceeding a target keeps its wider shape: one retrace,
         # never wrong results)
         self.width_targets = width_targets
+        # flatten sub-phase wall-clock (c_columnize / py_assemble /
+        # canon_fill / stabilize) — folded into the evaluator's perf dict
+        self.perf: dict = {}
 
     def _apply_alias(self, batch: ColumnBatch) -> ColumnBatch:
         for orig, new in self.alias.items():
@@ -795,6 +820,8 @@ class Flattener:
                 items.append(json.dumps(o, separators=(",", ":")).encode())
         nthreads = int(os.environ.get("GTPU_FLATTEN_THREADS", "0") or 0) \
             or (os.cpu_count() or 1)
+        import time as _time
+        _t0 = _time.perf_counter()
         out = mod.flatten_json_batch(
             items,
             [tuple(s.path) for s in schema.scalars],
@@ -813,6 +840,9 @@ class Flattener:
             self.bucket,  # ragged bucket, matches round_up()
             nthreads,
         )
+        self.perf["c_columnize"] = (self.perf.get("c_columnize", 0.0)
+                                    + _time.perf_counter() - _t0)
+        _t0 = _time.perf_counter()
         n = max(pad_n or 0, len(items))
         batch = ColumnBatch(n=n, scalars={}, raggeds={}, axis_counts={},
                             keysets={})
@@ -840,8 +870,17 @@ class Flattener:
                 [c for c in schema.scalars
                  if c.path[:1] == ("__review__",)],
                 reviews)
+        self.perf["py_assemble"] = (self.perf.get("py_assemble", 0.0)
+                                    + _time.perf_counter() - _t0)
+        _t0 = _time.perf_counter()
         self._fill_canons(batch, raws)
-        return self._apply_alias(self._stabilize(batch))
+        self.perf["canon_fill"] = (self.perf.get("canon_fill", 0.0)
+                                   + _time.perf_counter() - _t0)
+        _t0 = _time.perf_counter()
+        batch = self._apply_alias(self._stabilize(batch))
+        self.perf["stabilize"] = (self.perf.get("stabilize", 0.0)
+                                  + _time.perf_counter() - _t0)
+        return batch
 
     def _fill_canons(self, batch: ColumnBatch, objects) -> None:
         """Canonical-selector sid columns (CanonCol) — computed host-side

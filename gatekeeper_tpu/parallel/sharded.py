@@ -779,6 +779,8 @@ class ShardedEvaluator:
         fl = self._flattener(schema)
         batch = fl.flatten(objects, pad_n=pad_n)
         self._perf_add("flatten", time.perf_counter() - t0)
+        for k, v in fl.perf.items():  # sub-phases of the flatten above
+            self._perf_add("fl_" + k, v)
 
         from gatekeeper_tpu.ir import masks as masks_mod
 

@@ -175,14 +175,21 @@ def peek_kind(obj) -> str:
         except UnicodeDecodeError:
             pass
     pos = 0
+    # depth/in-string/escape state carried incrementally across candidate
+    # positions: each '"kind"' occurrence only scans the bytes since the
+    # previous one (a prefix rescan from 0 per candidate is O(occurrences
+    # x object_size) on objects whose top-level kind serializes after
+    # nested kind keys — ownerReferences, roleRef)
+    depth = 0
+    instr = False
+    esc = False
+    scanned = 0
+    mv = memoryview(raw)
     while True:
         pos = raw.find(b'"kind"', pos)
         if pos < 0:
             return ""  # no "kind" bytes at all: the key cannot exist
-        depth = 0
-        instr = False
-        esc = False
-        for b in memoryview(raw)[:pos]:
+        for b in mv[scanned:pos]:
             if esc:
                 esc = False
             elif b == 0x5C:  # backslash
@@ -194,6 +201,7 @@ def peek_kind(obj) -> str:
                     depth += 1
                 elif b == 0x7D or b == 0x5D:  # } ]
                     depth -= 1
+        scanned = pos
         if depth == 1 and not instr:
             m = _KIND_VAL.match(raw, pos + 6)
             if m:
