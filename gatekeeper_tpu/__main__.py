@@ -47,6 +47,22 @@ def main(argv=None) -> int:
                    help="enable disk export of audit violations")
     p.add_argument("--log-denies", action="store_true",
                    help="log structured deny events (reference --log-denies)")
+    p.add_argument("--emit-admission-events", action="store_true",
+                   help="emit K8s Events on admission violations "
+                        "(reference --emit-admission-events)")
+    p.add_argument("--admission-events-involved-namespace",
+                   action="store_true",
+                   help="emit admission Events in the violating object's "
+                        "namespace instead of the gatekeeper namespace")
+    p.add_argument("--emit-audit-events", action="store_true",
+                   help="emit K8s Events on audit violations "
+                        "(reference --emit-audit-events)")
+    p.add_argument("--audit-events-involved-namespace",
+                   action="store_true",
+                   help="emit audit Events in the violating object's "
+                        "namespace instead of the gatekeeper namespace")
+    p.add_argument("--gatekeeper-namespace", default="gatekeeper-system",
+                   help="namespace Events land in by default")
     p.add_argument("--log-stats-admission", action="store_true",
                    help="log per-request evaluation stats (reference "
                         "--log-stats-admission)")
@@ -278,6 +294,18 @@ def main(argv=None) -> int:
         else:
             def lister():
                 return iter(cluster.list())
+        audit_event_sink = None
+        if args.emit_audit_events:
+            from gatekeeper_tpu.sync import events as _events
+
+            audit_event_sink = _events.audit_event_sink(
+                _events.EventRecorder(
+                    cluster, "gatekeeper-audit",
+                    gk_namespace=args.gatekeeper_namespace,
+                    involved_namespace=(
+                        args.audit_events_involved_namespace),
+                    on_error=lambda e: print(
+                        f"audit event emit failed: {e}", file=sys.stderr)))
         audit_mgr = AuditManager(
             client,
             lister=lister,
@@ -288,6 +316,7 @@ def main(argv=None) -> int:
             ),
             evaluator=evaluator,
             export_system=export,  # Connection CRs register here too
+            event_sink=audit_event_sink,
             log_violations=args.log_denies,
         )
 
@@ -331,6 +360,17 @@ def main(argv=None) -> int:
 
     batcher = Batcher(client, stats=args.log_stats_admission,
                       small_batch=args.webhook_small_batch).start()
+    admission_sink = None
+    if args.emit_admission_events:
+        from gatekeeper_tpu.sync import events as _events
+
+        admission_sink = _events.admission_event_sink(
+            _events.EventRecorder(
+                cluster, "gatekeeper-webhook",
+                gk_namespace=args.gatekeeper_namespace,
+                involved_namespace=args.admission_events_involved_namespace,
+                on_error=lambda e: print(
+                    f"admission event emit failed: {e}", file=sys.stderr)))
     server = None
     if mgr.is_assigned("webhook") or mgr.is_assigned("mutation-webhook"):
         # warm every grid-lane pad bucket before serving: readiness
@@ -387,6 +427,7 @@ def main(argv=None) -> int:
                 namespace_lookup=namespace_lookup,
                 batcher=batcher,
                 log_denies=args.log_denies,
+                event_sink=admission_sink,
                 metrics=metrics,
                 fail_open=args.fail_open_on_error,
                 trace_config=lambda: mgr.validation_traces,

@@ -900,10 +900,15 @@ class Flattener:
             # already-extracted identity column, so the (expensive) Python
             # parse is reserved for the ~10% of objects that probe-hit
             # (measured: this fill was 1.06s of a 1.41s 32k-object chunk
-            # flatten when every object parsed)
+            # flatten when every object parsed).  Probe-MISS objects
+            # resolve in bulk: their canon depends only on ns_sid, so one
+            # intern per DISTINCT namespace sid (dozens per cluster)
+            # replaces a per-object Python body (measured 0.24s/100k).
             probe = f'"{cc.path[-1]}"'.encode() if cc.path else b""
             to_str = self.vocab._to_str
             ns_sid = batch.ns_sid
+            parse_idx: list = []  # objects that need the exact parse
+            miss_idx: list = []   # provable probe-misses (ns path only)
             for i, obj in enumerate(objects):
                 raw = None
                 if isinstance(obj, (bytes, bytearray, memoryview)):
@@ -916,9 +921,8 @@ class Flattener:
                     # key bytes)
                     if cc.ns_scoped:
                         s = int(ns_sid[i]) if ns_sid is not None else -1
-                        ns = to_str[s] if 0 <= s < len(to_str) else ""
-                        if ns:
-                            sids[i] = self.vocab.intern(ns + "\x00")
+                        if 0 <= s < len(to_str) and to_str[s]:
+                            miss_idx.append(i)
                             continue
                         # the identity column interns absent AND explicit
                         # "" namespaces to the same sid — only the parse
@@ -926,10 +930,23 @@ class Flattener:
                         # "\x00"-prefixed canon, matching the dict lane)
                         if b'"namespace"' not in raw:
                             continue  # provably absent: -2
-                        # fall through to the parse path
+                        parse_idx.append((i, raw))
                     else:
                         sids[i] = self.vocab.intern("")
-                        continue
+                    continue
+                parse_idx.append((i, raw))
+            if miss_idx:
+                mi = np.asarray(miss_idx, np.intp)
+                msids = ns_sid[mi]
+                # one intern per distinct namespace sid, then a vectorized
+                # gather maps every miss object through it
+                uniq, inv = np.unique(msids, return_inverse=True)
+                lut = np.array(
+                    [self.vocab.intern(to_str[int(s)] + "\x00")
+                     for s in uniq], np.int32)
+                sids[mi] = lut[inv]
+            for i, raw in parse_idx:
+                obj = objects[i]
                 if raw is not None:
                     try:
                         obj = json.loads(raw)

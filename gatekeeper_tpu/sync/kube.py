@@ -289,6 +289,13 @@ class KubeCluster:
         obj.setdefault("kind", kind)
         return obj
 
+    def create(self, obj: dict) -> None:
+        """Plain POST create (Events: unique per-emit names, no replace
+        path needed)."""
+        gvk = gvk_of(obj)
+        ns = namespace_of(obj)
+        self._request("POST", self._collection_path(gvk, ns), body=obj)
+
     def apply(self, obj: dict) -> None:
         """Create-or-replace (the Manager's CRD/VAP/status writes)."""
         gvk = gvk_of(obj)

@@ -50,6 +50,7 @@ DEFAULT_RESOURCES = {
                                  "customresourcedefinitions", False),
     "ServiceAccount": ("", "v1", "serviceaccounts", True),
     "Secret": ("", "v1", "secrets", True),
+    "Event": ("", "v1", "events", True),
     "ClusterRole": ("rbac.authorization.k8s.io", "v1", "clusterroles",
                     False),
     "ClusterRoleBinding": ("rbac.authorization.k8s.io", "v1",

@@ -209,8 +209,10 @@ class ValidationHandler:
         else:
             resp = ValidationResponse(allowed=True, warnings=warns,
                                       uid=req.uid)
-        if self.event_sink is not None and (denies or warns):
-            self.event_sink(req, denies, warns)
+        if self.event_sink is not None:
+            results = responses.results()
+            if results:  # reference emits per result incl. dryrun-only
+                self.event_sink(req, results)
         return resp
 
     def _review(self, augmented):

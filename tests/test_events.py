@@ -1,0 +1,249 @@
+"""Kubernetes Events emission (reference --emit-admission-events,
+pkg/webhook/policy.go:276-340; --emit-audit-events,
+pkg/audit/manager.go:1247-1296): both sinks must POST real corev1 Event
+objects through the apiserver client."""
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import Constraint
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.sync.events import (EventRecorder, admission_event_sink,
+                                        audit_event_sink, violation_ref)
+from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
+from gatekeeper_tpu.sync.mock_apiserver import MockApiServer
+from gatekeeper_tpu.target.target import K8sValidationTarget
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8sdenyall"},
+    "spec": {"crd": {"spec": {"names": {"kind": "K8sDenyAll"}}},
+             "targets": [{"target": TARGET, "rego": """
+package k8sdenyall
+
+violation[{"msg": msg}] {
+  msg := sprintf("denied: %v", [input.review.object.metadata.name])
+}
+"""}]},
+}
+
+
+@pytest.fixture()
+def server():
+    srv = MockApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def cluster(server):
+    kc = KubeCluster(KubeConfig(server=server.url))
+    yield kc
+    kc.close()
+
+
+def _client():
+    tpu = TpuDriver()
+    client = Client(target=K8sValidationTarget(), drivers=[tpu],
+                    enforcement_points=[
+                        "validation.gatekeeper.sh", "audit.gatekeeper.sh"])
+    client.add_template(TEMPLATE)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sDenyAll", "metadata": {"name": "deny-everything"},
+        "spec": {}})
+    return client
+
+
+def _events(cluster):
+    return cluster.list(("", "v1", "Event"))
+
+
+def test_violation_ref_reference_semantics():
+    # default: gatekeeper namespace + synthetic aggregation UID
+    ref = violation_ref("gatekeeper-system", "Pod", "p", "apps", "7", "u1",
+                        "K8sDenyAll", "deny-everything", "", False)
+    assert ref["namespace"] == "gatekeeper-system"
+    assert ref["uid"] == "Pod/apps/p/K8sDenyAll//deny-everything"
+    # involved-namespace: real uid/rv in the resource's own namespace
+    ref = violation_ref("gatekeeper-system", "Pod", "p", "apps", "7", "u1",
+                        "K8sDenyAll", "deny-everything", "", True)
+    assert ref["namespace"] == "apps"
+    assert ref["uid"] == "u1" and ref["resourceVersion"] == "7"
+
+
+def test_admission_events_end_to_end(cluster):
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    rec = EventRecorder(cluster, "gatekeeper-webhook")
+    handler = ValidationHandler(
+        _client(), event_sink=admission_event_sink(rec),
+    )
+    resp = handler.handle({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": "req-1",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE", "name": "bad-pod", "namespace": "apps",
+            "userInfo": {"username": "alice"},
+            "object": {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "bad-pod", "namespace": "apps",
+                                    "uid": "u-1", "resourceVersion": "5"},
+                       "spec": {"containers": []}},
+        }})
+    assert not resp.allowed
+    rec.flush()
+    evs = _events(cluster)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["reason"] == "FailedAdmission"
+    assert ev["type"] == "Warning"
+    assert ev["source"]["component"] == "gatekeeper-webhook"
+    assert ev["metadata"]["namespace"] == "gatekeeper-system"
+    assert ev["involvedObject"]["kind"] == "Pod"
+    assert ev["involvedObject"]["name"] == "bad-pod"
+    assert "Constraint: deny-everything" in ev["message"]
+    assert "denied request" in ev["message"]
+    ann = ev["metadata"]["annotations"]
+    assert ann["process"] == "admission"
+    assert ann["event_type"] == "violation"
+    assert ann["constraint_kind"] == "K8sDenyAll"
+    assert ann["resource_namespace"] == "apps"
+    assert ann["request_username"] == "alice"
+
+
+def test_admission_events_involved_namespace(cluster):
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    rec = EventRecorder(cluster, "gatekeeper-webhook",
+                        involved_namespace=True)
+    handler = ValidationHandler(
+        _client(), event_sink=admission_event_sink(rec),
+    )
+    handler.handle({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": "req-2",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE", "name": "bad-pod", "namespace": "apps",
+            "object": {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "bad-pod", "namespace": "apps",
+                                    "uid": "u-1", "resourceVersion": "5"},
+                       "spec": {"containers": []}},
+        }})
+    rec.flush()
+    evs = _events(cluster)
+    assert len(evs) == 1
+    assert evs[0]["metadata"]["namespace"] == "apps"
+    assert evs[0]["involvedObject"]["uid"] == "u-1"
+    # involved-namespace message omits the namespace clause
+    assert "Resource Namespace:" not in evs[0]["message"]
+
+
+def test_audit_events_per_kept_violation(cluster):
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+
+    client = _client()
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": f"p{i}", "namespace": "apps"},
+             "spec": {"containers": []}} for i in range(3)]
+    rec = EventRecorder(cluster, "gatekeeper-audit")
+    mgr = AuditManager(
+        client, lister=lambda: iter(objs),
+        config=AuditConfig(violations_limit=20),
+        event_sink=audit_event_sink(rec),
+    )
+    run = mgr.audit()
+    assert sum(run.total_violations.values()) == 3
+    rec.flush()
+    evs = _events(cluster)
+    assert len(evs) == 3
+    for ev in evs:
+        assert ev["reason"] == "AuditViolation"
+        assert ev["source"]["component"] == "gatekeeper-audit"
+        assert ev["metadata"]["namespace"] == "gatekeeper-system"
+        ann = ev["metadata"]["annotations"]
+        assert ann["process"] == "audit"
+        assert ann["event_type"] == "violation_audited"
+        assert ann["auditTimestamp"] == run.timestamp
+        assert ann["constraint_name"] == "deny-everything"
+    assert sorted(e["involvedObject"]["name"] for e in evs) == \
+        ["p0", "p1", "p2"]
+
+
+def test_event_emit_failure_never_raises():
+    class Boom:
+        def create(self, obj):
+            raise RuntimeError("apiserver down")
+
+    errors = []
+    rec = EventRecorder(Boom(), "gatekeeper-webhook",
+                        on_error=errors.append)
+    rec.annotated_event({"kind": "Pod", "name": "p",
+                         "namespace": "gatekeeper-system"}, {},
+                        "FailedAdmission", "msg")
+    rec.flush()
+    assert len(errors) == 1  # reported, not raised
+
+
+def test_audit_events_aggregate_across_passes(cluster):
+    """A violation persisting across audit intervals bumps count on the
+    SAME Event object (record.EventRecorder series aggregation) instead of
+    minting a new etcd object per pass."""
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+
+    client = _client()
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "p0", "namespace": "apps"},
+             "spec": {"containers": []}}]
+    rec = EventRecorder(cluster, "gatekeeper-audit")
+    mgr = AuditManager(
+        client, lister=lambda: iter(objs),
+        config=AuditConfig(violations_limit=20),
+        event_sink=audit_event_sink(rec),
+    )
+    mgr.audit()
+    mgr.audit()
+    rec.flush()
+    evs = _events(cluster)
+    assert len(evs) == 1
+    assert evs[0]["count"] == 2
+
+
+def test_aggregation_preserves_first_timestamp(cluster):
+    rec = EventRecorder(cluster, "gatekeeper-audit")
+    ref = violation_ref("gatekeeper-system", "Pod", "p0", "apps", "", "",
+                        "K8sDenyAll", "c", "", False)
+    rec.annotated_event(ref, {}, "AuditViolation", "m")
+    rec.flush()
+    first = _events(cluster)[0]["firstTimestamp"]
+    rec.annotated_event(ref, {}, "AuditViolation", "m")
+    rec.flush()
+    ev = _events(cluster)[0]
+    assert ev["count"] == 2
+    assert ev["firstTimestamp"] == first
+
+
+def test_sweep_ready_handles_rpc_futures():
+    """RemoteEvaluator pendings are grpc futures: readiness must come from
+    done(), never from treating the bound .result method as a jax array
+    (which would force a blocking collect per submit — no pipelining)."""
+    from gatekeeper_tpu.audit.manager import _sweep_ready
+
+    class FakeFuture:
+        def __init__(self, ready):
+            self._ready = ready
+
+        def done(self):
+            return self._ready
+
+        def result(self):
+            return {}
+
+    assert _sweep_ready(FakeFuture(True)) is True
+    assert _sweep_ready(FakeFuture(False)) is False
+    assert _sweep_ready({}) is True  # empty submit
