@@ -43,6 +43,21 @@ def main(argv=None) -> int:
     p.add_argument("--audit-interval", type=float, default=60.0)
     p.add_argument("--constraint-violations-limit", type=int, default=20)
     p.add_argument("--audit-chunk-size", type=int, default=500)
+    p.add_argument("--pipeline", default="auto",
+                   choices=["auto", "on", "off", "differential"],
+                   help="audit sweep schedule: 'auto' runs the staged "
+                        "host pipeline (list->flatten->dispatch->collect"
+                        "->fold on separate threads, bounded queues) when "
+                        "the host has >1 effective core and degrades to "
+                        "the serial eager-poll schedule otherwise; "
+                        "'on'/'off' force; 'differential' runs both and "
+                        "asserts bit-identical output (debugging)")
+    p.add_argument("--pipeline-flatten-workers", type=int, default=0,
+                   help="threads in the pipeline's flatten stage; 0 = "
+                        "auto (2 when the host has >=4 effective cores). "
+                        "The C columnizer already shards each chunk over "
+                        "an internal pthread pool; extra workers overlap "
+                        "the Python assembly slices across chunks")
     p.add_argument("--export-dir", default="",
                    help="enable disk export of audit violations")
     p.add_argument("--log-denies", action="store_true",
@@ -216,7 +231,7 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
     else:
-        tpu = TpuDriver(cel_driver=cel)
+        tpu = TpuDriver(cel_driver=cel, metrics=metrics)
     client = Client(target=K8sValidationTarget(),
                     drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
@@ -313,11 +328,14 @@ def main(argv=None) -> int:
                 interval_s=args.audit_interval,
                 violations_limit=args.constraint_violations_limit,
                 chunk_size=args.audit_chunk_size,
+                pipeline=args.pipeline,
+                pipeline_flatten_workers=args.pipeline_flatten_workers,
             ),
             evaluator=evaluator,
             export_system=export,  # Connection CRs register here too
             event_sink=audit_event_sink,
             log_violations=args.log_denies,
+            metrics=metrics,
         )
 
     if args.once:

@@ -40,6 +40,26 @@ class AuditConfig:
     chunk_size: int = 500  # --audit-chunk-size
     match_kind_only: bool = False  # --audit-match-kind-only
     from_cache: bool = False  # --audit-from-cache
+    # sweep schedule (--pipeline): 'auto' takes the staged host pipeline
+    # (pipeline/executor.py — flatten, dispatch, collect and fold on
+    # their own threads with bounded queues, so chunk K's flatten
+    # overlaps chunk K-1's collect/fold) when the host has >1 effective
+    # core; 'on'/'off' force it; 'differential' runs BOTH schedules and
+    # asserts bit-identical output (totals, kept order, messages)
+    pipeline: str = "auto"
+    # threads in the flatten stage; 0 = auto (2 on hosts with >=4
+    # effective cores, else 1).  The C columnizer already shards one
+    # chunk over an internal pthread pool with the GIL released, so
+    # cross-chunk workers mainly overlap the GIL-held assembly slices;
+    # >1 worker makes vocab-intern ORDER depend on thread timing (ids
+    # stay self-consistent and verdicts/messages identical — the warm
+    # pass freezes the vocab before timed sweeps anyway) and emission
+    # order stays canonical either way (the executor restores input
+    # order).
+    pipeline_flatten_workers: int = 0
+    # bound of each inter-stage queue (chunks buffered between stages);
+    # the collect stage's input bound is submit_window, not this
+    pipeline_queue_cap: int = 2
     # exact totals = reference parity: totalViolations counts every violation
     # *result* (a pod with 2 privileged containers contributes 2), which
     # requires rendering every hit through the interpreter.  False counts
@@ -113,6 +133,7 @@ class AuditManager:
         export_system=None,
         event_sink: Optional[Callable] = None,
         log_violations: bool = False,
+        metrics=None,  # metrics.registry.MetricsRegistry (optional)
     ):
         self.client = client
         self.lister = lister
@@ -122,10 +143,15 @@ class AuditManager:
         self.export_system = export_system
         self.event_sink = event_sink
         self.log_violations = log_violations
+        self.metrics = metrics
         self._stop = threading.Event()
         # per-phase seconds for the host-side fold/render of device sweeps
         # (the evaluator tracks its own flatten/masks/wire/dispatch/collect)
         self.perf: dict = {}
+        # per-stage breakdown of the last pipelined sweep (JSON-ready dict
+        # from pipeline.executor.PipelineRun.summary + device-idle proxy);
+        # None when the last sweep ran the serial schedule
+        self.pipe_stats: Optional[dict] = None
 
     # --- loop (reference: auditManagerLoop, manager.go:831) -------------
     def run_forever(self):
@@ -158,27 +184,7 @@ class AuditManager:
         kept: dict = {(c.kind, c.name): [] for c in constraints}
         totals: dict = {(c.kind, c.name): 0 for c in constraints}
 
-        # eager-poll pipelined chunking: the host lists + flattens +
-        # dispatches chunks (jit dispatch is async, so the device drains
-        # the queue while the host keeps flattening); after each submit,
-        # any in-flight chunk whose device result IS ALREADY READY
-        # (non-blocking ``is_ready`` poll) is collected + folded
-        # immediately.  The host thread therefore never blocks while
-        # listing continues — by the final drain only the tail chunks are
-        # still executing, and their wait overlaps their predecessors'
-        # fold/render.  On a one-core host this beats a collector THREAD
-        # (measured: two GIL-hungry threads thrash — flatten wall-time
-        # doubled); single-threaded, total time ~= host CPU work with
-        # device+wire waits hidden.  ``submit_window`` still bounds
-        # in-flight chunks (host memory + device HBM).
-        #
-        # kind-bucketed routing (device path): objects stream into
-        # per-kind-group chunks (parallel/sharded.make_kind_router — the
-        # match-kinds prefilter of manager.go:427-483 applied per
-        # template), so a Service chunk never flattens/ships/evaluates
-        # container columns, and objects no template can match skip the
-        # device entirely.
-        from collections import deque
+        from gatekeeper_tpu.pipeline import resolve_schedule
 
         batch_driver = next(
             (d for d in self.client.drivers if hasattr(d, "query_batch")),
@@ -189,6 +195,124 @@ class AuditManager:
             device
             and getattr(self.evaluator, "renders", False) is False
         )
+        # staged-pipeline eligibility: a LOCAL evaluator exposing the
+        # split flatten/dispatch stages.  The sidecar lane (renders=True,
+        # grpc futures) and the no-evaluator interpreter lane stay serial.
+        device_capable = (
+            use_router
+            and hasattr(self.evaluator, "sweep_flatten")
+            and hasattr(self.evaluator, "sweep_dispatch")
+        )
+        schedule = resolve_schedule(
+            getattr(self.config, "pipeline", "auto"), device_capable)
+        self.pipe_stats = None
+        self.perf["pipelined"] = 1.0 if schedule == "pipelined" else 0.0
+
+        counter = [0]
+        if schedule == "differential":
+            # serial is the reference schedule; the pipelined pass must
+            # reproduce it bit-for-bit (totals, kept order, messages)
+            self._sweep_serial(constraints, kind_filter, use_router,
+                               device, kept, totals, limit, counter)
+            kept_p: dict = {k: [] for k in kept}
+            totals_p: dict = {k: 0 for k in totals}
+            self._sweep_pipelined(constraints, kind_filter, use_router,
+                                  kept_p, totals_p, limit, [0])
+            diff = self._schedules_differ(kept, totals, kept_p, totals_p)
+            if diff:
+                raise RuntimeError(
+                    f"pipeline differential mismatch: {diff}")
+            self.perf["pipeline_differential_ok"] = 1.0
+        elif schedule == "pipelined":
+            self._sweep_pipelined(constraints, kind_filter, use_router,
+                                  kept, totals, limit, counter)
+        else:
+            self._sweep_serial(constraints, kind_filter, use_router,
+                               device, kept, totals, limit, counter)
+        run.total_objects = counter[0]
+
+        run.total_violations = totals
+        run.kept = kept
+        run.duration_s = time.time() - t0
+        self._write_statuses(run, constraints)
+        self._publish_metrics(run)
+        self._finish(run)
+        return run
+
+    # --- sweep chunk source (shared by both schedules) -------------------
+    def _chunk_source(self, constraints, kind_filter, use_router, counter):
+        """Yield ``(objects, constraint_subset)`` sweep chunks in the ONE
+        canonical order both schedules share — the pipelined fold and the
+        serial fold therefore see identical chunk sequences, which is what
+        makes their outputs bit-identical.
+
+        kind-bucketed routing (device path): objects stream into
+        per-kind-group chunks (parallel/sharded.make_kind_router — the
+        match-kinds prefilter of manager.go:427-483 applied per
+        template), so a Service chunk never flattens/ships/evaluates
+        container columns, and objects no template can match skip the
+        device entirely.  ``counter[0]`` accumulates listed (post
+        kind-filter) objects."""
+        if use_router:
+            from gatekeeper_tpu.parallel.sharded import make_kind_router
+            from gatekeeper_tpu.utils.rawjson import peek_kind
+
+            router = make_kind_router(constraints)
+            cons_of_group: dict = {}
+            bufs: dict = {}  # group -> pending chunk
+            for obj in self.lister():
+                k = peek_kind(obj)
+                if kind_filter is not None and k not in kind_filter:
+                    continue
+                counter[0] += 1
+                g = router(k)
+                if not g:
+                    continue  # no template's match reaches this kind
+                buf = bufs.setdefault(g, [])
+                buf.append(obj)
+                if len(buf) >= self.config.chunk_size:
+                    cg = cons_of_group.get(g)
+                    if cg is None:
+                        cg = [c for c in constraints if c.kind in g]
+                        cons_of_group[g] = cg
+                    yield buf, cg
+                    bufs[g] = []
+            for g, buf in bufs.items():
+                if buf:
+                    yield buf, [c for c in constraints if c.kind in g]
+        else:
+            chunk: list = []
+            for obj in self.lister():
+                if kind_filter is not None:
+                    _, _, k = gvk_of(obj)
+                    if k not in kind_filter:
+                        continue
+                chunk.append(obj)
+                counter[0] += 1
+                if len(chunk) >= self.config.chunk_size:
+                    yield chunk, constraints
+                    chunk = []
+            if chunk:
+                yield chunk, constraints
+
+    # --- serial schedule (eager-poll, the one-core-safe path) ------------
+    def _sweep_serial(self, constraints, kind_filter, use_router, device,
+                      kept, totals, limit, counter):
+        """Eager-poll pipelined chunking on ONE thread: the host lists +
+        flattens + dispatches chunks (jit dispatch is async, so the device
+        drains the queue while the host keeps flattening); after each
+        submit, any in-flight chunk whose device result IS ALREADY READY
+        (non-blocking ``is_ready`` poll) is collected + folded
+        immediately.  The host thread therefore never blocks while
+        listing continues — by the final drain only the tail chunks are
+        still executing, and their wait overlaps their predecessors'
+        fold/render.  On a one-core host this beats stage THREADS
+        (measured: two GIL-hungry threads thrash — flatten wall-time
+        doubled); single-threaded, total time ~= host CPU work with
+        device+wire waits hidden.  ``submit_window`` still bounds
+        in-flight chunks (host memory + device HBM)."""
+        from collections import deque
+
         window: deque = deque()  # (pending, objects, constraint subset)
         max_inflight = max(1, self.config.submit_window)
 
@@ -255,48 +379,9 @@ class AuditManager:
                 self._audit_chunk(objects, cons, kept, totals, limit)
 
         try:
-            if use_router:
-                from gatekeeper_tpu.parallel.sharded import make_kind_router
-                from gatekeeper_tpu.utils.rawjson import peek_kind
-
-                router = make_kind_router(constraints)
-                cons_of_group: dict = {}
-                bufs: dict = {}  # group -> pending chunk
-                for obj in self.lister():
-                    k = peek_kind(obj)
-                    if kind_filter is not None and k not in kind_filter:
-                        continue
-                    run.total_objects += 1
-                    g = router(k)
-                    if not g:
-                        continue  # no template's match reaches this kind
-                    buf = bufs.setdefault(g, [])
-                    buf.append(obj)
-                    if len(buf) >= self.config.chunk_size:
-                        cg = cons_of_group.get(g)
-                        if cg is None:
-                            cg = [c for c in constraints if c.kind in g]
-                            cons_of_group[g] = cg
-                        submit(buf, cg)
-                        bufs[g] = []
-                for g, buf in bufs.items():
-                    if buf:
-                        submit(buf,
-                               [c for c in constraints if c.kind in g])
-            else:
-                chunk: list[dict] = []
-                for obj in self.lister():
-                    if kind_filter is not None:
-                        _, _, k = gvk_of(obj)
-                        if k not in kind_filter:
-                            continue
-                    chunk.append(obj)
-                    run.total_objects += 1
-                    if len(chunk) >= self.config.chunk_size:
-                        submit(chunk, constraints)
-                        chunk = []
-                if chunk:
-                    submit(chunk, constraints)
+            for objs, cons in self._chunk_source(constraints, kind_filter,
+                                                 use_router, counter):
+                submit(objs, cons)
             while window:  # drain: blocking collect of the tail chunks
                 fold_oldest()
         finally:
@@ -307,12 +392,130 @@ class AuditManager:
                 waitq.put(None)
                 waiter.join()
 
-        run.total_violations = totals
-        run.kept = kept
-        run.duration_s = time.time() - t0
-        self._write_statuses(run, constraints)
-        self._finish(run)
-        return run
+    # --- pipelined schedule (staged executor) ----------------------------
+    def _sweep_pipelined(self, constraints, kind_filter, use_router,
+                         kept, totals, limit, counter):
+        """Staged host pipeline: ``list -> flatten -> dispatch -> collect
+        -> fold_render`` with one thread per stage and bounded inter-stage
+        queues (pipeline/executor.py).  Chunk K's flatten (GIL-released C
+        columnizer) overlaps chunk K-1's collect/fold, so host work hides
+        device/wire waits and vice versa; the collect stage's input bound
+        is ``submit_window`` (in-flight device chunks: host memory + HBM),
+        and the fold stage consumes chunks in submission order so output
+        is bit-identical to the serial schedule."""
+        from gatekeeper_tpu.pipeline import Stage, StagedPipeline
+
+        import jax as _jax
+
+        ev = self.evaluator
+        cfg = self.config
+        rb = cfg.exact_totals
+
+        def fl(item):
+            objs, cons = item
+            return ev.sweep_flatten(cons, objs, return_bits=rb), objs, cons
+
+        def disp(item):
+            flat, objs, cons = item
+            return ev.sweep_dispatch(flat), objs, cons
+
+        def coll(item):
+            pending, objs, cons = item
+            res = getattr(pending, "result", None)
+            if res is not None:
+                # the stage's ONLY blocking wait: device + wire time for
+                # the head-of-line chunk (a GIL-released C++ wait) — its
+                # busy_s is the run's device-wait measurement
+                try:
+                    _jax.block_until_ready(res)
+                except Exception:
+                    pass  # surfaces at sweep_collect below
+            return ev.sweep_collect(pending), objs, cons
+
+        def fold(item):
+            swept, objs, cons = item
+            t0 = time.perf_counter()
+            self._process_swept(swept, objs, cons, kept, totals, limit)
+            self.perf["fold_render"] = (
+                self.perf.get("fold_render", 0.0)
+                + time.perf_counter() - t0)
+            return None
+
+        from gatekeeper_tpu.pipeline import effective_cpu_count
+
+        fw = cfg.pipeline_flatten_workers
+        if fw <= 0:  # auto: a second flatten worker once cores allow it
+            fw = 2 if effective_cpu_count() >= 4 else 1
+        pipe = StagedPipeline([
+            Stage("flatten", fl, workers=fw,
+                  queue_cap=cfg.pipeline_queue_cap),
+            Stage("dispatch", disp, queue_cap=cfg.pipeline_queue_cap),
+            Stage("collect", coll,
+                  queue_cap=max(1, cfg.submit_window)),
+            Stage("fold_render", fold, queue_cap=cfg.pipeline_queue_cap),
+        ], source_cap=cfg.pipeline_queue_cap)
+        pr = pipe.run(self._chunk_source(constraints, kind_filter,
+                                         use_router, counter))
+        stats = pr.summary()
+        # device-idle proxy: the collect stage blocks exactly while the
+        # device (or wire) is still producing the head-of-line result;
+        # the rest of the wall the chip had nothing in flight to finish.
+        # An upper bound on device busy (it includes wire drain), hence a
+        # LOWER bound on idle-fraction improvements it reports.
+        coll_s = pr.stage("collect")
+        device_wait = coll_s.busy_s if coll_s is not None else 0.0
+        stats["device_wait_s"] = round(device_wait, 3)
+        stats["device_idle_fraction"] = (
+            round(max(0.0, 1.0 - device_wait / pr.wall_s), 3)
+            if pr.wall_s > 0 else 0.0)
+        self.pipe_stats = stats
+        self.perf["pipe_wall"] = (
+            self.perf.get("pipe_wall", 0.0) + pr.wall_s)
+        self.perf["pipe_stage_busy_sum"] = (
+            self.perf.get("pipe_stage_busy_sum", 0.0)
+            + pr.stage_busy_sum())
+        self.perf["pipe_device_wait"] = (
+            self.perf.get("pipe_device_wait", 0.0) + device_wait)
+
+    @staticmethod
+    def _schedules_differ(kept_a, totals_a, kept_b, totals_b):
+        """None when two schedules produced bit-identical output, else a
+        human-readable first difference (differential mode)."""
+        if totals_a != totals_b:
+            keys = [k for k in totals_a
+                    if totals_a.get(k) != totals_b.get(k)]
+            return (f"totals differ for {keys[:3]}: "
+                    f"{[totals_a.get(k) for k in keys[:3]]} vs "
+                    f"{[totals_b.get(k) for k in keys[:3]]}")
+        for key in kept_a:
+            va = [(v.message, v.kind, v.name, v.namespace,
+                   v.enforcement_action) for v in kept_a[key]]
+            vb = [(v.message, v.kind, v.name, v.namespace,
+                   v.enforcement_action) for v in kept_b.get(key, [])]
+            if va != vb:
+                return f"kept violations differ for {key}"
+        return None
+
+    def _publish_metrics(self, run: AuditRun) -> None:
+        if self.metrics is None:
+            return
+        from gatekeeper_tpu.metrics import registry as M
+
+        self.metrics.observe(M.AUDIT_DURATION, run.duration_s)
+        self.metrics.set_gauge(M.AUDIT_LAST_RUN, time.time())
+        if not self.pipe_stats:
+            return
+        for name, s in self.pipe_stats.get("stages", {}).items():
+            lab = {"stage": name}
+            self.metrics.set_gauge(M.PIPELINE_STAGE_SECONDS,
+                                   s["busy_s"], lab)
+            self.metrics.set_gauge(M.PIPELINE_STAGE_OCCUPANCY,
+                                   s["occupancy"], lab)
+            self.metrics.set_gauge(M.PIPELINE_QUEUE_HIGHWATER,
+                                   s["queue_highwater"], lab)
+        self.metrics.set_gauge(
+            M.PIPELINE_DEVICE_IDLE,
+            self.pipe_stats.get("device_idle_fraction", 0.0))
 
     def _kinds_of(self, constraints: Sequence[Constraint]) -> set:
         """--audit-match-kind-only prefilter (manager.go:427-483): only valid

@@ -81,7 +81,8 @@ class TpuDriver:
     remains the exact oracle and message renderer for those kinds — the
     same compile-or-fallback split the Rego path uses."""
 
-    def __init__(self, batch_bucket: int = 256, cel_driver=None):
+    def __init__(self, batch_bucket: int = 256, cel_driver=None,
+                 metrics=None):
         self._interp = RegoDriver()
         self._cel = cel_driver  # optional CELDriver
         self._cel_kinds: set = set()  # kinds owned by the CEL engine
@@ -95,6 +96,33 @@ class TpuDriver:
         self._render_idx: dict = {}  # spec.key() -> (version, value -> entries)
         self._dev_cache: dict = {}  # host array id -> device array (bounded)
         self.batch_bucket = batch_bucket
+        # metrics.registry.MetricsRegistry (optional): lowering coverage
+        # counters — a user template silently falling back to the
+        # interpreter loses the device speedup, and nothing else reports it
+        self.metrics = metrics
+
+    def _count_lowering(self, kind: str, engine: str, lowered: bool) -> None:
+        if self.metrics is None:
+            return
+        from gatekeeper_tpu.metrics import registry as M
+
+        self.metrics.inc_counter(
+            M.LOWERING_LOWERED if lowered else M.LOWERING_FALLBACK,
+            {"kind": kind, "engine": engine})
+
+    def lowering_stats(self) -> dict:
+        """Device-coverage summary for bench/CLI output: how much of the
+        loaded template set actually rides the device verdict path."""
+        lowered = len(self._programs)
+        fallback = len(self._lower_errors)
+        total = lowered + fallback
+        return {
+            "templates": total,
+            "lowered": lowered,
+            "fallback": fallback,
+            "fallback_fraction": round(fallback / total, 4) if total else 0.0,
+            "fallback_kinds": dict(self._lower_errors),
+        }
 
     # --- Driver protocol (delegating lifecycle to the exact engine) ------
     def name(self) -> str:
@@ -124,9 +152,11 @@ class TpuDriver:
             self._trial_param_table(program, template.kind)
             self._programs[template.kind] = CompiledProgram(program)
             self._lower_errors.pop(template.kind, None)
+            self._count_lowering(template.kind, "rego", True)
         except LowerError as e:
             self._programs.pop(template.kind, None)
             self._lower_errors[template.kind] = str(e)
+            self._count_lowering(template.kind, "rego", False)
         self._inv_cache.pop(template.kind, None)
         self._render_specs.pop(template.kind, None)
 
@@ -154,9 +184,11 @@ class TpuDriver:
             self._trial_param_table(program, template.kind)
             self._programs[template.kind] = CompiledProgram(program)
             self._lower_errors.pop(template.kind, None)
+            self._count_lowering(template.kind, "cel", True)
         except LowerError as e:
             self._programs.pop(template.kind, None)
             self._lower_errors[template.kind] = str(e)
+            self._count_lowering(template.kind, "cel", False)
         self._inv_cache.pop(template.kind, None)
         self._render_specs.pop(template.kind, None)
 

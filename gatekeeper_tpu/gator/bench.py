@@ -2,9 +2,13 @@
 
 Reference: pkg/gator/bench/bench.go — per-engine setup-vs-eval timing with
 warmup, P50/P90/P99 latencies, reviews/sec (>=1000 iterations recommended
-for P99 validity, bench.go:29-31).  Engines: rego | cel | all — plus the
-TPU-native addition ``tpu`` which drives the batched verdict-grid path
-(query_batch) instead of the per-review loop.
+for P99 validity, bench.go:29-31).  Engines: rego | cel | all — plus two
+TPU-native additions: ``tpu`` drives the batched verdict-grid path
+(query_batch) instead of the per-review loop, and ``sweep`` drives the
+full audit-sweep lane (AuditManager + ShardedEvaluator) through the
+staged host pipeline (``--pipeline``), reporting the per-stage breakdown.
+Both device engines report the lowering fallback fraction — templates
+silently losing the device speedup are visible here.
 """
 
 from __future__ import annotations
@@ -42,10 +46,14 @@ class BenchResult:
     p90_ms: float = 0.0
     p99_ms: float = 0.0
     violations: int = 0
+    # device engines only (tpu/sweep): lowering coverage + the sweep
+    # engine's per-stage pipeline breakdown (None for rego/cel/all)
+    lowering: dict = None
+    pipeline: dict = None
 
     def to_dict(self) -> dict:
         return {k: (round(v, 4) if isinstance(v, float) else v)
-                for k, v in self.__dict__.items()}
+                for k, v in self.__dict__.items() if v is not None}
 
 
 def _drivers_for(engine: str):
@@ -53,12 +61,13 @@ def _drivers_for(engine: str):
         return [RegoDriver()]
     if engine == "cel":
         return [CELDriver()]
-    if engine == "tpu":
+    if engine in ("tpu", "sweep"):
         return [TpuDriver(cel_driver=CELDriver())]
     return [RegoDriver(), CELDriver()]  # all
 
 
-def run_bench(objs, engine: str, iterations: int) -> BenchResult:
+def run_bench(objs, engine: str, iterations: int,
+              pipeline: str = "auto") -> BenchResult:
     templates = [o for o in objs if reader.is_template(o)]
     constraints = [o for o in objs if reader.is_constraint(o)]
     data = [o for o in objs
@@ -98,6 +107,9 @@ def run_bench(objs, engine: str, iterations: int) -> BenchResult:
         if not _reader.is_admission_review(d):
             client.add_data(d)
     r.setup_data_s = time.perf_counter() - t0
+
+    if engine == "sweep":
+        return _run_sweep_bench(r, client, data, iterations, pipeline)
 
     from gatekeeper_tpu.target.review import AugmentedReview
     from gatekeeper_tpu.webhook.policy import parse_admission_review
@@ -145,11 +157,64 @@ def run_bench(objs, engine: str, iterations: int) -> BenchResult:
 
     r.reviews_per_sec = (total_reviews / r.total_eval_s
                          if r.total_eval_s else 0.0)
+    _fill_latencies(r, latencies)
+    r.violations = violations
+    if engine == "tpu":
+        tpu = next((d for d in client.drivers
+                    if hasattr(d, "lowering_stats")), None)
+        if tpu is not None:
+            r.lowering = tpu.lowering_stats()
+    return r
+
+
+def _fill_latencies(r: BenchResult, latencies: list) -> None:
     if latencies:
         qs = statistics.quantiles(latencies, n=100, method="inclusive") if (
             len(latencies) > 1) else [latencies[0]] * 99
         r.p50_ms, r.p90_ms, r.p99_ms = qs[49], qs[89], qs[98]
+
+
+def _run_sweep_bench(r: BenchResult, client: Client, data: list,
+                     iterations: int, pipeline: str) -> BenchResult:
+    """The ``sweep`` engine: the production audit lane (AuditManager +
+    ShardedEvaluator) over the fixture's data objects, scheduled through
+    the staged host pipeline per ``--pipeline``.  One latency sample per
+    full sweep; the per-stage breakdown of the last pipelined sweep rides
+    the result."""
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+    from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+
+    tpu = next((d for d in client.drivers
+                if hasattr(d, "lowering_stats")), None)
+    corpus = [o for o in data if not reader.is_admission_review(o)]
+    r.objects = len(corpus)
+    mgr = AuditManager(
+        client, lister=lambda: iter(corpus),
+        config=AuditConfig(pipeline=pipeline),
+        evaluator=ShardedEvaluator(tpu, make_mesh()),
+    )
+    latencies = []
+    violations = 0
+    if corpus:
+        mgr.audit()  # warmup: vocab + per-bucket jit compile
+        t_all0 = time.perf_counter()
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            run = mgr.audit()
+            latencies.append((time.perf_counter() - t0) * 1000)
+            violations = sum(run.total_violations.values())
+        r.total_eval_s = time.perf_counter() - t_all0
+    total_reviews = iterations * len(corpus)
+    r.reviews_per_sec = (total_reviews / r.total_eval_s
+                         if r.total_eval_s else 0.0)
+    _fill_latencies(r, latencies)
     r.violations = violations
+    if tpu is not None:
+        r.lowering = tpu.lowering_stats()
+    stats = dict(mgr.pipe_stats) if mgr.pipe_stats else {}
+    stats["schedule"] = ("pipelined" if mgr.perf.get("pipelined")
+                        else "serial")
+    r.pipeline = stats
     return r
 
 
@@ -172,6 +237,24 @@ def format_text(results: list) -> str:
             f"P99={r.p99_ms:.3f}ms"
         )
         lines.append(f"  violations (last pass): {r.violations}")
+        if r.lowering is not None:
+            lo = r.lowering
+            lines.append(
+                f"  lowering: {lo['lowered']}/{lo['templates']} templates "
+                f"on the device verdict path "
+                f"({lo['fallback_fraction'] * 100:.1f}% interpreter "
+                f"fallback)"
+            )
+            for kind, why in sorted(lo.get("fallback_kinds", {}).items()):
+                lines.append(f"    fallback {kind}: {why}")
+        if r.pipeline is not None:
+            lines.append(f"  pipeline: schedule={r.pipeline.get('schedule')}")
+            for name, s in (r.pipeline.get("stages") or {}).items():
+                lines.append(
+                    f"    stage {name}: busy={s['busy_s']:.3f}s "
+                    f"occupancy={s['occupancy'] * 100:.0f}% "
+                    f"queue_hw={s['queue_highwater']}"
+                )
     return "\n".join(lines)
 
 
@@ -179,9 +262,16 @@ def run_cli(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="gator bench")
     p.add_argument("--filename", "-f", action="append", default=[])
     p.add_argument("--engine", default="all",
-                   choices=["rego", "cel", "all", "tpu"])
+                   choices=["rego", "cel", "all", "tpu", "sweep"])
     p.add_argument("--iterations", "-n", type=int, default=10)
     p.add_argument("--output", "-o", default="", choices=["", "json"])
+    p.add_argument("--pipeline", default="auto",
+                   choices=["auto", "on", "off", "differential"],
+                   help="sweep-engine schedule: staged host pipeline "
+                        "(on/auto) vs serial eager-poll (off; auto "
+                        "degrades to serial on one-core hosts); "
+                        "differential runs both and asserts bit-identical "
+                        "output")
     args = p.parse_args(argv)
 
     try:
@@ -198,7 +288,8 @@ def run_cli(argv: list[str]) -> int:
     results = []
     for engine in engines:
         try:
-            results.append(run_bench(objs, engine, args.iterations))
+            results.append(run_bench(objs, engine, args.iterations,
+                                     pipeline=args.pipeline))
         except Exception as e:
             print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
             return 1

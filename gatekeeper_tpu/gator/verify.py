@@ -39,6 +39,9 @@ class CaseResult:
     error: str = ""
     skipped: bool = False
     duration_s: float = 0.0
+    # rego print() output captured during this case (reference: the
+    # PrintHook the verify runner wires into the driver, SURVEY §2.8)
+    prints: list = field(default_factory=list)
 
 
 @dataclass
@@ -162,6 +165,11 @@ def run_suite(path: str, filter_re: Optional[str] = None) -> SuiteResult:
                 cr.skipped = True
                 continue
             t0 = time.perf_counter()
+            # capture rego print() output for this case only (the hook is
+            # a contextvar: concurrent evaluation elsewhere is unaffected)
+            from gatekeeper_tpu.lang.rego import builtins as _builtins
+
+            tok = _builtins.set_print_hook(cr.prints.append)
             try:
                 results = _run_case(client, case, base, expander_objs)
                 err = _assert_case(case.get("assertions"), results)
@@ -169,6 +177,8 @@ def run_suite(path: str, filter_re: Optional[str] = None) -> SuiteResult:
                     cr.error = err
             except Exception as e:
                 cr.error = str(e)
+            finally:
+                _builtins.reset_print_hook(tok)
             cr.duration_s = time.perf_counter() - t0
     return sr
 
@@ -272,7 +282,11 @@ def print_result(sr: SuiteResult, out=sys.stdout) -> None:
         for c in t.cases:
             if c.skipped:
                 out.write(f"    --- SKIP: {t.name}/{c.name}\n")
-            elif c.error:
+                continue
+            for line in getattr(c, "prints", []):
+                # go-test idiom: print output interleaves above the verdict
+                out.write(f"        print: {line}\n")
+            if c.error:
                 out.write(f"    --- FAIL: {t.name}/{c.name} "
                           f"({c.duration_s:.3f}s)\n        {c.error}\n")
             else:

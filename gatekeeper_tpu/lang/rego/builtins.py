@@ -9,11 +9,12 @@ rely on undefined-propagation, which OPA applies for type errors when
 
 from __future__ import annotations
 
+import contextvars
 import fnmatch
 import json
 import math
 import re
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 from gatekeeper_tpu.lang.rego.value import (
     UNDEFINED,
@@ -839,3 +840,59 @@ def _all(coll):
     if isinstance(coll, (list, tuple, RegoSet)):
         return all(v is True for v in coll)
     return UNDEFINED
+
+
+# --- print (reference: topdown print.Hook, wired by gator verify) ---------
+#
+# OPA's print() is a debugging statement: it NEVER affects evaluation (the
+# compiler rewrites it so undefined args print as `<undefined>` and the
+# expression always succeeds).  The interpreter special-cases the call
+# (interp._eval_call) for the undefined-arg tolerance; this module owns the
+# sink.  A contextvar (not a global) scopes the hook to the evaluating
+# thread/context, so a gator verify run capturing prints cannot leak
+# another thread's webhook evaluation output into its suite report.
+
+_PRINT_HOOK: contextvars.ContextVar = contextvars.ContextVar(
+    "rego_print_hook", default=None)
+
+
+def set_print_hook(hook: Optional[Callable[[str], None]]):
+    """Install a print sink for the current context; returns a token for
+    :func:`reset_print_hook`.  ``None`` disables (the gatekeeper default:
+    print output is dropped unless a harness asks for it — reference
+    PrintEnabled is only set by gator verify)."""
+    return _PRINT_HOOK.set(hook)
+
+
+def reset_print_hook(token) -> None:
+    _PRINT_HOOK.reset(token)
+
+
+def print_message(args) -> None:
+    """Format + deliver one print() call's arguments to the active hook
+    (no-op without one).  Strings print raw, everything else as JSON —
+    OPA's print formatting."""
+    hook = _PRINT_HOOK.get()
+    if hook is None:
+        return
+    parts = []
+    for a in args:
+        if a is UNDEFINED:
+            parts.append("<undefined>")
+        elif isinstance(a, str):
+            parts.append(a)
+        else:
+            try:
+                parts.append(json.dumps(to_json(a), sort_keys=True,
+                                        separators=(",", ":")))
+            except (TypeError, ValueError):
+                parts.append(str(a))
+    hook(" ".join(parts))
+
+
+@builtin("print")
+def _print(*args):
+    # function-position fallback (the interpreter's statement special-case
+    # normally intercepts first): deliver and succeed
+    print_message(args)
+    return True

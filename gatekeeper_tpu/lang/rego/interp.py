@@ -617,6 +617,23 @@ class _Ctx:
         if term.op == "walk":
             yield from self._eval_walk(mod, term, env)
             return
+        # `print` is a debugging statement (OPA compiler rewrite
+        # semantics): it ALWAYS succeeds and an undefined argument prints
+        # as `<undefined>` instead of making the enclosing body undefined
+        # — so it cannot be routed through the strict arg-evaluation
+        # below.  Output goes to the builtins print hook (gator verify).
+        if term.op == "print" and "print" not in mod.rules \
+                and self._resolve_function(mod, "print")[0] is None:
+            from gatekeeper_tpu.lang.rego.builtins import (UNDEFINED as _UD,
+                                                           print_message)
+
+            vals = []
+            for at in term.args:
+                got = next(self.eval_term(mod, at, env), None)
+                vals.append(_UD if got is None else got[0])
+            print_message(vals)
+            yield True, env
+            return
         # resolve user-defined functions first (local, then data.*)
         fn_rule, fn_mod = self._resolve_function(mod, term.op)
         for args, env2 in self._eval_args(mod, term.args, env):

@@ -137,3 +137,17 @@ MUTATOR_INGESTION = "mutator_ingestion_count"
 MUTATOR_CONFLICTS = "mutator_conflicting_count"
 SYNC = "sync"
 WATCH_GVKS = "watch_manager_watched_gvk"
+# staged host-pipeline instrumentation (pipeline/executor.py via the
+# audit manager): per-stage busy seconds / occupancy (busy over pipeline
+# wall) / input-queue depth high-water, all labelled {stage=...}, plus
+# the device-idle proxy (1 - head-of-line device wait / wall)
+PIPELINE_STAGE_SECONDS = "audit_pipeline_stage_seconds"
+PIPELINE_STAGE_OCCUPANCY = "audit_pipeline_stage_occupancy"
+PIPELINE_QUEUE_HIGHWATER = "audit_pipeline_queue_depth_highwater"
+PIPELINE_DEVICE_IDLE = "audit_pipeline_device_idle_fraction"
+# TPU lowering coverage: templates whose compile lowered onto the device
+# verdict path vs templates that fell back to the exact interpreter
+# (labelled {kind=..., engine=rego|cel}); a user template silently losing
+# the device speedup shows up here and in `gator bench` output
+LOWERING_LOWERED = "lowering_lowered_count"
+LOWERING_FALLBACK = "lowering_fallback_count"

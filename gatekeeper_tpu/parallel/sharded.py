@@ -21,6 +21,7 @@ LimitQueue merge at pkg/audit/manager.go:886-945).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -504,6 +505,27 @@ class _PendingSweep:
         self.return_bits = return_bits
 
 
+class _FlatChunk:
+    """A host-flattened (not yet dispatched) sweep chunk — the hand-off
+    unit between the pipeline's flatten stage (GIL-released C columnizer)
+    and the dispatch stage (masks + wire pack + device_put + jit call)."""
+
+    __slots__ = ("by_kind", "kinds", "cols", "batch", "objects", "any_gen",
+                 "n", "pad_n", "return_bits")
+
+    def __init__(self, by_kind, kinds, cols, batch, objects, any_gen, n,
+                 pad_n, return_bits):
+        self.by_kind = by_kind
+        self.kinds = kinds
+        self.cols = cols
+        self.batch = batch
+        self.objects = objects
+        self.any_gen = any_gen
+        self.n = n
+        self.pad_n = pad_n
+        self.return_bits = return_bits
+
+
 class ShardedEvaluator:
     """Runs a TpuDriver's compiled programs over a device mesh.
 
@@ -528,11 +550,15 @@ class ShardedEvaluator:
         self._bucket = 2
         # per-phase wall-clock totals (seconds), reset via perf_reset():
         # flatten / masks / wire_pack / dispatch (device_put + jit call) /
-        # collect (device->host) — published by bench.py
+        # collect (device->host) — published by bench.py.  The lock makes
+        # accumulation safe under the staged pipeline, where flatten /
+        # dispatch / collect run on different stage threads.
         self.perf: dict = {}
+        self._perf_lock = threading.Lock()
 
     def _perf_add(self, phase: str, dt: float) -> None:
-        self.perf[phase] = self.perf.get(phase, 0.0) + dt
+        with self._perf_lock:
+            self.perf[phase] = self.perf.get(phase, 0.0) + dt
 
     def perf_reset(self) -> None:
         self.perf = {}
@@ -760,7 +786,21 @@ class ShardedEvaluator:
         """Flatten + dispatch without fetching: jit dispatch is async, so
         the caller can flatten/submit the NEXT chunk while the device works
         (the pipeline-parallel fix for the reference's fully-sequential
-        spill-review loop, SURVEY.md §2.9)."""
+        spill-review loop, SURVEY.md §2.9).
+
+        Composed of the two pipeline stages — :meth:`sweep_flatten` (host
+        columnize) then :meth:`sweep_dispatch` (masks/wire/device) — so
+        the serial schedule and the staged pipeline run the exact same
+        code."""
+        return self.sweep_dispatch(
+            self.sweep_flatten(constraints, objects, return_bits))
+
+    def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
+                      return_bits: bool = False):
+        """Pipeline stage 1 (host, GIL-released C columnizer): schema
+        union + flatten + column pack/slim.  Returns a :class:`_FlatChunk`
+        for :meth:`sweep_dispatch`, or {} when no kind is lowered (the
+        caller's fallback lane handles everything)."""
         by_kind: dict[str, list] = {}
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
@@ -782,8 +822,6 @@ class ShardedEvaluator:
         for k, v in fl.perf.items():  # sub-phases of the flatten above
             self._perf_add("fl_" + k, v)
 
-        from gatekeeper_tpu.ir import masks as masks_mod
-
         cols = pack_batch_cols(batch)
         # transfer slimming: ship only the array fields some program reads
         cols = slim_cols(cols, self._needs_union(lowered, fl.alias))
@@ -796,7 +834,24 @@ class ShardedEvaluator:
             any_gen = any(
                 "generateName" in (o.get("metadata") or {})
                 for o in objects)
-        kinds = tuple(sorted(lowered))
+        return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
+                          objects, any_gen, n, pad_n, return_bits)
+
+    def sweep_dispatch(self, flat):
+        """Pipeline stage 2 (host->device): match masks + param tables +
+        wire packing + sharded device_put + async jit dispatch.  Accepts
+        :meth:`sweep_flatten`'s output; {} passes through (empty submit)."""
+        if not isinstance(flat, _FlatChunk):
+            return flat if isinstance(flat, dict) else {}
+        from gatekeeper_tpu.ir import masks as masks_mod
+
+        by_kind = flat.by_kind
+        kinds = flat.kinds
+        batch = flat.batch
+        objects = flat.objects
+        cols = flat.cols
+        any_gen = flat.any_gen
+        n, pad_n, return_bits = flat.n, flat.pad_n, flat.return_bits
         k = self.violations_limit
         tables = []
         mask_rows = []

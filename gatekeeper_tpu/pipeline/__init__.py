@@ -1,0 +1,43 @@
+"""Staged host-pipeline executor (the audit sweep's overlap plane).
+
+See :mod:`gatekeeper_tpu.pipeline.executor` for the dataflow engine and
+:func:`resolve_schedule` for the serial-fallback policy (one-core hosts
+and ``--pipeline=off`` keep the eager-poll serial schedule).
+"""
+
+from gatekeeper_tpu.pipeline.executor import (  # noqa: F401
+    PipelineError,
+    PipelineRun,
+    Stage,
+    StagedPipeline,
+    StageStats,
+    effective_cpu_count,
+)
+
+PIPELINE_MODES = ("auto", "on", "off", "differential")
+
+
+def resolve_schedule(mode: str, device_capable: bool,
+                     cpu_count=None) -> str:
+    """Pick the sweep schedule: 'serial', 'pipelined', or 'differential'.
+
+    - ``off`` (or a non-device-capable evaluator) -> serial always.
+    - ``auto`` -> pipelined only when the host has >1 effective core
+      (the round-5 lesson: stage threads on a one-core host thrash the
+      GIL and DOUBLE flatten wall time; the serial eager-poll schedule
+      is strictly better there).
+    - ``on`` -> pipelined regardless of core count (tests, experiments).
+    - ``differential`` -> run BOTH schedules and assert bit-identical
+      output (totals, kept order, rendered messages).
+    """
+    if mode not in PIPELINE_MODES:
+        raise ValueError(
+            f"pipeline mode {mode!r} not in {PIPELINE_MODES}")
+    if not device_capable or mode == "off":
+        return "serial"
+    if mode == "auto":
+        n = effective_cpu_count() if cpu_count is None else cpu_count
+        return "pipelined" if n > 1 else "serial"
+    if mode == "on":
+        return "pipelined"
+    return "differential"

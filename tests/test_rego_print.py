@@ -1,0 +1,119 @@
+"""Rego print() builtin: hook capture, undefined-arg tolerance, and the
+gator verify wiring (reference: PrintEnabled/PrintHook in the verify
+runner, SURVEY.md §2.8)."""
+
+import os
+import textwrap
+
+import yaml
+
+from gatekeeper_tpu.gator.verify import print_result, run_suite
+from gatekeeper_tpu.lang.rego import builtins as rego_builtins
+
+TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8sprintprobe"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "K8sPrintProbe"}}},
+        "targets": [{
+            "target": "admission.k8s.gatekeeper.sh",
+            "rego": textwrap.dedent("""
+                package k8sprintprobe
+                violation[{"msg": msg}] {
+                  print("inspecting", input.review.object.metadata.name)
+                  print("labels:", input.review.object.metadata.labels)
+                  print("absent:", input.review.object.metadata.annotations.missing)
+                  not input.review.object.metadata.labels.owner
+                  msg := "missing owner label"
+                }
+            """),
+        }],
+    },
+}
+
+CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sPrintProbe",
+    "metadata": {"name": "need-owner"},
+    "spec": {},
+}
+
+BAD_POD = {
+    "apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "orphan", "namespace": "default",
+                 "labels": {"app": "x"}},
+}
+
+
+def _write_suite(tmp_path):
+    def dump(name, obj):
+        p = os.path.join(tmp_path, name)
+        with open(p, "w") as f:
+            yaml.safe_dump(obj, f)
+        return name
+
+    suite = {
+        "apiVersion": "test.gatekeeper.sh/v1alpha1",
+        "kind": "Suite",
+        "metadata": {"name": "print-suite"},
+        "tests": [{
+            "name": "print-probe",
+            "template": dump("template.yaml", TEMPLATE),
+            "constraint": dump("constraint.yaml", CONSTRAINT),
+            "cases": [{
+                "name": "missing-owner",
+                "object": dump("bad.yaml", BAD_POD),
+                "assertions": [{"violations": 1}],
+            }],
+        }],
+    }
+    path = os.path.join(tmp_path, "suite.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(suite, f)
+    return path
+
+
+def test_verify_captures_print_output(tmp_path):
+    sr = run_suite(_write_suite(str(tmp_path)))
+    assert not sr.failed(), [
+        (t.name, t.error, [(c.name, c.error) for c in t.cases])
+        for t in sr.tests]
+    case = sr.tests[0].cases[0]
+    assert "inspecting orphan" in case.prints
+    # non-string args format as JSON; undefined args print <undefined>
+    # instead of making the rule body undefined (the violation still fired)
+    assert 'labels: {"app":"x"}' in case.prints
+    assert "absent: <undefined>" in case.prints
+
+    import io
+
+    out = io.StringIO()
+    print_result(sr, out=out)
+    text = out.getvalue()
+    assert "print: inspecting orphan" in text
+    assert "--- PASS: print-probe/missing-owner" in text
+
+
+def test_print_hook_is_context_scoped():
+    """Without a hook, print() is a silent no-op that still succeeds;
+    a hook reset stops capture (webhook threads never observe a verify
+    run's hook — the contextvar scopes it)."""
+    import contextvars
+
+    captured = []
+    tok = rego_builtins.set_print_hook(captured.append)
+    try:
+        rego_builtins.print_message(["direct"])
+    finally:
+        rego_builtins.reset_print_hook(tok)
+    assert captured == ["direct"]
+
+    # after the reset the context has no hook: drops silently
+    rego_builtins.print_message(["dropped"])
+    assert captured == ["direct"]
+
+    # a copied context made while no hook is set never captures
+    contextvars.copy_context().run(
+        lambda: rego_builtins.print_message(["dropped-too"]))
+    assert captured == ["direct"]
