@@ -42,18 +42,31 @@ PIPELINE_MODE = "auto"
 
 
 def _parse_pipeline_flag(argv: list) -> list:
-    """Strip --pipeline[=mode] from argv (the remaining args stay
-    positional: N [chunk] | sweep [N [chunk]])."""
+    """Strip --pipeline[=mode] and --chaos[=spec.json] from argv (the
+    remaining args stay positional: N [chunk] | sweep [N [chunk]]).
+    --chaos installs the fault-injection plan process-wide so a bench run
+    doubles as a deterministic chaos run (the resilience metrics and the
+    run's incomplete/retried counters land in the JSON artifact)."""
     global PIPELINE_MODE
     out = []
+    chaos = ""
     it = iter(argv)
     for a in it:
         if a == "--pipeline":
             PIPELINE_MODE = next(it, "auto")
         elif a.startswith("--pipeline="):
             PIPELINE_MODE = a.split("=", 1)[1]
+        elif a == "--chaos":
+            chaos = next(it, "")
+        elif a.startswith("--chaos="):
+            chaos = a.split("=", 1)[1]
         else:
             out.append(a)
+    if chaos:
+        from gatekeeper_tpu.resilience import faults
+
+        faults.install(faults.load_chaos_spec(chaos))
+        log(f"chaos harness active: {chaos}")
     return out
 
 

@@ -75,6 +75,15 @@ class AuditConfig:
     # freed as the device drains the queue, so a deep window costs
     # little HBM.
     submit_window: int = 64
+    # resilience (resilience/policy.py): a chunk whose submit/collect/fold
+    # raises is re-submitted up to chunk_retries times, then SKIPPED —
+    # the run finishes with partial results and an explicit `incomplete`
+    # marker instead of aborting the pass.  Stage workers in the
+    # pipelined schedule restart and re-run their item up to
+    # pipeline_stage_retries times; past that the executor aborts and
+    # the sweep degrades to the serial schedule mid-pass.
+    chunk_retries: int = 1
+    pipeline_stage_retries: int = 1
 
 
 @dataclass
@@ -97,6 +106,13 @@ class AuditRun:
     total_violations: dict = field(default_factory=dict)  # (kind,name) -> int
     kept: dict = field(default_factory=dict)  # (kind,name) -> list[Violation]
     duration_s: float = 0.0
+    # partial-result marker: True when any chunk was dropped after
+    # exhausting its retries or the lister died mid-sweep — totals/kept
+    # then UNDERCOUNT and downstream consumers (status writeback, export,
+    # `--once` output) see the run flagged instead of silently short
+    incomplete: bool = False
+    failed_chunks: int = 0
+    retried_chunks: int = 0
 
 
 def _sweep_ready(pending) -> bool:
@@ -213,22 +229,53 @@ class AuditManager:
             # serial is the reference schedule; the pipelined pass must
             # reproduce it bit-for-bit (totals, kept order, messages)
             self._sweep_serial(constraints, kind_filter, use_router,
-                               device, kept, totals, limit, counter)
+                               device, kept, totals, limit, counter, run)
             kept_p: dict = {k: [] for k in kept}
             totals_p: dict = {k: 0 for k in totals}
             self._sweep_pipelined(constraints, kind_filter, use_router,
-                                  kept_p, totals_p, limit, [0])
+                                  kept_p, totals_p, limit, [0], run)
             diff = self._schedules_differ(kept, totals, kept_p, totals_p)
             if diff:
                 raise RuntimeError(
                     f"pipeline differential mismatch: {diff}")
             self.perf["pipeline_differential_ok"] = 1.0
         elif schedule == "pipelined":
-            self._sweep_pipelined(constraints, kind_filter, use_router,
-                                  kept, totals, limit, counter)
+            try:
+                self._sweep_pipelined(constraints, kind_filter, use_router,
+                                      kept, totals, limit, counter, run)
+            except Exception as e:
+                # graceful degradation: a pipeline whose stage kept
+                # crashing past its restart budget aborts cleanly — the
+                # sweep reruns on the one-thread serial schedule instead
+                # of losing the pass (chunks re-list from the source, so
+                # nothing is dropped)
+                from gatekeeper_tpu.utils.logging import log_event
+
+                log_event("warning",
+                          "pipelined sweep failed; degrading to the "
+                          "serial schedule",
+                          event_type="audit_degraded", error=str(e))
+                if self.metrics is not None:
+                    from gatekeeper_tpu.metrics import registry as M
+
+                    self.metrics.inc_counter(
+                        M.RESILIENCE_DEGRADED,
+                        {"component": "audit", "to": "serial"})
+                for k in kept:
+                    kept[k] = []
+                for k in totals:
+                    totals[k] = 0
+                counter[0] = 0
+                self.pipe_stats = None
+                self.perf["pipelined"] = 0.0
+                self.perf["degraded_to_serial"] = (
+                    self.perf.get("degraded_to_serial", 0.0) + 1.0)
+                self._sweep_serial(constraints, kind_filter, use_router,
+                                   device, kept, totals, limit, counter,
+                                   run)
         else:
             self._sweep_serial(constraints, kind_filter, use_router,
-                               device, kept, totals, limit, counter)
+                               device, kept, totals, limit, counter, run)
         run.total_objects = counter[0]
 
         run.total_violations = totals
@@ -297,7 +344,7 @@ class AuditManager:
 
     # --- serial schedule (eager-poll, the one-core-safe path) ------------
     def _sweep_serial(self, constraints, kind_filter, use_router, device,
-                      kept, totals, limit, counter):
+                      kept, totals, limit, counter, run=None):
         """Eager-poll pipelined chunking on ONE thread: the host lists +
         flattens + dispatches chunks (jit dispatch is async, so the device
         drains the queue while the host keeps flattening); after each
@@ -353,19 +400,81 @@ class AuditManager:
                                       name="audit-drain-waiter")
             waiter.start()
 
+        retries = max(0, getattr(self.config, "chunk_retries", 1))
+
+        def chunk_failed(exc, phase):
+            """Retry budget exhausted: skip the chunk, flag the run."""
+            if run is not None:
+                run.failed_chunks += 1
+                run.incomplete = True
+            from gatekeeper_tpu.utils.logging import log_event
+
+            log_event("warning",
+                      "audit chunk dropped after exhausting retries",
+                      event_type="audit_chunk_failed", phase=phase,
+                      error=str(exc))
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as M
+
+                self.metrics.inc_counter(M.RESILIENCE_CHUNKS_FAILED)
+
+        def chunk_retry(exc, phase):
+            if run is not None:
+                run.retried_chunks += 1
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as M
+
+                self.metrics.inc_counter(M.RESILIENCE_RETRIES,
+                                         {"dependency": "audit_chunk"})
+
         def fold_oldest():
+            # retry covers the non-mutating phases ONLY (submit/collect):
+            # once the fold touches kept/totals a re-run would double
+            # count, so a fold failure drops the chunk instead
             pending, objs, cons = window.popleft()
-            swept = self.evaluator.sweep_collect(pending)
-            t0 = time.perf_counter()
-            self._process_swept(swept, objs, cons, kept, totals, limit)
-            self.perf["fold_render"] = (
-                self.perf.get("fold_render", 0.0)
-                + time.perf_counter() - t0)
+            last = None
+            swept = None
+            for attempt in range(retries + 1):
+                try:
+                    if attempt > 0:
+                        # a failed collect can't be re-fetched: the whole
+                        # chunk re-submits through flatten/dispatch
+                        chunk_retry(last, "collect")
+                        pending = self.evaluator.sweep_submit(
+                            cons, objs,
+                            return_bits=self.config.exact_totals)
+                    swept = self.evaluator.sweep_collect(pending)
+                    break
+                except Exception as e:  # noqa: PERF203
+                    last = e
+            else:
+                chunk_failed(last, "collect")
+                return
+            try:
+                t0 = time.perf_counter()
+                self._process_swept(swept, objs, cons, kept, totals, limit)
+                self.perf["fold_render"] = (
+                    self.perf.get("fold_render", 0.0)
+                    + time.perf_counter() - t0)
+            except Exception as e:
+                chunk_failed(e, "fold")
 
         def submit(objects, cons):
             if device:
-                pending = self.evaluator.sweep_submit(
-                    cons, objects, return_bits=self.config.exact_totals)
+                last = None
+                for attempt in range(retries + 1):
+                    try:
+                        if attempt > 0:
+                            chunk_retry(last, "submit")
+                        pending = self.evaluator.sweep_submit(
+                            cons, objects,
+                            return_bits=self.config.exact_totals)
+                        break
+                    except Exception as e:  # noqa: PERF203
+                        last = e
+                else:
+                    chunk_failed(last, "submit")
+                    return
                 window.append((pending, objects, cons))
                 if waitq is not None and \
                         getattr(pending, "result", None) is not None:
@@ -376,11 +485,51 @@ class AuditManager:
                         self.perf.get("n_eager_collects", 0) + 1)
                     fold_oldest()
             else:
-                self._audit_chunk(objects, cons, kept, totals, limit)
+                # interpreter lane: evaluate into CHUNK-LOCAL dicts and
+                # merge only on success, so a mid-chunk failure (and its
+                # retry) can never double count
+                last = None
+                for attempt in range(retries + 1):
+                    try:
+                        if attempt > 0:
+                            chunk_retry(last, "interp")
+                        kept_c = {c.key(): [] for c in cons}
+                        totals_c = {c.key(): 0 for c in cons}
+                        self._audit_chunk(objects, cons, kept_c, totals_c,
+                                          limit)
+                        for key, n in totals_c.items():
+                            totals[key] += n
+                        for key, vs in kept_c.items():
+                            for v in vs:
+                                if len(kept[key]) < limit:
+                                    kept[key].append(v)
+                        return
+                    except Exception as e:  # noqa: PERF203
+                        last = e
+                chunk_failed(last, "interp")
 
         try:
-            for objs, cons in self._chunk_source(constraints, kind_filter,
-                                                 use_router, counter):
+            src = iter(self._chunk_source(constraints, kind_filter,
+                                          use_router, counter))
+            while True:
+                try:
+                    objs, cons = next(src)
+                except StopIteration:
+                    break
+                except Exception as e:
+                    # the lister died mid-iteration — a generator cannot
+                    # resume, so finish with what was listed and mark the
+                    # pass incomplete instead of aborting it
+                    if run is not None:
+                        run.incomplete = True
+                    from gatekeeper_tpu.utils.logging import log_event
+
+                    log_event("warning",
+                              "audit lister failed mid-sweep; finishing "
+                              "with partial results",
+                              event_type="audit_lister_failed",
+                              error=str(e))
+                    break
                 submit(objs, cons)
             while window:  # drain: blocking collect of the tail chunks
                 fold_oldest()
@@ -394,7 +543,7 @@ class AuditManager:
 
     # --- pipelined schedule (staged executor) ----------------------------
     def _sweep_pipelined(self, constraints, kind_filter, use_router,
-                         kept, totals, limit, counter):
+                         kept, totals, limit, counter, run=None):
         """Staged host pipeline: ``list -> flatten -> dispatch -> collect
         -> fold_render`` with one thread per stage and bounded inter-stage
         queues (pipeline/executor.py).  Chunk K's flatten (GIL-released C
@@ -446,16 +595,33 @@ class AuditManager:
         fw = cfg.pipeline_flatten_workers
         if fw <= 0:  # auto: a second flatten worker once cores allow it
             fw = 2 if effective_cpu_count() >= 4 else 1
+        # crashed-worker restarts: flatten/dispatch/collect re-run their
+        # item (idempotent, no run state touched); fold_render mutates
+        # kept/totals so it gets NO retry budget — its failure aborts the
+        # pipeline and the sweep degrades to the serial schedule
+        sr = max(0, getattr(cfg, "pipeline_stage_retries", 1))
         pipe = StagedPipeline([
             Stage("flatten", fl, workers=fw,
-                  queue_cap=cfg.pipeline_queue_cap),
-            Stage("dispatch", disp, queue_cap=cfg.pipeline_queue_cap),
+                  queue_cap=cfg.pipeline_queue_cap, max_retries=sr),
+            Stage("dispatch", disp, queue_cap=cfg.pipeline_queue_cap,
+                  max_retries=sr),
             Stage("collect", coll,
-                  queue_cap=max(1, cfg.submit_window)),
+                  queue_cap=max(1, cfg.submit_window), max_retries=sr),
             Stage("fold_render", fold, queue_cap=cfg.pipeline_queue_cap),
         ], source_cap=cfg.pipeline_queue_cap)
         pr = pipe.run(self._chunk_source(constraints, kind_filter,
                                          use_router, counter))
+        n_retries = sum(s.retries for s in pr.stages)
+        if n_retries:
+            if run is not None:
+                run.retried_chunks += n_retries
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as M
+
+                self.metrics.inc_counter(
+                    M.RESILIENCE_RETRIES,
+                    {"dependency": "audit_pipeline"},
+                    value=float(n_retries))
         stats = pr.summary()
         # device-idle proxy: the collect stage blocks exactly while the
         # device (or wire) is still producing the head-of-line result;
@@ -503,6 +669,8 @@ class AuditManager:
 
         self.metrics.observe(M.AUDIT_DURATION, run.duration_s)
         self.metrics.set_gauge(M.AUDIT_LAST_RUN, time.time())
+        self.metrics.set_gauge("audit_last_run_incomplete",
+                               1.0 if run.incomplete else 0.0)
         if not self.pipe_stats:
             return
         for name, s in self.pipe_stats.get("stages", {}).items():
@@ -744,6 +912,11 @@ class AuditManager:
             status = {
                 "auditTimestamp": run.timestamp,
                 "totalViolations": run.total_violations.get(key, 0),
+                # explicit partial-result marker (chunks were dropped
+                # after retries or the lister died): totals undercount.
+                # Only written when set so complete runs keep the
+                # reference status shape byte-for-byte
+                **({"incomplete": True} if run.incomplete else {}),
                 "violations": [
                     {
                         "message": v.message,

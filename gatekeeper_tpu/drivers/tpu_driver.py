@@ -437,6 +437,9 @@ class TpuDriver:
         responses = [QueryResponse() for _ in range(n)]
         if n == 0 or not constraints:
             return responses
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        fault_point("device.dispatch", lane="query_batch", n=n)
 
         objects = [r.request.object or {} for r in reviews]
         namespaces = [r.namespace for r in reviews]

@@ -83,15 +83,52 @@ def default_send(provider: Provider, keys: list) -> dict:
 
 
 class ProviderCache:
-    """Provider registry + response TTL cache + batched resolution."""
+    """Provider registry + response TTL cache + batched resolution.
+
+    Resilience (resilience/policy.py): each provider gets a circuit
+    breaker; transport failures retry with seeded-jitter exponential
+    backoff bounded by the ambient request deadline.  When the breaker is
+    open — or the transport keeps failing — keys present in the TTL cache
+    are served STALE (the reference's external-data TTL-cache fallback)
+    and counted in ``gatekeeper_resilience_stale_served_count``; keys
+    with no cached value surface a per-key error that flows into the
+    placeholder failure-policy semantics (Fail | Ignore | UseDefault)."""
 
     def __init__(self, send_fn: Optional[Callable] = None,
-                 response_ttl_s: float = 180.0):
+                 response_ttl_s: float = 180.0,
+                 metrics=None,
+                 retry=None,  # resilience.policy.RetryPolicy
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 30.0):
         self._providers: dict[str, Provider] = {}
         self._responses: dict[tuple, tuple] = {}  # (provider, key) -> (t, val)
         self.send_fn = send_fn or default_send
         self.response_ttl_s = response_ttl_s
+        self.metrics = metrics
+        self.breaker_threshold = breaker_threshold
+        self.breaker_reset_s = breaker_reset_s
+        if retry is None:
+            from gatekeeper_tpu.resilience.policy import RetryPolicy
+
+            retry = RetryPolicy(attempts=3, base_s=0.05, cap_s=1.0,
+                                dependency="externaldata", metrics=metrics)
+        self.retry = retry
+        self._breakers: dict[str, Any] = {}
         self._lock = threading.Lock()
+
+    def _breaker(self, provider_name: str):
+        from gatekeeper_tpu.resilience.policy import CircuitBreaker
+
+        with self._lock:
+            b = self._breakers.get(provider_name)
+            if b is None:
+                b = CircuitBreaker(
+                    f"externaldata/{provider_name}",
+                    failure_threshold=self.breaker_threshold,
+                    reset_timeout_s=self.breaker_reset_s,
+                    metrics=self.metrics)
+                self._breakers[provider_name] = b
+            return b
 
     def upsert(self, obj_or_provider) -> Provider:
         p = (obj_or_provider if isinstance(obj_or_provider, Provider)
@@ -108,8 +145,57 @@ class ProviderCache:
         return self._providers.get(name)
 
     # --- resolution (reference: system_external_data.go) ----------------
+    def _send(self, provider: Provider, keys: list) -> dict:
+        """One transport round-trip through the chaos seam.  A partial
+        fault truncates the item list (the provider 'answered' for only a
+        fraction of the keys); the missing keys surface per-key 'key not
+        returned' errors downstream."""
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        action = fault_point("externaldata.send", provider=provider.name,
+                             n_keys=len(keys))
+        resp = self.send_fn(provider, keys)
+        if action is not None and action.mode == "partial":
+            items = deep_get(resp, ("response", "items"), []) or []
+            keep = int(len(items) * action.spec.fraction)
+            resp = {"response": {
+                "items": items[:keep],
+                "systemError": deep_get(resp, ("response", "systemError"),
+                                        ""),
+            }}
+        system_error = deep_get(resp, ("response", "systemError"), "")
+        if system_error:
+            raise ProviderError(f"provider {provider.name}: {system_error}")
+        return resp
+
+    def _serve_stale(self, provider_name: str, keys: list, out: dict,
+                     reason: str) -> None:
+        """Fill ``out`` for ``keys`` from expired TTL-cache entries
+        (graceful degradation); keys never fetched get a per-key error
+        that the placeholder failure policy interprets."""
+        n_stale = 0
+        with self._lock:
+            for key in keys:
+                hit = self._responses.get((provider_name, key))
+                if hit is not None:
+                    out[key] = hit[1]
+                    n_stale += 1
+                else:
+                    out[key] = (None, f"provider {provider_name}: {reason}; "
+                                      "no cached value")
+        if n_stale and self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(
+                M.RESILIENCE_STALE_SERVED,
+                {"dependency": f"externaldata/{provider_name}"},
+                value=float(n_stale))
+
     def fetch(self, provider_name: str, keys: list) -> dict:
-        """Returns key -> (value, error-string-or-None); TTL-cached."""
+        """Returns key -> (value, error-string-or-None); TTL-cached.
+        Transport failures retry with jittered backoff (deadline-bounded);
+        a tripped breaker — or exhausted retries — serves stale cache
+        entries and per-key errors instead of raising."""
         provider = self._providers.get(provider_name)
         if provider is None:
             raise ProviderError(f"provider {provider_name!r} not found")
@@ -123,22 +209,30 @@ class ProviderCache:
                     out[key] = hit[1]
                 else:
                     missing.append(key)
-        if missing:
-            resp = self.send_fn(provider, missing)
-            items = deep_get(resp, ("response", "items"), []) or []
-            system_error = deep_get(resp, ("response", "systemError"), "")
-            if system_error:
-                raise ProviderError(
-                    f"provider {provider_name}: {system_error}")
-            got = {}
-            for item in items:
-                got[item.get("key")] = (item.get("value"),
-                                        item.get("error") or None)
-            with self._lock:
-                for key in missing:
-                    value = got.get(key, (None, "key not returned"))
-                    self._responses[(provider_name, key)] = (now, value)
-                    out[key] = value
+        if not missing:
+            return out
+        breaker = self._breaker(provider_name)
+        if not breaker.allow():
+            self._serve_stale(provider_name, missing, out,
+                              "circuit breaker open")
+            return out
+        try:
+            resp = self.retry.call(self._send, provider, missing)
+        except Exception as e:
+            breaker.record_failure()
+            self._serve_stale(provider_name, missing, out, str(e))
+            return out
+        breaker.record_success()
+        items = deep_get(resp, ("response", "items"), []) or []
+        got = {}
+        for item in items:
+            got[item.get("key")] = (item.get("value"),
+                                    item.get("error") or None)
+        with self._lock:
+            for key in missing:
+                value = got.get(key, (None, "key not returned"))
+                self._responses[(provider_name, key)] = (now, value)
+                out[key] = value
         return out
 
     def prefetch(self, pairs) -> None:

@@ -156,7 +156,30 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # JAX_PLATFORMS honored at package import (gatekeeper_tpu/__init__.py)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: gator {test|verify|expand|bench|sync|policy} [options]")
+        print("usage: gator [--chaos spec.json] "
+              "{test|verify|expand|bench|sync|policy} [options]")
+        return 0
+    # global --chaos spec.json: install the deterministic fault-injection
+    # plan before any subcommand runs (README 'Failure semantics')
+    stripped = []
+    chaos = ""
+    it = iter(argv)
+    for a in it:
+        if a == "--chaos":
+            chaos = next(it, "")
+        elif a.startswith("--chaos="):
+            chaos = a.split("=", 1)[1]
+        else:
+            stripped.append(a)
+    argv = stripped
+    if chaos:
+        from gatekeeper_tpu.resilience import faults
+
+        faults.install(faults.load_chaos_spec(chaos))
+        print(f"chaos harness active: {chaos}", file=sys.stderr)
+    if not argv:
+        print("usage: gator [--chaos spec.json] "
+              "{test|verify|expand|bench|sync|policy} [options]")
         return 0
     cmd = argv[0]
     fn = COMMANDS.get(cmd)

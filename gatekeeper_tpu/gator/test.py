@@ -118,21 +118,54 @@ def test(
                 )
         responses.stats_entries.extend(review.stats_entries)
 
+    from gatekeeper_tpu.expansion import aggregate
+
+    def review_resultants(source_obj, ns, review):
+        """Expand a (bare or request-embedded) object and aggregate its
+        resultants' reviews — the reference expands EVERY reviewed object
+        (test.go:125), including ones arriving inside AdmissionReview
+        fixtures."""
+        for resultant in expander.expand(source_obj):
+            r_au = AugmentedUnstructured(
+                object=resultant.obj, namespace=ns, source=SOURCE_GENERATED
+            )
+            r_review = client.review(
+                r_au, enforcement_point=GATOR_EP, tracing=tracing,
+                stats=stats
+            )
+            aggregate.override_enforcement_action(
+                resultant.enforcement_action, r_review
+            )
+            aggregate.aggregate_responses(
+                resultant.template_name, review, r_review
+            )
+
     for obj in objs:
         if reader.is_admission_review(obj):
             # review the embedded AdmissionRequest (operation, oldObject,
             # userInfo — the webhook's view), with the namespace resolved
             # from the fixture set exactly like the bare-object path;
-            # expansion operates on bare objects, not requests
+            # the embedded object then expands like any other (implied
+            # workload resultants reviewed as Source=Generated)
             from gatekeeper_tpu.target.review import AugmentedReview
             from gatekeeper_tpu.webhook.policy import parse_admission_review
 
             req = parse_admission_review(obj)
             ns = expander.namespace_for(req.object or req.old_object or {})
+            # snapshot BEFORE the review: the DELETE contract copies
+            # oldObject into request.object in place (target.go:269-287
+            # analog) and deleted objects must not expand; the deepcopy
+            # also keeps the expander's in-place base mutation off the
+            # fixture's request body
+            import copy
+
+            to_expand = copy.deepcopy(req.object) if req.object else None
             review = client.review(
                 AugmentedReview(admission_request=req, namespace=ns,
                                 is_admission=True),
                 enforcement_point=GATOR_EP, tracing=tracing, stats=stats)
+            if to_expand is not None:
+                review_resultants(to_expand, ns, review)
             fold_review(review, obj)
             continue
         ns = expander.namespace_for(obj)
@@ -141,21 +174,6 @@ def test(
         review = client.review(
             au, enforcement_point=GATOR_EP, tracing=tracing, stats=stats
         )
-        for resultant in expander.expand(obj):
-            r_au = AugmentedUnstructured(
-                object=resultant.obj, namespace=ns, source=SOURCE_GENERATED
-            )
-            r_review = client.review(
-                r_au, enforcement_point=GATOR_EP, tracing=tracing, stats=stats
-            )
-            from gatekeeper_tpu.expansion import aggregate
-
-            aggregate.override_enforcement_action(
-                resultant.enforcement_action, r_review
-            )
-            aggregate.aggregate_responses(
-                resultant.template_name, review, r_review
-            )
-
+        review_resultants(obj, ns, review)
         fold_review(review, obj)
     return responses

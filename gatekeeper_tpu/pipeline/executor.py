@@ -54,6 +54,20 @@ def effective_cpu_count() -> int:
     return os.cpu_count() or 1
 
 
+from gatekeeper_tpu.resilience.faults import fault_point
+
+
+def _log_stage_restart(stage: str, attempt: int, exc: BaseException) -> None:
+    try:
+        from gatekeeper_tpu.utils.logging import log_event
+
+        log_event("warning", "pipeline stage worker restarted",
+                  event_type="pipeline_worker_restart",
+                  stage=stage, attempt=attempt, error=str(exc))
+    except Exception:
+        pass
+
+
 class PipelineError(Exception):
     """A stage raised; carries the stage name, original error as __cause__."""
 
@@ -127,6 +141,7 @@ class StageStats:
     wait_s: float = 0.0   # blocked on upstream (input get)
     stall_s: float = 0.0  # blocked on downstream (output put, backpressure)
     queue_highwater: int = 0  # input channel depth high-water
+    retries: int = 0  # crashed-worker restarts that re-ran an item
 
     def occupancy(self, wall_s: float) -> float:
         """Fraction of the pipeline wall this stage spent doing work
@@ -175,6 +190,7 @@ class PipelineRun:
                     "occupancy": round(s.occupancy(self.wall_s), 3),
                     "queue_highwater": s.queue_highwater,
                     "workers": s.workers,
+                    "retries": s.retries,
                 }
                 for s in self.stages
             },
@@ -190,13 +206,20 @@ class Stage:
     limiting how far its producer may run ahead."""
 
     def __init__(self, name: str, fn: Callable[[Any], Any],
-                 workers: int = 1, queue_cap: int = 2):
+                 workers: int = 1, queue_cap: int = 2,
+                 max_retries: int = 0):
         if workers < 1:
             raise ValueError(f"stage {name}: workers must be >= 1")
         self.name = name
         self.fn = fn
         self.workers = workers
         self.queue_cap = queue_cap
+        # crashed-worker policy (resilience layer): a worker whose fn
+        # raises restarts and re-runs THE SAME item up to max_retries
+        # times before the failure aborts the pipeline — no item is ever
+        # silently dropped, and the chunk sequence downstream stages see
+        # is unchanged (the reorder buffer keys on arrival index)
+        self.max_retries = max_retries
 
 
 class _OrderedEmit:
@@ -288,11 +311,23 @@ class StagedPipeline:
                         in_ch.put(_DONE)  # release sibling workers
                         break
                     t0 = time.perf_counter()
-                    try:
-                        out = stage.fn(item)
-                    except BaseException as e:  # noqa: BLE001
-                        fail(stage.name, e)
-                        return
+                    attempt = 0
+                    while True:
+                        try:
+                            fault_point(f"pipeline.stage.{stage.name}")
+                            out = stage.fn(item)
+                            break
+                        except _Aborted:
+                            raise
+                        except BaseException as e:  # noqa: BLE001
+                            if attempt >= stage.max_retries or \
+                                    abort.is_set():
+                                fail(stage.name, e)
+                                return
+                            attempt += 1
+                            with st_locks[si]:
+                                st.retries += 1
+                            _log_stage_restart(stage.name, attempt, e)
                     busy = time.perf_counter() - t0
                     stall = emits[si].emit(
                         idx, _SKIP if out is None else out)

@@ -1,0 +1,31 @@
+"""Resilience layer: fault-injection seam + deadline/retry/breaker policies.
+
+``faults`` is the deterministic chaos seam (contextvar-scoped injection
+points threaded through the webhook, external-data, apiserver, pipeline
+and device-dispatch paths); ``policy`` is the unified failure-handling
+layer (deadline budgets, jittered exponential retry, per-dependency
+circuit breakers, graceful-degradation hooks).  Every injection, retry,
+breaker transition and deadline miss flows into the metrics registry
+(``gatekeeper_resilience_*``) and the structured log stream.
+"""
+
+from gatekeeper_tpu.resilience.faults import (  # noqa: F401
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    fault_point,
+    inject,
+    install,
+    load_chaos_spec,
+    set_metrics_registry,
+    uninstall,
+)
+from gatekeeper_tpu.resilience.policy import (  # noqa: F401
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)

@@ -122,7 +122,9 @@ class KubeCluster:
 
     def __init__(self, config: KubeConfig, page_limit: int = 500,
                  watch_backoff_s: float = 1.0,
-                 watch_timeout_s: float = 300.0):
+                 watch_timeout_s: float = 300.0,
+                 metrics=None,
+                 retry_attempts: int = 3):
         self.config = config
         self.page_limit = page_limit
         self.watch_backoff_s = watch_backoff_s
@@ -132,6 +134,17 @@ class KubeCluster:
         self._watchers: list = []
         self._stopped = threading.Event()
         self._lock = threading.RLock()
+        self.metrics = metrics
+        # transient-failure policy (resilience/policy.py): GETs (list,
+        # discovery, read-before-write) retry 5xx/429/network errors with
+        # seeded-jitter backoff bounded by the ambient deadline; writes
+        # never auto-retry here — their conflict semantics live in
+        # apply/apply_status (409 read-modify-write)
+        from gatekeeper_tpu.resilience.policy import RetryPolicy
+
+        self._retry = RetryPolicy(attempts=max(1, retry_attempts),
+                                  base_s=0.05, cap_s=1.0,
+                                  dependency="apiserver", metrics=metrics)
 
     # --- transport ---------------------------------------------------
     @staticmethod
@@ -148,8 +161,32 @@ class KubeCluster:
                                 cfg.client_key_file or None)
         return ctx
 
+    @staticmethod
+    def _transient(e: BaseException) -> bool:
+        """Retryable apiserver failure: 5xx / 429 / network errors.
+        Everything else (404, 409, 403, 410...) carries semantics the
+        callers handle themselves."""
+        if isinstance(e, KubeError):
+            return e.status >= 500 or e.status == 429
+        return isinstance(e, OSError)
+
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  timeout: float = 30.0):
+        if method == "GET":
+            return self._retry.call(
+                self._request_once, method, path, body, timeout,
+                retry_on=(KubeError, OSError),
+                giveup=lambda e: not self._transient(e))
+        return self._request_once(method, path, body, timeout)
+
+    def _request_once(self, method: str, path: str,
+                      body: Optional[dict] = None, timeout: float = 30.0):
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        fault_point(
+            "kube.request",
+            error_factory=lambda spec: KubeError(spec.status, spec.error),
+            method=method, path=path)
         url = self.config.server.rstrip("/") + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)

@@ -86,6 +86,8 @@ class ValidationHandler:
         fail_open: bool = False,
         trace_config=None,  # callable -> list of Config trace entries
         log_stats: bool = False,  # --log-stats-admission
+        deadline_budget_s: float = 0.0,  # hard per-request wall budget
+        failure_policy: Optional[str] = None,  # "ignore" | "fail"
     ):
         self.client = client
         self.expansion_system = expansion_system
@@ -95,20 +97,32 @@ class ValidationHandler:
         self.log_denies = log_denies
         self.event_sink = event_sink
         self.metrics = metrics
-        self.fail_open = fail_open
+        # failurePolicy (reference ValidatingWebhookConfiguration
+        # failurePolicy: Ignore fails open / Fail fails closed); the
+        # legacy fail_open flag maps onto it
+        if failure_policy is None:
+            failure_policy = "ignore" if fail_open else "fail"
+        if failure_policy not in ("ignore", "fail"):
+            raise ValueError(f"failure_policy must be ignore|fail, "
+                             f"got {failure_policy!r}")
+        self.failure_policy = failure_policy
+        self.fail_open = failure_policy == "ignore"
+        # deadline budget: 0 disables the guard (review runs inline on
+        # the server's handler thread, exactly the pre-resilience path)
+        self.deadline_budget_s = float(deadline_budget_s or 0.0)
         self.trace_config = trace_config
         self.log_stats = log_stats
 
     # --- the handler (reference: validationHandler.Handle, policy.go:139) -
     def handle(self, review_body: dict) -> ValidationResponse:
         if self.metrics is None:
-            return self._handle(review_body)
+            return self._guarded(review_body)
         from gatekeeper_tpu.metrics import registry as m
 
         status = "error"  # count even when _handle itself raises
         try:
             with self.metrics.timed(m.REQUEST_DURATION):
-                resp = self._handle(review_body)
+                resp = self._guarded(review_body)
             if not resp.allowed and resp.code == 500:
                 status = "error"  # internal error surfaced as Errored deny
             else:
@@ -117,6 +131,69 @@ class ValidationHandler:
         finally:
             self.metrics.inc_counter(m.REQUEST_COUNT,
                                      {"admission_status": status})
+
+    def _guarded(self, review_body: dict) -> ValidationResponse:
+        """Deadline-budget guard (reference: the apiserver's webhook
+        ``timeoutSeconds`` enforced server-side so the ANSWER — not the
+        apiserver's socket timeout — honors failurePolicy).  The review
+        runs on a helper thread with the budget propagated by contextvar
+        (dependencies bound their own waits by it); if the budget expires
+        the request resolves per failurePolicy immediately: Ignore allows
+        with a warning annotation, Fail denies with reason.  A timed-out
+        review thread finishes in the background and its result is
+        dropped."""
+        if self.deadline_budget_s <= 0:
+            return self._handle(review_body)
+        from gatekeeper_tpu.resilience.policy import Deadline, deadline_scope
+
+        dl = Deadline(self.deadline_budget_s)
+        done = threading.Event()
+        slot: dict = {}
+
+        def run():
+            try:
+                with deadline_scope(dl):
+                    slot["resp"] = self._handle(review_body)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                slot["err"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True,
+                         name="admit-deadline").start()
+        if done.wait(dl.remaining()):
+            err = slot.get("err")
+            if err is not None:
+                raise err
+            return slot["resp"]
+        uid = ((review_body.get("request") or {}).get("uid", "")) or ""
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as m
+
+            self.metrics.inc_counter(
+                m.RESILIENCE_DEADLINE_EXCEEDED,
+                {"component": "webhook", "policy": self.failure_policy})
+        from gatekeeper_tpu.utils.logging import log_event
+
+        log_event("warning", "admission deadline budget exceeded",
+                  event_type="deadline_exceeded",
+                  deadline_budget_s=self.deadline_budget_s,
+                  failure_policy=self.failure_policy)
+        if self.fail_open:
+            return ValidationResponse(
+                allowed=True, uid=uid,
+                warnings=[
+                    f"gatekeeper review exceeded its "
+                    f"{self.deadline_budget_s:.3f}s deadline budget; "
+                    f"failurePolicy=Ignore admitted the request "
+                    f"unreviewed"],
+            )
+        return ValidationResponse(
+            allowed=False, uid=uid, code=504,
+            message=(f"gatekeeper review exceeded its "
+                     f"{self.deadline_budget_s:.3f}s deadline budget "
+                     f"(failurePolicy=Fail)"),
+        )
 
     def _handle(self, review_body: dict) -> ValidationResponse:
         req = parse_admission_review(review_body)
@@ -217,6 +294,10 @@ class ValidationHandler:
 
     def _review(self, augmented):
         req = augmented.admission_request
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        fault_point("webhook.review", uid=req.uid,
+                    kind=(req.kind or {}).get("kind", ""))
         trace = self._trace_for(req)
         if trace is None and self.batcher is not None:
             # hot path: stats ride the coalesced batch (the Batcher's own
@@ -346,11 +427,18 @@ class Batcher:
     """
 
     def __init__(self, client, window_s: float = 0.003, max_batch: int = 64,
-                 stats: bool = False, small_batch: Optional[int] = None):
+                 stats: bool = False, small_batch: Optional[int] = None,
+                 metrics=None):
         self.client = client
         self.window_s = window_s
         self.max_batch = max_batch
         self.stats = stats
+        # serving-lane contention instrumentation (VERDICT r4 weak #5):
+        # how long each review sat queued before its batch ran, and the
+        # coalesced batch sizes — device-lane convoying shows up here
+        # while an accept-queue convoy shows up in the server's inflight
+        # gauge instead
+        self.metrics = metrics
         # low-latency lane: a device verdict-grid pass has ~60ms of fixed
         # per-launch cost (flatten + masks + per-template dispatch) while
         # the exact interpreter reviews one object in ~5ms — so batches
@@ -377,13 +465,33 @@ class Batcher:
             self._thread.join(timeout=2)
 
     def review(self, augmented):
+        from gatekeeper_tpu.resilience.policy import (DeadlineExceeded,
+                                                      current_deadline)
+
         done = threading.Event()
         slot: dict = {}
-        self._queue.put((augmented, done, slot))
-        done.wait()
+        self._queue.put((augmented, done, slot, time.perf_counter()))
+        dl = current_deadline()
+        timeout = None if dl is None else dl.remaining()
+        if not done.wait(timeout):
+            # the request's deadline budget expired while queued (or on
+            # the device): abandon the slot — the batch loop still sets
+            # it later, nobody is waiting
+            raise DeadlineExceeded("batched review outlived the "
+                                   "request deadline budget")
         if "error" in slot:
             raise slot["error"]
         return slot["responses"]
+
+    def _observe_batch(self, batch) -> None:
+        if self.metrics is None:
+            return
+        from gatekeeper_tpu.metrics import registry as m
+
+        now = time.perf_counter()
+        self.metrics.observe(m.WEBHOOK_BATCH_SIZE, len(batch))
+        for entry in batch:
+            self.metrics.observe(m.WEBHOOK_QUEUE_WAIT, now - entry[3])
 
     def _loop(self):
         while not self._stop.is_set():
@@ -412,12 +520,13 @@ class Batcher:
                     except queue.Empty:
                         break
             reviews = [b[0] for b in batch]
+            self._observe_batch(batch)
             try:
                 if len(batch) <= self.small_batch:
                     # low-latency lane: per-review exact interpreter.
                     # Each slot completes as soon as ITS review finishes
                     # (no head-of-line wait on the rest of the batch)
-                    for aug, done, slot in batch:
+                    for aug, done, slot, _t in batch:
                         try:
                             slot["responses"] = self.client.review(
                                 aug, enforcement_point=WEBHOOK_EP,
@@ -431,7 +540,8 @@ class Batcher:
                         reviews, enforcement_point=WEBHOOK_EP,
                         stats=self.stats,
                     )
-                for (_, done, slot), responses in zip(batch, all_responses):
+                for (_, done, slot, _t), responses in zip(batch,
+                                                          all_responses):
                     # per-slot isolation: one bad request must not poison the
                     # coalesced batch (review_batch returns Exception entries)
                     if isinstance(responses, Exception):
@@ -440,6 +550,6 @@ class Batcher:
                         slot["responses"] = responses
                     done.set()
             except Exception as e:
-                for _, done, slot in batch:
+                for _, done, slot, _t in batch:
                     slot["error"] = e
                     done.set()

@@ -1,0 +1,114 @@
+"""gator test expands AdmissionReview-embedded objects (reference
+test.go:125 expands EVERY reviewed object): a Deployment arriving inside
+an AdmissionReview fixture produces its implied Pod, and violations on
+the implied Pod surface with the [Implied by] prefix."""
+
+import copy
+
+from gatekeeper_tpu.gator.test import test as gator_test
+
+TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8srequiredprivdeny"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "K8sRequiredPrivDeny"}}},
+        "targets": [{
+            "target": "admission.k8s.io",
+            "rego": """
+package k8srequiredprivdeny
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  c.securityContext.privileged
+  msg := sprintf("privileged container %v", [c.name])
+}
+""",
+        }],
+    },
+}
+
+CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sRequiredPrivDeny",
+    "metadata": {"name": "no-priv"},
+    "spec": {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}},
+}
+
+EXPANSION = {
+    "apiVersion": "expansion.gatekeeper.sh/v1alpha1",
+    "kind": "ExpansionTemplate",
+    "metadata": {"name": "expand-deployments"},
+    "spec": {
+        "applyTo": [{"groups": ["apps"], "versions": ["v1"],
+                     "kinds": ["Deployment"]}],
+        "templateSource": "spec.template",
+        "generatedGVK": {"group": "", "version": "v1", "kind": "Pod"},
+    },
+}
+
+DEPLOYMENT = {
+    "apiVersion": "apps/v1",
+    "kind": "Deployment",
+    "metadata": {"name": "web", "namespace": "default"},
+    "spec": {
+        "template": {
+            "metadata": {"labels": {"app": "web"}},
+            "spec": {"containers": [{
+                "name": "evil",
+                "securityContext": {"privileged": True},
+            }]},
+        },
+    },
+}
+
+
+def _admission_review(obj):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "fixture-1", "operation": "CREATE",
+            "kind": {"group": "apps", "version": "v1",
+                     "kind": "Deployment"},
+            "userInfo": {"username": "dev"},
+            "object": obj,
+        },
+    }
+
+
+def test_admission_review_fixture_expands_implied_pod():
+    fixtures = [TEMPLATE, CONSTRAINT, EXPANSION,
+                _admission_review(copy.deepcopy(DEPLOYMENT))]
+    responses = gator_test(fixtures, include_cel=False)
+    results = responses.results()
+    msgs = [r.msg for r in results]
+    assert any("privileged container evil" in m for m in msgs), msgs
+    # the violation came from the IMPLIED Pod (expansion aggregation
+    # prefixes the resultant's messages with the template name)
+    assert any("expand-deployments" in m and "Implied" in m
+               for m in msgs), msgs
+
+
+def test_bare_object_expansion_unchanged():
+    """The bare-Deployment path (pre-existing behavior) reports the same
+    implied-Pod violation — the fixture lanes agree."""
+    bare = gator_test([TEMPLATE, CONSTRAINT, EXPANSION,
+                       copy.deepcopy(DEPLOYMENT)], include_cel=False)
+    via_review = gator_test(
+        [TEMPLATE, CONSTRAINT, EXPANSION,
+         _admission_review(copy.deepcopy(DEPLOYMENT))],
+        include_cel=False)
+    assert sorted(r.msg for r in bare.results()) == \
+        sorted(r.msg for r in via_review.results())
+
+
+def test_admission_review_without_object_does_not_expand():
+    """DELETE-shaped fixtures (oldObject only) review fine and skip
+    expansion — no resultant, no crash."""
+    ar = _admission_review(copy.deepcopy(DEPLOYMENT))
+    ar["request"]["operation"] = "DELETE"
+    ar["request"]["oldObject"] = ar["request"].pop("object")
+    responses = gator_test([TEMPLATE, CONSTRAINT, EXPANSION, ar],
+                           include_cel=False)
+    assert all("Implied" not in r.msg for r in responses.results())

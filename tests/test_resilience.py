@@ -1,0 +1,548 @@
+"""Unit tests for the resilience layer: the fault-injection seam
+(resilience/faults.py) and the policy primitives (resilience/policy.py —
+deadline budgets, jittered retry, circuit breakers), plus their direct
+integrations (external-data stale serving, apiserver retry, pipeline
+stage-worker restart, webhook deadline guard)."""
+
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.resilience.faults import (
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    fault_point,
+    inject,
+    load_chaos_spec,
+)
+from gatekeeper_tpu.resilience.policy import (
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)
+
+
+# --- fault seam -----------------------------------------------------------
+
+def test_fault_plan_counting_is_deterministic():
+    def pattern(plan):
+        out = []
+        with inject(plan):
+            for _ in range(6):
+                try:
+                    fault_point("a.b")
+                    out.append(0)
+                except FaultError:
+                    out.append(1)
+        return out
+
+    spec = {"site": "a.*", "mode": "error", "after": 1, "times": 2}
+    assert pattern(FaultPlan([spec], seed=3)) == [0, 1, 1, 0, 0, 0]
+    # same spec + seed -> same firing sequence (reproducible chaos)
+    assert pattern(FaultPlan([spec], seed=3)) == \
+        pattern(FaultPlan([spec], seed=3))
+
+
+def test_fault_plan_every_and_probability_seeded():
+    plan = FaultPlan([{"site": "s", "mode": "error", "every": 3}])
+    hits = []
+    with inject(plan):
+        for _ in range(7):
+            try:
+                fault_point("s")
+                hits.append(0)
+            except FaultError:
+                hits.append(1)
+    assert hits == [1, 0, 0, 1, 0, 0, 1]
+
+    def prob_pattern(seed):
+        p = FaultPlan([{"site": "s", "mode": "error",
+                        "probability": 0.5}], seed=seed)
+        out = []
+        with inject(p):
+            for _ in range(16):
+                try:
+                    fault_point("s")
+                    out.append(0)
+                except FaultError:
+                    out.append(1)
+        return out
+
+    assert prob_pattern(1) == prob_pattern(1)
+    assert 0 < sum(prob_pattern(1)) < 16
+
+
+def test_fault_modes_sleep_error_factory_partial():
+    slept = []
+    plan = FaultPlan(
+        [FaultSpec(site="sl", mode="sleep", delay_s=0.25),
+         FaultSpec(site="er", mode="error", error="boom", status=503),
+         FaultSpec(site="pa", mode="partial", fraction=0.5)],
+        sleep=slept.append)
+    with inject(plan):
+        assert fault_point("sl") is None
+        assert slept == [0.25]
+
+        class MyErr(Exception):
+            def __init__(self, spec):
+                super().__init__(spec.error)
+                self.status = spec.status
+
+        with pytest.raises(MyErr) as ei:
+            fault_point("er", error_factory=lambda s: MyErr(s))
+        assert ei.value.status == 503
+
+        action = fault_point("pa")
+        assert action is not None and action.mode == "partial"
+        assert action.spec.fraction == 0.5
+    # outside the scope the seam is inert
+    assert fault_point("er") is None
+    assert plan.fired() == 3
+    assert plan.fired("sl") == 1
+
+
+def test_fault_metrics_counted():
+    from gatekeeper_tpu.resilience import faults
+
+    reg = MetricsRegistry()
+    faults.set_metrics_registry(reg)
+    try:
+        plan = FaultPlan([{"site": "m", "mode": "error"}])
+        with inject(plan):
+            with pytest.raises(FaultError):
+                fault_point("m")
+        assert reg.get_counter(M.RESILIENCE_FAULTS,
+                               {"site": "m", "mode": "error"}) == 1
+    finally:
+        faults.set_metrics_registry(None)
+
+
+def test_load_chaos_spec_validation(tmp_path):
+    p = tmp_path / "chaos.json"
+    p.write_text('{"seed": 5, "faults": [{"site": "kube.request", '
+                 '"mode": "error", "status": 500, "times": 2}]}')
+    plan = load_chaos_spec(str(p))
+    assert plan.seed == 5 and plan.specs[0].status == 500
+    with pytest.raises(ValueError):
+        load_chaos_spec({"faults": [{"mode": "error"}]})  # no site
+    with pytest.raises(ValueError):
+        load_chaos_spec({"faults": [{"site": "x", "mode": "explode"}]})
+    with pytest.raises(ValueError):
+        load_chaos_spec({"faults": [{"site": "x", "typo_field": 1}]})
+
+
+# --- deadline budgets -----------------------------------------------------
+
+def test_deadline_budget_and_scope():
+    clock = [0.0]
+    dl = Deadline(1.0, clock=lambda: clock[0])
+    assert not dl.expired and abs(dl.remaining() - 1.0) < 1e-9
+    assert dl.bound(5.0) == 1.0 and dl.bound(0.2) == 0.2
+    clock[0] = 2.0
+    assert dl.expired
+    with pytest.raises(DeadlineExceeded):
+        dl.check("unit test")
+    assert dl.bound(None) == 0.0
+
+    unlimited = Deadline(0)
+    assert unlimited.remaining() is None and not unlimited.expired
+    assert unlimited.bound(3.0) == 3.0
+
+    assert current_deadline() is None
+    with deadline_scope(dl):
+        assert current_deadline() is dl
+    assert current_deadline() is None
+
+
+# --- retry policy ---------------------------------------------------------
+
+def test_retry_jitter_deterministic_and_capped():
+    a = RetryPolicy(attempts=5, base_s=0.1, cap_s=0.3, seed=11)
+    b = RetryPolicy(attempts=5, base_s=0.1, cap_s=0.3, seed=11)
+    seq_a = [a.backoff(i) for i in range(4)]
+    seq_b = [b.backoff(i) for i in range(4)]
+    assert seq_a == seq_b
+    assert all(d <= 0.3 for d in seq_a)
+    assert all(d >= 0.05 for d in seq_a)  # full-jitter floor: hi*(1-0.5)
+
+
+def test_retry_giveup_and_metrics():
+    reg = MetricsRegistry()
+    rp = RetryPolicy(attempts=4, base_s=0.001, metrics=reg,
+                     dependency="dep", sleep=lambda s: None)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert rp.call(flaky) == "ok"
+    assert calls[0] == 3
+    assert reg.get_counter(M.RESILIENCE_RETRIES,
+                           {"dependency": "dep"}) == 2
+
+    calls[0] = 0
+
+    def fatal():
+        calls[0] += 1
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        rp.call(fatal, giveup=lambda e: isinstance(e, ValueError))
+    assert calls[0] == 1  # no retry on non-transient
+
+
+def test_retry_respects_deadline():
+    clock = [0.0]
+    dl = Deadline(0.5, clock=lambda: clock[0])
+
+    def advance(s):
+        clock[0] += 10.0  # any sleep blows the budget
+
+    rp = RetryPolicy(attempts=10, base_s=0.1, sleep=advance)
+    calls = [0]
+
+    def failing():
+        calls[0] += 1
+        raise OSError("x")
+
+    with pytest.raises((DeadlineExceeded, OSError)):
+        rp.call(failing, deadline=dl)
+    assert calls[0] <= 2  # budget cut the loop, not the attempt count
+
+
+# --- circuit breaker ------------------------------------------------------
+
+def test_breaker_state_machine_and_metrics():
+    clock = [0.0]
+    reg = MetricsRegistry()
+    transitions = []
+    b = CircuitBreaker("dep", failure_threshold=3, reset_timeout_s=10.0,
+                       half_open_max=1, clock=lambda: clock[0],
+                       metrics=reg,
+                       on_transition=lambda o, n: transitions.append((o, n)))
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"  # below threshold
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert b.retry_after_s() == 10.0
+    assert reg.get_gauge(M.RESILIENCE_BREAKER_STATE,
+                         {"dependency": "dep"}) == 2
+
+    clock[0] = 11.0
+    assert b.state == "half_open"
+    assert b.allow()          # the single probe slot
+    assert not b.allow()      # second concurrent probe refused
+    b.record_failure()        # probe failed -> reopen
+    assert b.state == "open"
+    clock[0] = 22.0
+    assert b.allow()
+    b.record_success()        # probe succeeded -> close
+    assert b.state == "closed" and b.allow()
+    assert transitions == [("closed", "open"), ("open", "half_open"),
+                           ("half_open", "open"), ("open", "half_open"),
+                           ("half_open", "closed")]
+    # every transition counted (the acceptance criterion)
+    total = sum(
+        reg.get_counter(M.RESILIENCE_BREAKER_TRANSITIONS,
+                        {"dependency": "dep", "from": o, "to": n})
+        for o, n in set(transitions))
+    assert total == len(transitions)
+
+
+def test_breaker_call_wrapper():
+    clock = [0.0]
+    b = CircuitBreaker("d", failure_threshold=1, reset_timeout_s=5.0,
+                       clock=lambda: clock[0])
+    with pytest.raises(RuntimeError):
+        b.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(BreakerOpen) as ei:
+        b.call(lambda: "never")
+    assert ei.value.dependency == "d"
+    clock[0] = 6.0
+    assert b.call(lambda: "ok") == "ok"
+    assert b.state == "closed"
+
+
+# --- external-data integration -------------------------------------------
+
+def _provider_cache(send_fn, **kw):
+    from gatekeeper_tpu.externaldata.providers import Provider, ProviderCache
+
+    kw.setdefault("retry", RetryPolicy(attempts=2, base_s=0.001,
+                                       sleep=lambda s: None))
+    cache = ProviderCache(send_fn=send_fn, **kw)
+    cache.upsert(Provider(name="p", url="https://x", ca_bundle="x"))
+    return cache
+
+
+def test_externaldata_serves_stale_when_provider_down():
+    calls = [0]
+    healthy = [True]
+
+    def send(provider, keys):
+        calls[0] += 1
+        if not healthy[0]:
+            raise RuntimeError("provider down")
+        return {"response": {"items": [
+            {"key": k, "value": f"v-{k}"} for k in keys]}}
+
+    reg = MetricsRegistry()
+    cache = _provider_cache(send, response_ttl_s=0.01, metrics=reg,
+                            breaker_threshold=2)
+    assert cache.fetch("p", ["a"])["a"] == ("v-a", None)
+    time.sleep(0.02)  # TTL expired -> entry is stale now
+    healthy[0] = False
+    out = cache.fetch("p", ["a"])
+    assert out["a"] == ("v-a", None)  # stale-from-TTL-cache fallback
+    assert reg.get_counter(M.RESILIENCE_STALE_SERVED,
+                           {"dependency": "externaldata/p"}) >= 1
+    # a key never cached fails with a per-key error -> failure policy
+    out = cache.fetch("p", ["never-seen"])
+    val, err = out["never-seen"]
+    assert val is None and "no cached value" in err
+
+
+def test_externaldata_breaker_opens_and_skips_transport():
+    def send(provider, keys):
+        raise RuntimeError("down")
+
+    cache = _provider_cache(send, breaker_threshold=2, breaker_reset_s=60)
+    cache.fetch("p", ["k1"])  # failure 1 (retied internally)
+    cache.fetch("p", ["k2"])  # failure 2 -> breaker opens
+    assert cache._breaker("p").state == "open"
+    before = cache._breaker("p")._failures
+    out = cache.fetch("p", ["k3"])  # breaker open: transport untouched
+    assert "circuit breaker open" in out["k3"][1]
+    assert cache._breaker("p")._failures == before
+
+
+def test_externaldata_partial_response_fault():
+    def send(provider, keys):
+        return {"response": {"items": [
+            {"key": k, "value": f"v-{k}"} for k in keys]}}
+
+    cache = _provider_cache(send)
+    plan = FaultPlan([{"site": "externaldata.send", "mode": "partial",
+                       "fraction": 0.5, "times": 1}])
+    with inject(plan):
+        out = cache.fetch("p", ["a", "b"])
+    errs = [k for k, (v, e) in out.items() if e]
+    assert len(errs) == 1 and out[errs[0]][1] == "key not returned"
+
+
+def test_externaldata_resolve_failure_policies_still_hold():
+    from gatekeeper_tpu.externaldata.placeholders import (
+        ExternalDataPlaceholder,
+    )
+    from gatekeeper_tpu.externaldata.providers import ProviderError
+
+    def send(provider, keys):
+        raise RuntimeError("down")
+
+    cache = _provider_cache(send)
+    ph = ExternalDataPlaceholder(provider="p", failure_policy="UseDefault",
+                                 default="dflt")
+    assert cache.resolve(ph) == "dflt"
+    ph2 = ExternalDataPlaceholder(provider="p", failure_policy="Fail")
+    with pytest.raises(ProviderError):
+        cache.resolve(ph2)
+
+
+# --- apiserver (sync/kube.py) integration ---------------------------------
+
+def test_kube_get_retries_transient_500():
+    from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig, KubeError
+
+    reg = MetricsRegistry()
+    kc = KubeCluster(KubeConfig(server="http://unused"), metrics=reg)
+    kc._retry._sleep = lambda s: None
+    calls = [0]
+
+    def flaky(method, path, body=None, timeout=30.0):
+        calls[0] += 1
+        if calls[0] < 3:
+            raise KubeError(500, "storm")
+        return {"ok": True}
+
+    kc._request_once = flaky
+    assert kc._request("GET", "/api") == {"ok": True}
+    assert calls[0] == 3
+    assert reg.get_counter(M.RESILIENCE_RETRIES,
+                           {"dependency": "apiserver"}) == 2
+
+    # 404 is semantic, not transient: no retry
+    calls[0] = 0
+
+    def not_found(method, path, body=None, timeout=30.0):
+        calls[0] += 1
+        raise KubeError(404, "nope")
+
+    kc._request_once = not_found
+    with pytest.raises(KubeError):
+        kc._request("GET", "/api")
+    assert calls[0] == 1
+
+    # writes never auto-retry here (their 409 semantics live in apply)
+    calls[0] = 0
+
+    def post_fails(method, path, body=None, timeout=30.0):
+        calls[0] += 1
+        raise KubeError(500, "storm")
+
+    kc._request_once = post_fails
+    with pytest.raises(KubeError):
+        kc._request("POST", "/api/v1/pods", body={})
+    assert calls[0] == 1
+
+
+def test_kube_fault_site_maps_to_kube_error():
+    from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig, KubeError
+
+    kc = KubeCluster(KubeConfig(server="http://unused"), retry_attempts=1)
+    plan = FaultPlan([{"site": "kube.request", "mode": "error",
+                       "status": 503, "error": "injected outage"}])
+    with inject(plan):
+        with pytest.raises(KubeError) as ei:
+            kc._request("GET", "/api")
+    assert ei.value.status == 503
+
+
+# --- pipeline stage-worker restart ---------------------------------------
+
+def test_pipeline_stage_retry_recovers_and_counts():
+    from gatekeeper_tpu.pipeline import PipelineError, Stage, StagedPipeline
+
+    failed_once = set()
+
+    def flaky(x):
+        if x not in failed_once:
+            failed_once.add(x)
+            raise RuntimeError(f"crash on {x}")
+        return x * 2
+
+    out = []
+    pipe = StagedPipeline([
+        Stage("flaky", flaky, max_retries=1),
+        Stage("sink", lambda x: out.append(x)),
+    ])
+    run = pipe.run(range(5))
+    assert out == [0, 2, 4, 6, 8]
+    assert run.stage("flaky").retries == 5
+    assert run.summary()["stages"]["flaky"]["retries"] == 5
+
+    # past the restart budget the pipeline aborts (callers degrade)
+    def always(x):
+        raise RuntimeError("dead")
+
+    pipe2 = StagedPipeline([Stage("dead", always, max_retries=2)])
+    with pytest.raises(PipelineError):
+        pipe2.run(range(3))
+
+
+def test_pipeline_stage_fault_site():
+    from gatekeeper_tpu.pipeline import Stage, StagedPipeline
+
+    out = []
+    plan = FaultPlan([{"site": "pipeline.stage.work", "mode": "error",
+                       "times": 2}])
+    with inject(plan):
+        pipe = StagedPipeline([
+            Stage("work", lambda x: x, max_retries=2),
+            Stage("sink", lambda x: out.append(x)),
+        ])
+        run = pipe.run(range(4))
+    assert out == [0, 1, 2, 3]
+    assert run.stage("work").retries == 2
+
+
+# --- webhook deadline guard ----------------------------------------------
+
+class _EmptyResponses:
+    stats_entries: list = []
+
+    def results(self):
+        return []
+
+
+class _StubClient:
+    drivers: list = []
+
+    def review(self, augmented, **kw):
+        return _EmptyResponses()
+
+
+def _admission_body(uid="u1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": uid, "operation": "CREATE",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "userInfo": {"username": "alice"},
+            "object": {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "x", "namespace": "default"},
+                       "spec": {"containers": [{"name": "c"}]}},
+        },
+    }
+
+
+def test_webhook_deadline_fail_open_and_closed():
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    plan = FaultPlan([{"site": "webhook.review", "mode": "hang",
+                       "delay_s": 1.5}])
+    reg = MetricsRegistry()
+    with inject(plan):
+        h = ValidationHandler(_StubClient(), metrics=reg,
+                              deadline_budget_s=0.15,
+                              failure_policy="ignore")
+        t0 = time.perf_counter()
+        resp = h.handle(_admission_body())
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 1.0  # answered within budget, not the hang
+        assert resp.allowed
+        assert any("deadline budget" in w for w in resp.warnings)
+
+        h2 = ValidationHandler(_StubClient(), metrics=reg,
+                               deadline_budget_s=0.15,
+                               failure_policy="fail")
+        t0 = time.perf_counter()
+        resp2 = h2.handle(_admission_body("u2"))
+        assert time.perf_counter() - t0 < 1.0
+        assert not resp2.allowed and resp2.code == 504
+        assert "deadline budget" in resp2.message
+    assert reg.get_counter(M.RESILIENCE_DEADLINE_EXCEEDED,
+                           {"component": "webhook",
+                            "policy": "ignore"}) == 1
+    assert reg.get_counter(M.RESILIENCE_DEADLINE_EXCEEDED,
+                           {"component": "webhook", "policy": "fail"}) == 1
+
+
+def test_webhook_no_deadline_runs_inline():
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    h = ValidationHandler(_StubClient())
+    main_thread = threading.get_ident()
+    seen = []
+
+    class _Client(_StubClient):
+        def review(self, augmented, **kw):
+            seen.append(threading.get_ident())
+            return _EmptyResponses()
+
+    h.client = _Client()
+    resp = h.handle(_admission_body())
+    assert resp.allowed
+    assert seen == [main_thread]  # pre-resilience path: no helper thread

@@ -1,0 +1,186 @@
+"""sync/routing.py error paths beyond the happy path (VERDICT weak #8):
+status-group write failure, the Secrets-client split fallback, and the
+apiserver retry-on-conflict loop the routed writes rely on."""
+
+import pytest
+
+from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig, KubeError
+from gatekeeper_tpu.sync.routing import OPERATOR_NAMESPACE, RoutingCluster
+from gatekeeper_tpu.sync.source import FakeCluster
+
+
+def _status_obj(name="tpl-status"):
+    return {"apiVersion": "status.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplatePodStatus",
+            "metadata": {"name": name, "namespace": OPERATOR_NAMESPACE},
+            "status": {"observed": True}}
+
+
+def _secret(ns, name="tls-cert"):
+    return {"apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": name, "namespace": ns},
+            "data": {"tls.crt": "x"}}
+
+
+class _FailingCluster(FakeCluster):
+    """ObjectSource double whose writes fail like a dead management
+    apiserver."""
+
+    def __init__(self, exc):
+        super().__init__()
+        self.exc = exc
+
+    def apply(self, obj):
+        raise self.exc
+
+    def apply_status(self, obj):
+        raise self.exc
+
+
+def test_status_group_write_failure_propagates_and_target_untouched():
+    """A dead management cluster fails STATUS writes loudly (callers own
+    the retry policy) while target-side traffic is unaffected."""
+    mgmt = _FailingCluster(KubeError(500, "management apiserver down"))
+    target = FakeCluster()
+    rc = RoutingCluster(mgmt, target)
+
+    with pytest.raises(KubeError):
+        rc.apply(_status_obj())
+    with pytest.raises(KubeError):
+        rc.apply_status(_status_obj())
+    # target-side writes still work — the split isolates the failure
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "default"}}
+    rc.apply(pod)
+    assert rc.get(("", "v1", "Pod"), "default", "p") is not None
+
+
+def test_apply_status_falls_back_to_plain_apply():
+    """A management source without an apply_status method (FakeCluster
+    shape) takes the getattr fallback — the status write lands as a
+    full-object apply instead of crashing."""
+
+    class _NoStatus(FakeCluster):
+        def __getattribute__(self, name):
+            if name == "apply_status":
+                raise AttributeError(name)
+            return super().__getattribute__(name)
+
+    mgmt = _NoStatus()
+    rc = RoutingCluster(mgmt, FakeCluster())
+    rc.apply_status(_status_obj("s1"))
+    got = mgmt.get(("status.gatekeeper.sh", "v1beta1",
+                    "ConstraintTemplatePodStatus"),
+                   OPERATOR_NAMESPACE, "s1")
+    assert got is not None and got["status"] == {"observed": True}
+
+
+def test_secret_split_write_routing_and_list_merge():
+    """Operator-namespace Secrets (webhook certs) live management-side;
+    the target cluster's Secrets stay ordinary audited objects.  A list
+    merges both with management WINNING for the operator namespace."""
+    mgmt, target = FakeCluster(), FakeCluster()
+    rc = RoutingCluster(mgmt, target)
+    gvk = ("", "v1", "Secret")
+
+    rc.apply(_secret(OPERATOR_NAMESPACE))          # -> management
+    rc.apply(_secret("default", "app-secret"))     # -> target
+    assert mgmt.get(gvk, OPERATOR_NAMESPACE, "tls-cert") is not None
+    assert target.get(gvk, OPERATOR_NAMESPACE, "tls-cert") is None
+    assert target.get(gvk, "default", "app-secret") is not None
+
+    # the target runs its OWN gatekeeper with a same-named cert secret:
+    # the merged list must not show a duplicate identity, management wins
+    target.apply({**_secret(OPERATOR_NAMESPACE),
+                  "data": {"tls.crt": "target-side"}})
+    listed = rc.list(gvk)
+    op_side = [s for s in listed
+               if s["metadata"]["namespace"] == OPERATOR_NAMESPACE]
+    assert len(op_side) == 1
+    assert op_side[0]["data"]["tls.crt"] == "x"  # management copy
+    assert {s["metadata"]["name"] for s in listed} == \
+        {"tls-cert", "app-secret"}
+
+    # reads route the same way writes did
+    assert rc.get(gvk, OPERATOR_NAMESPACE, "tls-cert")["data"][
+        "tls.crt"] == "x"
+
+
+def test_secret_delete_routes_management_for_operator_namespace():
+    mgmt, target = FakeCluster(), FakeCluster()
+    rc = RoutingCluster(mgmt, target)
+    rc.apply(_secret(OPERATOR_NAMESPACE))
+    rc.delete(_secret(OPERATOR_NAMESPACE))
+    assert mgmt.get(("", "v1", "Secret"), OPERATOR_NAMESPACE,
+                    "tls-cert") is None
+
+
+# --- retry-on-conflict (the 409 loop routed writes depend on) -------------
+
+def _kube_with_script(script):
+    """KubeCluster whose transport replays a scripted response list:
+    each entry is a KubeError to raise or a dict to return."""
+    kc = KubeCluster(KubeConfig(server="http://unused"), retry_attempts=1)
+    calls = []
+
+    def fake(method, path, body=None, timeout=30.0):
+        calls.append((method, path))
+        step = script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+    kc._request_once = fake
+    kc._discovery[("", "v1")] = {"Pod": ("pods", True)}
+    return kc, calls
+
+
+def _pod(rv="1"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "d",
+                         "resourceVersion": rv}}
+
+
+def test_apply_retries_on_conflict_then_succeeds():
+    kc, calls = _kube_with_script([
+        KubeError(409, "exists"),          # POST -> exists
+        _pod("7"),                         # GET current
+        KubeError(409, "conflict"),        # PUT -> concurrent writer won
+        _pod("8"),                         # GET again (fresh rv)
+        {},                                # PUT ok
+    ])
+    kc.apply(_pod())
+    assert [m for m, _ in calls] == ["POST", "GET", "PUT", "GET", "PUT"]
+
+
+def test_apply_conflict_exhaustion_raises():
+    script = [KubeError(409, "exists")]
+    for _ in range(4):  # the bounded loop: 4 GET+PUT rounds, all conflict
+        script += [_pod("7"), KubeError(409, "conflict")]
+    kc, calls = _kube_with_script(script)
+    with pytest.raises(KubeError) as ei:
+        kc.apply(_pod())
+    assert ei.value.status == 409
+    assert [m for m, _ in calls].count("PUT") == 4
+
+
+def test_apply_status_retry_on_conflict_and_deleted_object():
+    # conflict once, then clean write through /status
+    kc, calls = _kube_with_script([
+        _pod("5"),                         # GET current
+        KubeError(409, "conflict"),        # PUT status -> conflict
+        _pod("6"),                         # GET again
+        {},                                # PUT status ok
+    ])
+    kc.apply_status(_pod())
+    puts = [p for m, p in calls if m == "PUT"]
+    assert all(p.endswith("/status") for p in puts) and len(puts) == 2
+
+    # object deleted between GET and PUT: 404 disambiguation, no resurrect
+    kc2, calls2 = _kube_with_script([
+        _pod("5"),                         # GET current
+        KubeError(404, "status path"),     # PUT /status -> 404
+        KubeError(404, "object gone"),     # re-GET -> object gone
+    ])
+    kc2.apply_status(_pod())               # returns silently: nothing to do
+    assert [m for m, _ in calls2] == ["GET", "PUT", "GET"]
