@@ -39,15 +39,21 @@ PROBE_BACKOFF_S = (10.0, 30.0)
 # --pipeline=auto|on|off|differential (default auto: staged host pipeline
 # when the host has >1 effective core, serial eager-poll otherwise)
 PIPELINE_MODE = "auto"
+# --trace out.json: span-trace the timed sweeps and export a Chrome
+# trace-event file at exit (Perfetto-loadable device timeline)
+TRACE_PATH = ""
 
 
 def _parse_pipeline_flag(argv: list) -> list:
-    """Strip --pipeline[=mode] and --chaos[=spec.json] from argv (the
-    remaining args stay positional: N [chunk] | sweep [N [chunk]]).
-    --chaos installs the fault-injection plan process-wide so a bench run
-    doubles as a deterministic chaos run (the resilience metrics and the
-    run's incomplete/retried counters land in the JSON artifact)."""
-    global PIPELINE_MODE
+    """Strip --pipeline[=mode], --chaos[=spec.json] and --trace[=path]
+    from argv (the remaining args stay positional: N [chunk] |
+    sweep [N [chunk]]).  --chaos installs the fault-injection plan
+    process-wide so a bench run doubles as a deterministic chaos run (the
+    resilience metrics and the run's incomplete/retried counters land in
+    the JSON artifact); --trace installs the span tracer (seeded, full
+    sampling) and writes the Chrome trace-event artifact — with --chaos
+    the injected faults show up as instant events on the spans they hit."""
+    global PIPELINE_MODE, TRACE_PATH
     out = []
     chaos = ""
     it = iter(argv)
@@ -60,14 +66,39 @@ def _parse_pipeline_flag(argv: list) -> list:
             chaos = next(it, "")
         elif a.startswith("--chaos="):
             chaos = a.split("=", 1)[1]
+        elif a == "--trace":
+            TRACE_PATH = next(it, "")
+        elif a.startswith("--trace="):
+            TRACE_PATH = a.split("=", 1)[1]
         else:
             out.append(a)
+    if TRACE_PATH:
+        from gatekeeper_tpu.observability import tracing
+
+        tracing.install(tracing.Tracer(seed=0))
+        log(f"span tracer active (export: {TRACE_PATH})")
     if chaos:
         from gatekeeper_tpu.resilience import faults
 
         faults.install(faults.load_chaos_spec(chaos))
         log(f"chaos harness active: {chaos}")
     return out
+
+
+def export_trace() -> None:
+    """Write the Chrome trace-event artifact (--trace), if tracing ran."""
+    if not TRACE_PATH:
+        return
+    from gatekeeper_tpu.observability import (format_span_summary, tracing,
+                                              write_chrome_trace)
+
+    tracer = tracing.active_tracer()
+    if tracer is None:
+        return
+    n = write_chrome_trace(TRACE_PATH, tracer)
+    log(f"trace: {n} events ({tracer.kept} traces kept) -> {TRACE_PATH} "
+        "(load in ui.perfetto.dev or chrome://tracing)")
+    log(format_span_summary(tracer.traces()))
 
 
 def bench_history_append(entry: dict, path: str = None) -> None:
@@ -364,6 +395,7 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "SWEEP1M.json"), "w") as f:
         f.write(_json.dumps(out) + "\n")
+    export_trace()
     print(_json.dumps(out))
 
 
@@ -501,6 +533,7 @@ def main():
         "date": time.strftime("%Y-%m-%d"),
         **({"cpu_fallback": True} if cpu_fallback else {}),
     })
+    export_trace()
     print(json.dumps(out))
 
 

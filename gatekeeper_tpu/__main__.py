@@ -132,6 +132,28 @@ def main(argv=None) -> int:
                         "wide — the deterministic chaos harness for "
                         "exercising the resilience layer (README "
                         "'Failure semantics')")
+    p.add_argument("--trace", default="",
+                   help="write a Chrome trace-event JSON (Perfetto-"
+                        "loadable) of the kept traces to this path on "
+                        "exit; also serves the live tail-sampled ring "
+                        "buffer at /debug/traces next to /metrics")
+    p.add_argument("--trace-buffer", action="store_true",
+                   help="enable the span tracer without a file export "
+                        "(ring buffer served at /debug/traces only)")
+    p.add_argument("--trace-slow-ms", type=float, default=0.0,
+                   help="tail-sampling latency threshold: traces whose "
+                        "root span is slower than this are ALWAYS kept; "
+                        "the rest keep at --trace-sample (0 = no "
+                        "threshold, keep per --trace-sample alone)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="keep probability for traces under the "
+                        "--trace-slow-ms threshold (1.0 keeps all; 0.0 "
+                        "is the empty sampler — span machinery runs, "
+                        "nothing retained)")
+    p.add_argument("--trace-seed", type=int, default=None,
+                   help="seed the trace/span ID generator and sampler "
+                        "(deterministic IDs for differential runs; "
+                        "default: OS entropy)")
     p.add_argument("--webhook-deadline", type=float, default=0.0,
                    help="per-admission wall-clock budget in seconds; on "
                         "expiry the request resolves per "
@@ -201,10 +223,13 @@ def main(argv=None) -> int:
             if skip:
                 skip = False
                 continue
-            if a in ("--webhook-workers", "--operation"):
+            if a in ("--webhook-workers", "--operation", "--trace"):
+                # --trace: N workers would race the export-file write at
+                # exit; only the parent writes the artifact
                 skip = True
                 continue
-            if a.startswith(("--webhook-workers=", "--operation=")):
+            if a.startswith(("--webhook-workers=", "--operation=",
+                             "--trace=")):
                 continue
             stripped.append(a)
         child = [a for a in stripped if a != "--once"]
@@ -245,6 +270,21 @@ def main(argv=None) -> int:
 
     operations = args.operation or list(ALL_OPERATIONS)
     metrics = MetricsRegistry()
+    tracer = None
+    if args.trace or args.trace_buffer:
+        from gatekeeper_tpu.observability import tracing
+
+        tracer = tracing.Tracer(
+            seed=args.trace_seed,
+            slow_threshold_s=(args.trace_slow_ms / 1000.0
+                              if args.trace_slow_ms > 0 else None),
+            sample_rate=args.trace_sample,
+            metrics=metrics,
+        )
+        tracing.install(tracer)
+        print("span tracer active"
+              + (f" (export: {args.trace})" if args.trace else
+                 " (ring buffer at /debug/traces)"), file=sys.stderr)
     if args.chaos:
         from gatekeeper_tpu.resilience import faults
 
@@ -376,6 +416,17 @@ def main(argv=None) -> int:
             metrics=metrics,
         )
 
+    def export_trace():
+        if tracer is None or not args.trace:
+            return
+        from gatekeeper_tpu.observability import write_chrome_trace
+
+        n = write_chrome_trace(args.trace, tracer)
+        print(f"trace: {n} events ({tracer.kept} traces kept, "
+              f"{tracer.sampled_out} sampled out) -> {args.trace} "
+              f"(load in ui.perfetto.dev or chrome://tracing)",
+              file=sys.stderr)
+
     if args.once:
         run = audit_mgr.audit()
         total = sum(run.total_violations.values())
@@ -389,6 +440,7 @@ def main(argv=None) -> int:
                 print(f"  {key[0]}/{key[1]}: {v.kind} "
                       f"{v.namespace + '/' if v.namespace else ''}{v.name}: "
                       f"{v.message}")
+        export_trace()
         return 0
 
     # namespace lookup for the webhook hot path: with a live apiserver,
@@ -565,6 +617,7 @@ def main(argv=None) -> int:
         batcher.stop()
         if server:
             server.stop()
+        export_trace()
         for wp in worker_procs:
             wp.terminate()
         for wp in worker_procs:

@@ -179,6 +179,32 @@ class AuditManager:
 
     # --- one sweep (reference: audit(), manager.go:258) -----------------
     def audit(self) -> AuditRun:
+        """One sweep under its root span: the per-stage busy/wall/idle
+        numbers the ROADMAP says to read from the bench JSON are ALSO
+        recorded as attributes here, so a trace timeline carries them."""
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("audit.sweep") as sp:
+            run = self._audit_impl()
+            sp.set_attribute("objects", run.total_objects)
+            sp.set_attribute("duration_s", round(run.duration_s, 3))
+            sp.set_attribute("violations",
+                             sum(run.total_violations.values()))
+            if run.incomplete:
+                sp.set_attribute("incomplete", True)
+            if self.pipe_stats:
+                sp.set_attribute("wall_s", self.pipe_stats.get("wall_s"))
+                sp.set_attribute(
+                    "stage_busy_sum_s",
+                    self.pipe_stats.get("stage_busy_sum_s"))
+                sp.set_attribute(
+                    "device_idle_fraction",
+                    self.pipe_stats.get("device_idle_fraction"))
+                sp.set_attribute(
+                    "overlap_ratio", self.pipe_stats.get("overlap_ratio"))
+            return run
+
+    def _audit_impl(self) -> AuditRun:
         t0 = time.time()
         run = AuditRun(timestamp=_now_rfc3339())
         constraints = [
@@ -427,58 +453,65 @@ class AuditManager:
                 self.metrics.inc_counter(M.RESILIENCE_RETRIES,
                                          {"dependency": "audit_chunk"})
 
+        from gatekeeper_tpu.observability import tracing
+
         def fold_oldest():
             # retry covers the non-mutating phases ONLY (submit/collect):
             # once the fold touches kept/totals a re-run would double
             # count, so a fold failure drops the chunk instead
-            pending, objs, cons = window.popleft()
-            last = None
-            swept = None
-            for attempt in range(retries + 1):
-                try:
-                    if attempt > 0:
-                        # a failed collect can't be re-fetched: the whole
-                        # chunk re-submits through flatten/dispatch
-                        chunk_retry(last, "collect")
-                        pending = self.evaluator.sweep_submit(
-                            cons, objs,
-                            return_bits=self.config.exact_totals)
-                    swept = self.evaluator.sweep_collect(pending)
-                    break
-                except Exception as e:  # noqa: PERF203
-                    last = e
-            else:
-                chunk_failed(last, "collect")
-                return
-            try:
-                t0 = time.perf_counter()
-                self._process_swept(swept, objs, cons, kept, totals, limit)
-                self.perf["fold_render"] = (
-                    self.perf.get("fold_render", 0.0)
-                    + time.perf_counter() - t0)
-            except Exception as e:
-                chunk_failed(e, "fold")
-
-        def submit(objects, cons):
-            if device:
+            pending, objs, cons, chunk_i = window.popleft()
+            with tracing.span("audit.chunk.collect_fold", chunk=chunk_i,
+                              objects=len(objs)):
                 last = None
+                swept = None
                 for attempt in range(retries + 1):
                     try:
                         if attempt > 0:
-                            chunk_retry(last, "submit")
-                        pending = self.evaluator.sweep_submit(
-                            cons, objects,
-                            return_bits=self.config.exact_totals)
+                            # a failed collect can't be re-fetched: the whole
+                            # chunk re-submits through flatten/dispatch
+                            chunk_retry(last, "collect")
+                            pending = self.evaluator.sweep_submit(
+                                cons, objs,
+                                return_bits=self.config.exact_totals)
+                        swept = self.evaluator.sweep_collect(pending)
                         break
                     except Exception as e:  # noqa: PERF203
                         last = e
                 else:
-                    chunk_failed(last, "submit")
+                    chunk_failed(last, "collect")
                     return
-                window.append((pending, objects, cons))
-                if waitq is not None and \
-                        getattr(pending, "result", None) is not None:
-                    waitq.put(pending)
+                try:
+                    t0 = time.perf_counter()
+                    self._process_swept(swept, objs, cons, kept, totals,
+                                        limit)
+                    self.perf["fold_render"] = (
+                        self.perf.get("fold_render", 0.0)
+                        + time.perf_counter() - t0)
+                except Exception as e:
+                    chunk_failed(e, "fold")
+
+        def submit(objects, cons, chunk_i):
+            if device:
+                with tracing.span("audit.chunk.submit", chunk=chunk_i,
+                                  objects=len(objects)):
+                    last = None
+                    for attempt in range(retries + 1):
+                        try:
+                            if attempt > 0:
+                                chunk_retry(last, "submit")
+                            pending = self.evaluator.sweep_submit(
+                                cons, objects,
+                                return_bits=self.config.exact_totals)
+                            break
+                        except Exception as e:  # noqa: PERF203
+                            last = e
+                    else:
+                        chunk_failed(last, "submit")
+                        return
+                    window.append((pending, objects, cons, chunk_i))
+                    if waitq is not None and \
+                            getattr(pending, "result", None) is not None:
+                        waitq.put(pending)
                 while window and (len(window) > max_inflight
                                   or _sweep_ready(window[0][0])):
                     self.perf["n_eager_collects"] = (
@@ -488,32 +521,36 @@ class AuditManager:
                 # interpreter lane: evaluate into CHUNK-LOCAL dicts and
                 # merge only on success, so a mid-chunk failure (and its
                 # retry) can never double count
-                last = None
-                for attempt in range(retries + 1):
-                    try:
-                        if attempt > 0:
-                            chunk_retry(last, "interp")
-                        kept_c = {c.key(): [] for c in cons}
-                        totals_c = {c.key(): 0 for c in cons}
-                        self._audit_chunk(objects, cons, kept_c, totals_c,
-                                          limit)
-                        for key, n in totals_c.items():
-                            totals[key] += n
-                        for key, vs in kept_c.items():
-                            for v in vs:
-                                if len(kept[key]) < limit:
-                                    kept[key].append(v)
-                        return
-                    except Exception as e:  # noqa: PERF203
-                        last = e
-                chunk_failed(last, "interp")
+                with tracing.span("audit.chunk.interp", chunk=chunk_i,
+                                  objects=len(objects)):
+                    last = None
+                    for attempt in range(retries + 1):
+                        try:
+                            if attempt > 0:
+                                chunk_retry(last, "interp")
+                            kept_c = {c.key(): [] for c in cons}
+                            totals_c = {c.key(): 0 for c in cons}
+                            self._audit_chunk(objects, cons, kept_c,
+                                              totals_c, limit)
+                            for key, n in totals_c.items():
+                                totals[key] += n
+                            for key, vs in kept_c.items():
+                                for v in vs:
+                                    if len(kept[key]) < limit:
+                                        kept[key].append(v)
+                            return
+                        except Exception as e:  # noqa: PERF203
+                            last = e
+                    chunk_failed(last, "interp")
 
         try:
             src = iter(self._chunk_source(constraints, kind_filter,
                                           use_router, counter))
+            chunk_i = -1
             while True:
                 try:
                     objs, cons = next(src)
+                    chunk_i += 1
                 except StopIteration:
                     break
                 except Exception as e:
@@ -530,7 +567,7 @@ class AuditManager:
                               event_type="audit_lister_failed",
                               error=str(e))
                     break
-                submit(objs, cons)
+                submit(objs, cons, chunk_i)
             while window:  # drain: blocking collect of the tail chunks
                 fold_oldest()
         finally:
@@ -669,7 +706,7 @@ class AuditManager:
 
         self.metrics.observe(M.AUDIT_DURATION, run.duration_s)
         self.metrics.set_gauge(M.AUDIT_LAST_RUN, time.time())
-        self.metrics.set_gauge("audit_last_run_incomplete",
+        self.metrics.set_gauge(M.AUDIT_LAST_RUN_INCOMPLETE,
                                1.0 if run.incomplete else 0.0)
         if not self.pipe_stats:
             return
@@ -684,6 +721,13 @@ class AuditManager:
         self.metrics.set_gauge(
             M.PIPELINE_DEVICE_IDLE,
             self.pipe_stats.get("device_idle_fraction", 0.0))
+        # sweep-level aggregates (previously only in the bench JSON):
+        # wall vs summed stage busy is the overlap proof, scrapeable now
+        self.metrics.set_gauge(M.PIPELINE_WALL,
+                               self.pipe_stats.get("wall_s", 0.0))
+        self.metrics.set_gauge(
+            M.PIPELINE_STAGE_BUSY_SUM,
+            self.pipe_stats.get("stage_busy_sum_s", 0.0))
 
     def _kinds_of(self, constraints: Sequence[Constraint]) -> set:
         """--audit-match-kind-only prefilter (manager.go:427-483): only valid

@@ -432,6 +432,15 @@ class TpuDriver:
         Returns one QueryResponse per review.  This is the kernel behind the
         audit sweep (SURVEY.md §3.2) and the webhook batcher.
         """
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("device.query_batch", n=len(reviews),
+                          constraints=len(constraints)):
+            return self._query_batch_impl(target, constraints, reviews,
+                                          cfg, render_messages)
+
+    def _query_batch_impl(self, target, constraints, reviews, cfg,
+                          render_messages) -> list[QueryResponse]:
         cfg = cfg or ReviewCfg()
         n = len(reviews)
         responses = [QueryResponse() for _ in range(n)]

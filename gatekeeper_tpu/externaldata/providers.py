@@ -74,9 +74,16 @@ def default_send(provider: Provider, keys: list) -> dict:
     ctx = ssl.create_default_context(
         cadata=base64.b64decode(provider.ca_bundle).decode()
     )
+    headers = {"Content-Type": "application/json"}
+    # traceparent emit: the provider can join its own processing span to
+    # the admission/audit trace that triggered this fetch
+    from gatekeeper_tpu.observability import tracing
+
+    tp = tracing.format_traceparent()
+    if tp is not None:
+        headers[tracing.TRACEPARENT_HEADER] = tp
     req = urllib.request.Request(
-        provider.url, data=body,
-        headers={"Content-Type": "application/json"})
+        provider.url, data=body, headers=headers)
     with urllib.request.urlopen(req, timeout=provider.timeout_s,
                                 context=ctx) as resp:
         return json.loads(resp.read())
@@ -150,23 +157,28 @@ class ProviderCache:
         fault truncates the item list (the provider 'answered' for only a
         fraction of the keys); the missing keys surface per-key 'key not
         returned' errors downstream."""
+        from gatekeeper_tpu.observability import tracing
         from gatekeeper_tpu.resilience.faults import fault_point
 
-        action = fault_point("externaldata.send", provider=provider.name,
-                             n_keys=len(keys))
-        resp = self.send_fn(provider, keys)
-        if action is not None and action.mode == "partial":
-            items = deep_get(resp, ("response", "items"), []) or []
-            keep = int(len(items) * action.spec.fraction)
-            resp = {"response": {
-                "items": items[:keep],
-                "systemError": deep_get(resp, ("response", "systemError"),
-                                        ""),
-            }}
-        system_error = deep_get(resp, ("response", "systemError"), "")
-        if system_error:
-            raise ProviderError(f"provider {provider.name}: {system_error}")
-        return resp
+        with tracing.span("externaldata.send", provider=provider.name,
+                          n_keys=len(keys)):
+            action = fault_point("externaldata.send",
+                                 provider=provider.name, n_keys=len(keys))
+            resp = self.send_fn(provider, keys)
+            if action is not None and action.mode == "partial":
+                items = deep_get(resp, ("response", "items"), []) or []
+                keep = int(len(items) * action.spec.fraction)
+                resp = {"response": {
+                    "items": items[:keep],
+                    "systemError": deep_get(resp,
+                                            ("response", "systemError"),
+                                            ""),
+                }}
+            system_error = deep_get(resp, ("response", "systemError"), "")
+            if system_error:
+                raise ProviderError(
+                    f"provider {provider.name}: {system_error}")
+            return resp
 
     def _serve_stale(self, provider_name: str, keys: list, out: dict,
                      reason: str) -> None:

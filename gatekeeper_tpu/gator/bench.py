@@ -123,6 +123,7 @@ def run_bench(objs, engine: str, iterations: int,
     ]
     latencies = []
     violations = 0
+    from gatekeeper_tpu.observability import tracing
 
     if not reviews:
         total_reviews = 0
@@ -131,9 +132,12 @@ def run_bench(objs, engine: str, iterations: int,
         client.review_batch(reviews, enforcement_point=GATOR_EP)  # warmup
         t_all0 = time.perf_counter()
         for _ in range(iterations):
-            t0 = time.perf_counter()
-            out = client.review_batch(reviews, enforcement_point=GATOR_EP)
-            latencies.append((time.perf_counter() - t0) * 1000)
+            with tracing.span("gator.bench.pass", engine=engine,
+                              n=len(reviews)):
+                t0 = time.perf_counter()
+                out = client.review_batch(reviews,
+                                          enforcement_point=GATOR_EP)
+                latencies.append((time.perf_counter() - t0) * 1000)
             violations = sum(
                 len(o.results()) for o in out
                 if not isinstance(o, Exception)
@@ -146,11 +150,15 @@ def run_bench(objs, engine: str, iterations: int,
         t_all0 = time.perf_counter()
         for _ in range(iterations):
             pass_violations = 0
-            for rv in reviews:
-                t0 = time.perf_counter()
-                resp = client.review(rv, enforcement_point=GATOR_EP)
-                latencies.append((time.perf_counter() - t0) * 1000)
-                pass_violations += len(resp.results())
+            # one span per PASS, not per review: tracing must not tax the
+            # per-review latency samples it sits next to
+            with tracing.span("gator.bench.pass", engine=engine,
+                              n=len(reviews)):
+                for rv in reviews:
+                    t0 = time.perf_counter()
+                    resp = client.review(rv, enforcement_point=GATOR_EP)
+                    latencies.append((time.perf_counter() - t0) * 1000)
+                    pass_violations += len(resp.results())
             violations = pass_violations
         r.total_eval_s = time.perf_counter() - t_all0
         total_reviews = iterations * len(reviews)
@@ -272,6 +280,9 @@ def run_cli(argv: list[str]) -> int:
                         "degrades to serial on one-core hosts); "
                         "differential runs both and asserts bit-identical "
                         "output")
+    p.add_argument("--trace", default="",
+                   help="export a Chrome trace-event JSON of the bench "
+                        "run's spans to this path (Perfetto-loadable)")
     args = p.parse_args(argv)
 
     try:
@@ -285,14 +296,41 @@ def run_cli(argv: list[str]) -> int:
 
     engines = ([args.engine] if args.engine != "all"
                else ["rego", "cel", "all"])
+    # span-trace every engine run: an already-active tracer (gator
+    # --chaos runs under an outer harness, tests) is reused; otherwise a
+    # seeded full-sampling tracer is installed for the bench duration so
+    # the per-engine self-time summary below always has data
+    from gatekeeper_tpu.observability import (format_span_summary, tracing,
+                                              write_chrome_trace)
+
+    tracer = tracing.active_tracer()
+    installed = False
+    if tracer is None:
+        tracer = tracing.Tracer(seed=0)
+        tracing.install(tracer)
+        installed = True
     results = []
-    for engine in engines:
-        try:
-            results.append(run_bench(objs, engine, args.iterations,
-                                     pipeline=args.pipeline))
-        except Exception as e:
-            print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
-            return 1
+    try:
+        for engine in engines:
+            seen = len(tracer.traces())
+            try:
+                results.append(run_bench(objs, engine, args.iterations,
+                                         pipeline=args.pipeline))
+            except Exception as e:
+                print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
+                return 1
+            # one-line top-3-by-self-time span summary per engine run:
+            # where the wall actually went, straight from the timeline
+            print(f"[{engine}] "
+                  + format_span_summary(tracer.traces()[seen:]),
+                  file=sys.stderr)
+        if args.trace:
+            n = write_chrome_trace(args.trace, tracer)
+            print(f"trace: {n} events -> {args.trace} (load in "
+                  "ui.perfetto.dev or chrome://tracing)", file=sys.stderr)
+    finally:
+        if installed:
+            tracing.uninstall()
     if args.output == "json":
         print(json.dumps([r.to_dict() for r in results], indent=2))
     else:

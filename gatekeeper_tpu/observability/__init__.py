@@ -1,0 +1,36 @@
+"""Observability layer: end-to-end span tracing + device-timeline export.
+
+``tracing`` is the dependency-free span tracer (trace/span IDs, parent
+links, events, contextvar propagation, W3C traceparent interop, tail-
+sampled ring buffer); ``export`` renders kept traces as Chrome
+trace-event JSON (Perfetto-loadable) and self-time summaries.  The
+tracer is the one timeline that connects the webhook HTTP path, the
+batcher lane, device dispatch, and every audit-sweep pipeline stage —
+with the resilience layer's retries, breaker transitions, deadline
+misses and injected faults attached as span events.
+"""
+
+from gatekeeper_tpu.observability.export import (  # noqa: F401
+    chrome_trace,
+    format_span_summary,
+    top_spans_by_self_time,
+    write_chrome_trace,
+)
+from gatekeeper_tpu.observability.tracing import (  # noqa: F401
+    NOOP_SPAN,
+    Span,
+    SpanContext,
+    Tracer,
+    activate,
+    active_tracer,
+    add_event,
+    current_span,
+    enabled,
+    format_traceparent,
+    install,
+    parse_traceparent,
+    set_attribute,
+    span,
+    uninstall,
+    use_span,
+)

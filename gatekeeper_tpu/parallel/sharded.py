@@ -843,6 +843,13 @@ class ShardedEvaluator:
         :meth:`sweep_flatten`'s output; {} passes through (empty submit)."""
         if not isinstance(flat, _FlatChunk):
             return flat if isinstance(flat, dict) else {}
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("device.sweep_dispatch", n=flat.n,
+                          kinds=len(flat.kinds)):
+            return self._sweep_dispatch_impl(flat)
+
+    def _sweep_dispatch_impl(self, flat):
         from gatekeeper_tpu.resilience.faults import fault_point
 
         fault_point("device.dispatch", lane="sweep", n=flat.n)
@@ -937,6 +944,12 @@ class ShardedEvaluator:
             return {}
         if isinstance(pending, dict):  # empty submit
             return pending
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("device.sweep_collect", n=pending.n):
+            return self._sweep_collect_impl(pending)
+
+    def _sweep_collect_impl(self, pending):
         t0 = time.perf_counter()
         if pending.return_bits:
             packed_np = np.asarray(pending.result[0])

@@ -54,6 +54,7 @@ def effective_cpu_count() -> int:
     return os.cpu_count() or 1
 
 
+from gatekeeper_tpu.observability import tracing
 from gatekeeper_tpu.resilience.faults import fault_point
 
 
@@ -299,6 +300,13 @@ class StagedPipeline:
         remaining = [s.workers for s in self.stages]
         rem_lock = threading.Lock()
 
+        # chunk-scoped span parent: stage workers run on their own
+        # threads, so the caller's ambient span (e.g. the audit sweep
+        # root) is captured HERE and passed explicitly — every
+        # ``pipeline.stage.<name>`` span carries its chunk index, so one
+        # slow chunk is visible on the timeline
+        trace_parent = tracing.current_span()
+
         def worker(si: int, stage: Stage) -> None:
             st = stats[si]
             in_ch, out_ch = chans[si], chans[si + 1]
@@ -312,22 +320,26 @@ class StagedPipeline:
                         break
                     t0 = time.perf_counter()
                     attempt = 0
-                    while True:
-                        try:
-                            fault_point(f"pipeline.stage.{stage.name}")
-                            out = stage.fn(item)
-                            break
-                        except _Aborted:
-                            raise
-                        except BaseException as e:  # noqa: BLE001
-                            if attempt >= stage.max_retries or \
-                                    abort.is_set():
-                                fail(stage.name, e)
-                                return
-                            attempt += 1
-                            with st_locks[si]:
-                                st.retries += 1
-                            _log_stage_restart(stage.name, attempt, e)
+                    with tracing.span(f"pipeline.stage.{stage.name}",
+                                      parent=trace_parent, chunk=idx) as sp:
+                        while True:
+                            try:
+                                fault_point(f"pipeline.stage.{stage.name}")
+                                out = stage.fn(item)
+                                break
+                            except _Aborted:
+                                raise
+                            except BaseException as e:  # noqa: BLE001
+                                if attempt >= stage.max_retries or \
+                                        abort.is_set():
+                                    fail(stage.name, e)
+                                    return
+                                attempt += 1
+                                with st_locks[si]:
+                                    st.retries += 1
+                                sp.add_event("stage_retry",
+                                             attempt=attempt, error=str(e))
+                                _log_stage_restart(stage.name, attempt, e)
                     busy = time.perf_counter() - t0
                     stall = emits[si].emit(
                         idx, _SKIP if out is None else out)

@@ -259,6 +259,14 @@ def _record(site: str, mode: str, ctx: dict) -> None:
         reg.inc_counter(M.RESILIENCE_FAULTS,
                         {"site": site, "mode": mode})
     try:
+        from gatekeeper_tpu.observability import tracing
+
+        # a --chaos run with --trace shows exactly where each fault
+        # landed: the injection becomes an event on the ambient span
+        tracing.add_event("fault_injected", site=site, mode=mode)
+    except Exception:
+        pass
+    try:
         from gatekeeper_tpu.utils.logging import log_event
 
         log_event("info", "fault injected", event_type="fault_injected",

@@ -159,6 +159,15 @@ class RetryPolicy:
         last: Optional[BaseException] = None
         for attempt in range(self.attempts):
             if deadline is not None and deadline.expired:
+                try:
+                    from gatekeeper_tpu.observability import tracing
+
+                    tracing.add_event(
+                        "deadline_exceeded",
+                        dependency=self.dependency or "unknown",
+                        attempt=attempt)
+                except Exception:
+                    pass
                 raise DeadlineExceeded(
                     f"retry budget for {self.dependency or 'call'} "
                     "outlived the deadline") from last
@@ -190,6 +199,14 @@ class RetryPolicy:
             self.metrics.inc_counter(
                 M.RESILIENCE_RETRIES,
                 {"dependency": self.dependency or "unknown"})
+        try:
+            from gatekeeper_tpu.observability import tracing
+
+            tracing.add_event("retry",
+                              dependency=self.dependency or "unknown",
+                              attempt=attempt + 1, error=str(exc))
+        except Exception:
+            pass
         try:
             from gatekeeper_tpu.utils.logging import log_event
 
@@ -315,6 +332,14 @@ class CircuitBreaker:
             self.metrics.inc_counter(
                 M.RESILIENCE_BREAKER_TRANSITIONS,
                 {"dependency": self.dependency, "from": old, "to": new})
+        try:
+            from gatekeeper_tpu.observability import tracing
+
+            tracing.add_event("breaker_transition",
+                              dependency=self.dependency,
+                              breaker_from=old, breaker_to=new)
+        except Exception:
+            pass
         try:
             from gatekeeper_tpu.utils.logging import log_event
 

@@ -181,32 +181,40 @@ class KubeCluster:
 
     def _request_once(self, method: str, path: str,
                       body: Optional[dict] = None, timeout: float = 30.0):
+        from gatekeeper_tpu.observability import tracing
         from gatekeeper_tpu.resilience.faults import fault_point
 
-        fault_point(
-            "kube.request",
-            error_factory=lambda spec: KubeError(spec.status, spec.error),
-            method=method, path=path)
-        url = self.config.server.rstrip("/") + path
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        if self.config.token:
-            req.add_header("Authorization", f"Bearer {self.config.token}")
-        try:
-            resp = urllib.request.urlopen(req, timeout=timeout,
-                                          context=self._ctx)
-            return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            detail = ""
+        with tracing.span("kube.request", method=method, path=path):
+            fault_point(
+                "kube.request",
+                error_factory=lambda spec: KubeError(spec.status, spec.error),
+                method=method, path=path)
+            url = self.config.server.rstrip("/") + path
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            if self.config.token:
+                req.add_header("Authorization",
+                               f"Bearer {self.config.token}")
+            # traceparent emit: apiserver audit logs / proxies can join
+            # this request to the originating admission or sweep trace
+            tp = tracing.format_traceparent()
+            if tp is not None:
+                req.add_header(tracing.TRACEPARENT_HEADER, tp)
             try:
-                detail = (json.loads(e.read() or b"{}")
-                          .get("message", "")) or e.reason
-            except Exception:
-                detail = str(e.reason)
-            raise KubeError(e.code, detail) from None
+                resp = urllib.request.urlopen(req, timeout=timeout,
+                                              context=self._ctx)
+                return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                detail = ""
+                try:
+                    detail = (json.loads(e.read() or b"{}")
+                              .get("message", "")) or e.reason
+                except Exception:
+                    detail = str(e.reason)
+                raise KubeError(e.code, detail) from None
 
     # --- discovery ---------------------------------------------------
     def _resource_for(self, gvk: tuple) -> tuple:

@@ -144,15 +144,17 @@ class ValidationHandler:
         dropped."""
         if self.deadline_budget_s <= 0:
             return self._handle(review_body)
+        from gatekeeper_tpu.observability import tracing
         from gatekeeper_tpu.resilience.policy import Deadline, deadline_scope
 
         dl = Deadline(self.deadline_budget_s)
         done = threading.Event()
         slot: dict = {}
+        parent_span = tracing.current_span()  # request span -> helper thread
 
         def run():
             try:
-                with deadline_scope(dl):
+                with tracing.use_span(parent_span), deadline_scope(dl):
                     slot["resp"] = self._handle(review_body)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 slot["err"] = e
@@ -167,6 +169,9 @@ class ValidationHandler:
                 raise err
             return slot["resp"]
         uid = ((review_body.get("request") or {}).get("uid", "")) or ""
+        tracing.add_event("deadline_exceeded", component="webhook",
+                          policy=self.failure_policy,
+                          budget_s=self.deadline_budget_s)
         if self.metrics is not None:
             from gatekeeper_tpu.metrics import registry as m
 
@@ -294,6 +299,13 @@ class ValidationHandler:
 
     def _review(self, augmented):
         req = augmented.admission_request
+        from gatekeeper_tpu.observability import tracing as otel
+
+        with otel.span("webhook.review", uid=req.uid,
+                       kind=(req.kind or {}).get("kind", "")):
+            return self._review_inner(augmented, req)
+
+    def _review_inner(self, augmented, req):
         from gatekeeper_tpu.resilience.faults import fault_point
 
         fault_point("webhook.review", uid=req.uid,
@@ -465,20 +477,27 @@ class Batcher:
             self._thread.join(timeout=2)
 
     def review(self, augmented):
+        from gatekeeper_tpu.observability import tracing
         from gatekeeper_tpu.resilience.policy import (DeadlineExceeded,
                                                       current_deadline)
 
         done = threading.Event()
         slot: dict = {}
-        self._queue.put((augmented, done, slot, time.perf_counter()))
-        dl = current_deadline()
-        timeout = None if dl is None else dl.remaining()
-        if not done.wait(timeout):
-            # the request's deadline budget expired while queued (or on
-            # the device): abandon the slot — the batch loop still sets
-            # it later, nobody is waiting
-            raise DeadlineExceeded("batched review outlived the "
-                                   "request deadline budget")
+        # the caller's span rides the queue entry so the batch thread's
+        # flush span can parent into the request's trace (cross-thread
+        # propagation is explicit — contextvars don't cross the lane)
+        with tracing.span("webhook.batcher.enqueue") as sp:
+            self._queue.put((augmented, done, slot, time.perf_counter(),
+                             tracing.current_span()))
+            dl = current_deadline()
+            timeout = None if dl is None else dl.remaining()
+            if not done.wait(timeout):
+                # the request's deadline budget expired while queued (or on
+                # the device): abandon the slot — the batch loop still sets
+                # it later, nobody is waiting
+                sp.add_event("deadline_exceeded", component="batcher")
+                raise DeadlineExceeded("batched review outlived the "
+                                       "request deadline budget")
         if "error" in slot:
             raise slot["error"]
         return slot["responses"]
@@ -521,27 +540,37 @@ class Batcher:
                         break
             reviews = [b[0] for b in batch]
             self._observe_batch(batch)
+            from gatekeeper_tpu.observability import tracing
+
+            lane = ("interp" if len(batch) <= self.small_batch
+                    else "grid")
             try:
-                if len(batch) <= self.small_batch:
-                    # low-latency lane: per-review exact interpreter.
-                    # Each slot completes as soon as ITS review finishes
-                    # (no head-of-line wait on the rest of the batch)
-                    for aug, done, slot, _t in batch:
-                        try:
-                            slot["responses"] = self.client.review(
-                                aug, enforcement_point=WEBHOOK_EP,
-                                stats=self.stats)
-                        except Exception as e:
-                            slot["error"] = e
-                        done.set()
-                    continue
-                else:
+                # the flush span lives on the batch thread, parented into
+                # the FIRST entry's trace (its request waited longest);
+                # the other coalesced requests are recorded by count
+                with tracing.span("webhook.batcher.flush",
+                                  parent=batch[0][4],
+                                  batch_size=len(batch), lane=lane):
+                    if lane == "interp":
+                        # low-latency lane: per-review exact interpreter.
+                        # Each slot completes as soon as ITS review
+                        # finishes (no head-of-line wait on the rest of
+                        # the batch)
+                        for aug, done, slot, _t, _sp in batch:
+                            try:
+                                slot["responses"] = self.client.review(
+                                    aug, enforcement_point=WEBHOOK_EP,
+                                    stats=self.stats)
+                            except Exception as e:
+                                slot["error"] = e
+                            done.set()
+                        continue
                     all_responses = self.client.review_batch(
                         reviews, enforcement_point=WEBHOOK_EP,
                         stats=self.stats,
                     )
-                for (_, done, slot, _t), responses in zip(batch,
-                                                          all_responses):
+                for (_, done, slot, _t, _sp), responses in \
+                        zip(batch, all_responses):
                     # per-slot isolation: one bad request must not poison the
                     # coalesced batch (review_batch returns Exception entries)
                     if isinstance(responses, Exception):
@@ -550,6 +579,6 @@ class Batcher:
                         slot["responses"] = responses
                     done.set()
             except Exception as e:
-                for _, done, slot, _t in batch:
+                for _, done, slot, _t, _sp in batch:
                     slot["error"] = e
                     done.set()

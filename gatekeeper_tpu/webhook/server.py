@@ -17,12 +17,15 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from gatekeeper_tpu.observability import tracing
+
 ADMIT_PATH = "/v1/admit"
 MUTATE_PATH = "/v1/mutate"
 ADMIT_LABEL_PATH = "/v1/admitlabel"
 HEALTH_PATH = "/healthz"
 METRICS_PATH = "/metrics"
 PROFILE_PATH = "/debug/profile"
+TRACES_PATH = "/debug/traces"
 
 
 def admission_response(uid: str, allowed: bool, message: str = "",
@@ -122,6 +125,17 @@ class WebhookServer:
                 elif self.path.startswith(PROFILE_PATH) and \
                         outer.enable_profile:
                     self._profile()
+                elif self.path == TRACES_PATH:
+                    # tail-sampled span ring buffer, served next to
+                    # /metrics: the tracer keeps the N most recent kept
+                    # traces (slow ones always kept), JSON per
+                    # observability/tracing.Tracer.snapshot
+                    tracer = tracing.active_tracer()
+                    if tracer is None:
+                        self._reply(404, {"error": "tracing not enabled "
+                                                   "(run with --trace)"})
+                    else:
+                        self._reply(200, tracer.snapshot())
                 elif self.path == METRICS_PATH and outer.metrics is not None:
                     data = outer.metrics.render().encode()
                     self.send_response(200)
@@ -191,19 +205,26 @@ class WebhookServer:
                     return
                 uid = ((body.get("request") or {}).get("uid", "")) or ""
                 _track_inflight(+1)
+                # W3C trace-context ingest: a traceparent header parents
+                # the request span into the caller's trace (apiserver or
+                # load generator); absent/malformed starts a fresh trace
+                remote = tracing.parse_traceparent(
+                    self.headers.get(tracing.TRACEPARENT_HEADER))
                 try:
-                    from gatekeeper_tpu.resilience.faults import \
-                        fault_point
+                    with tracing.span("webhook.request", parent=remote,
+                                      path=self.path, uid=uid):
+                        from gatekeeper_tpu.resilience.faults import \
+                            fault_point
 
-                    fault_point("webhook.request", path=self.path)
-                    if self.path == ADMIT_PATH:
-                        self._admit(body, uid)
-                    elif self.path == MUTATE_PATH:
-                        self._mutate(body, uid)
-                    elif self.path == ADMIT_LABEL_PATH:
-                        self._admit_label(body, uid)
-                    else:
-                        self._reply(404, {"error": "not found"})
+                        fault_point("webhook.request", path=self.path)
+                        if self.path == ADMIT_PATH:
+                            self._admit(body, uid)
+                        elif self.path == MUTATE_PATH:
+                            self._mutate(body, uid)
+                        elif self.path == ADMIT_LABEL_PATH:
+                            self._admit_label(body, uid)
+                        else:
+                            self._reply(404, {"error": "not found"})
                 except Exception as e:
                     # handler bug: admission.Errored equivalent — a
                     # well-formed allowed=false code-500 response, matching
@@ -250,6 +271,12 @@ class WebhookServer:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                # traceparent emit: callers correlate their timeline with
+                # the server-side request span
+                tp = tracing.format_traceparent()
+                if tp is not None:
+                    tracing.set_attribute("http.status", status)
+                    self.send_header(tracing.TRACEPARENT_HEADER, tp)
                 if close:
                     # send_header("Connection", "close") also sets
                     # close_connection so handle() drops the socket after

@@ -1,0 +1,77 @@
+"""Metrics registry exposition format: TYPE lines, label escaping,
+quantile label ordering, numeric formatting (the scrape must parse)."""
+
+from gatekeeper_tpu.metrics.registry import (MetricsRegistry, PREFIX, _fmt,
+                                             _num)
+
+
+def test_counter_gauge_summary_type_lines():
+    reg = MetricsRegistry()
+    reg.inc_counter("requests_count", {"status": "allow"})
+    reg.inc_counter("requests_count", {"status": "deny"}, value=2)
+    reg.set_gauge("depth", 3)
+    reg.observe("latency_seconds", 0.5)
+    out = reg.render()
+    lines = out.splitlines()
+    assert f"# TYPE {PREFIX}requests_count counter" in lines
+    assert f"# TYPE {PREFIX}depth gauge" in lines
+    assert f"# TYPE {PREFIX}latency_seconds summary" in lines
+    # exactly ONE TYPE line per metric name, before its first sample
+    assert sum(1 for ln in lines if ln.startswith("# TYPE")) == 3
+    assert f'{PREFIX}requests_count{{status="allow"}} 1' in lines
+    assert f'{PREFIX}requests_count{{status="deny"}} 2' in lines
+    assert f"{PREFIX}depth 3" in lines
+    assert out.endswith("\n")
+
+
+def test_summary_count_sum_and_quantile_label_ordering():
+    reg = MetricsRegistry()
+    for v in (0.1, 0.2, 0.3, 0.4, 1.0):
+        reg.observe("dur_seconds", v, {"stage": "flatten"})
+    lines = reg.render().splitlines()
+    assert f'{PREFIX}dur_seconds_count{{stage="flatten"}} 5' in lines
+    assert f'{PREFIX}dur_seconds_sum{{stage="flatten"}} 2' in lines
+    # quantile rides LAST, after the sorted user labels
+    for q in ("0.5", "0.9", "0.99"):
+        assert any(
+            ln.startswith(f'{PREFIX}dur_seconds{{stage="flatten",'
+                          f'quantile="{q}"}} ')
+            for ln in lines), (q, lines)
+
+
+def test_label_values_are_escaped():
+    reg = MetricsRegistry()
+    reg.inc_counter("errs_count", {"msg": 'say "hi"\nback\\slash'})
+    out = reg.render()
+    line = next(ln for ln in out.splitlines()
+                if ln.startswith(f"{PREFIX}errs_count"))
+    # exposition-format escapes: \\ then \" then \n — and the rendered
+    # page must not contain a raw newline inside a label value
+    assert '\\"hi\\"' in line
+    assert "\\n" in line and "\nback" not in line
+    assert "back\\\\slash" in line
+    # every sample line still has the NAME{LABELS} VALUE shape
+    for ln in out.splitlines():
+        if not ln.startswith("#"):
+            assert ln.rsplit(" ", 1)[1] != ""
+
+
+def test_fmt_and_num_formatting():
+    assert _fmt(()) == ""
+    assert _fmt((("a", "x"),)) == '{a="x"}'
+    assert _fmt((("a", 'q"u'), ("b", "c\\d"))) == \
+        '{a="q\\"u",b="c\\\\d"}'
+    # integral floats render as integers, true floats as repr
+    assert _num(3.0) == "3"
+    assert _num(0) == "0"
+    assert _num(0.5) == "0.5"
+    assert _num(1e-9) == "1e-09"
+
+
+def test_counter_total_and_get_helpers():
+    reg = MetricsRegistry()
+    reg.inc_counter("c", {"k": "a"})
+    reg.inc_counter("c", {"k": "b"}, value=4)
+    assert reg.counter_total("c") == 5
+    assert reg.get_counter("c", {"k": "a"}) == 1
+    assert reg.get_gauge("missing") is None

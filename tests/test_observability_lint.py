@@ -1,0 +1,66 @@
+"""Tier-1 gate: every fault_point site and every gatekeeper_* metric
+constant must be documented in tools/observability_registry.md."""
+
+import importlib.util
+import pathlib
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_observability", _TOOLS / "lint_observability.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_registry_is_in_sync():
+    lint = _load_lint()
+    problems = lint.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_source_scan_sees_known_sites_and_metrics():
+    lint = _load_lint()
+    sites = lint.fault_sites_in_source()
+    # the multi-line kube call site and the f-string pipeline site are
+    # the two parse hazards; both must resolve
+    assert "kube.request" in sites
+    assert "pipeline.stage.*" in sites
+    assert "device.dispatch" in sites
+    metrics = lint.metric_names_in_source()
+    assert "gatekeeper_validation_request_count" in metrics
+    assert "gatekeeper_trace_traces_kept_count" in metrics
+    assert "gatekeeper_audit_pipeline_stage_busy_sum_seconds" in metrics
+    # PREFIX itself is configuration, not a metric
+    assert "gatekeeper_gatekeeper_" not in metrics
+
+
+def test_lint_flags_undocumented_additions(tmp_path, monkeypatch):
+    """An undocumented site or metric must produce a problem (the gate
+    actually gates)."""
+    lint = _load_lint()
+    doc_sites, doc_metrics = lint.documented()
+
+    monkeypatch.setattr(
+        lint, "fault_sites_in_source",
+        lambda: {**{s: ["x:1"] for s in doc_sites},
+                 "rogue.site": ["gatekeeper_tpu/rogue.py:1"]})
+    monkeypatch.setattr(
+        lint, "metric_names_in_source",
+        lambda: {**{m: "OK" for m in doc_metrics},
+                 "gatekeeper_rogue_count": "ROGUE"})
+    problems = lint.check()
+    assert any("rogue.site" in p for p in problems)
+    assert any("gatekeeper_rogue_count" in p for p in problems)
+
+
+def test_lint_flags_stale_documentation(monkeypatch):
+    lint = _load_lint()
+    doc_sites, doc_metrics = lint.documented()
+    monkeypatch.setattr(
+        lint, "documented",
+        lambda: (doc_sites | {"gone.site"}, doc_metrics))
+    problems = lint.check()
+    assert any("gone.site" in p and "stale" in p for p in problems)

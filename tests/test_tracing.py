@@ -1,0 +1,238 @@
+"""Span tracer core: IDs, parent links, context propagation, W3C
+traceparent interop, tail sampling, ring bounds, Chrome export."""
+
+import json
+import threading
+
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import export, tracing
+
+
+# --- zero-cost disabled path ----------------------------------------------
+
+def test_disabled_tracer_is_noop():
+    assert tracing.active_tracer() is None
+    assert not tracing.enabled()
+    with tracing.span("anything", attr=1) as s:
+        s.set_attribute("k", "v")
+        s.add_event("ev", x=1)
+        assert tracing.current_span() is None  # noop span is not ambient
+    tracing.add_event("free-floating")  # must not raise
+    assert tracing.format_traceparent() is None
+
+
+# --- span structure -------------------------------------------------------
+
+def test_parent_links_and_attributes():
+    t = tracing.Tracer(seed=1)
+    with tracing.activate(t):
+        with tracing.span("root", lane="test") as r:
+            assert tracing.current_span() is r
+            with tracing.span("child", chunk=7) as c:
+                c.add_event("retry", attempt=1)
+            with tracing.span("child2"):
+                pass
+    traces = t.traces()
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr["root"] == "root" and tr["n_spans"] == 3
+    by_name = {s["name"]: s for s in tr["spans"]}
+    root = by_name["root"]
+    assert root["parent_id"] is None
+    assert root["attributes"] == {"lane": "test"}
+    assert by_name["child"]["parent_id"] == root["span_id"]
+    assert by_name["child"]["attributes"]["chunk"] == 7
+    assert by_name["child"]["events"][0]["name"] == "retry"
+    assert by_name["child2"]["parent_id"] == root["span_id"]
+    assert all(s["trace_id"] == tr["trace_id"] for s in tr["spans"])
+
+
+def test_span_records_error_status():
+    t = tracing.Tracer(seed=1)
+    with tracing.activate(t):
+        try:
+            with tracing.span("boom"):
+                raise ValueError("nope")
+        except ValueError:
+            pass
+    sp = t.traces()[0]["spans"][0]
+    assert sp["status"] == "error"
+    assert "nope" in sp["error"]
+
+
+def test_deterministic_ids_under_seed():
+    def run(seed):
+        t = tracing.Tracer(seed=seed)
+        with tracing.activate(t):
+            with tracing.span("a"):
+                with tracing.span("b"):
+                    pass
+            with tracing.span("c"):
+                pass
+        return [(s["trace_id"], s["span_id"])
+                for tr in t.traces() for s in tr["spans"]]
+
+    assert run(42) == run(42)
+    assert run(42) != run(43)
+
+
+def test_explicit_parent_crosses_threads():
+    t = tracing.Tracer(seed=2)
+    got = {}
+    with tracing.activate(t):
+        with tracing.span("request") as req:
+            def worker():
+                # contextvars do not cross threads: the parent must ride
+                # explicitly (the batcher / pipeline-stage pattern)
+                assert tracing.current_span() is None
+                with tracing.use_span(req):
+                    with tracing.span("work") as w:
+                        got["trace"] = w.trace_id
+                        got["parent"] = w.parent_id
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+    assert got["trace"] == req.trace_id
+    assert got["parent"] == req.span_id
+    assert t.traces()[0]["n_spans"] == 2
+
+
+# --- W3C traceparent ------------------------------------------------------
+
+def test_traceparent_roundtrip():
+    t = tracing.Tracer(seed=3)
+    with tracing.activate(t):
+        with tracing.span("out") as s:
+            header = tracing.format_traceparent()
+            assert header == f"00-{s.trace_id}-{s.span_id}-01"
+    ctx = tracing.parse_traceparent(header)
+    assert ctx.trace_id == s.trace_id and ctx.span_id == s.span_id
+    # a remote parent joins the caller's trace but the local span is
+    # still the LOCAL root (its end finalizes the trace)
+    with tracing.activate(t):
+        with tracing.span("ingest", parent=ctx):
+            pass
+    tr = t.traces()[-1]
+    assert tr["trace_id"] == s.trace_id
+    assert tr["spans"][0]["parent_id"] == s.span_id
+
+
+def test_traceparent_rejects_malformed():
+    bad = [
+        None, "", "garbage", "00-abc-def-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+    ]
+    for h in bad:
+        assert tracing.parse_traceparent(h) is None, h
+
+
+# --- tail sampling + ring bounds -----------------------------------------
+
+def test_empty_sampler_retains_nothing():
+    t = tracing.Tracer(seed=0, sample_rate=0.0)
+    with tracing.activate(t):
+        for _ in range(5):
+            with tracing.span("r"):
+                pass
+    assert t.traces() == []
+    assert t.sampled_out == 5 and t.kept == 0
+    assert t.span_count == 5  # the machinery ran; nothing was retained
+
+
+def test_slow_traces_always_kept():
+    clock = [0.0]
+    t = tracing.Tracer(seed=0, sample_rate=0.0, slow_threshold_s=1.0,
+                       clock=lambda: clock[0])
+    with tracing.activate(t):
+        with tracing.span("fast"):
+            clock[0] += 0.5
+        with tracing.span("slow"):
+            clock[0] += 2.0
+    kept = t.traces()
+    assert [tr["root"] for tr in kept] == ["slow"]
+    assert t.sampled_out == 1
+
+
+def test_probabilistic_sampling_is_seeded():
+    def run():
+        t = tracing.Tracer(seed=7, sample_rate=0.5)
+        with tracing.activate(t):
+            for i in range(40):
+                with tracing.span(f"r{i}"):
+                    pass
+        return [tr["root"] for tr in t.traces()]
+
+    first = run()
+    assert run() == first  # same seed -> same keep/drop sequence
+    assert 0 < len(first) < 40
+
+
+def test_ring_buffer_is_bounded():
+    t = tracing.Tracer(seed=0, ring_capacity=8)
+    with tracing.activate(t):
+        for i in range(30):
+            with tracing.span(f"r{i}"):
+                pass
+    traces = t.traces()
+    assert len(traces) == 8
+    assert traces[-1]["root"] == "r29"  # most recent kept
+    assert t.kept == 30  # kept counts all, the ring holds the tail
+
+
+def test_sampler_outcomes_flow_into_metrics():
+    from gatekeeper_tpu.metrics import registry as M
+
+    reg = MetricsRegistry()
+    t = tracing.Tracer(seed=0, sample_rate=0.0, slow_threshold_s=10.0,
+                       metrics=reg)
+    with tracing.activate(t):
+        with tracing.span("r"):
+            pass
+    assert reg.counter_total(M.TRACE_SAMPLED_OUT) == 1
+    assert reg.counter_total(M.TRACE_KEPT) == 0
+
+
+# --- export ---------------------------------------------------------------
+
+def test_chrome_trace_export(tmp_path):
+    t = tracing.Tracer(seed=5)
+    with tracing.activate(t):
+        with tracing.span("root"):
+            with tracing.span("stage", chunk=3) as s:
+                s.add_event("fault_injected", site="x", mode="error")
+    path = tmp_path / "out.json"
+    n = export.write_chrome_trace(str(path), t)
+    doc = json.loads(path.read_text())
+    assert len(doc["traceEvents"]) == n
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"root", "stage"}
+    stage = next(e for e in complete if e["name"] == "stage")
+    assert stage["args"]["chunk"] == 3
+    assert stage["args"]["parent_id"]
+    assert stage["ts"] > 0 and stage["dur"] >= 0
+    instant = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert instant[0]["name"] == "fault_injected"
+    assert instant[0]["args"] == {"site": "x", "mode": "error"}
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert meta and meta[0]["name"] == "thread_name"
+
+
+def test_self_time_summary_ranks_by_self_time():
+    clock = [0.0]
+    t = tracing.Tracer(seed=0, clock=lambda: clock[0])
+    with tracing.activate(t):
+        with tracing.span("outer"):
+            clock[0] += 0.1  # outer self-time
+            with tracing.span("inner"):
+                clock[0] += 5.0  # inner dominates
+    ranked = export.top_spans_by_self_time(t.traces(), top=3)
+    assert ranked[0][0] == "inner"
+    assert abs(ranked[0][1] - 5.0) < 1e-6
+    assert abs(ranked[1][1] - 0.1) < 1e-6  # outer MINUS child time
+    line = export.format_span_summary(t.traces())
+    assert line.startswith("spans (top self-time): inner")
+    assert export.format_span_summary([]) == "spans: (no traces kept)"

@@ -1,0 +1,135 @@
+"""Observability registry lint.
+
+Cross-checks the code's observability surface against the documented
+registry (``tools/observability_registry.md``):
+
+- every ``fault_point("<site>")`` call site in ``gatekeeper_tpu/`` must
+  be documented (f-string sites like ``pipeline.stage.{name}`` are
+  normalized to their ``pipeline.stage.*`` pattern);
+- every metric-name constant in ``gatekeeper_tpu/metrics/registry.py``
+  must be documented under its exposed ``gatekeeper_*`` name;
+- stale documentation (a documented site/metric that no longer exists
+  in the source) fails too, so the registry can be trusted.
+
+Run standalone (``python tools/lint_observability.py``) or via tier-1
+(``tests/test_observability_lint.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "gatekeeper_tpu"
+REGISTRY_MD = REPO / "tools" / "observability_registry.md"
+METRICS_PY = PKG / "metrics" / "registry.py"
+
+_FAULT_CALL = re.compile(r'fault_point\(\s*(f?)"([^"]+)"')
+_DOC_ENTRY = re.compile(r"^\s*-\s+`([^`]+)`")
+_FSTRING_FIELD = re.compile(r"\{[^}]*\}")
+
+
+def documented() -> tuple[set, set]:
+    """(fault sites, metric names) parsed from the registry markdown."""
+    sites: set = set()
+    metrics: set = set()
+    section = ""
+    for line in REGISTRY_MD.read_text().splitlines():
+        if line.startswith("## "):
+            section = line[3:].strip().lower()
+            continue
+        m = _DOC_ENTRY.match(line)
+        if not m:
+            continue
+        if section.startswith("fault sites"):
+            sites.add(m.group(1))
+        elif section.startswith("metrics"):
+            metrics.add(m.group(1))
+    return sites, metrics
+
+
+def fault_sites_in_source() -> dict:
+    """site -> [file:line] for every ``fault_point("...")`` literal in
+    the package (docstrings included — a documented example must name a
+    real site too).  F-string sites normalize ``{expr}`` to ``*``."""
+    out: dict = {}
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        # whole-text scan: call sites wrap across lines (the \s* spans
+        # the newline between the paren and the site string)
+        for m in _FAULT_CALL.finditer(text):
+            site = m.group(2)
+            if m.group(1):  # f-string: dynamic segments become *
+                site = _FSTRING_FIELD.sub("*", site)
+            line = text.count("\n", 0, m.start()) + 1
+            out.setdefault(site, []).append(
+                f"{path.relative_to(REPO)}:{line}")
+    return out
+
+
+def metric_names_in_source() -> dict:
+    """exposed name ('gatekeeper_' + value) -> constant name, from the
+    module-level string constants of metrics/registry.py."""
+    tree = ast.parse(METRICS_PY.read_text())
+    prefix = "gatekeeper_"
+    out: dict = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) or not target.id.isupper():
+            continue
+        if target.id == "PREFIX":
+            if isinstance(node.value, ast.Constant):
+                prefix = node.value.value
+            continue
+        if isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[prefix + node.value.value] = target.id
+    return out
+
+
+def check() -> list:
+    """List of problem strings; empty means the registry is in sync."""
+    problems: list = []
+    doc_sites, doc_metrics = documented()
+    src_sites = fault_sites_in_source()
+    src_metrics = metric_names_in_source()
+    for site, where in sorted(src_sites.items()):
+        if site not in doc_sites:
+            problems.append(
+                f"undocumented fault site {site!r} ({where[0]}) — add it "
+                f"to {REGISTRY_MD.relative_to(REPO)}")
+    for site in sorted(doc_sites - set(src_sites)):
+        problems.append(
+            f"stale documented fault site {site!r} — no fault_point() "
+            "call site matches; remove it from the registry")
+    for name, const in sorted(src_metrics.items()):
+        if name not in doc_metrics:
+            problems.append(
+                f"undocumented metric {name!r} (constant {const} in "
+                f"{METRICS_PY.relative_to(REPO)}) — add it to "
+                f"{REGISTRY_MD.relative_to(REPO)}")
+    for name in sorted(doc_metrics - set(src_metrics)):
+        problems.append(
+            f"stale documented metric {name!r} — no matching constant in "
+            f"{METRICS_PY.relative_to(REPO)}; remove it from the registry")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"lint: {p}", file=sys.stderr)
+    if not problems:
+        sites, metrics = documented()
+        print(f"observability registry in sync: {len(sites)} fault "
+              f"sites, {len(metrics)} metrics")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
