@@ -39,13 +39,17 @@ PROBE_BACKOFF_S = (10.0, 30.0)
 # --pipeline=auto|on|off|differential (default auto: staged host pipeline
 # when the host has >1 effective core, serial eager-poll otherwise)
 PIPELINE_MODE = "auto"
+# --flatten-lane=auto|dict|raw|py|differential (sweep columnizer lane;
+# auto = raw bytes through the threaded C columnizer when available)
+FLATTEN_LANE = "auto"
 # --trace out.json: span-trace the timed sweeps and export a Chrome
 # trace-event file at exit (Perfetto-loadable device timeline)
 TRACE_PATH = ""
 
 
 def _parse_pipeline_flag(argv: list) -> list:
-    """Strip --pipeline[=mode], --chaos[=spec.json] and --trace[=path]
+    """Strip --pipeline[=mode], --flatten-lane[=lane], --chaos[=spec.json]
+    and --trace[=path]
     from argv (the remaining args stay positional: N [chunk] |
     sweep [N [chunk]]).  --chaos installs the fault-injection plan
     process-wide so a bench run doubles as a deterministic chaos run (the
@@ -53,7 +57,7 @@ def _parse_pipeline_flag(argv: list) -> list:
     the JSON artifact); --trace installs the span tracer (seeded, full
     sampling) and writes the Chrome trace-event artifact — with --chaos
     the injected faults show up as instant events on the spans they hit."""
-    global PIPELINE_MODE, TRACE_PATH
+    global PIPELINE_MODE, TRACE_PATH, FLATTEN_LANE
     out = []
     chaos = ""
     it = iter(argv)
@@ -62,6 +66,10 @@ def _parse_pipeline_flag(argv: list) -> list:
             PIPELINE_MODE = next(it, "auto")
         elif a.startswith("--pipeline="):
             PIPELINE_MODE = a.split("=", 1)[1]
+        elif a == "--flatten-lane":
+            FLATTEN_LANE = next(it, "auto")
+        elif a.startswith("--flatten-lane="):
+            FLATTEN_LANE = a.split("=", 1)[1]
         elif a == "--chaos":
             chaos = next(it, "")
         elif a.startswith("--chaos="):
@@ -330,7 +338,8 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
-    evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20)
+    evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
+                                 flatten_lane=FLATTEN_LANE)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
                       exact_totals=False, submit_window=submit_window,
                       pipeline=PIPELINE_MODE)
@@ -388,6 +397,7 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
                        "schedule": ("pipelined"
                                     if mgr.perf.get("pipelined")
                                     else "serial")}
+    out["flatten_lane"] = FLATTEN_LANE
     if mgr.pipe_stats:
         out["pipeline"].update(mgr.pipe_stats)
     if cpu_fallback:
@@ -435,7 +445,8 @@ def main():
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
-    evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20)
+    evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
+                                 flatten_lane=FLATTEN_LANE)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
                       exact_totals=False, pipeline=PIPELINE_MODE)
     mgr = AuditManager(client, lister=lambda: iter(objects), config=cfg,
@@ -517,6 +528,7 @@ def main():
                        "schedule": ("pipelined"
                                     if phases.get("pipelined")
                                     else "serial")}
+    out["flatten_lane"] = FLATTEN_LANE
     if pipe_stats:
         out["pipeline"].update(pipe_stats)
     if cpu_fallback:
@@ -525,12 +537,15 @@ def main():
         out["cpu_fallback"] = True
     bench_history_append({
         "note": f"auto-appended by bench.py (pipeline={PIPELINE_MODE}, "
-                f"schedule={out['pipeline']['schedule']})",
+                f"schedule={out['pipeline']['schedule']}, "
+                f"flatten_lane={FLATTEN_LANE})",
         "value": out["value"],
         "legacy": out["legacy_3template_reviews_per_s"],
         "platform": out["platform"],
         "pass_iqr_s": iqr,
         "date": time.strftime("%Y-%m-%d"),
+        "flatten_lane": FLATTEN_LANE,
+        "host_cpus": os.cpu_count(),
         **({"cpu_fallback": True} if cpu_fallback else {}),
     })
     export_trace()

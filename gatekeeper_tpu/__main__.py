@@ -58,6 +58,15 @@ def main(argv=None) -> int:
                         "The C columnizer already shards each chunk over "
                         "an internal pthread pool; extra workers overlap "
                         "the Python assembly slices across chunks")
+    p.add_argument("--flatten-lane", default="auto",
+                   choices=["auto", "dict", "raw", "py", "differential"],
+                   help="sweep columnizer lane: 'auto' feeds raw JSON "
+                        "bytes from the lister straight through the "
+                        "threaded C columnizer when available (falling "
+                        "back to the GIL-bound dict walker, then "
+                        "Python); 'raw'/'dict'/'py' force a lane; "
+                        "'differential' runs raw THEN dict per chunk "
+                        "and asserts bit-identical columns (debugging)")
     p.add_argument("--export-dir", default="",
                    help="enable disk export of audit violations")
     p.add_argument("--log-denies", action="store_true",
@@ -367,7 +376,9 @@ def main(argv=None) -> int:
 
             evaluator = ShardedEvaluator(
                 tpu, make_mesh(),
-                violations_limit=args.constraint_violations_limit)
+                violations_limit=args.constraint_violations_limit,
+                flatten_lane=args.flatten_lane,
+                metrics=metrics)
 
         if kube_cluster is not None:
             # discovery-driven audit listing (auditResources,

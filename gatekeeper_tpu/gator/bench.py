@@ -67,7 +67,8 @@ def _drivers_for(engine: str):
 
 
 def run_bench(objs, engine: str, iterations: int,
-              pipeline: str = "auto") -> BenchResult:
+              pipeline: str = "auto",
+              flatten_lane: str = "auto") -> BenchResult:
     templates = [o for o in objs if reader.is_template(o)]
     constraints = [o for o in objs if reader.is_constraint(o)]
     data = [o for o in objs
@@ -109,7 +110,8 @@ def run_bench(objs, engine: str, iterations: int,
     r.setup_data_s = time.perf_counter() - t0
 
     if engine == "sweep":
-        return _run_sweep_bench(r, client, data, iterations, pipeline)
+        return _run_sweep_bench(r, client, data, iterations, pipeline,
+                                flatten_lane)
 
     from gatekeeper_tpu.target.review import AugmentedReview
     from gatekeeper_tpu.webhook.policy import parse_admission_review
@@ -183,7 +185,8 @@ def _fill_latencies(r: BenchResult, latencies: list) -> None:
 
 
 def _run_sweep_bench(r: BenchResult, client: Client, data: list,
-                     iterations: int, pipeline: str) -> BenchResult:
+                     iterations: int, pipeline: str,
+                     flatten_lane: str = "auto") -> BenchResult:
     """The ``sweep`` engine: the production audit lane (AuditManager +
     ShardedEvaluator) over the fixture's data objects, scheduled through
     the staged host pipeline per ``--pipeline``.  One latency sample per
@@ -199,7 +202,8 @@ def _run_sweep_bench(r: BenchResult, client: Client, data: list,
     mgr = AuditManager(
         client, lister=lambda: iter(corpus),
         config=AuditConfig(pipeline=pipeline),
-        evaluator=ShardedEvaluator(tpu, make_mesh()),
+        evaluator=ShardedEvaluator(tpu, make_mesh(),
+                                   flatten_lane=flatten_lane),
     )
     latencies = []
     violations = 0
@@ -280,6 +284,13 @@ def run_cli(argv: list[str]) -> int:
                         "degrades to serial on one-core hosts); "
                         "differential runs both and asserts bit-identical "
                         "output")
+    p.add_argument("--flatten-lane", default="auto",
+                   choices=["auto", "dict", "raw", "py", "differential"],
+                   help="sweep-engine columnizer lane: raw JSON bytes "
+                        "through the threaded C columnizer (auto/raw) "
+                        "vs the GIL-bound dict walker (dict) vs Python "
+                        "(py); differential runs raw THEN dict and "
+                        "asserts bit-identical columns")
     p.add_argument("--trace", default="",
                    help="export a Chrome trace-event JSON of the bench "
                         "run's spans to this path (Perfetto-loadable)")
@@ -315,7 +326,8 @@ def run_cli(argv: list[str]) -> int:
             seen = len(tracer.traces())
             try:
                 results.append(run_bench(objs, engine, args.iterations,
-                                         pipeline=args.pipeline))
+                                         pipeline=args.pipeline,
+                                         flatten_lane=args.flatten_lane))
             except Exception as e:
                 print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
                 return 1

@@ -184,6 +184,12 @@ PIPELINE_STAGE_BUSY_SUM = "audit_pipeline_stage_busy_sum_seconds"
 # many finished traces the ring buffer kept vs sampled out
 TRACE_KEPT = "trace_traces_kept_count"
 TRACE_SAMPLED_OUT = "trace_traces_sampled_out_count"
+# flatten lanes (ops/flatten.py + parallel/sharded.py sweep_flatten):
+# which columnizer lane each sweep chunk actually took {lane=raw|dict|
+# py|differential:*}, and the last chunk's host flatten throughput —
+# the ROADMAP's "flatten is the sweep ceiling" number, scrapeable
+FLATTEN_LANE = "flatten_lane_count"
+FLATTEN_OBJECTS_PER_SECOND = "flatten_objects_per_second"
 # webhook serving-lane contention (VERDICT r4 weak #5 instrumentation):
 # in-flight admission handlers per worker, time a review spent queued in
 # the batcher lane before its batch ran, and the coalesced batch sizes —

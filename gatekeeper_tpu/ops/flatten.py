@@ -538,6 +538,51 @@ def _synth_review(obj: dict) -> dict:
     }
 
 
+def diff_batches(schema: Schema, a: ColumnBatch, b: ColumnBatch):
+    """First difference between two flattened batches (None when
+    bit-identical): identity columns, axis counts, and every column of
+    every family.  Shapes count — the lanes share one bucket grid, so a
+    width mismatch is a real divergence."""
+
+    def ne(x, y):
+        if x is None or y is None:
+            return (x is None) != (y is None)
+        x, y = np.asarray(x), np.asarray(y)
+        return x.shape != y.shape or not np.array_equal(x, y)
+
+    for name in ("group_sid", "kind_sid", "ns_sid", "name_sid"):
+        if ne(getattr(a, name), getattr(b, name)):
+            return f"identity column {name}"
+    if set(a.axis_counts) != set(b.axis_counts):
+        return "axis sets differ"
+    for axis, cnt in a.axis_counts.items():
+        if ne(cnt, b.axis_counts[axis]):
+            return f"axis counts {axis.key()}"
+    families = (
+        ("scalars", a.scalars, b.scalars, ("kind", "num", "sid")),
+        ("raggeds", a.raggeds, b.raggeds, ("kind", "num", "sid")),
+        ("keysets", a.keysets, b.keysets, ("sid", "count")),
+        ("ragged_keysets", a.ragged_keysets, b.ragged_keysets,
+         ("sid", "count")),
+        ("map_keys", a.map_keys, b.map_keys, ("sid",)),
+        ("parent_idx", a.parent_idx, b.parent_idx, ("idx",)),
+    )
+    for label, fa, fb, fields in families:
+        if set(fa) != set(fb):
+            return f"{label} spec sets differ"
+        for spec, ca in fa.items():
+            cb = fb[spec]
+            for f in fields:
+                if ne(getattr(ca, f), getattr(cb, f)):
+                    return f"{label}[{spec}].{f}"
+    if set(a.canons) != set(b.canons):
+        return "canon spec sets differ"
+    for spec, sa in a.canons.items():
+        if ne(sa, b.canons[spec]):
+            return f"canons[{spec}]"
+    return None
+
+
 def round_up(n: int, bucket: int = 8) -> int:
     """Pad ragged widths to buckets so jit shapes stay stable."""
     if n <= 0:
@@ -545,10 +590,14 @@ def round_up(n: int, bucket: int = 8) -> int:
     return ((n + bucket - 1) // bucket) * bucket
 
 
+FLATTEN_LANES = ("auto", "dict", "raw", "py", "differential")
+
+
 class Flattener:
     def __init__(self, schema: Schema, vocab: Optional[Vocab] = None,
                  use_native: bool = True, bucket: int = 8,
-                 width_targets: Optional[dict] = None):
+                 width_targets: Optional[dict] = None,
+                 lane: str = "auto"):
         # prefix-axis dedup: extraction runs over the exec schema; the
         # requested (orig) specs are aliased onto the exec columns after
         # flatten (same numpy arrays — identity the wire packer dedups on)
@@ -569,6 +618,19 @@ class Flattener:
         # flatten sub-phase wall-clock (c_columnize / py_assemble /
         # canon_fill / stabilize) — folded into the evaluator's perf dict
         self.perf: dict = {}
+        # lane selection (--flatten-lane): 'auto' takes the raw-bytes
+        # threaded columnizer when every object carries bytes and the
+        # native module built, else the C dict walker, else Python;
+        # 'raw'/'dict'/'py' force a lane (raw serializes dict inputs
+        # once); 'differential' runs raw THEN dict over one vocab and
+        # asserts bit-identical columns (⇒ bit-identical verdicts)
+        if lane not in FLATTEN_LANES:
+            raise ValueError(f"unknown flatten lane {lane!r}")
+        self.lane = lane
+        # the lane the last flatten() actually took ('raw'/'dict'/'py'),
+        # for metrics/span attribution; 'raw' batches that fell back to
+        # the dict lane on a parse reject report the lane they landed on
+        self.lane_used: str = ""
 
     def _apply_alias(self, batch: ColumnBatch) -> ColumnBatch:
         for orig, new in self.alias.items():
@@ -663,18 +725,25 @@ class Flattener:
                 reviews: Optional[Sequence[dict]] = None) -> ColumnBatch:
         """``reviews``: per-object review documents (kind/operation/...)
         backing __review__-rooted scalar columns; synthesized from the
-        objects when not supplied (the audit path)."""
+        objects when not supplied (the audit path).  Lane dispatch per
+        ``self.lane`` (see __init__)."""
+        lane = self.lane
+        if lane == "differential" and objects:
+            return self._flatten_differential(objects, pad_n, reviews)
+        use_native = self.use_native and lane != "py"
         if objects:
             from gatekeeper_tpu.utils.rawjson import RawJSON
 
-            if self.use_native and all(isinstance(o, RawJSON)
-                                       for o in objects):
+            if use_native and lane in ("auto", "raw") and (
+                    lane == "raw" or all(isinstance(o, RawJSON)
+                                         for o in objects)):
                 from gatekeeper_tpu.ops import native
 
                 if native.load_json() is not None:
                     # materialized (possibly mutated) RawJSONs are
                     # re-serialized inside flatten_raw, so the lane stays
-                    # correct for mixed batches
+                    # correct for mixed batches; a forced 'raw' lane
+                    # serializes dict inputs once
                     return self.flatten_raw(objects, pad_n=pad_n,
                                             reviews=reviews)
             # the C dict columnizer reads dict storage directly
@@ -704,7 +773,7 @@ class Flattener:
             schema.map_keys = list(map_key_cols)
             schema.parent_idx = list(parent_idx_cols)
             schema.extra_axes = list(getattr(self.schema, "extra_axes", []))
-        inner = Flattener(schema, self.vocab, self.use_native,
+        inner = Flattener(schema, self.vocab, use_native,
                           bucket=self.bucket)
         mod = None
         if inner.use_native:
@@ -714,8 +783,10 @@ class Flattener:
             batch = (inner._flatten_native(mod, objects, pad_n)
                      if mod is not None
                      else inner._flatten_py(objects, pad_n))
+            self.lane_used = "dict" if mod is not None else "py"
         else:
             batch = inner._flatten_py(objects, pad_n)
+            self.lane_used = "py"
         if review_cols:
             if reviews is None:
                 reviews = [_synth_review(o) for o in objects]
@@ -833,26 +904,30 @@ class Flattener:
                 items.append(json.dumps(o, separators=(",", ":")).encode())
         nthreads = int(os.environ.get("GTPU_FLATTEN_THREADS", "0") or 0) \
             or (os.cpu_count() or 1)
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        fault_point("ops.flatten_raw", n=len(items), nthreads=nthreads)
         import time as _time
         _t0 = _time.perf_counter()
-        out = mod.flatten_json_batch(
-            items,
-            [tuple(s.path) for s in schema.scalars],
-            [a.segments for a in axes],
-            [(axis_index[r.axis], tuple(r.subpath))
-             for r in schema.raggeds],
-            [tuple(k.path) for k in schema.keysets],
-            [axis_index[mk.axis] for mk in schema.map_keys],
-            [(axis_index[p.axis], axis_index[p.parent])
-             for p in schema.parent_idx],
-            [(axis_index[rk.axis], tuple(rk.subpath))
-             for rk in schema.ragged_keysets],
-            self.vocab._to_id,
-            self.vocab._to_str,
-            int(pad_n or len(items)),
-            self.bucket,  # ragged bucket, matches round_up()
-            nthreads,
-        )
+        try:
+            out = self._call_columnizer(
+                mod, items, schema, axes, axis_index, pad_n, nthreads)
+        except ValueError:
+            # the C parser rejected an item: malformed/truncated bytes,
+            # or input past its stricter limits (e.g. >256 nesting).
+            # The dict lane is the oracle — re-parse in Python and take
+            # it for this batch; an item json.loads also rejects raises
+            # THERE, into the chunk retry/drop machinery.  The vocab is
+            # untouched by the failed call (parse errors surface before
+            # the intern merge), so the fallback interns identically.
+            objects = [o if isinstance(o, dict) else RawJSON(bytes(o))
+                       for o in raws]
+            prev_lane, self.lane = self.lane, "dict"
+            try:
+                return self.flatten(objects, pad_n=pad_n, reviews=reviews)
+            finally:
+                self.lane = prev_lane
+        self.lane_used = "raw"
         self.perf["c_columnize"] = (self.perf.get("c_columnize", 0.0)
                                     + _time.perf_counter() - _t0)
         _t0 = _time.perf_counter()
@@ -876,6 +951,12 @@ class Flattener:
         for spec, (sid, cnt) in zip(schema.ragged_keysets,
                                     out["ragged_keysets"]):
             batch.ragged_keysets[spec] = RaggedKeySetColumn(sid, cnt)
+        # canon columns computed inside the kernel pass (the Python
+        # _fill_canons below skips specs already present — it remains
+        # the oracle for the dict lane and older native builds)
+        for spec, sid in zip(getattr(schema, "canons", []),
+                             out.get("canons", [])):
+            batch.canons[spec] = sid
         if reviews is not None:
             # provided review docs override the synthesized columns
             self._fill_review_cols(
@@ -894,6 +975,57 @@ class Flattener:
         self.perf["stabilize"] = (self.perf.get("stabilize", 0.0)
                                   + _time.perf_counter() - _t0)
         return batch
+
+    def _call_columnizer(self, mod, items, schema, axes, axis_index,
+                         pad_n, nthreads):
+        """The raw native call, specs marshalled from the exec schema."""
+        return mod.flatten_json_batch(
+            items,
+            [tuple(s.path) for s in schema.scalars],
+            [a.segments for a in axes],
+            [(axis_index[r.axis], tuple(r.subpath))
+             for r in schema.raggeds],
+            [tuple(k.path) for k in schema.keysets],
+            [axis_index[mk.axis] for mk in schema.map_keys],
+            [(axis_index[p.axis], axis_index[p.parent])
+             for p in schema.parent_idx],
+            [(axis_index[rk.axis], tuple(rk.subpath))
+             for rk in schema.ragged_keysets],
+            [(tuple(cc.path), 1 if cc.ns_scoped else 0)
+             for cc in getattr(schema, "canons", [])],
+            self.vocab._to_id,
+            self.vocab._to_str,
+            int(pad_n or len(items)),
+            self.bucket,  # ragged bucket, matches round_up()
+            nthreads,
+        )
+
+    def _flatten_differential(self, objects, pad_n, reviews) -> ColumnBatch:
+        """``lane='differential'``: run the raw lane THEN the dict lane
+        over the same objects and the same vocab, and assert every
+        column array is bit-identical.  Raw runs first so every dict-
+        lane intern is a lookup hit — identical columns therefore prove
+        identical verdicts for any program reading them.  Returns the
+        raw batch."""
+        from gatekeeper_tpu.utils.rawjson import as_raw
+
+        raws = [as_raw(o) for o in objects]
+        prev = self.lane
+        try:
+            self.lane = "raw"
+            braw = self.flatten(raws, pad_n=pad_n, reviews=reviews)
+            raw_lane = self.lane_used
+            self.lane = "dict"
+            bdict = self.flatten(raws, pad_n=pad_n, reviews=reviews)
+        finally:
+            self.lane = prev
+        diff = diff_batches(self.orig_schema, braw, bdict)
+        if diff:
+            raise RuntimeError(
+                f"flatten lane differential mismatch ({raw_lane} vs "
+                f"{self.lane_used}): {diff}")
+        self.lane_used = f"differential:{raw_lane}"
+        return braw
 
     def _fill_canons(self, batch: ColumnBatch, objects) -> None:
         """Canonical-selector sid columns (CanonCol) — computed host-side

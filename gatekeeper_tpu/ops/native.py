@@ -62,27 +62,46 @@ def load_json() -> Optional[object]:
     return _load_named("gtpu_flattenjson", "flattenjsonmod.c")
 
 
+def _build_flags() -> list:
+    """The full compiler invocation prefix (compiler + every flag).
+    ``GTPU_NATIVE_CFLAGS`` appends extra flags (sanitizer builds, the
+    lint harness, tests)."""
+    cc = sysconfig.get_config_var("CC") or "cc"
+    cflags = (sysconfig.get_config_var("CFLAGS") or "").split()
+    extra = os.environ.get("GTPU_NATIVE_CFLAGS", "").split()
+    return (
+        cc.split()
+        + ["-O3", "-shared", "-fPIC", "-pthread"]
+        + [f for f in cflags if f.startswith("-f") or f.startswith("-m")]
+        + extra
+    )
+
+
+def _flag_digest(flags: list) -> str:
+    import hashlib
+
+    return hashlib.sha256(" ".join(flags).encode()).hexdigest()[:12]
+
+
 def _build(name: str, src_file: str):
     import numpy as np
 
     src = os.path.abspath(os.path.join(_NATIVE_DIR, src_file))
-    out_dir = os.path.abspath(_BUILD_DIR)
+    flags = _build_flags()
+    # the flag set is hashed into the output directory: a compile-flag
+    # change (edited CFLAGS, GTPU_NATIVE_CFLAGS, a different compiler)
+    # lands in a fresh dir and rebuilds — the mtime check alone silently
+    # reused the old binary under flag drift
+    out_dir = os.path.abspath(os.path.join(_BUILD_DIR, _flag_digest(flags)))
     os.makedirs(out_dir, exist_ok=True)
     ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = os.path.join(out_dir, name + ext)
     if not os.path.exists(out) or (
         os.path.getmtime(out) < os.path.getmtime(src)
     ):
-        cc = sysconfig.get_config_var("CC") or "cc"
-        cflags = (sysconfig.get_config_var("CFLAGS") or "").split()
         include = sysconfig.get_path("include")
         np_include = np.get_include()
-        cmd = (
-            cc.split()
-            + ["-O3", "-shared", "-fPIC", "-pthread", src, "-o", out,
-               f"-I{include}", f"-I{np_include}"]
-            + [f for f in cflags if f.startswith("-f") or f.startswith("-m")]
-        )
+        cmd = flags + [src, "-o", out, f"-I{include}", f"-I{np_include}"]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     if out_dir not in sys.path:
         sys.path.insert(0, out_dir)

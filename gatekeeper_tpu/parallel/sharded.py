@@ -533,10 +533,16 @@ class ShardedEvaluator:
     ids agree with single-chip evaluation.
     """
 
-    def __init__(self, driver, mesh: Mesh, violations_limit: int = 20):
+    def __init__(self, driver, mesh: Mesh, violations_limit: int = 20,
+                 flatten_lane: str = "auto", metrics=None):
         self.driver = driver
         self.mesh = mesh
         self.violations_limit = violations_limit
+        # --flatten-lane: how sweep chunks columnize (ops/flatten.py
+        # FLATTEN_LANES) — auto takes the raw-bytes threaded C lane when
+        # the lister hands over bytes and the native module built
+        self.flatten_lane = flatten_lane
+        self.metrics = metrics
         self._sweep_fns: dict = {}
         self._table_dev_cache: dict = {}  # key -> (host_array, dev_array)
         self._param_dev_cache: dict = {}  # digest -> dev uint8 buffer
@@ -565,7 +571,8 @@ class ShardedEvaluator:
 
     def _flattener(self, schema: Schema) -> Flattener:
         return Flattener(schema, self.driver.vocab, bucket=self._bucket,
-                         width_targets=self._width_targets or None)
+                         width_targets=self._width_targets or None,
+                         lane=self.flatten_lane)
 
     def _needs_union(self, kinds, alias: Optional[dict] = None) -> dict:
         """Union of array fields any lowered program reads — the
@@ -700,7 +707,8 @@ class ShardedEvaluator:
             for kind in lowered:
                 schema.merge(self.driver._programs[kind].program.schema)
             fl = Flattener(schema, self.driver.vocab,
-                           bucket=self._bucket)
+                           bucket=self._bucket,
+                           lane=self.flatten_lane)
             st = (cons_g, fl, self._needs_union(lowered, fl.alias))
             state[g] = st
             return st
@@ -815,12 +823,26 @@ class ShardedEvaluator:
             schema.merge(self.driver._programs[kind].program.schema)
         n = len(objects)
         pad_n = self._pad(n)
+        from gatekeeper_tpu.observability import tracing
+
         t0 = time.perf_counter()
         fl = self._flattener(schema)
-        batch = fl.flatten(objects, pad_n=pad_n)
-        self._perf_add("flatten", time.perf_counter() - t0)
+        with tracing.span("ops.flatten.columnize", n=n,
+                          lane=self.flatten_lane) as sp:
+            batch = fl.flatten(objects, pad_n=pad_n)
+            sp.set_attribute("lane_used", fl.lane_used)
+        dt = time.perf_counter() - t0
+        self._perf_add("flatten", dt)
         for k, v in fl.perf.items():  # sub-phases of the flatten above
             self._perf_add("fl_" + k, v)
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(M.FLATTEN_LANE,
+                                     {"lane": fl.lane_used or "unknown"})
+            if dt > 0:
+                self.metrics.set_gauge(M.FLATTEN_OBJECTS_PER_SECOND,
+                                       n / dt)
 
         cols = pack_batch_cols(batch)
         # transfer slimming: ship only the array fields some program reads

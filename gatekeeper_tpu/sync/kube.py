@@ -124,11 +124,18 @@ class KubeCluster:
                  watch_backoff_s: float = 1.0,
                  watch_timeout_s: float = 300.0,
                  metrics=None,
-                 retry_attempts: int = 3):
+                 retry_attempts: int = 3,
+                 raw_list: bool = True):
         self.config = config
         self.page_limit = page_limit
         self.watch_backoff_s = watch_backoff_s
         self.watch_timeout_s = watch_timeout_s
+        # raw-bytes list lane: ``list_iter`` yields lazily-parsed
+        # RawJSON objects split straight out of the page bytes, so the
+        # audit sweep's kind routing (peek_kind) and the threaded C
+        # columnizer never materialize Python dicts.  Consumers that do
+        # touch the objects parse on first access — same dict surface.
+        self.raw_list = raw_list
         self._ctx = self._ssl_context(config)
         self._discovery: dict = {}  # (group, version) -> {kind: (res, nsd)}
         self._watchers: list = []
@@ -171,16 +178,20 @@ class KubeCluster:
         return isinstance(e, OSError)
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, raw: bool = False):
+        # ``raw`` travels as a kwarg ONLY when set: _request_once is a
+        # monkeypatch seam and existing doubles carry the 4-arg shape
+        kw = {"raw": True} if raw else {}
         if method == "GET":
             return self._retry.call(
                 self._request_once, method, path, body, timeout,
                 retry_on=(KubeError, OSError),
-                giveup=lambda e: not self._transient(e))
-        return self._request_once(method, path, body, timeout)
+                giveup=lambda e: not self._transient(e), **kw)
+        return self._request_once(method, path, body, timeout, **kw)
 
     def _request_once(self, method: str, path: str,
-                      body: Optional[dict] = None, timeout: float = 30.0):
+                      body: Optional[dict] = None, timeout: float = 30.0,
+                      raw: bool = False):
         from gatekeeper_tpu.observability import tracing
         from gatekeeper_tpu.resilience.faults import fault_point
 
@@ -206,7 +217,10 @@ class KubeCluster:
             try:
                 resp = urllib.request.urlopen(req, timeout=timeout,
                                               context=self._ctx)
-                return json.loads(resp.read() or b"{}")
+                data = resp.read()
+                if raw:
+                    return data or b"{}"
+                return json.loads(data or b"{}")
             except urllib.error.HTTPError as e:
                 detail = ""
                 try:
@@ -305,10 +319,55 @@ class KubeCluster:
             if not cont:
                 return
 
+    def _pages_raw(self, gvk: tuple) -> Iterable[tuple]:
+        """Paged LIST over raw bytes: yields (RawJSON items, list
+        metadata) per page without materializing item dicts.  The page
+        bytes split per item (utils/rawjson.split_list_items) and each
+        item is backfilled with the List's apiVersion/kind by byte
+        splice; a page the splitter rejects falls back to the parsed
+        path for that page."""
+        from gatekeeper_tpu.utils.rawjson import (RawJSON, backfill_gvk,
+                                                  split_list_items)
+
+        path = self._collection_path(gvk)
+        cont = ""
+        while True:
+            q = {"limit": str(self.page_limit)}
+            if cont:
+                q["continue"] = cont
+            page = self._request("GET", path + "?" +
+                                 urllib.parse.urlencode(q), raw=True)
+            try:
+                spans, envelope = split_list_items(page)
+            except ValueError:
+                doc = json.loads(page)
+                gv = doc.get("apiVersion", "")
+                item_kind = (doc.get("kind", "") or "List")[:-4]
+                items = doc.get("items", [])
+                for item in items:
+                    item.setdefault("apiVersion", gv)
+                    item.setdefault("kind", item_kind)
+                meta = doc.get("metadata", {})
+            else:
+                gv = envelope.get("apiVersion", "")
+                item_kind = (envelope.get("kind", "") or "List")[:-4]
+                items = [RawJSON(backfill_gvk(s, gv, item_kind))
+                         for s in spans]
+                meta = envelope.get("metadata", {})
+            yield items, meta
+            cont = meta.get("continue", "")
+            if not cont:
+                return
+
     def list_iter(self, gvk: tuple) -> Iterable[dict]:
         """Streaming paged list: yields objects page by page (the audit's
-        chunked List; pages are the spill-to-disk analog)."""
-        for items, _meta in self._pages(gvk):
+        chunked List; pages are the spill-to-disk analog).  With
+        ``raw_list`` (the default) objects are lazily-parsed RawJSON
+        views over the page bytes — the audit sweep routes them by
+        ``peek_kind`` and columnizes the bytes directly in the threaded
+        native lane."""
+        pages = self._pages_raw(gvk) if self.raw_list else self._pages(gvk)
+        for items, _meta in pages:
             yield from items
 
     def _list_paged(self, gvk: tuple) -> tuple:

@@ -222,3 +222,82 @@ def as_raw(obj) -> "RawJSON":
     if isinstance(obj, (bytes, bytearray, memoryview)):
         return RawJSON(bytes(obj))
     return RawJSON(json.dumps(obj, separators=(",", ":")).encode())
+
+
+# one regex pass yields only strings and structural brackets; strings
+# are consumed wholesale so brackets inside them never count
+_STRUCT_TOKEN = _re.compile(rb'"(?:[^"\\]|\\.)*"|[{}\[\]]')
+
+
+def split_list_items(page: bytes) -> tuple:
+    """Split a K8s ``*List`` response into per-item raw byte spans.
+
+    Returns ``(item_spans, envelope)``: the raw bytes of each element of
+    the top-level ``items`` array, plus the envelope dict (the page with
+    ``items`` replaced by ``[]`` — apiVersion/kind/metadata.continue
+    parse from a few hundred bytes instead of the whole page).  This is
+    the zero-copy half of the raw-bytes flatten path: list pages never
+    materialize their items as Python dicts.
+
+    Raises ``ValueError`` when the page has no top-level ``items`` array
+    or carries non-object elements — callers fall back to the parsed
+    page.
+    """
+    items: list = []
+    depth = 0
+    in_items = False
+    pend_key = None  # (token bytes, token end) of the last depth-1 string
+    items_lb = items_rb = -1
+    elem_start = -1
+    for m in _STRUCT_TOKEN.finditer(page):
+        t = page[m.start()]
+        if t == 0x22:  # string
+            if depth == 1 and not in_items:
+                pend_key = (m.group(), m.end())
+            elif in_items and depth == 2:
+                raise ValueError("non-object element in items")
+            continue
+        if t == 0x7B:  # {
+            if in_items and depth == 2:
+                elem_start = m.start()
+            depth += 1
+        elif t == 0x5B:  # [
+            # an '[' at depth 1 in valid JSON can only be a key's value:
+            # it opens the items array iff that key is "items"
+            if (depth == 1 and not in_items and pend_key is not None
+                    and pend_key[0] == b'"items"'
+                    and page[pend_key[1]:m.start()].strip() == b":"):
+                in_items = True
+                items_lb = m.start()
+            depth += 1
+        elif t == 0x7D:  # }
+            depth -= 1
+            if in_items and depth == 2 and elem_start >= 0:
+                items.append(page[elem_start:m.end()])
+                elem_start = -1
+        else:  # ]
+            depth -= 1
+            if in_items and depth == 1:
+                in_items = False
+                items_rb = m.end()
+    if items_lb < 0 or items_rb < 0 or depth != 0:
+        raise ValueError("no top-level items array")
+    envelope = json.loads(page[:items_lb] + b"[]" + page[items_rb:])
+    return items, envelope
+
+
+def backfill_gvk(raw: bytes, api_version: str, kind: str) -> bytes:
+    """Prepend apiVersion/kind defaults to one split List item (List
+    responses omit them on elements).  JSON duplicate keys are last-wins
+    (both ``json.loads`` and the native parser), so an item carrying
+    either key keeps its own value — the byte-splice equivalent of
+    ``dict.setdefault``, and it lands the keys where ``peek_kind``'s
+    head fast path reads them."""
+    if not raw.startswith(b"{"):
+        return raw
+    head = b'{"apiVersion":%s,"kind":%s' % (
+        json.dumps(api_version).encode(), json.dumps(kind).encode())
+    rest = raw[1:]
+    if rest.lstrip().startswith(b"}"):
+        return head + rest
+    return head + b"," + rest

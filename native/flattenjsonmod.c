@@ -35,6 +35,24 @@
 enum { K_ABSENT = 0, K_FALSE = 1, K_TRUE = 2, K_NUM = 3, K_STR = 4,
        K_OTHER = 5, K_NULL = 6, K_MAP = 7 };
 
+/* SWAR (SIMD-within-a-register) byte scanning: find quote/backslash/
+ * whitespace bytes 8 at a time with the classic haszero bit trick.
+ * Little-endian GCC/Clang hosts only; everything falls back to the
+ * scalar loops elsewhere. */
+#if defined(__GNUC__) && defined(__BYTE_ORDER__) && \
+    __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+#define GTPU_SWAR 1
+#define SWAR_ONES 0x0101010101010101ULL
+#define SWAR_HIGH 0x8080808080808080ULL
+
+static inline uint64_t
+swar_eq(uint64_t w, uint64_t b)
+{
+    uint64_t x = w ^ (SWAR_ONES * b);
+    return (x - SWAR_ONES) & ~x & SWAR_HIGH;
+}
+#endif
+
 /* ---------------- arena ---------------- */
 
 typedef struct ArenaBlock {
@@ -183,6 +201,136 @@ intern_get(Intern *it, const char *s, uint32_t n)
     return id;
 }
 
+/* probe without inserting: id or -1 */
+static int32_t
+intern_lookup(const Intern *it, const char *s, uint32_t n)
+{
+    uint32_t h = fnv1a(s, n);
+    uint32_t j = h & (it->cap - 1);
+    while (it->tab[j]) {
+        if (it->tabhash[j] == h) {
+            int32_t id = it->tab[j] - 1;
+            if (it->lens[id] == n && memcmp(it->strs[id], s, n) == 0)
+                return id;
+        }
+        j = (j + 1) & (it->cap - 1);
+    }
+    return -1;
+}
+
+/* reset for reuse: entries dropped, allocations kept */
+static void
+intern_reset(Intern *it)
+{
+    it->count = 0;
+    memset(it->tab, 0, it->cap * sizeof(int32_t));
+}
+
+/* ---------------- persistent global vocab mirror ----------------
+ *
+ * The batch merge used to round-trip EVERY thread-locally interned
+ * string through the Python vocab dict (PyUnicode_DecodeUTF8 +
+ * PyDict_GetItem per string per batch) — over a chunked sweep the same
+ * ~36k-string vocabulary re-pays that cost on every chunk.  The mirror
+ * is a C-side positive cache of the Python vocab: entry i holds the
+ * UTF-8 bytes of to_str[i] (an owned reference keeps the unicode
+ * object's cached UTF-8 buffer alive), so merge hits resolve with one
+ * C hash probe and only genuinely-new strings touch Python objects.
+ *
+ * All mutation happens with the GIL held.  Correctness does not depend
+ * on the mirror being complete: it only ever holds verified
+ * (bytes -> position-in-to_str) pairs, so a hit is always right and a
+ * miss falls back to the exact dict path.  Vocab identity changes
+ * (a different Vocab object) reset it; a to_str that shrank or carries
+ * duplicates disables it until the next identity change. */
+
+typedef struct {
+    PyObject *to_id;    /* identity markers only (borrowed, never used) */
+    PyObject *to_str;
+    PyObject **objs;    /* owned refs: entry i == to_str[i] */
+    Py_ssize_t count, cap;
+    Intern table;       /* bytes -> mirrored position */
+    int inited;
+    int disabled;       /* duplicate/undecodable vocab entry seen */
+} VocabMirror;
+
+static VocabMirror g_vm;
+
+/* append one vocab string; 0 ok, 1 skip (dup / no utf8), -1 oom */
+static int
+vm_push(PyObject *s)
+{
+    Py_ssize_t len;
+    const char *u = PyUnicode_AsUTF8AndSize(s, &len);
+    if (u == NULL) {
+        PyErr_Clear();
+        return 1;
+    }
+    if (g_vm.count == g_vm.cap) {
+        Py_ssize_t ncap = g_vm.cap * 2;
+        PyObject **no = (PyObject **)realloc(
+            (void *)g_vm.objs, (size_t)ncap * sizeof(PyObject *));
+        if (no == NULL)
+            return -1;
+        g_vm.objs = no;
+        g_vm.cap = ncap;
+    }
+    int32_t id = intern_get(&g_vm.table, u, (uint32_t)len);
+    if (id < 0)
+        return -1;
+    if (id != (int32_t)g_vm.count)
+        return 1; /* duplicate string: table unchanged (probe hit) */
+    Py_INCREF(s);
+    g_vm.objs[g_vm.count++] = s;
+    return 0;
+}
+
+static int
+vm_reset(void)
+{
+    for (Py_ssize_t i = 0; i < g_vm.count; i++)
+        Py_DECREF(g_vm.objs[i]);
+    g_vm.count = 0;
+    g_vm.disabled = 0;
+    if (!g_vm.inited) {
+        g_vm.cap = 1024;
+        g_vm.objs = (PyObject **)malloc((size_t)g_vm.cap *
+                                        sizeof(PyObject *));
+        if (g_vm.objs == NULL || intern_init(&g_vm.table) < 0)
+            return -1;
+        g_vm.inited = 1;
+    } else {
+        intern_reset(&g_vm.table);
+    }
+    return 0;
+}
+
+/* sync the mirror up to len(to_str); 0 usable, 1 disabled, -1 oom */
+static int
+vm_sync(PyObject *to_id, PyObject *to_str)
+{
+    if (!g_vm.inited || g_vm.to_id != to_id || g_vm.to_str != to_str ||
+        g_vm.count > PyList_GET_SIZE(to_str)) {
+        if (vm_reset() < 0)
+            return -1;
+        g_vm.to_id = to_id;
+        g_vm.to_str = to_str;
+    }
+    if (g_vm.disabled)
+        return 1;
+    Py_ssize_t n = PyList_GET_SIZE(to_str);
+    for (Py_ssize_t i = g_vm.count; i < n; i++) {
+        int r = vm_push(PyList_GET_ITEM(to_str, i));
+        if (r < 0)
+            return -1;
+        if (r) {
+            g_vm.disabled = 1;
+            return 1;
+        }
+    }
+    return 0;
+}
+
 /* ---------------- JSON DOM + parser ---------------- */
 
 enum { JT_NULL, JT_FALSE, JT_TRUE, JT_NUM, JT_STR, JT_ARR, JT_OBJ };
@@ -241,9 +389,27 @@ static void
 skip_ws(Parser *ps)
 {
     const char *p = ps->p;
-    while (p < ps->end &&
-           (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+    const char *end = ps->end;
+    /* minified K8s serializations: the first byte almost always breaks
+     * straight out; the SWAR run-skip only engages after a whitespace
+     * byte was actually seen (pretty-printed docs: indentation runs) */
+    while (p < end) {
+        char c = *p;
+        if (c != ' ' && c != '\t' && c != '\n' && c != '\r')
+            break;
         p++;
+#ifdef GTPU_SWAR
+        while (p + 8 <= end) {
+            uint64_t w, ws;
+            memcpy(&w, p, 8);
+            ws = swar_eq(w, ' ') | swar_eq(w, '\n') |
+                 swar_eq(w, '\t') | swar_eq(w, '\r');
+            if (ws != SWAR_HIGH)
+                break;
+            p += 8;
+        }
+#endif
+    }
     ps->p = p;
 }
 
@@ -301,12 +467,24 @@ hex4(const char *p, uint32_t *out)
 }
 
 /* parse a JSON string (after the opening quote); returns 0 ok.
- * *sout/*nout point either into the input (no escapes) or an arena copy. */
+ * *sout and *nout point into the input (no escapes) or an arena copy. */
 static int
 parse_string(Parser *ps, const char **sout, uint32_t *nout)
 {
     const char *p = ps->p;
     const char *start = p;
+#ifdef GTPU_SWAR
+    while (p + 8 <= ps->end) {
+        uint64_t w, hit;
+        memcpy(&w, p, 8);
+        hit = swar_eq(w, '"') | swar_eq(w, '\\');
+        if (hit) {
+            p += __builtin_ctzll(hit) >> 3;
+            break;
+        }
+        p += 8;
+    }
+#endif
     while (p < ps->end && *p != '"' && *p != '\\')
         p++;
     if (p >= ps->end)
@@ -573,6 +751,25 @@ parse_value(Parser *ps, int depth)
         return n;
     }
     if (c == '-' || (c >= '0' && c <= '9')) {
+        /* fast path for short decimal integers (ports, counts, replica
+         * numbers dominate K8s docs): <= 15 digits are exact in a double
+         * and need none of strtod's locale/rounding machinery */
+        const char *q = ps->p;
+        if (*q == '-')
+            q++;
+        const char *d0 = q;
+        uint64_t v = 0;
+        while (q < ps->end && *q >= '0' && *q <= '9' && q - d0 < 16) {
+            v = v * 10 + (uint64_t)(*q - '0');
+            q++;
+        }
+        if (q > d0 && q - d0 <= 15 &&
+            (q >= ps->end || (*q != '.' && *q != 'e' && *q != 'E'))) {
+            ps->p = q;
+            JNode *n = jnode_new(ps, JT_NUM);
+            if (n) n->u.num = (c == '-') ? -(double)v : (double)v;
+            return n;
+        }
         char *endp = NULL;
         double d = strtod(ps->p, &endp);
         if (endp == ps->p)
@@ -634,6 +831,43 @@ typedef struct {
     CPath sub;
 } CRKSpec;
 
+typedef struct {
+    CPath path;
+    int ns_scoped;
+} CCanonSpec;
+
+/* Per-axis subpath trie over the ragged columns that share the axis:
+ * the per-column extraction loop used to re-walk every shared subpath
+ * prefix per item per column (securityContext.* columns each re-found
+ * securityContext).  One trie descent per item touches each prefix
+ * once.  Nodes live in the spec arena; children are a sibling list
+ * (ragged fan-out per level is small). */
+typedef struct RTrie {
+    struct RTrie *children, *sibling;
+    const char *key;
+    uint32_t klen;
+    int col; /* ragged column whose subpath ends here, else -1 */
+} RTrie;
+
+static RTrie *
+rtrie_child(RTrie *node, const char *k, uint32_t kn, Arena *ar)
+{
+    RTrie *c;
+    for (c = node->children; c != NULL; c = c->sibling)
+        if (c->klen == kn && memcmp(c->key, k, kn) == 0)
+            return c;
+    c = (RTrie *)arena_alloc(ar, sizeof(RTrie));
+    if (c == NULL)
+        return NULL;
+    c->children = NULL;
+    c->key = k;
+    c->klen = kn;
+    c->col = -1;
+    c->sibling = node->children;
+    node->children = c;
+    return c;
+}
+
 /* ---------------- DOM helpers ---------------- */
 
 static JNode *
@@ -641,9 +875,13 @@ obj_get(JNode *o, const char *k, uint32_t kn)
 {
     if (o == NULL || o->type != JT_OBJ)
         return NULL;
+    char k0 = kn ? k[0] : 0;
     for (uint32_t i = 0; i < o->n; i++) {
+        /* length + first-byte reject before the memcmp call: K8s keys
+         * cluster at 4-10 bytes, so length alone collides constantly */
         if (o->u.obj.klens[i] == kn &&
-            memcmp(o->u.obj.keys[i], k, kn) == 0)
+            (kn == 0 || (o->u.obj.keys[i][0] == k0 &&
+                         memcmp(o->u.obj.keys[i], k, kn) == 0)))
             return o->u.obj.vals[i];
     }
     return NULL;
@@ -695,10 +933,14 @@ typedef struct {
 } NKList;
 
 static int
-nklist_push(NKList *l, JNode *n, const char *k, uint32_t kn)
+nklist_reserve(NKList *l, size_t extra)
 {
-    if (l->n == l->cap) {
-        size_t ncap = l->cap ? l->cap * 2 : 64;
+    if (l->n + extra <= l->cap)
+        return 0;
+    size_t ncap = l->cap ? l->cap * 2 : 64;
+    while (ncap < l->n + extra)
+        ncap *= 2;
+    {
         JNode **ni = (JNode **)realloc((void *)l->items,
                                        ncap * sizeof(JNode *));
         const char **nk = (const char **)realloc((void *)l->keys,
@@ -713,11 +955,142 @@ nklist_push(NKList *l, JNode *n, const char *k, uint32_t kn)
         }
         l->items = ni; l->keys = nk; l->klens = nl; l->cap = ncap;
     }
+    return 0;
+}
+
+static int
+nklist_push(NKList *l, JNode *n, const char *k, uint32_t kn)
+{
+    if (l->n == l->cap && nklist_reserve(l, 1) < 0)
+        return -1;
     l->items[l->n] = n;
     l->keys[l->n] = k;
     l->klens[l->n] = kn;
     l->n++;
     return 0;
+}
+
+/* bulk-append one collected node's children (list values keyless, map
+ * values with their keys) — memcpys instead of per-item pushes */
+static int
+nklist_extend_node(NKList *l, JNode *val)
+{
+    if (val->n == 0)
+        return 0;
+    if (nklist_reserve(l, val->n) < 0)
+        return -1;
+    if (val->type == JT_ARR) {
+        memcpy((void *)(l->items + l->n), val->u.items,
+               val->n * sizeof(JNode *));
+        memset((void *)(l->keys + l->n), 0, val->n * sizeof(char *));
+        memset(l->klens + l->n, 0, val->n * sizeof(uint32_t));
+    } else { /* JT_OBJ */
+        memcpy((void *)(l->items + l->n), val->u.obj.vals,
+               val->n * sizeof(JNode *));
+        memcpy((void *)(l->keys + l->n), val->u.obj.keys,
+               val->n * sizeof(char *));
+        memcpy(l->klens + l->n, val->u.obj.klens,
+               val->n * sizeof(uint32_t));
+    }
+    l->n += val->n;
+    return 0;
+}
+
+/* ---------------- pooled thread contexts ----------------
+ *
+ * A sweep calls flatten_json_batch once per chunk; the per-thread
+ * arena (1MB blocks), intern table and parser/BFS scratch used to be
+ * malloc'd and freed on every call.  The pool keeps them across calls
+ * (acquired/released with the GIL held), so a steady-state chunk
+ * re-parses into already-warm memory.  Retained arena bytes are capped
+ * per context so one giant document can't pin memory forever. */
+
+#define CTX_POOL_MAX 64
+#define CTX_ARENA_KEEP (16u << 20)
+
+typedef struct CtxCache {
+    Arena arena;
+    Intern intern;
+    /* parser scratch stacks */
+    JNode **nstack;
+    const char **kstack;
+    uint32_t *lstack;
+    size_t scap;
+    /* BFS scratch */
+    NKList sa, sb, sout;
+    struct CtxCache *next;
+} CtxCache;
+
+static CtxCache *g_ctx_pool;
+static int g_ctx_pool_n;
+
+/* keep at most one (bounded) block; drop the rest */
+static void
+arena_trim(Arena *a)
+{
+    ArenaBlock *keep = NULL, *b = a->head;
+    while (b) {
+        ArenaBlock *nx = b->next;
+        if (keep == NULL && b->cap <= CTX_ARENA_KEEP)
+            keep = b;
+        else
+            free(b);
+        b = nx;
+    }
+    if (keep) {
+        keep->used = 0;
+        keep->next = NULL;
+    }
+    a->head = keep;
+}
+
+static CtxCache *
+ctx_acquire(void)
+{
+    CtxCache *c = g_ctx_pool;
+    if (c != NULL) {
+        g_ctx_pool = c->next;
+        g_ctx_pool_n--;
+        c->next = NULL;
+        return c;
+    }
+    c = (CtxCache *)calloc(1, sizeof(CtxCache));
+    if (c == NULL)
+        return NULL;
+    if (intern_init(&c->intern) < 0) {
+        free(c);
+        return NULL;
+    }
+    return c;
+}
+
+static void
+ctx_destroy(CtxCache *c)
+{
+    arena_free(&c->arena);
+    intern_destroy(&c->intern);
+    free(c->nstack);
+    free((void *)c->kstack);
+    free(c->lstack);
+    free(c->sa.items); free((void *)c->sa.keys); free(c->sa.klens);
+    free(c->sb.items); free((void *)c->sb.keys); free(c->sb.klens);
+    free(c->sout.items); free((void *)c->sout.keys); free(c->sout.klens);
+    free(c);
+}
+
+static void
+ctx_release(CtxCache *c)
+{
+    if (g_ctx_pool_n >= CTX_POOL_MAX) {
+        ctx_destroy(c);
+        return;
+    }
+    arena_trim(&c->arena);
+    intern_reset(&c->intern);
+    c->sa.n = c->sb.n = c->sout.n = 0;
+    c->next = g_ctx_pool;
+    g_ctx_pool = c;
+    g_ctx_pool_n++;
 }
 
 /* append items of one segment (mirrors collect_segment_keyed in
@@ -737,26 +1110,26 @@ jcollect_segment(JNode *root, const CSeg *seg, NKList *out,
             JNode *val = jwalk(level->items[i], &seg->paths[p]);
             if (val == NULL)
                 continue;
-            if (val->type == JT_ARR) {
-                for (uint32_t j = 0; j < val->n; j++)
-                    if (nklist_push(next, val->u.items[j], NULL, 0) < 0)
-                        return -1;
-            } else if (val->type == JT_OBJ) {
-                for (uint32_t j = 0; j < val->n; j++)
-                    if (nklist_push(next, val->u.obj.vals[j],
-                                    val->u.obj.keys[j],
-                                    val->u.obj.klens[j]) < 0)
-                        return -1;
-            }
+            if ((val->type == JT_ARR || val->type == JT_OBJ) &&
+                nklist_extend_node(next, val) < 0)
+                return -1;
         }
         NKList *t = level;
         level = next;
         next = t;
     }
-    for (size_t i = 0; i < level->n; i++)
-        if (nklist_push(out, level->items[i], level->keys[i],
-                        level->klens[i]) < 0)
+    if (level->n) {
+        size_t base = out->n;
+        if (nklist_reserve(out, level->n) < 0)
             return -1;
+        memcpy((void *)(out->items + base), level->items,
+               level->n * sizeof(JNode *));
+        memcpy((void *)(out->keys + base), level->keys,
+               level->n * sizeof(char *));
+        memcpy(out->klens + base, level->klens,
+               level->n * sizeof(uint32_t));
+        out->n += level->n;
+    }
     return 0;
 }
 
@@ -776,6 +1149,28 @@ keyref_cmp(const void *pa, const void *pb)
     if (c)
         return c;
     return a->n < b->n ? -1 : (a->n > b->n ? 1 : 0);
+}
+
+/* label/key sets are tiny (a handful per map): insertion sort beats a
+ * qsort call per item; big sets still take qsort */
+static void
+keyref_sort(KeyRef *keys, int c)
+{
+    if (c <= 1)
+        return;
+    if (c > 16) {
+        qsort(keys, (size_t)c, sizeof(KeyRef), keyref_cmp);
+        return;
+    }
+    for (int i = 1; i < c; i++) {
+        KeyRef k = keys[i];
+        int j = i - 1;
+        while (j >= 0 && keyref_cmp(&keys[j], &k) > 0) {
+            keys[j + 1] = keys[j];
+            j--;
+        }
+        keys[j + 1] = k;
+    }
 }
 
 /* collect truthy keys of map node into arena array; returns count */
@@ -799,9 +1194,83 @@ truthy_keys(Arena *arena, JNode *val, KeyRef **out)
         keys[c].n = val->u.obj.klens[i];
         c++;
     }
-    qsort(keys, (size_t)c, sizeof(KeyRef), keyref_cmp);
+    keyref_sort(keys, c);
     *out = keys;
     return c;
+}
+
+/* canonical selector encoding (selector_canon in ops/flatten.py): the
+ * ','-joined byte-wise sort of "key:value" over the STRING pairs of the
+ * map at the spec path ("" for scalars/arrays/absent maps — OPA's
+ * non-strict builtin-error semantics skip non-string pairs).  ns-scoped
+ * specs prefix "ns\0"; a non-string namespace leaves the column at its
+ * -2 default (the rule's ns assignment yields nothing).  Byte-wise pair
+ * sort == code-point sort for UTF-8, matching Python sorted(). */
+static int
+canon_row(Arena *arena, Intern *intern, JNode *root, const CPath *path,
+          int ns_scoped, int32_t *out)
+{
+    if (root == NULL)
+        return 0; /* non-object document: stays -2 */
+    const char *ns = NULL;
+    uint32_t nsn = 0;
+    if (ns_scoped) {
+        JNode *meta = obj_get(root, "metadata", 8);
+        JNode *nsv = meta ? obj_get(meta, "namespace", 9) : NULL;
+        if (nsv == NULL || nsv->type != JT_STR)
+            return 0; /* stays -2 */
+        ns = nsv->u.str;
+        nsn = nsv->n;
+    }
+    JNode *val = jwalk(root, path);
+    KeyRef *pairs = NULL;
+    size_t total = 0;
+    int c = 0;
+    if (val != NULL && val->type == JT_OBJ && val->n) {
+        pairs = (KeyRef *)arena_alloc(arena, val->n * sizeof(KeyRef));
+        if (pairs == NULL)
+            return -1;
+        for (uint32_t i = 0; i < val->n; i++) {
+            JNode *v = val->u.obj.vals[i];
+            if (v->type != JT_STR)
+                continue;
+            uint32_t kn = val->u.obj.klens[i];
+            uint32_t pn = kn + 1 + v->n;
+            char *pb = (char *)arena_alloc(arena, pn);
+            if (pb == NULL)
+                return -1;
+            memcpy(pb, val->u.obj.keys[i], kn);
+            pb[kn] = ':';
+            memcpy(pb + kn + 1, v->u.str, v->n);
+            pairs[c].s = pb;
+            pairs[c].n = pn;
+            total += pn;
+            c++;
+        }
+        keyref_sort(pairs, c);
+    }
+    size_t len = (ns_scoped ? (size_t)nsn + 1 : 0) + total +
+                 (c ? (size_t)c - 1 : 0);
+    char *buf = (char *)arena_alloc(arena, len ? len : 1);
+    if (buf == NULL)
+        return -1;
+    size_t o = 0;
+    if (ns_scoped) {
+        memcpy(buf, ns, nsn);
+        o = nsn;
+        buf[o++] = '\0';
+    }
+    for (int i = 0; i < c; i++) {
+        if (i)
+            buf[o++] = ',';
+        memcpy(buf + o, pairs[i].s, pairs[i].n);
+        o += pairs[i].n;
+    }
+    int32_t id = intern_get(intern, buf, (uint32_t)o);
+    if (id < 0)
+        return -1;
+    *out = id;
+    return 0;
 }
 
 /* ---------------- work context ---------------- */
@@ -810,6 +1279,7 @@ typedef struct {
     JNode **items;
     const char **keys;
     uint32_t *klens;
+    uint32_t *seg_counts; /* items contributed per axis segment */
     int count;
 } AxisItems;
 
@@ -837,6 +1307,7 @@ typedef struct {
     struct Work *w;
     int tid;
     Py_ssize_t row0, row1;
+    CtxCache *cc;  /* pooled backing store of the four fields below */
     Arena arena;
     Intern intern;
     Parser parser;
@@ -861,6 +1332,14 @@ typedef struct Work {
     int n_axes;
     CRagged *raggeds;
     int n_raggeds;
+    /* per-axis ragged extraction plan (built from raggeds, GIL-held) */
+    RTrie **ax_trie;     /* subpath trie per axis (NULL: none) */
+    int **ax_self;       /* ragged cols whose subpath is the item itself */
+    int *ax_nself;
+    Py_ssize_t *ax_m;    /* padded width shared by the axis's raggeds */
+    RTrie *sc_trie;      /* path trie over the non-review scalars */
+    int *sc_self;        /* scalar cols whose path is the root itself */
+    int sc_nself;
     CPath *keysets;
     int n_keysets;
     int *mk_axes;
@@ -869,10 +1348,13 @@ typedef struct Work {
     int n_parents;
     CRKSpec *rks;
     int n_rks;
+    CCanonSpec *canons;
+    int n_canons;
     long bucket;
     Row *rows;
     /* phase-1 outputs */
     int32_t *gid, *kid, *nsid, *nmid;
+    int32_t **c_sid; /* canon columns [N], -2 = idiom yields nothing */
     uint8_t *genname;
     signed char **s_kind;
     float **s_num;
@@ -895,6 +1377,10 @@ typedef struct Work {
     int nthreads;
     ThreadCtx *tc;
 } Work;
+
+static int trie_extract(ThreadCtx *t, const RTrie *node, JNode *obj,
+                        signed char **kind, float **num, int32_t **sid,
+                        Py_ssize_t off);
 
 static long
 bucket_up(long n, long bucket)
@@ -1047,37 +1533,54 @@ phase1_row(ThreadCtx *t, Py_ssize_t i)
     w->nmid[i] = nmv;
     w->genname[i] = (meta && obj_get(meta, "generateName", 12)) ? 1 : 0;
 
-    /* scalars */
+    /* scalars: review-synth columns one by one; the rest through one
+     * path-trie descent (absent values keep the arrays' prefill, which
+     * equals the defaults the per-column loop used to write) */
     for (int s = 0; s < w->n_scalars; s++) {
+        if (!w->scalar_review[s])
+            continue;
         signed char k = 0;
         float nmb = 0.0f;
         int32_t sd = -1;
-        if (w->scalar_review[s]) {
-            if (synth_review_scalar(t, root, &w->scalars[s], &k, &nmb,
-                                    &sd) < 0)
-                goto oom;
-        } else {
-            JNode *val = jwalk(root, &w->scalars[s]);
-            if (val != NULL && jclassify(&t->intern, val, &k, &nmb,
-                                         &sd) < 0)
-                goto oom;
-        }
+        if (synth_review_scalar(t, root, &w->scalars[s], &k, &nmb,
+                                &sd) < 0)
+            goto oom;
         w->s_kind[s][i] = k;
         w->s_num[s][i] = nmb;
         w->s_sid[s][i] = sd;
+    }
+    if (root != NULL) {
+        for (int q = 0; q < w->sc_nself; q++) {
+            int s = w->sc_self[q];
+            if (jclassify(&t->intern, root, &w->s_kind[s][i],
+                          &w->s_num[s][i], &w->s_sid[s][i]) < 0)
+                goto oom;
+        }
+        if (w->sc_trie != NULL &&
+            trie_extract(t, w->sc_trie, root, w->s_kind, w->s_num,
+                         w->s_sid, i) < 0)
+            goto oom;
     }
 
     /* axes */
     for (int a = 0; a < w->n_axes; a++) {
         t->sout.n = 0;
         const CAxis *ax = &w->axes[a];
+        AxisItems *ai = &row->axes[a];
+        /* per-segment contribution counts let phase-2 parent-idx slice
+         * this enumeration instead of re-walking the DOM per row */
+        ai->seg_counts = (uint32_t *)arena_alloc(
+            &t->arena, (size_t)(ax->n ? ax->n : 1) * sizeof(uint32_t));
+        if (ai->seg_counts == NULL)
+            goto oom;
         for (int g = 0; g < ax->n; g++) {
+            size_t before = t->sout.n;
             if (jcollect_segment(root, &ax->segs[g], &t->sout, &t->sa,
                                  &t->sb) < 0)
                 goto oom;
+            ai->seg_counts[g] = (uint32_t)(t->sout.n - before);
         }
         size_t c = t->sout.n;
-        AxisItems *ai = &row->axes[a];
         ai->count = (int)c;
         if (c) {
             ai->items = (JNode **)arena_alloc(&t->arena,
@@ -1108,6 +1611,13 @@ phase1_row(ThreadCtx *t, Py_ssize_t i)
         row->keysets[s].count = c;
         if (c > t->max_keyset[s])
             t->max_keyset[s] = c;
+    }
+
+    /* canonical-selector columns */
+    for (int s = 0; s < w->n_canons; s++) {
+        if (canon_row(&t->arena, &t->intern, root, &w->canons[s].path,
+                      w->canons[s].ns_scoped, &w->c_sid[s][i]) < 0)
+            goto oom;
     }
 
     /* ragged keysets: per-item truthy keys (clipping to m happens in
@@ -1150,27 +1660,55 @@ oom:
 }
 
 static int
+trie_extract(ThreadCtx *t, const RTrie *node, JNode *obj,
+             signed char **kind, float **num, int32_t **sid,
+             Py_ssize_t off)
+{
+    for (const RTrie *c = node->children; c != NULL; c = c->sibling) {
+        JNode *v = obj_get(obj, c->key, c->klen);
+        if (v == NULL)
+            continue;
+        if (c->col >= 0 &&
+            jclassify(&t->intern, v, &kind[c->col][off],
+                      &num[c->col][off], &sid[c->col][off]) < 0)
+            return -1;
+        if (c->children != NULL &&
+            trie_extract(t, c, v, kind, num, sid, off) < 0)
+            return -1;
+    }
+    return 0;
+}
+
+static int
 phase2_row(ThreadCtx *t, Py_ssize_t i)
 {
     Work *w = t->w;
     Row *row = &w->rows[i];
 
-    for (int r = 0; r < w->n_raggeds; r++) {
-        const CRagged *spec = &w->raggeds[r];
-        AxisItems *ai = &row->axes[spec->axis];
-        Py_ssize_t m = w->r_m[r];
+    /* ragged columns, grouped per axis: one trie descent per item
+     * covers every subpath column (shared prefixes walk once) */
+    for (int a = 0; a < w->n_axes; a++) {
+        const RTrie *tr = w->ax_trie[a];
+        int nself = w->ax_nself[a];
+        if (tr == NULL && nself == 0)
+            continue;
+        AxisItems *ai = &row->axes[a];
+        Py_ssize_t m = w->ax_m[a];
         int cnt = ai->count;
         if ((Py_ssize_t)cnt > m)
             cnt = (int)m;
         for (int j = 0; j < cnt; j++) {
-            JNode *val = spec->sub.n
-                ? jwalk(ai->items[j], &spec->sub)
-                : ai->items[j];
-            if (val == NULL)
-                continue;
+            JNode *item = ai->items[j];
             Py_ssize_t off = i * m + j;
-            if (jclassify(&t->intern, val, &w->r_kind[r][off],
-                          &w->r_num[r][off], &w->r_sid[r][off]) < 0)
+            for (int s = 0; s < nself; s++) {
+                int r = w->ax_self[a][s];
+                if (jclassify(&t->intern, item, &w->r_kind[r][off],
+                              &w->r_num[r][off], &w->r_sid[r][off]) < 0)
+                    goto oom;
+            }
+            if (tr != NULL &&
+                trie_extract(t, tr, item, w->r_kind, w->r_num,
+                             w->r_sid, off) < 0)
                 goto oom;
         }
     }
@@ -1208,23 +1746,24 @@ phase2_row(ThreadCtx *t, Py_ssize_t i)
     }
 
     /* parent-idx: ordinal of each child item's parent in the parent
-     * axis's enumeration (mirrors extract_extras in flattenmod.c) */
+     * axis's enumeration (mirrors extract_extras in flattenmod.c).
+     * The parent axis was already enumerated in phase 1 — its
+     * seg_counts slice that enumeration per segment, so no DOM re-walk
+     * happens here. */
     for (int p = 0; p < w->n_parents; p++) {
         const CAxis *cax = &w->axes[w->parents[p].child];
         const CAxis *pax = &w->axes[w->parents[p].parent];
+        const AxisItems *pai = &row->axes[w->parents[p].parent];
         Py_ssize_t m = w->p_m[p];
         Py_ssize_t j = 0, base = 0;
+        size_t poff = 0;
         int nseg = cax->n < pax->n ? cax->n : pax->n;
         for (int g = 0; g < nseg; g++) {
             const CSeg *cseg = &cax->segs[g];
             const CPath *sub = &cseg->paths[cseg->n - 1];
-            t->sout.n = 0;
-            if (jcollect_segment(row->root, &pax->segs[g], &t->sout,
-                                 &t->sa, &t->sb) < 0)
-                goto oom;
-            size_t npar = t->sout.n;
+            size_t npar = pai->seg_counts[g];
             for (size_t k = 0; k < npar; k++) {
-                JNode *val = jwalk(t->sout.items[k], sub);
+                JNode *val = jwalk(pai->items[poff + k], sub);
                 if (val == NULL)
                     continue;
                 if (val->type == JT_ARR || val->type == JT_OBJ) {
@@ -1233,6 +1772,7 @@ phase2_row(ThreadCtx *t, Py_ssize_t i)
                             (int32_t)(base + (Py_ssize_t)k);
                 }
             }
+            poff += npar;
             base += (Py_ssize_t)npar;
         }
     }
@@ -1286,6 +1826,8 @@ phase3_remap(ThreadCtx *t)
     remap_range(rm, w->nmid, r0, r1);
     for (int s = 0; s < w->n_scalars; s++)
         remap_range(rm, w->s_sid[s], r0, r1);
+    for (int s = 0; s < w->n_canons; s++)
+        remap_range(rm, w->c_sid[s], r0, r1);
     for (int r = 0; r < w->n_raggeds; r++)
         remap_range(rm, w->r_sid[r], r0 * w->r_m[r], r1 * w->r_m[r]);
     for (int s = 0; s < w->n_keysets; s++)
@@ -1345,16 +1887,23 @@ run_phase(Work *w, int phase)
 /* ---------------- GIL-side glue ---------------- */
 
 static PyArrayObject *
-new_arr(int nd, npy_intp *dims, int typenum, int fill_minus1)
+new_arr(int nd, npy_intp *dims, int typenum, int fill)
 {
-    PyArrayObject *a = (PyArrayObject *)PyArray_ZEROS(nd, dims, typenum, 0);
+    PyArrayObject *a;
+    if (fill == 0)
+        return (PyArrayObject *)PyArray_ZEROS(nd, dims, typenum, 0);
+    a = (PyArrayObject *)PyArray_EMPTY(nd, dims, typenum, 0);
     if (a == NULL)
         return NULL;
-    if (fill_minus1) {
+    if (fill == -1) {
+        /* int32 -1 is all-ones bytes: one vectorized memset instead of
+         * an element loop (the sid arrays are the bulk of the output) */
+        memset(PyArray_DATA(a), 0xFF, (size_t)PyArray_NBYTES(a));
+    } else {
         int32_t *data = (int32_t *)PyArray_DATA(a);
         npy_intp total = PyArray_SIZE(a);
         for (npy_intp i = 0; i < total; i++)
-            data[i] = -1;
+            data[i] = fill;
     }
     return a;
 }
@@ -1413,15 +1962,19 @@ work_free(Work *w, Py_buffer *views, Py_ssize_t n_views, Arena *spec_arena)
     if (w->tc) {
         for (int t = 0; t < w->nthreads; t++) {
             ThreadCtx *tc = &w->tc[t];
-            arena_free(&tc->arena);
-            intern_destroy(&tc->intern);
-            free(tc->parser.nstack);
-            free((void *)tc->parser.kstack);
-            free(tc->parser.lstack);
-            free(tc->sa.items); free((void *)tc->sa.keys); free(tc->sa.klens);
-            free(tc->sb.items); free((void *)tc->sb.keys); free(tc->sb.klens);
-            free(tc->sout.items); free((void *)tc->sout.keys);
-            free(tc->sout.klens);
+            if (tc->cc != NULL) {
+                /* hand the (possibly realloc'd) scratch back to the pool */
+                tc->cc->arena = tc->arena;
+                tc->cc->intern = tc->intern;
+                tc->cc->nstack = tc->parser.nstack;
+                tc->cc->kstack = tc->parser.kstack;
+                tc->cc->lstack = tc->parser.lstack;
+                tc->cc->scap = tc->parser.scap;
+                tc->cc->sa = tc->sa;
+                tc->cc->sb = tc->sb;
+                tc->cc->sout = tc->sout;
+                ctx_release(tc->cc);
+            }
             free(tc->max_axis);
             free(tc->max_keyset);
             free(tc->max_rk_l);
@@ -1437,7 +1990,9 @@ work_free(Work *w, Py_buffer *views, Py_ssize_t n_views, Arena *spec_arena)
     }
     free(w->scalars); free(w->scalar_review);
     free(w->axes); free(w->raggeds); free(w->keysets); free(w->mk_axes);
-    free(w->parents); free(w->rks);
+    free(w->parents); free(w->rks); free(w->canons); free(w->c_sid);
+    free(w->sc_self);
+    free(w->ax_trie); free(w->ax_self); free(w->ax_nself); free(w->ax_m);
     free(w->s_kind); free(w->s_num); free(w->s_sid);
     free(w->a_count);
     free(w->r_kind); free(w->r_num); free(w->r_sid); free(w->r_m);
@@ -1476,14 +2031,15 @@ static PyObject *
 py_flatten_json_batch(PyObject *self, PyObject *args)
 {
     PyObject *items, *scalars, *axes, *raggeds, *keysets, *mk_axes;
-    PyObject *parent_specs, *rk_specs, *to_id, *to_str;
+    PyObject *parent_specs, *rk_specs, *canons, *to_id, *to_str;
     Py_ssize_t pad_n;
     long bucket;
     int nthreads;
-    if (!PyArg_ParseTuple(args, "OOOOOOOOOOnli", &items, &scalars, &axes,
+    (void)self;
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOOnli", &items, &scalars, &axes,
                           &raggeds, &keysets, &mk_axes, &parent_specs,
-                          &rk_specs, &to_id, &to_str, &pad_n, &bucket,
-                          &nthreads))
+                          &rk_specs, &canons, &to_id, &to_str, &pad_n,
+                          &bucket, &nthreads))
         return NULL;
     if (!PyList_Check(items)) {
         PyErr_SetString(PyExc_TypeError, "items must be a list");
@@ -1505,6 +2061,7 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
     w.n_mk = (int)PyList_GET_SIZE(mk_axes);
     w.n_parents = (int)PyList_GET_SIZE(parent_specs);
     w.n_rks = (int)PyList_GET_SIZE(rk_specs);
+    w.n_canons = (int)PyList_GET_SIZE(canons);
 
     /* buffers */
     views = (Py_buffer *)calloc((size_t)(w.n_real ? w.n_real : 1),
@@ -1516,8 +2073,15 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
     if (!views || !w.bufs || !w.blens)
         goto oom;
     for (Py_ssize_t i = 0; i < w.n_real; i++) {
-        if (PyObject_GetBuffer(PyList_GET_ITEM(items, i), &views[i],
-                               PyBUF_SIMPLE) < 0)
+        PyObject *it = PyList_GET_ITEM(items, i);
+        if (PyBytes_CheckExact(it)) {
+            /* the overwhelmingly common case: skip the buffer-protocol
+             * machinery (the items list keeps the bytes alive) */
+            w.bufs[i] = PyBytes_AS_STRING(it);
+            w.blens[i] = PyBytes_GET_SIZE(it);
+            continue;
+        }
+        if (PyObject_GetBuffer(it, &views[i], PyBUF_SIMPLE) < 0)
             goto error;
         w.bufs[i] = (const char *)views[i].buf;
         w.blens[i] = views[i].len;
@@ -1579,8 +2143,88 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
                        &spec_arena) < 0)
             goto error;
     }
+    ALLOCN(w.canons, CCanonSpec, w.n_canons);
+    for (int s = 0; s < w.n_canons; s++) {
+        PyObject *e = PyList_GET_ITEM(canons, s);
+        if (cpath_conv(PyTuple_GET_ITEM(e, 0), &w.canons[s].path,
+                       &spec_arena) < 0)
+            goto error;
+        w.canons[s].ns_scoped =
+            (int)PyLong_AsLong(PyTuple_GET_ITEM(e, 1));
+    }
     if (PyErr_Occurred())
         goto error;
+
+    /* per-axis ragged extraction plan: self-column lists + subpath
+     * tries (see RTrie) */
+    ALLOCN(w.ax_trie, RTrie *, w.n_axes);
+    ALLOCN(w.ax_self, int *, w.n_axes);
+    ALLOCN(w.ax_nself, int, w.n_axes);
+    ALLOCN(w.ax_m, Py_ssize_t, w.n_axes);
+    for (int r = 0; r < w.n_raggeds; r++)
+        if (w.raggeds[r].sub.n == 0)
+            w.ax_nself[w.raggeds[r].axis]++;
+    for (int a = 0; a < w.n_axes; a++) {
+        if (w.ax_nself[a]) {
+            w.ax_self[a] = (int *)arena_alloc(
+                &spec_arena, (size_t)w.ax_nself[a] * sizeof(int));
+            if (w.ax_self[a] == NULL)
+                goto oom;
+            w.ax_nself[a] = 0; /* refilled below */
+        }
+    }
+    for (int r = 0; r < w.n_raggeds; r++) {
+        const CRagged *rg = &w.raggeds[r];
+        int a = rg->axis;
+        if (rg->sub.n == 0) {
+            w.ax_self[a][w.ax_nself[a]++] = r;
+            continue;
+        }
+        RTrie *node = w.ax_trie[a];
+        if (node == NULL) {
+            node = (RTrie *)arena_alloc(&spec_arena, sizeof(RTrie));
+            if (node == NULL)
+                goto oom;
+            memset(node, 0, sizeof(*node));
+            node->col = -1;
+            w.ax_trie[a] = node;
+        }
+        for (int q = 0; q < rg->sub.n; q++) {
+            node = rtrie_child(node, rg->sub.parts[q], rg->sub.lens[q],
+                               &spec_arena);
+            if (node == NULL)
+                goto oom;
+        }
+        node->col = r;
+    }
+    /* scalar-path trie: non-review scalars share prefix walks too
+     * (metadata.* / spec.* fan out from two root lookups) */
+    ALLOCN(w.sc_self, int, w.n_scalars);
+    for (int s = 0; s < w.n_scalars; s++) {
+        if (w.scalar_review[s])
+            continue;
+        const CPath *sp = &w.scalars[s];
+        if (sp->n == 0) {
+            w.sc_self[w.sc_nself++] = s;
+            continue;
+        }
+        RTrie *node = w.sc_trie;
+        if (node == NULL) {
+            node = (RTrie *)arena_alloc(&spec_arena, sizeof(RTrie));
+            if (node == NULL)
+                goto oom;
+            memset(node, 0, sizeof(*node));
+            node->col = -1;
+            w.sc_trie = node;
+        }
+        for (int q = 0; q < sp->n; q++) {
+            node = rtrie_child(node, sp->parts[q], sp->lens[q],
+                               &spec_arena);
+            if (node == NULL)
+                goto oom;
+        }
+        node->col = s;
+    }
 
     /* rows (block-allocated sub-arrays) */
     if (w.n_real > 0) {
@@ -1630,8 +2274,18 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
                 tc->row0 = w.n_real;
             if (tc->row1 > w.n_real)
                 tc->row1 = w.n_real;
-            if (intern_init(&tc->intern) < 0)
+            tc->cc = ctx_acquire();
+            if (tc->cc == NULL)
                 goto oom;
+            tc->arena = tc->cc->arena;
+            tc->intern = tc->cc->intern;
+            tc->parser.nstack = tc->cc->nstack;
+            tc->parser.kstack = tc->cc->kstack;
+            tc->parser.lstack = tc->cc->lstack;
+            tc->parser.scap = tc->cc->scap;
+            tc->sa = tc->cc->sa;
+            tc->sb = tc->cc->sb;
+            tc->sout = tc->cc->sout;
             ALLOCN(tc->max_axis, Py_ssize_t, w.n_axes);
             ALLOCN(tc->max_keyset, Py_ssize_t, w.n_keysets);
             ALLOCN(tc->max_rk_l, Py_ssize_t, w.n_rks);
@@ -1644,10 +2298,10 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
         goto error;
     {
         npy_intp d1[1] = {(npy_intp)w.n_pad};
-        PyArrayObject *gid = new_arr(1, d1, NPY_INT32, 1);
-        PyArrayObject *kid = new_arr(1, d1, NPY_INT32, 1);
-        PyArrayObject *nsid = new_arr(1, d1, NPY_INT32, 1);
-        PyArrayObject *nmid = new_arr(1, d1, NPY_INT32, 1);
+        PyArrayObject *gid = new_arr(1, d1, NPY_INT32, -1);
+        PyArrayObject *kid = new_arr(1, d1, NPY_INT32, -1);
+        PyArrayObject *nsid = new_arr(1, d1, NPY_INT32, -1);
+        PyArrayObject *nmid = new_arr(1, d1, NPY_INT32, -1);
         PyArrayObject *gen = new_arr(1, d1, NPY_UINT8, 0);
         if (!gid || !kid || !nsid || !nmid || !gen) {
             Py_XDECREF(gid); Py_XDECREF(kid); Py_XDECREF(nsid);
@@ -1677,7 +2331,7 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
         for (int s = 0; s < w.n_scalars; s++) {
             PyArrayObject *a_kind = new_arr(1, d1, NPY_INT8, 0);
             PyArrayObject *a_num = new_arr(1, d1, NPY_FLOAT32, 0);
-            PyArrayObject *a_sid = new_arr(1, d1, NPY_INT32, 1);
+            PyArrayObject *a_sid = new_arr(1, d1, NPY_INT32, -1);
             if (!a_kind || !a_num || !a_sid) {
                 Py_XDECREF(a_kind); Py_XDECREF(a_num); Py_XDECREF(a_sid);
                 Py_DECREF(s_out);
@@ -1694,6 +2348,25 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
             goto error;
         }
         Py_DECREF(s_out);
+
+        ALLOCN(w.c_sid, int32_t *, w.n_canons);
+        PyObject *c_out = PyList_New(w.n_canons);
+        if (c_out == NULL)
+            goto error;
+        for (int s = 0; s < w.n_canons; s++) {
+            PyArrayObject *a_sid = new_arr(1, d1, NPY_INT32, -2);
+            if (a_sid == NULL) {
+                Py_DECREF(c_out);
+                goto error;
+            }
+            w.c_sid[s] = (int32_t *)PyArray_DATA(a_sid);
+            PyList_SET_ITEM(c_out, s, (PyObject *)a_sid);
+        }
+        if (PyDict_SetItemString(result, "canons", c_out) < 0) {
+            Py_DECREF(c_out);
+            goto error;
+        }
+        Py_DECREF(c_out);
 
         ALLOCN(w.a_count, int32_t *, w.n_axes);
         PyObject *a_out = PyList_New(w.n_axes);
@@ -1747,10 +2420,11 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
                     maxc = w.tc[t].max_axis[w.raggeds[r].axis];
             Py_ssize_t m = bucket_up((long)maxc, w.bucket);
             w.r_m[r] = m;
+            w.ax_m[w.raggeds[r].axis] = m;
             npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
             PyArrayObject *a_kind = new_arr(2, d2, NPY_INT8, 0);
             PyArrayObject *a_num = new_arr(2, d2, NPY_FLOAT32, 0);
-            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, 1);
+            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, -1);
             if (!a_kind || !a_num || !a_sid) {
                 Py_XDECREF(a_kind); Py_XDECREF(a_num); Py_XDECREF(a_sid);
                 Py_DECREF(r_out);
@@ -1782,7 +2456,7 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
             Py_ssize_t l = bucket_up((long)maxc, w.bucket);
             w.k_l[s] = l;
             npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)l};
-            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, 1);
+            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, -1);
             PyArrayObject *a_cnt = new_arr(1, d1, NPY_INT32, 0);
             if (!a_sid || !a_cnt) {
                 Py_XDECREF(a_sid); Py_XDECREF(a_cnt); Py_DECREF(k_out);
@@ -1811,7 +2485,7 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
             Py_ssize_t m = bucket_up((long)maxc, w.bucket);
             w.mk_m[q] = m;
             npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
-            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, 1);
+            PyArrayObject *a_sid = new_arr(2, d2, NPY_INT32, -1);
             if (a_sid == NULL) {
                 Py_DECREF(mk_out);
                 goto error;
@@ -1838,7 +2512,7 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
             Py_ssize_t m = bucket_up((long)maxc, w.bucket);
             w.p_m[p] = m;
             npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
-            PyArrayObject *a_idx = new_arr(2, d2, NPY_INT32, 1);
+            PyArrayObject *a_idx = new_arr(2, d2, NPY_INT32, -1);
             if (a_idx == NULL) {
                 Py_DECREF(p_out);
                 goto error;
@@ -1873,7 +2547,7 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
             w.rk_l[s] = l;
             npy_intp d3[3] = {(npy_intp)w.n_pad, (npy_intp)m, (npy_intp)l};
             npy_intp d2[2] = {(npy_intp)w.n_pad, (npy_intp)m};
-            PyArrayObject *a_sid = new_arr(3, d3, NPY_INT32, 1);
+            PyArrayObject *a_sid = new_arr(3, d3, NPY_INT32, -1);
             PyArrayObject *a_cnt = new_arr(2, d2, NPY_INT32, 0);
             if (!a_sid || !a_cnt) {
                 Py_XDECREF(a_sid); Py_XDECREF(a_cnt); Py_DECREF(rk_out);
@@ -1899,38 +2573,68 @@ py_flatten_json_batch(PyObject *self, PyObject *args)
             goto oom;
 
     /* merge per-thread interns into the Python vocab (deterministic:
-     * thread order, then first-seen order) */
-    for (int t = 0; t < w.nthreads; t++) {
-        ThreadCtx *tc = &w.tc[t];
-        if (tc->intern.count == 0)
-            continue;
-        tc->remap = (int32_t *)malloc(tc->intern.count * sizeof(int32_t));
-        if (tc->remap == NULL)
-            goto oom;
-        for (uint32_t id = 0; id < tc->intern.count; id++) {
-            PyObject *key = PyUnicode_DecodeUTF8(
-                tc->intern.strs[id], (Py_ssize_t)tc->intern.lens[id],
-                "strict");
-            if (key == NULL)
-                goto error;
-            PyObject *hit = PyDict_GetItem(to_id, key);
-            long gl;
-            if (hit != NULL) {
-                gl = PyLong_AsLong(hit);
-            } else {
-                gl = (long)PyList_GET_SIZE(to_str);
-                PyObject *idobj = PyLong_FromLong(gl);
-                if (idobj == NULL ||
-                    PyDict_SetItem(to_id, key, idobj) < 0 ||
-                    PyList_Append(to_str, key) < 0) {
-                    Py_XDECREF(idobj);
-                    Py_DECREF(key);
-                    goto error;
+     * thread order, then first-seen order).  The persistent mirror
+     * resolves every already-known string with one C hash probe; only
+     * genuinely new strings create Python objects — a chunked sweep
+     * used to re-pay a decode + dict lookup per string per chunk. */
+    {
+        int vm_ok;
+        {
+            int r = vm_sync(to_id, to_str);
+            if (r < 0)
+                goto oom;
+            vm_ok = (r == 0);
+        }
+        for (int t = 0; t < w.nthreads; t++) {
+            ThreadCtx *tc = &w.tc[t];
+            if (tc->intern.count == 0)
+                continue;
+            tc->remap = (int32_t *)malloc(tc->intern.count *
+                                          sizeof(int32_t));
+            if (tc->remap == NULL)
+                goto oom;
+            for (uint32_t id = 0; id < tc->intern.count; id++) {
+                if (vm_ok) {
+                    int32_t mhit = intern_lookup(&g_vm.table,
+                                                 tc->intern.strs[id],
+                                                 tc->intern.lens[id]);
+                    if (mhit >= 0) {
+                        tc->remap[id] = mhit;
+                        continue;
+                    }
                 }
-                Py_DECREF(idobj);
+                PyObject *key = PyUnicode_DecodeUTF8(
+                    tc->intern.strs[id], (Py_ssize_t)tc->intern.lens[id],
+                    "strict");
+                if (key == NULL)
+                    goto error;
+                PyObject *hit = PyDict_GetItem(to_id, key);
+                long gl;
+                if (hit != NULL) {
+                    gl = PyLong_AsLong(hit);
+                } else {
+                    gl = (long)PyList_GET_SIZE(to_str);
+                    PyObject *idobj = PyLong_FromLong(gl);
+                    if (idobj == NULL ||
+                        PyDict_SetItem(to_id, key, idobj) < 0 ||
+                        PyList_Append(to_str, key) < 0) {
+                        Py_XDECREF(idobj);
+                        Py_DECREF(key);
+                        goto error;
+                    }
+                    Py_DECREF(idobj);
+                    /* cache the new entry; the position guard covers
+                     * vocab writes interleaved by GC callbacks (the
+                     * mirror only ever stores verified positions) */
+                    if (vm_ok && gl == (long)g_vm.count &&
+                        vm_push(key) < 0) {
+                        Py_DECREF(key);
+                        goto oom;
+                    }
+                }
+                Py_DECREF(key);
+                tc->remap[id] = (int32_t)gl;
             }
-            Py_DECREF(key);
-            tc->remap[id] = (int32_t)gl;
         }
     }
 
@@ -1957,8 +2661,28 @@ static PyMethodDef jmethods[] = {
     {NULL, NULL, 0, NULL},
 };
 
+static void
+jmodule_free(void *mod)
+{
+    (void)mod;
+    while (g_ctx_pool != NULL) {
+        CtxCache *c = g_ctx_pool;
+        g_ctx_pool = c->next;
+        ctx_destroy(c);
+    }
+    g_ctx_pool_n = 0;
+    if (g_vm.inited) {
+        for (Py_ssize_t i = 0; i < g_vm.count; i++)
+            Py_DECREF(g_vm.objs[i]);
+        free((void *)g_vm.objs);
+        intern_destroy(&g_vm.table);
+        memset(&g_vm, 0, sizeof(g_vm));
+    }
+}
+
 static struct PyModuleDef jmoduledef = {
     PyModuleDef_HEAD_INIT, "gtpu_flattenjson", NULL, -1, jmethods,
+    NULL, NULL, NULL, jmodule_free,
 };
 
 PyMODINIT_FUNC

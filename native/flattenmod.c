@@ -239,6 +239,7 @@ static PyObject *
 flatten_batch(PyObject *self, PyObject *args)
 {
     PyObject *objects, *scalars, *axes, *raggeds, *keysets, *map_key_axes;
+    (void)self;
     PyObject *to_id, *to_str;
     Py_ssize_t pad_n;
     long ragged_bucket;
@@ -721,6 +722,7 @@ static PyObject *
 extract_extras(PyObject *self, PyObject *args)
 {
     PyObject *objects, *parent_specs, *rk_specs, *to_id, *to_str;
+    (void)self;
     Py_ssize_t pad_n;
     long ragged_bucket;
     if (!PyArg_ParseTuple(args, "OOOOOnl", &objects, &parent_specs,
@@ -944,6 +946,7 @@ static PyMethodDef methods[] = {
 
 static struct PyModuleDef moduledef = {
     PyModuleDef_HEAD_INIT, "gtpu_flatten", NULL, -1, methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC

@@ -1,0 +1,303 @@
+"""Flatten-lane acceptance tests (ISSUE 4).
+
+1. Three-way lane differential over the full shipped-library union
+   schema: py (oracle) vs dict-walking C vs raw c-json produce
+   bit-identical columns AND an identical vocabulary.
+2. Verdict differential: the audit sweep run with
+   ``flatten_lane=raw|dict|py|differential`` yields bit-identical
+   totals and kept violations over the library corpus.
+3. Raw-bytes ingest: KubeCluster.list_iter yields unparsed RawJSON
+   objects split straight out of List page bytes, routable by
+   peek_kind, content-identical to the parsed lane.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.ops import native
+from gatekeeper_tpu.ops.flatten import (Flattener, Schema, Vocab,
+                                         diff_batches)
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.rawjson import (RawJSON, as_raw, backfill_gvk,
+                                          peek_kind, split_list_items)
+from gatekeeper_tpu.utils.synthetic import load_library, make_cluster_objects
+
+jmod = native.load_json()
+
+
+def _library_client():
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[AUDIT_EP])
+    load_library(client)
+    return client, tpu
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    client, tpu = _library_client()
+    objects = make_cluster_objects(160, seed=21)
+    for o in objects:
+        if o.get("kind") == "Ingress":
+            client.add_data(o)
+    return client, tpu, objects
+
+
+def _union_schema(tpu):
+    schema = Schema()
+    for kind in tpu.lowered_kinds():
+        schema.merge(tpu._programs[kind].program.schema)
+    return schema
+
+
+# --- 1. three-way column differential ---------------------------------
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_three_way_lane_differential_library_schema(corpus):
+    """raw c-json FIRST (creates every interning), then dict-walking C,
+    then pure python, all over ONE vocab: every column bit-identical,
+    and neither oracle lane interns a single new string — the raw
+    kernel's vocabulary is exactly the oracle's."""
+    client, tpu, objects = corpus
+    schema = _union_schema(tpu)
+    vocab = Vocab()
+
+    f_raw = Flattener(schema, vocab, lane="raw")
+    b_raw = f_raw.flatten([as_raw(o) for o in objects], pad_n=192)
+    assert f_raw.lane_used == "raw"
+    vocab_after_raw = len(vocab)
+
+    f_dict = Flattener(schema, vocab, lane="dict")
+    b_dict = f_dict.flatten(objects, pad_n=192)
+    assert f_dict.lane_used == "dict"
+
+    f_py = Flattener(schema, vocab, lane="py")
+    b_py = f_py.flatten(objects, pad_n=192)
+    assert f_py.lane_used == "py"
+
+    assert diff_batches(schema, b_raw, b_dict) is None
+    assert diff_batches(schema, b_raw, b_py) is None
+    # identical vocab: the oracle lanes only ever looked strings up
+    assert len(vocab) == vocab_after_raw
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_differential_lane_runs_and_agrees(corpus):
+    client, tpu, objects = corpus
+    schema = _union_schema(tpu)
+    f = Flattener(schema, Vocab(), lane="differential")
+    batch = f.flatten([as_raw(o) for o in objects], pad_n=192)
+    assert f.lane_used == "differential:raw"
+    assert batch.n == 192
+
+
+def test_differential_lane_catches_divergence():
+    """A poisoned batch comparison must raise, not pass silently."""
+    schema = _union_schema(_library_client()[1])
+    f = Flattener(schema, Vocab(), lane="differential")
+    objects = make_cluster_objects(8, seed=3)
+    real_diff = diff_batches
+
+    import gatekeeper_tpu.ops.flatten as fl_mod
+
+    orig = fl_mod.diff_batches
+    fl_mod.diff_batches = lambda *a: "synthetic divergence"
+    try:
+        with pytest.raises(RuntimeError, match="synthetic divergence"):
+            f.flatten([as_raw(o) for o in objects], pad_n=8)
+    finally:
+        fl_mod.diff_batches = orig
+    assert real_diff is orig
+
+
+# --- 2. verdict differential across sweep lanes -----------------------
+
+def _audit_with_lane(client, tpu, objects, lane, metrics=None):
+    mgr = AuditManager(
+        client, lister=lambda: iter(objects),
+        config=AuditConfig(chunk_size=64, exact_totals=False,
+                           pipeline="off"),
+        evaluator=ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
+                                   flatten_lane=lane, metrics=metrics),
+        metrics=metrics,
+    )
+    return mgr.audit()
+
+
+def _signature(run):
+    return (
+        {k: v for k, v in run.total_violations.items()},
+        {k: [(v.message, v.kind, v.name, v.namespace,
+              v.enforcement_action) for v in vs]
+         for k, vs in run.kept.items()},
+    )
+
+
+def test_sweep_verdicts_identical_across_lanes(corpus):
+    """The acceptance differential: raw / dict / py / differential
+    sweep lanes produce bit-identical verdicts over the library
+    corpus.  The raw lanes see RawJSON input (the lister contract);
+    materialization inside the oracle lanes is the lanes' own
+    business."""
+    client, tpu, objects = corpus
+    lanes = ["dict", "py", "differential"]
+    if jmod is not None:
+        lanes.insert(0, "raw")
+    metrics = MetricsRegistry()
+    base = None
+    for lane in lanes:
+        raws = [as_raw(o) for o in objects]
+        run = _audit_with_lane(client, tpu, raws, lane, metrics=metrics)
+        sig = _signature(run)
+        assert sum(sig[0].values()) > 0, "corpus produced no violations"
+        if base is None:
+            base = sig
+        else:
+            assert sig == base, f"lane {lane} diverged"
+    # the lane counter observed every lane it ran
+    for lane in lanes:
+        label = {"lane": lane if lane != "differential"
+                 else ("differential:raw" if jmod is not None
+                       else "differential:dict")}
+        assert metrics.get_counter(M.FLATTEN_LANE, label) > 0, label
+    assert metrics.get_gauge(M.FLATTEN_OBJECTS_PER_SECOND) > 0
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_sweep_auto_lane_takes_raw_on_rawjson_input(corpus):
+    client, tpu, objects = corpus
+    metrics = MetricsRegistry()
+    run = _audit_with_lane(client, tpu, [as_raw(o) for o in objects],
+                           "auto", metrics=metrics)
+    assert sum(run.total_violations.values()) > 0
+    assert metrics.get_counter(M.FLATTEN_LANE, {"lane": "raw"}) > 0
+    assert metrics.get_counter(M.FLATTEN_LANE, {"lane": "dict"}) == 0
+
+
+# --- 3. raw-bytes list ingest -----------------------------------------
+
+def test_split_list_items_roundtrip():
+    page_doc = {
+        "apiVersion": "v1", "kind": "PodList",
+        "metadata": {"resourceVersion": "42", "continue": "tok"},
+        "items": [
+            {"metadata": {"name": "a", "labels": {"x": "1"}},
+             "spec": {"containers": [{"name": "c,{}[]\""}]}},
+            {"metadata": {"name": "b"}, "note": 'tricky "items": ['},
+            {},
+        ],
+    }
+    for dumps_kw in ({"separators": (",", ":")}, {"indent": 2}):
+        page = json.dumps(page_doc, **dumps_kw).encode()
+        spans, envelope = split_list_items(page)
+        assert [json.loads(s) for s in spans] == page_doc["items"]
+        assert envelope["metadata"]["continue"] == "tok"
+        assert envelope["kind"] == "PodList"
+        assert envelope["items"] == []
+
+
+def test_split_list_items_rejects_non_lists():
+    with pytest.raises(ValueError):
+        split_list_items(b'{"kind":"Pod","metadata":{"name":"x"}}')
+    with pytest.raises(ValueError):
+        split_list_items(b'{"items":[1,2,"three"]}')
+
+
+def test_backfill_gvk_setdefault_semantics():
+    # absent keys take the defaults
+    r = json.loads(backfill_gvk(b'{"metadata":{"name":"x"}}', "v1", "Pod"))
+    assert r["apiVersion"] == "v1" and r["kind"] == "Pod"
+    assert r["metadata"]["name"] == "x"
+    # present keys win (JSON duplicate keys are last-wins)
+    r = json.loads(backfill_gvk(
+        b'{"apiVersion":"apps/v1","kind":"Deployment"}', "v1", "Pod"))
+    assert r["apiVersion"] == "apps/v1" and r["kind"] == "Deployment"
+    # empty object stays valid
+    assert json.loads(backfill_gvk(b"{}", "v1", "Pod")) == {
+        "apiVersion": "v1", "kind": "Pod"}
+    # the native parser agrees on the spliced bytes
+    if jmod is not None:
+        raw = RawJSON(backfill_gvk(b'{"metadata":{"name":"x"}}',
+                                   "v1", "Pod"))
+        assert peek_kind(raw) == "Pod"
+        assert not raw._loaded
+
+
+def test_kube_list_iter_yields_unparsed_rawjson():
+    from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
+    from gatekeeper_tpu.sync.mock_apiserver import MockApiServer
+
+    srv = MockApiServer().start()
+    try:
+        for i in range(8):
+            srv.put_object({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"p{i}", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "image": "x"}]},
+            })
+        kc = KubeCluster(KubeConfig(server=srv.url), page_limit=3)
+        try:
+            objs = list(kc.list_iter(("", "v1", "Pod")))
+            assert len(objs) == 8
+            assert all(isinstance(o, RawJSON) for o in objs)
+            # kind routing never parses
+            assert all(peek_kind(o) == "Pod" for o in objs)
+            assert all(not o._loaded for o in objs)
+            # content identical to the parsed lane (materializes now)
+            parsed = {o["metadata"]["name"]: o for o in kc.list(
+                ("", "v1", "Pod"))}
+            for o in objs:
+                assert dict(o) == parsed[o["metadata"]["name"]]
+            # the parsed-lane opt-out still yields plain dicts
+            kc.raw_list = False
+            objs2 = list(kc.list_iter(("", "v1", "Pod")))
+            assert len(objs2) == 8
+            assert not any(isinstance(o, RawJSON) for o in objs2)
+        finally:
+            kc.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_kube_raw_ingest_flattens_identically(corpus):
+    """End to end: bytes listed from the apiserver, split, routed and
+    columnized raw match the dict lane bit for bit."""
+    from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
+    from gatekeeper_tpu.sync.mock_apiserver import MockApiServer
+
+    client, tpu, objects = corpus
+    pods = [o for o in objects if o.get("kind") == "Pod"][:24]
+    srv = MockApiServer().start()
+    try:
+        for o in pods:
+            srv.put_object(o)
+        kc = KubeCluster(KubeConfig(server=srv.url), page_limit=5)
+        try:
+            raws = list(kc.list_iter(("", "v1", "Pod")))
+            assert raws and all(not r._loaded for r in raws)
+            schema = _union_schema(tpu)
+            vocab = Vocab()
+            f = Flattener(schema, vocab, lane="raw")
+            b_raw = f.flatten(raws, pad_n=32)
+            assert f.lane_used == "raw"
+            kc.raw_list = False
+            dicts = list(kc.list_iter(("", "v1", "Pod")))
+            b_dict = Flattener(schema, vocab, lane="dict").flatten(
+                dicts, pad_n=32)
+            assert diff_batches(schema, b_raw, b_dict) is None
+        finally:
+            kc.close()
+    finally:
+        srv.stop()

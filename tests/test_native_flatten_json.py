@@ -18,6 +18,7 @@ import pytest
 from gatekeeper_tpu.ops import native
 from gatekeeper_tpu.ops.flatten import (
     Axis,
+    CanonCol,
     Flattener,
     KeySetCol,
     MapKeyCol,
@@ -65,6 +66,8 @@ def rich_schema():
                         RaggedKeySetCol(axis=containers,
                                         subpath=("resources", "limits"))]
     s.parent_idx = [ParentIdxCol(axis=ports, parent=containers)]
+    s.canons = [CanonCol(("metadata", "labels")),
+                CanonCol(("spec", "selector"), ns_scoped=True)]
     return s
 
 
@@ -118,6 +121,14 @@ def rich_objects(n, seed=0):
                 [1, 2.5, -3, "high", None, 10 ** 400, -(10 ** 400), 0.1])
         if rng.random() < 0.3:
             obj["spec"]["nodeName"] = rng.choice(strings)
+        if rng.random() < 0.3:
+            obj["spec"]["selector"] = rng.choice([
+                {"app": f"a{i % 7}", "tier": rng.choice(strings)},
+                {"x": 3, "app": "mixed-types"},  # non-string pair skipped
+                {},
+                ["not", "a", "map"],
+                "scalar",
+            ])
         if rng.random() < 0.2:
             obj["spec"]["initContainers"] = [
                 {"name": "init", "ports": [{"hostPort": 53}]}]
@@ -163,6 +174,9 @@ def assert_batches_equal(schema, a, b):
                                       b.ragged_keysets[spec].sid)
         np.testing.assert_array_equal(a.ragged_keysets[spec].count,
                                       b.ragged_keysets[spec].count)
+    for spec in getattr(schema, "canons", []):
+        np.testing.assert_array_equal(a.canons[spec], b.canons[spec],
+                                      err_msg=str(spec))
 
 
 @pytest.mark.skipif(jmod is None, reason="native json build unavailable")
@@ -219,10 +233,43 @@ def test_json_thread_counts_agree():
 
 @pytest.mark.skipif(jmod is None, reason="native json build unavailable")
 def test_json_invalid_raises():
+    """Truly malformed bytes raise through BOTH lanes: the C reject
+    falls back to the dict lane, whose json.loads reject propagates
+    as a ValueError into the audit chunk retry/drop machinery."""
     schema = rich_schema()
     raws = [as_raw({"kind": "Pod"}), RawJSON(b"{not json")]
-    with pytest.raises(ValueError, match="item 1"):
+    with pytest.raises(ValueError):
         Flattener(schema, Vocab()).flatten_raw(raws, pad_n=8)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_c_reject_falls_back_to_dict_lane():
+    """Input the C parser rejects but json.loads accepts (nesting past
+    the C 256-depth cap) lands on the dict lane with oracle-identical
+    columns instead of failing the batch."""
+    deep = (b'{"kind":"Pod","metadata":{"name":"deep"},"spec":'
+            + b'{"a":' * 300 + b"1" + b"}" * 300 + b"}")
+    docs = [deep, b'{"kind":"Pod","metadata":{"name":"flat"}}']
+    schema = rich_schema()
+    vocab = Vocab()
+    f = Flattener(schema, vocab)
+    nat = f.flatten_raw([RawJSON(d) for d in docs], pad_n=8)
+    assert f.lane_used in ("dict", "py")  # the fallback lane ran
+    py = Flattener(schema, vocab, use_native=False).flatten(
+        [json.loads(d) for d in docs], pad_n=8)
+    assert_batches_equal(schema, py, nat)
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_json_truncated_bytes_fall_back_then_raise():
+    """Truncated page bytes (a torn ingest) fail the C parser AND the
+    dict-lane reparse: the error must surface (chunk machinery retries
+    or drops the chunk), never silently flatten as an empty row."""
+    whole = as_raw({"kind": "Pod", "metadata": {"name": "x"}})
+    torn = RawJSON(whole.raw[:-5])
+    f = Flattener(rich_schema(), Vocab())
+    with pytest.raises(ValueError):
+        f.flatten_raw([torn], pad_n=8)
 
 
 @pytest.mark.skipif(jmod is None, reason="native json build unavailable")
@@ -239,9 +286,21 @@ def test_json_weird_documents():
     # dict-parseable cases must agree with the Python path; non-dict roots
     # behave as empty rows (identity "")
     objs = [json.loads(c) for c in cases]
+    dict_rooted = [isinstance(o, dict) for o in objs]
     objs = [o if isinstance(o, dict) else {} for o in objs]
     py = Flattener(schema, vocab, use_native=False).flatten(objs, pad_n=16)
-    assert_batches_equal(schema, py, nat)
+    nocanon = rich_schema()
+    nocanon.canons = []
+    assert_batches_equal(nocanon, py, nat)
+    # canon columns: object-rooted rows match the oracle; a non-object
+    # root stays -2 in the raw lane (the parse path's "yields nothing"),
+    # where the {}-substituted oracle row interns "" instead
+    for spec in schema.canons:
+        for i, isdict in enumerate(dict_rooted):
+            if isdict:
+                assert nat.canons[spec][i] == py.canons[spec][i], (spec, i)
+            else:
+                assert nat.canons[spec][i] == -2, (spec, i)
 
 
 @pytest.mark.skipif(jmod is None, reason="native json build unavailable")

@@ -6,9 +6,15 @@ target).  This tool measures the shipped library's union flatten schema
 over synthetic cluster objects on:
   - the Python flattener (oracle)
   - the C dict columnizer (flattenmod.c, GIL-bound)
-  - the threaded JSON columnizer (flattenjsonmod.c) at 1..N threads
+  - the sweep entry point (Flattener.flatten, lane=auto — what
+    sweep_flatten actually calls on RawJSON input)
+  - the threaded JSON columnizer (flattenjsonmod.c) at 1..N threads;
+    multi-thread lanes are skipped on one-core hosts (r05 showed
+    1T==8T at host_cpus=1 — the numbers would be noise, not signal)
 
-Writes FLATTEN_BENCH.json at the repo root.
+Writes FLATTEN_BENCH.json at the repo root: the latest capture at the
+top level plus a ``history`` list (prior captures preserved), each
+entry carrying host_cpus and per-lane thread counts.
 
 Usage: python tools/bench_flatten.py [n_objects]
 """
@@ -27,7 +33,6 @@ def main(n: int = 100_000):
     from gatekeeper_tpu.utils.rawjson import as_raw
     from gatekeeper_tpu.utils.synthetic import make_cluster_objects
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     import bench
 
     client, tpu, nt, nc = bench.build_client()
@@ -47,10 +52,11 @@ def main(n: int = 100_000):
     print(f"payload: {payload / 1e6:.1f} MB JSON "
           f"({payload / max(1, n):.0f} B/object)")
 
+    host_cpus = os.cpu_count() or 1
     chunk = 32_768
     results = {}
 
-    def run(label, flatten_fn, repeats=2):
+    def run(label, flatten_fn, threads=None, repeats=2):
         # warmup (page cache / allocator); then best-of-repeats
         flatten_fn(0, min(n, 2 * chunk))
         best = None
@@ -65,6 +71,8 @@ def main(n: int = 100_000):
         results[label] = {"objects_per_s": round(rate),
                           "us_per_object": round(us, 2),
                           "seconds": round(best, 3)}
+        if threads is not None:
+            results[label]["threads"] = threads
         print(f"{label:28s} {rate:10.0f} obj/s   {us:6.2f} µs/obj")
 
     # Python oracle (sampled at 1/10 scale: it is far too slow at n)
@@ -83,34 +91,64 @@ def main(n: int = 100_000):
           f"   {1e6 * dt / len(sample):6.2f} µs/obj")
 
     v = Vocab()
-    f = Flattener(schema, v, use_native=True)
+    f = Flattener(schema, v, use_native=True, lane="dict")
     run("c-dict (GIL-bound)",
-        lambda lo, hi: f.flatten(objects[lo:hi], pad_n=None))
+        lambda lo, hi: f.flatten(objects[lo:hi], pad_n=None), threads=1)
 
-    for nt_ in (1, 2, 4, 8, 0):
+    # the sweep entry point: exactly what sweep_flatten calls (auto lane
+    # routes RawJSON batches to the threaded raw columnizer)
+    os.environ["GTPU_FLATTEN_THREADS"] = "0"
+    v = Vocab()
+    f = Flattener(schema, v, use_native=True, lane="auto")
+    run(f"sweep-auto ({host_cpus}cpu)",
+        lambda lo, hi: f.flatten(raws[lo:hi], pad_n=None),
+        threads=host_cpus)
+
+    # thread-count sweep of the raw lane: only where threads exist —
+    # on a one-core host every lane measures the same single core
+    thread_lanes = (1, 2, 4, 8, 0) if host_cpus >= 2 else (1,)
+    if host_cpus < 2:
+        print("host_cpus < 2: skipping multi-thread lanes "
+              "(1T == NT on one core)")
+    for nt_ in thread_lanes:
         os.environ["GTPU_FLATTEN_THREADS"] = str(nt_)
         v = Vocab()
         f = Flattener(schema, v, use_native=True)
         label = (f"c-json {nt_}T" if nt_
-                 else f"c-json auto ({os.cpu_count()}cpu)")
-        run(label, lambda lo, hi: f.flatten_raw(raws[lo:hi], pad_n=None))
+                 else f"c-json auto ({host_cpus}cpu)")
+        run(label, lambda lo, hi: f.flatten_raw(raws[lo:hi], pad_n=None),
+            threads=nt_ or host_cpus)
     del os.environ["GTPU_FLATTEN_THREADS"]
 
     best = max(results.values(), key=lambda r: r["objects_per_s"])
+    dict_rate = results["c-dict (GIL-bound)"]["objects_per_s"]
+    sweep_key = f"sweep-auto ({host_cpus}cpu)"
     out = {
         "n_objects": n,
         "chunk": chunk,
         "templates": nt,
         "schema_columns": n_cols,
         "payload_mb": round(payload / 1e6, 1),
-        "host_cpus": os.cpu_count(),
+        "host_cpus": host_cpus,
+        "date": time.strftime("%Y-%m-%d"),
         "lanes": results,
         "headline_objects_per_s": best["objects_per_s"],
+        "sweep_raw_vs_dict": round(
+            results[sweep_key]["objects_per_s"] / max(1, dict_rate), 2),
         "target_objects_per_s": 100_000,
         "vs_target": round(best["objects_per_s"] / 100_000, 2),
     }
     path = os.path.join(os.path.dirname(__file__), "..",
                         "FLATTEN_BENCH.json")
+    history = []
+    try:
+        with open(path) as fh:
+            prev = json.load(fh)
+        history = prev.pop("history", [])
+        history.append(prev)  # the previous latest becomes history
+    except (OSError, ValueError):
+        pass
+    out["history"] = history
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(json.dumps({"metric": "host flatten throughput",
