@@ -437,6 +437,145 @@ def legacy_lane(n: int = 100_000):
     return rate
 
 
+def burst_main(n_base: int = 240, conc_base: int = 2,
+               burst_mult: int = 8):
+    """``--burst``: offered-load step pattern against the real webhook
+    stack with the overload limiter engaged — the overload-trajectory
+    record (P50/P99/shed-rate per step), appended to WEBHOOK_LOAD.json's
+    ``burst_history`` like FLATTEN_BENCH tracks the columnizer.
+
+    Step 1 serves ``conc_base`` connections (the unloaded anchor); step 2
+    offers ``burst_mult``x that.  The limiter is sized SMALL (the point is
+    to exercise the shed path, not to absorb the burst), so the burst
+    step reports how accepted-request latency holds while excess load is
+    shed per failurePolicy."""
+    import os
+    import statistics
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import http.client
+
+    from gatekeeper_tpu.metrics.registry import MetricsRegistry
+    from gatekeeper_tpu.resilience import overload as _overload
+    from gatekeeper_tpu.webhook.policy import Batcher, ValidationHandler
+    from gatekeeper_tpu.webhook.server import WebhookServer
+    from tools.loadtest_webhook import make_body
+
+    jax, client, tpu, nt, nc, _cpu_fallback = setup_platform_and_client()
+    metrics = MetricsRegistry()
+    # deliberately tight: in-flight capped at 4 with a 4-deep/50ms queue
+    # so a burst_mult x step actually overflows into the shed path (a
+    # production-sized limiter would absorb this workload's ~5ms reviews
+    # without a single shed, recording nothing about the trajectory)
+    ctl = _overload.OverloadController(_overload.OverloadConfig(
+        min_inflight=1, max_inflight=4, initial_inflight=4,
+        queue_depth=4, queue_timeout_s=0.05), metrics=metrics)
+    _overload.install(ctl)
+    batcher = Batcher(client, window_s=0.002, max_batch=64,
+                      metrics=metrics).start()
+    handler = ValidationHandler(client, batcher=batcher, metrics=metrics,
+                                failure_policy="fail", overload=ctl)
+    srv = WebhookServer(validation_handler=handler, port=0,
+                        metrics=metrics, batcher=batcher).start()
+    bodies = [make_body(i) for i in range(128)]
+
+    def drive(n: int, conc: int) -> dict:
+        lat_ms: list = []
+        sheds = [0]
+        errors: list = []
+        lock = threading.Lock()
+
+        def worker(wid: int):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=60)
+            try:
+                for i in range(n // conc):
+                    body = bodies[(wid + i * conc) % len(bodies)]
+                    t0 = time.perf_counter()
+                    c.request("POST", "/v1/admit", body=body,
+                              headers={"Content-Type": "application/json"})
+                    resp = json.loads(c.getresponse().read())
+                    dt = (time.perf_counter() - t0) * 1000
+                    r = resp["response"]
+                    shed = (r.get("status", {}).get("code") == 429
+                            or any("overload" in w
+                                   for w in r.get("warnings", [])))
+                    with lock:
+                        if shed:
+                            sheds[0] += 1
+                        else:
+                            lat_ms.append(dt)
+            except Exception as e:
+                with lock:
+                    errors.append(f"{wid}: {type(e).__name__}: {e}")
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        sv = sorted(lat_ms)
+
+        def pct(p):
+            return round(sv[min(len(sv) - 1, int(p / 100 * len(sv)))], 2) \
+                if sv else 0.0
+
+        total = len(lat_ms) + sheds[0]
+        return {"concurrency": conc, "requests": total,
+                "accepted": len(lat_ms), "shed": sheds[0],
+                "shed_rate": round(sheds[0] / total, 4) if total else 0.0,
+                "p50_ms": pct(50), "p99_ms": pct(99),
+                "mean_ms": (round(statistics.mean(sv), 2) if sv else 0.0),
+                "requests_per_s": round(total / elapsed, 1),
+                "errors": errors}
+
+    log("warmup...")
+    drive(32, 1)
+    log(f"step 1: unloaded anchor (conc={conc_base}, n={n_base})...")
+    unloaded = drive(n_base, conc_base)
+    log(f"  p50 {unloaded['p50_ms']}ms p99 {unloaded['p99_ms']}ms "
+        f"shed {unloaded['shed']}")
+    conc_burst = conc_base * burst_mult
+    log(f"step 2: {burst_mult}x offered-load burst (conc={conc_burst})...")
+    burst = drive(n_base * burst_mult, conc_burst)
+    log(f"  p50 {burst['p50_ms']}ms p99 {burst['p99_ms']}ms "
+        f"shed {burst['shed']} ({burst['shed_rate']:.1%})")
+    srv.stop(drain_timeout=5.0)
+    _overload.uninstall()
+
+    entry = {
+        "date": time.strftime("%Y-%m-%d"),
+        "host_cpus": os.cpu_count(),
+        "limiter": {"max_inflight": 4, "initial": 4, "queue_depth": 4,
+                    "queue_timeout_s": 0.05,
+                    "final_limit": ctl.limiter.limit},
+        "unloaded": unloaded,
+        "burst": burst,
+        "p99_ratio": (round(burst["p99_ms"] / unloaded["p99_ms"], 2)
+                      if unloaded["p99_ms"] else None),
+        "note": f"offered-load step {conc_base}->{conc_burst} conns; "
+                "accepted-request latency only (sheds excluded, counted "
+                "in shed_rate); failurePolicy=fail (429 + Retry-After)",
+    }
+    root = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(root, "WEBHOOK_LOAD.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"metric": "webhook serving load"}
+    doc.setdefault("burst_history", []).append(entry)
+    with open(path, "w") as f:
+        f.write(json.dumps(doc) + "\n")
+    print(json.dumps({"metric": "webhook overload burst", **entry}))
+
+
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 16_384
@@ -554,7 +693,10 @@ def main():
 
 if __name__ == "__main__":
     sys.argv[1:] = _parse_pipeline_flag(sys.argv[1:])
-    if len(sys.argv) > 1 and sys.argv[1] == "sweep":
+    if "--burst" in sys.argv:
+        sys.argv.remove("--burst")
+        burst_main(int(sys.argv[1]) if len(sys.argv) > 1 else 240)
+    elif len(sys.argv) > 1 and sys.argv[1] == "sweep":
         sweep_main(int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000,
                    int(sys.argv[3]) if len(sys.argv) > 3 else 32_768)
     else:

@@ -99,7 +99,39 @@ def main(argv=None) -> int:
                    choices=["1.2", "1.3"])
     p.add_argument("--shutdown-delay", type=float, default=0.0,
                    help="seconds to keep serving after SIGTERM before "
-                        "shutting down (reference --shutdown-delay)")
+                        "shutting down (reference --shutdown-delay); "
+                        "readiness answers 503 {draining:true} for the "
+                        "whole window so the LB deregisters first")
+    p.add_argument("--drain-timeout", type=float, default=10.0,
+                   help="graceful-drain budget in seconds: after SIGTERM "
+                        "(and --shutdown-delay) the listener stops "
+                        "accepting and in-flight admissions + the "
+                        "batcher queue drain to completion within this "
+                        "budget — zero accepted verdicts lost")
+    p.add_argument("--webhook-backlog", type=int, default=128,
+                   help="kernel listen(2) accept-queue depth for the "
+                        "webhook socket (unanswered TCP connects. "
+                        "Distinct from the overload limiter's cost-aware "
+                        "admission queue, which holds ACCEPTED requests "
+                        "waiting for a review slot — see README "
+                        "'Overload & drain semantics')")
+    p.add_argument("--overload-limiter", default="on",
+                   choices=["on", "off"],
+                   help="adaptive-concurrency admission gate in front of "
+                        "the validating webhook (AIMD on review latency "
+                        "vs a baseline EWMA + bounded cost-aware queue); "
+                        "'on' is bit-identical to 'off' while unloaded "
+                        "(differential-tested); sheds resolve per "
+                        "--webhook-failure-policy")
+    p.add_argument("--overload-max-inflight", type=int, default=64,
+                   help="upper bound of the adaptive in-flight limit")
+    p.add_argument("--overload-queue-depth", type=int, default=256,
+                   help="max requests waiting in the admission queue "
+                        "before sheds begin")
+    p.add_argument("--overload-queue-cost", type=float, default=256e6,
+                   help="max summed admission cost (object bytes x "
+                        "matched-constraint estimate) queued before "
+                        "sheds begin")
     p.add_argument("--enable-profile", action="store_true",
                    help="serve /debug/profile?seconds=N (pprof equivalent)")
     p.add_argument("--fail-open-on-error", action="store_true",
@@ -300,6 +332,24 @@ def main(argv=None) -> int:
         faults.set_metrics_registry(metrics)
         faults.install(faults.load_chaos_spec(args.chaos))
         print(f"chaos harness active: {args.chaos}", file=sys.stderr)
+    # overload protection + graceful drain (resilience/overload.py):
+    # the drain coordinator always exists (SIGTERM drives it); the
+    # adaptive limiter gates the validating webhook when enabled —
+    # installed process-wide so the brownout ladder reaches the
+    # externaldata cache and the audit sweep's device-lane yield
+    from gatekeeper_tpu.resilience import overload as _overload
+
+    drain = _overload.DrainCoordinator(metrics=metrics)
+    overload_ctl = None
+    if args.overload_limiter == "on" and not args.once:
+        overload_ctl = _overload.OverloadController(
+            _overload.OverloadConfig(
+                max_inflight=args.overload_max_inflight,
+                queue_depth=args.overload_queue_depth,
+                queue_cost=args.overload_queue_cost,
+            ),
+            metrics=metrics)
+        _overload.install(overload_ctl)
     cel = CELDriver()
     if args.evaluate_sidecar:
         from gatekeeper_tpu.drivers.remote import RemoteDriver
@@ -558,6 +608,7 @@ def main(argv=None) -> int:
                 deadline_budget_s=args.webhook_deadline,
                 trace_config=lambda: mgr.validation_traces,
                 log_stats=args.log_stats_admission,
+                overload=overload_ctl,
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,
@@ -572,10 +623,15 @@ def main(argv=None) -> int:
             port=args.port,
             certfile=certfile,
             keyfile=keyfile,
-            readiness_check=mgr.tracker.satisfied,
+            # drain pulls readiness BEFORE the listener closes (the LB
+            # deregisters during --shutdown-delay)
+            readiness_check=lambda: (not drain.draining
+                                     and mgr.tracker.satisfied()),
             readiness_stats=mgr.tracker.stats,
             metrics=metrics,
             reuse_port=args.reuse_port,
+            backlog=args.webhook_backlog,
+            batcher=batcher,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
         if args.certs_dir and args.cert_rotation_check_s > 0:
@@ -595,18 +651,28 @@ def main(argv=None) -> int:
                 daemon=True,
             ).start()
 
-    # graceful shutdown: on SIGTERM keep serving --shutdown-delay seconds
-    # (reference main.go manages this so the LB deregisters the pod first)
+    # graceful shutdown (the drain state machine, README "Overload &
+    # drain semantics"): on SIGTERM readiness flips 503 {draining:true}
+    # immediately (the LB deregisters during --shutdown-delay while the
+    # listener KEEPS serving), then the listener stops accepting and
+    # in-flight handlers + the batcher queue drain to completion within
+    # --drain-timeout, the tracer/metrics flush, and worker children
+    # drain in sequence — zero accepted verdicts lost
     import signal
     import threading
 
     stopping = threading.Event()
 
     def _on_term(signum, frame):
-        print(f"signal {signum}: shutting down"
-              + (f" after {args.shutdown_delay:.0f}s drain"
-                 if args.shutdown_delay else ""), file=sys.stderr)
-        for wp in worker_procs:  # propagate before our own drain
+        if not drain.begin(f"signal {signum}"):
+            return  # a second SIGTERM while already draining
+        print(f"signal {signum}: draining"
+              + (f" (serving {args.shutdown_delay:.0f}s more for LB "
+                 f"deregistration)" if args.shutdown_delay else ""),
+              file=sys.stderr)
+        if server is not None:
+            server.begin_drain()  # healthz 503 + retire keep-alives
+        for wp in worker_procs:  # children start their own drains now
             wp.terminate()
         if args.shutdown_delay:
             time.sleep(args.shutdown_delay)
@@ -625,17 +691,30 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        batcher.stop()
+        drain.begin("shutdown")
         if server:
-            server.stop()
-        export_trace()
+            # stops accepting, then drains in-flight handlers AND the
+            # batcher queue inside the budget before closing
+            drained = server.stop(drain_timeout=args.drain_timeout)
+            if not drained:
+                print(f"WARNING: drain exceeded --drain-timeout "
+                      f"{args.drain_timeout:.0f}s; in-flight work "
+                      f"abandoned", file=sys.stderr)
+        batcher.stop()  # idempotent (server.stop drained it already)
+        export_trace()  # tracer flush happens after the last span closed
+        # worker children drain in sequence: each runs this same
+        # machinery; the parent waits for them one at a time so every
+        # replica finishes its in-flight verdicts before the port dies
         for wp in worker_procs:
             wp.terminate()
         for wp in worker_procs:
             try:
-                wp.wait(timeout=5)
+                wp.wait(timeout=max(5.0, args.drain_timeout))
             except Exception:
                 wp.kill()
+        dt = drain.finish()
+        if drain.drain_seconds is not None and server is not None:
+            print(f"drain complete in {dt:.2f}s", file=sys.stderr)
     return 0
 
 

@@ -312,6 +312,19 @@ class AuditManager:
         self._finish(run)
         return run
 
+    # --- overload brownout (resilience/overload.py) ----------------------
+    def _brownout_yield(self) -> None:
+        """Brownout level-2 hook: while the webhook admission queue is
+        under heavy pressure, the sweep yields the device lane before
+        submitting its next chunk (bounded per call — audit slows, never
+        stalls).  A no-op without an installed OverloadController."""
+        from gatekeeper_tpu.resilience import overload
+
+        waited = overload.yield_device_lane()
+        if waited:
+            self.perf["brownout_yield_s"] = (
+                self.perf.get("brownout_yield_s", 0.0) + waited)
+
     # --- sweep chunk source (shared by both schedules) -------------------
     def _chunk_source(self, constraints, kind_filter, use_router, counter):
         """Yield ``(objects, constraint_subset)`` sweep chunks in the ONE
@@ -348,6 +361,7 @@ class AuditManager:
                     if cg is None:
                         cg = [c for c in constraints if c.kind in g]
                         cons_of_group[g] = cg
+                    self._brownout_yield()
                     yield buf, cg
                     bufs[g] = []
             for g, buf in bufs.items():
@@ -363,6 +377,7 @@ class AuditManager:
                 chunk.append(obj)
                 counter[0] += 1
                 if len(chunk) >= self.config.chunk_size:
+                    self._brownout_yield()
                     yield chunk, constraints
                     chunk = []
             if chunk:

@@ -223,6 +223,16 @@ class ProviderCache:
                     missing.append(key)
         if not missing:
             return out
+        from gatekeeper_tpu.resilience import overload as _overload
+
+        if _overload.current_brownout() >= 1:
+            # overload brownout (resilience/overload.py): external-data
+            # joins are the expensive optional work degraded BEFORE any
+            # admission is shed — expired cache entries serve stale, keys
+            # never fetched flow into the placeholder failure policy
+            self._serve_stale(provider_name, missing, out,
+                              "overload brownout")
+            return out
         breaker = self._breaker(provider_name)
         if not breaker.allow():
             self._serve_stale(provider_name, missing, out,

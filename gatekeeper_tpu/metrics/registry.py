@@ -198,3 +198,13 @@ WEBHOOK_INFLIGHT = "webhook_inflight_requests"  # gauge (per process)
 WEBHOOK_INFLIGHT_HIGHWATER = "webhook_inflight_highwater"  # gauge
 WEBHOOK_QUEUE_WAIT = "webhook_batch_queue_wait_seconds"  # summary
 WEBHOOK_BATCH_SIZE = "webhook_batch_size"  # summary
+# overload protection (resilience/overload.py): the adaptive limiter's
+# current in-flight limit, the cost-aware admission queue's depth, the
+# brownout ladder level (0 = normal, 1 = optional work stale, 2 = audit
+# yields the device lane), sheds by reason, and the measured duration of
+# the last graceful drain
+OVERLOAD_INFLIGHT_LIMIT = "overload_inflight_limit"  # gauge
+OVERLOAD_QUEUE_DEPTH = "overload_queue_depth"  # gauge
+OVERLOAD_BROWNOUT = "overload_brownout_level"  # gauge
+OVERLOAD_SHED = "overload_shed_count"  # {reason}
+DRAIN_SECONDS = "drain_seconds"  # gauge

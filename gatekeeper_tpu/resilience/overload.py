@@ -1,0 +1,479 @@
+"""Overload protection for the serving path: adaptive concurrency,
+cost-aware load shedding, brownout ladder, graceful-drain state machine.
+
+The resilience layer (policy.py) protects the server from *dependency*
+failures; this module protects it from *its own* overload.  The
+reference delegates this tier to kube-apiserver's API Priority and
+Fairness (the webhook rides the apiserver's own flow control); we are
+our own server, so we carry our own limiter, in the gradient/AIMD
+adaptive-concurrency shape production inference gateways use:
+
+- :class:`AdaptiveLimiter` — AIMD on observed review latency vs a
+  seeded-deterministic baseline EWMA.  Latency above
+  ``threshold × baseline`` over an update window multiplicatively
+  decreases the in-flight limit; healthy windows additively increase
+  it.  Deterministic for a given (seed, sample sequence), so tests
+  replay the exact limit trajectory.
+- :class:`OverloadController` — the admission gate in front of
+  ``ValidationHandler``: a bounded **cost-aware queue** (cost = object
+  bytes × matched-constraint estimate) holds requests that arrive while
+  the limiter is full; a request that cannot queue (bounds exceeded,
+  queue-wait timeout) is **shed** by raising :class:`Shed`, which the
+  webhook maps onto the request's ``failurePolicy`` exactly like a
+  deadline miss (Ignore = allow + warning annotation, Fail = 429 with
+  Retry-After).
+- **Brownout ladder** — before any validation request is shed, the
+  controller degrades expensive *optional* work first, driven by queue
+  pressure: level 1 serves namespace-label lookups and external-data
+  joins stale-from-cache; level 2 additionally makes the audit sweep
+  yield the device lane (:func:`yield_device_lane`).  Level 0 is
+  bit-identical to no limiter at all (the overload differential test
+  pins this).
+- :class:`DrainCoordinator` — the graceful-drain state machine
+  (``serving → draining → stopped``) wired into ``__main__``: on
+  SIGTERM readiness flips 503, the listener stops accepting, in-flight
+  handlers and the Batcher queue drain within ``--drain-timeout``, the
+  tracer/metrics flush, worker children drain in sequence — zero
+  in-flight verdicts lost.
+
+Activation mirrors faults.py: :func:`install` process-global (the CLI),
+:func:`activate` contextvar-free scoped helper for tests, and cheap
+module-level reads (:func:`current_brownout`) for consumers on other
+layers (externaldata, audit).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+class Shed(Exception):
+    """The admission gate refused this request (queue bounds exceeded,
+    queue-wait timeout, or an injected ``webhook.overload`` chaos
+    fault).  The webhook resolves it per the request's failurePolicy —
+    never by dropping the connection."""
+
+    def __init__(self, reason: str = "overload",
+                 retry_after_s: float = 1.0):
+        super().__init__(f"request shed under overload ({reason}); "
+                         f"retry in {retry_after_s:.1f}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class OverloadConfig:
+    """Knobs for the limiter + admission queue + brownout ladder."""
+
+    # adaptive concurrency (AIMD)
+    min_inflight: int = 1
+    max_inflight: int = 64
+    initial_inflight: int = 8
+    ewma_alpha: float = 0.1  # baseline EWMA smoothing
+    latency_threshold: float = 2.0  # window avg > threshold*baseline: back off
+    decrease_factor: float = 0.7  # multiplicative decrease
+    increase_step: float = 1.0  # additive increase per healthy window
+    update_window: int = 16  # samples per AIMD decision
+    # fraction of *congested* samples fed to the baseline EWMA (seeded
+    # RNG): the baseline tracks slow drift without learning queueing
+    # delay as the new normal
+    congested_sample_p: float = 0.05
+    seed: int = 0
+    # cost-aware admission queue (cost = object bytes x matched-constraint
+    # estimate); both bounds shed when exceeded
+    queue_depth: int = 256
+    queue_cost: float = 256e6
+    queue_timeout_s: float = 1.0  # max wait for a limiter slot
+    shed_retry_after_s: float = 1.0
+    # brownout ladder thresholds on queue fill fraction
+    # (max of depth-fill and cost-fill), with exit hysteresis
+    brownout1_enter: float = 0.05
+    brownout1_exit: float = 0.0
+    brownout2_enter: float = 0.5
+    brownout2_exit: float = 0.25
+
+
+class AdaptiveLimiter:
+    """AIMD in-flight limiter against a seeded-deterministic latency
+    baseline EWMA.
+
+    The baseline learns from samples observed while the lane was
+    *uncongested* (in-flight at release time ≤ half the limit) plus a
+    seeded ``congested_sample_p`` trickle of loaded samples, so a
+    sustained overload cannot teach the limiter that queueing delay is
+    normal.  Every decision is a pure function of (config, seed, sample
+    sequence): tests replay the exact limit trajectory."""
+
+    def __init__(self, config: Optional[OverloadConfig] = None,
+                 metrics=None):
+        self.config = config or OverloadConfig()
+        c = self.config
+        self.metrics = metrics
+        self._limit = float(
+            min(c.max_inflight, max(c.min_inflight, c.initial_inflight)))
+        self._inflight = 0
+        self._baseline: Optional[float] = None
+        self._win_sum = 0.0
+        self._win_n = 0
+        self._rng = random.Random(c.seed)
+        self._lock = threading.Lock()
+        self._export()
+
+    @property
+    def limit(self) -> int:
+        with self._lock:
+            return int(self._limit)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def baseline_s(self) -> Optional[float]:
+        with self._lock:
+            return self._baseline
+
+    def try_acquire(self) -> bool:
+        with self._lock:
+            if self._inflight < int(self._limit):
+                self._inflight += 1
+                return True
+            return False
+
+    def release(self, latency_s: float) -> None:
+        c = self.config
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            # uncongested at release: this sample measured service time,
+            # not queueing — feed the baseline
+            uncongested = (self._inflight + 1) <= max(
+                1, int(self._limit) // 2)
+            if self._baseline is None:
+                self._baseline = latency_s
+            elif uncongested or self._rng.random() < c.congested_sample_p:
+                self._baseline += c.ewma_alpha * (
+                    latency_s - self._baseline)
+            self._win_sum += latency_s
+            self._win_n += 1
+            if self._win_n >= c.update_window:
+                avg = self._win_sum / self._win_n
+                self._win_sum, self._win_n = 0.0, 0
+                if self._baseline and \
+                        avg > c.latency_threshold * self._baseline:
+                    self._limit = max(float(c.min_inflight),
+                                      self._limit * c.decrease_factor)
+                else:
+                    self._limit = min(float(c.max_inflight),
+                                      self._limit + c.increase_step)
+        self._export()
+
+    def _export(self) -> None:
+        if self.metrics is None:
+            return
+        from gatekeeper_tpu.metrics import registry as M
+
+        self.metrics.set_gauge(M.OVERLOAD_INFLIGHT_LIMIT, self.limit)
+
+
+def estimate_cost(review_body: dict, cost_hint: int = 0,
+                  constraint_count: Optional[Callable[[str], int]] = None
+                  ) -> float:
+    """Admission cost = object bytes × matched-constraint estimate.
+
+    ``cost_hint`` is the HTTP Content-Length when the server knows it
+    (the cheap path); otherwise the request object is sized by one
+    compact serialize.  ``constraint_count(kind)`` is the caller's
+    cached matched-constraint estimator (ValidationHandler caches per
+    kind)."""
+    req = review_body.get("request") or {}
+    nbytes = int(cost_hint or 0)
+    if nbytes <= 0:
+        obj = req.get("object")
+        if obj is not None:
+            try:
+                nbytes = len(json.dumps(obj, separators=(",", ":")))
+            except (TypeError, ValueError):
+                nbytes = 1024
+        else:
+            nbytes = 64
+    n_cons = 1
+    if constraint_count is not None:
+        kind = ((req.get("kind") or {}).get("kind", "")) or ""
+        try:
+            n_cons = max(1, int(constraint_count(kind)))
+        except Exception:
+            n_cons = 1
+    return float(max(1, nbytes)) * n_cons
+
+
+class OverloadController:
+    """The admission gate: limiter slot or bounded cost-aware queue or
+    shed.  ``admit(cost)`` is the single seam the webhook wraps its
+    review in; the measured time inside is the latency sample the
+    limiter adapts on."""
+
+    def __init__(self, config: Optional[OverloadConfig] = None,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.config = config or OverloadConfig()
+        self.metrics = metrics
+        self.limiter = AdaptiveLimiter(self.config, metrics=metrics)
+        self._clock = clock
+        self._sleep = sleep
+        self._cv = threading.Condition()
+        self._queue_len = 0
+        self._queue_cost = 0.0
+        self._brownout = 0
+        self.shed_count = 0  # total sheds (tests/introspection)
+
+    # --- admission -------------------------------------------------------
+    @contextmanager
+    def admit(self, cost: float = 1.0):
+        """Admission gate: acquire a limiter slot (immediately or via the
+        bounded queue) or raise :class:`Shed`.  The body's wall time is
+        the limiter's latency sample."""
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        # the chaos seam for this tier: error mode forces a shed (the
+        # failurePolicy plumbing downstream is what's under test);
+        # sleep/hang stall the gate like a saturated queue would
+        fault_point("webhook.overload",
+                    error_factory=lambda spec: Shed(
+                        reason="chaos",
+                        retry_after_s=spec.delay_s or 1.0))
+        if not self.limiter.try_acquire():
+            self._queue_for_slot(cost)  # raises Shed on refusal
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.limiter.release(self._clock() - t0)
+            with self._cv:
+                self._cv.notify()
+
+    def _queue_for_slot(self, cost: float) -> None:
+        c = self.config
+        with self._cv:
+            depth_full = self._queue_len + 1 > c.queue_depth
+            cost_full = self._queue_cost + cost > c.queue_cost
+            if depth_full or cost_full:
+                self._shed_locked(
+                    "queue_cost" if cost_full and not depth_full
+                    else "queue_full")
+            self._queue_len += 1
+            self._queue_cost += cost
+            self._pressure_locked()
+            end = self._clock() + max(0.0, c.queue_timeout_s)
+            try:
+                while True:
+                    if self.limiter.try_acquire():
+                        return
+                    remaining = end - self._clock()
+                    if remaining <= 0:
+                        self._shed_locked("queue_timeout")
+                    self._cv.wait(min(remaining, 0.05))
+            finally:
+                self._queue_len -= 1
+                self._queue_cost -= cost
+                self._pressure_locked()
+
+    def _shed_locked(self, reason: str) -> None:
+        self.shed_count += 1
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(M.OVERLOAD_SHED, {"reason": reason})
+        try:
+            from gatekeeper_tpu.utils.logging import log_event
+
+            log_event("warning", "request shed under overload",
+                      event_type="overload_shed", reason=reason,
+                      queue_depth=self._queue_len,
+                      inflight_limit=self.limiter.limit)
+        except Exception:
+            pass
+        raise Shed(reason=reason,
+                   retry_after_s=self.config.shed_retry_after_s)
+
+    # --- brownout ladder -------------------------------------------------
+    def _pressure_locked(self) -> None:
+        """Recompute queue fill + brownout level (call under _cv)."""
+        c = self.config
+        fill = 0.0
+        if c.queue_depth > 0:
+            fill = max(fill, self._queue_len / c.queue_depth)
+        if c.queue_cost > 0:
+            fill = max(fill, self._queue_cost / c.queue_cost)
+        lvl = self._brownout
+        if fill >= c.brownout2_enter or \
+                (lvl >= 2 and fill > c.brownout2_exit):
+            new = 2
+        elif fill >= c.brownout1_enter or \
+                (lvl >= 1 and fill > c.brownout1_exit):
+            new = 1
+        else:
+            new = 0
+        if new != lvl:
+            self._brownout = new
+            try:
+                from gatekeeper_tpu.utils.logging import log_event
+
+                log_event("warning" if new > lvl else "info",
+                          "overload brownout level change",
+                          event_type="overload_brownout",
+                          brownout_from=lvl, brownout_to=new,
+                          queue_fill=round(fill, 3))
+            except Exception:
+                pass
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.set_gauge(M.OVERLOAD_QUEUE_DEPTH, self._queue_len)
+            self.metrics.set_gauge(M.OVERLOAD_BROWNOUT, self._brownout)
+
+    def brownout_level(self) -> int:
+        with self._cv:
+            return self._brownout
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._queue_len
+
+
+# --- activation (mirrors faults.py: process-global + scoped) --------------
+
+_active: list = [None]
+
+
+def install(controller: Optional[OverloadController]) -> None:
+    """Process-global activation (the serving entrypoint)."""
+    _active[0] = controller
+
+
+def uninstall() -> None:
+    _active[0] = None
+
+
+@contextmanager
+def activate(controller: OverloadController):
+    """Scoped activation for tests; restores the previous controller."""
+    prev = _active[0]
+    _active[0] = controller
+    try:
+        yield controller
+    finally:
+        _active[0] = prev
+
+
+def active_controller() -> Optional[OverloadController]:
+    return _active[0]
+
+
+def current_brownout() -> int:
+    """Brownout level of the installed controller (0 when none) — the
+    cheap cross-layer read for optional-work consumers (externaldata
+    stale serves, audit device-lane yield)."""
+    ctl = _active[0]
+    if ctl is None:
+        return 0
+    return ctl.brownout_level()
+
+
+def yield_device_lane(level: int = 2, max_wait_s: float = 0.25,
+                      poll_s: float = 0.01) -> float:
+    """Brownout level-2 hook for the audit sweep: while the webhook lane
+    is under heavy queue pressure, the sweep pauses before submitting its
+    next chunk so admission batches win the device.  Bounded by
+    ``max_wait_s`` per call — audit degrades, it never stalls.  Returns
+    the seconds actually yielded."""
+    ctl = _active[0]
+    if ctl is None or ctl.brownout_level() < level:
+        return 0.0
+    waited = 0.0
+    while waited < max_wait_s and ctl.brownout_level() >= level:
+        ctl._sleep(poll_s)
+        waited += poll_s
+    if waited and ctl.metrics is not None:
+        from gatekeeper_tpu.metrics import registry as M
+
+        ctl.metrics.inc_counter(
+            M.RESILIENCE_DEGRADED,
+            {"component": "audit", "to": "device_lane_yield"})
+    return waited
+
+
+# --- graceful drain -------------------------------------------------------
+
+SERVING, DRAINING, STOPPED = "serving", "draining", "stopped"
+
+
+class DrainCoordinator:
+    """The shutdown state machine: ``serving → draining → stopped``.
+
+    ``begin()`` is idempotent and first-caller-wins (SIGTERM may arrive
+    twice); readiness checks gate on :attr:`draining` so the LB pulls
+    the pod before the listener closes.  ``finish()`` records the drain
+    duration into ``gatekeeper_drain_seconds``."""
+
+    def __init__(self, metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = SERVING
+        self._begun_at: Optional[float] = None
+        self.drain_seconds: Optional[float] = None
+        self._stopped = threading.Event()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._state != SERVING
+
+    def begin(self, reason: str = "") -> bool:
+        """Enter DRAINING; True for the first caller only."""
+        with self._lock:
+            if self._state != SERVING:
+                return False
+            self._state = DRAINING
+            self._begun_at = self._clock()
+        try:
+            from gatekeeper_tpu.utils.logging import log_event
+
+            log_event("info", "graceful drain started",
+                      event_type="drain_started", reason=reason)
+        except Exception:
+            pass
+        return True
+
+    def finish(self) -> float:
+        """Enter STOPPED; records and returns the drain duration."""
+        with self._lock:
+            if self._state == STOPPED:
+                return self.drain_seconds or 0.0
+            dt = (self._clock() - self._begun_at
+                  if self._begun_at is not None else 0.0)
+            self._state = STOPPED
+            self.drain_seconds = dt
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.set_gauge(M.DRAIN_SECONDS, dt)
+        self._stopped.set()
+        return dt
+
+    def wait_stopped(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)

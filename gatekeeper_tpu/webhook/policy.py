@@ -52,6 +52,9 @@ class ValidationResponse:
     code: int = 200
     warnings: list = field(default_factory=list)
     uid: str = ""
+    # shed under failurePolicy=Fail: the server emits an HTTP Retry-After
+    # header with this hint (0 = no header)
+    retry_after_s: float = 0.0
 
 
 def parse_admission_review(body: dict) -> AdmissionRequest:
@@ -88,6 +91,7 @@ class ValidationHandler:
         log_stats: bool = False,  # --log-stats-admission
         deadline_budget_s: float = 0.0,  # hard per-request wall budget
         failure_policy: Optional[str] = None,  # "ignore" | "fail"
+        overload=None,  # resilience.overload.OverloadController
     ):
         self.client = client
         self.expansion_system = expansion_system
@@ -112,9 +116,32 @@ class ValidationHandler:
         self.deadline_budget_s = float(deadline_budget_s or 0.0)
         self.trace_config = trace_config
         self.log_stats = log_stats
+        # overload protection (resilience/overload.py): the admission
+        # gate in front of the review, plus the caches its brownout
+        # ladder degrades onto — a bounded stale namespace-lookup cache
+        # and a per-kind matched-constraint estimate for the cost model
+        self.overload = overload
+        self._ns_stale: dict = {}
+        self._kind_est: dict = {}
+        self._kind_est_total = -1
 
     # --- the handler (reference: validationHandler.Handle, policy.go:139) -
-    def handle(self, review_body: dict) -> ValidationResponse:
+    def handle(self, review_body: dict,
+               cost_hint: int = 0) -> ValidationResponse:
+        if self.overload is not None:
+            from gatekeeper_tpu.resilience.overload import (Shed,
+                                                            estimate_cost)
+
+            try:
+                cost = estimate_cost(review_body, cost_hint,
+                                     self._constraint_estimate)
+                with self.overload.admit(cost):
+                    return self._counted(review_body)
+            except Shed as shed:
+                return self._shed_response(review_body, shed)
+        return self._counted(review_body)
+
+    def _counted(self, review_body: dict) -> ValidationResponse:
         if self.metrics is None:
             return self._guarded(review_body)
         from gatekeeper_tpu.metrics import registry as m
@@ -131,6 +158,70 @@ class ValidationHandler:
         finally:
             self.metrics.inc_counter(m.REQUEST_COUNT,
                                      {"admission_status": status})
+
+    # --- overload plumbing ------------------------------------------------
+    def _constraint_estimate(self, kind: str) -> int:
+        """Matched-constraint count per kind for the admission cost model
+        (cost = object bytes x this).  Cached until the constraint count
+        changes; an estimate, not a matcher — namespaces/labels are not
+        consulted."""
+        cons = self.client.constraints()
+        if self._kind_est_total != len(cons):
+            self._kind_est_total = len(cons)
+            self._kind_est.clear()
+        n = self._kind_est.get(kind)
+        if n is None:
+            n = 0
+            for c in cons:
+                entries = (c.match or {}).get("kinds") or []
+                if not entries:
+                    n += 1
+                    continue
+                for e in entries:
+                    ks = e.get("kinds") or []
+                    if not ks or "*" in ks or kind in ks:
+                        n += 1
+                        break
+            n = max(1, n)
+            self._kind_est[kind] = n
+        return n
+
+    def _shed_response(self, review_body: dict, shed) -> ValidationResponse:
+        """Shed semantics == deadline-miss semantics: the request's
+        failurePolicy decides (Ignore = allow + warning annotation,
+        Fail = deny 429 with Retry-After)."""
+        uid = ((review_body.get("request") or {}).get("uid", "")) or ""
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("webhook.shed", uid=uid, reason=shed.reason,
+                          policy=self.failure_policy):
+            pass
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as m
+
+            self.metrics.inc_counter(m.REQUEST_COUNT,
+                                     {"admission_status": "shed"})
+        from gatekeeper_tpu.utils.logging import log_event
+
+        log_event("warning", "admission request shed under overload",
+                  event_type="overload_shed_resolved",
+                  shed_reason=shed.reason,
+                  failure_policy=self.failure_policy)
+        if self.fail_open:
+            return ValidationResponse(
+                allowed=True, uid=uid,
+                warnings=[
+                    f"gatekeeper shed this request under overload "
+                    f"({shed.reason}); failurePolicy=Ignore admitted it "
+                    f"unreviewed"],
+            )
+        return ValidationResponse(
+            allowed=False, uid=uid, code=429,
+            message=(f"gatekeeper shed this request under overload "
+                     f"({shed.reason}) (failurePolicy=Fail); retry after "
+                     f"{shed.retry_after_s:.0f}s"),
+            retry_after_s=shed.retry_after_s or 1.0,
+        )
 
     def _guarded(self, review_body: dict) -> ValidationResponse:
         """Deadline-budget guard (reference: the apiserver's webhook
@@ -220,7 +311,8 @@ class ValidationHandler:
                 return ValidationResponse(allowed=True, uid=req.uid)
 
         # review (+ expansion)
-        ns_obj = self.namespace_lookup(req.namespace) if req.namespace else None
+        ns_obj = self._lookup_namespace(req.namespace) if req.namespace \
+            else None
         augmented = AugmentedReview(
             admission_request=req, namespace=ns_obj,
             source=SOURCE_ORIGINAL, is_admission=True,
@@ -296,6 +388,28 @@ class ValidationHandler:
             if results:  # reference emits per result incl. dryrun-only
                 self.event_sink(req, results)
         return resp
+
+    def _lookup_namespace(self, name: str):
+        """Namespace lookup with brownout degradation: at brownout level
+        >= 1 the (possibly apiserver-backed) lookup is skipped and the
+        last-seen value serves STALE — the first rung of the ladder,
+        degraded before any request is shed."""
+        if self.overload is not None and \
+                self.overload.brownout_level() >= 1 and \
+                name in self._ns_stale:
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as m
+
+                self.metrics.inc_counter(
+                    m.RESILIENCE_STALE_SERVED,
+                    {"dependency": "webhook/namespace_lookup"})
+            return self._ns_stale[name]
+        ns_obj = self.namespace_lookup(name)
+        if self.overload is not None:
+            if len(self._ns_stale) >= 4096 and name not in self._ns_stale:
+                self._ns_stale.pop(next(iter(self._ns_stale)))
+            self._ns_stale[name] = ns_obj
+        return ns_obj
 
     def _review(self, augmented):
         req = augmented.admission_request
@@ -471,10 +585,20 @@ class Batcher:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, timeout: float = 2.0) -> bool:
+        """Stop AND drain: the loop keeps flushing until the queue is
+        empty before exiting, so reviews queued at stop time still get
+        their verdicts (the old stop dropped them — their handler threads
+        waited forever on abandoned slots).  Idempotent; returns True
+        when the loop exited (queue drained) within ``timeout``."""
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2)
+            self._thread.join(timeout=timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
 
     def review(self, augmented):
         from gatekeeper_tpu.observability import tracing
@@ -513,10 +637,14 @@ class Batcher:
             self.metrics.observe(m.WEBHOOK_QUEUE_WAIT, now - entry[3])
 
     def _loop(self):
-        while not self._stop.is_set():
+        while True:
             try:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
+                # exit only when stopped AND drained: entries queued at
+                # stop time flush first (zero-loss shutdown)
+                if self._stop.is_set():
+                    return
                 continue
             batch = [first]
             # drain whatever is already queued without blocking; the

@@ -65,6 +65,8 @@ class WebhookServer:
         tls_min_version: str = "1.3",  # reference --webhook-tls-min-version
         enable_profile: bool = False,  # pprof-equivalent /debug/profile
         reuse_port: bool = False,  # SO_REUSEPORT multi-worker serving
+        backlog: int = 128,  # --webhook-backlog: kernel accept queue
+        batcher=None,  # Batcher to drain inside stop() (zero-loss shutdown)
     ):
         self.validation_handler = validation_handler
         self.mutation_handler = mutation_handler
@@ -73,6 +75,13 @@ class WebhookServer:
         self.readiness_stats = readiness_stats
         self.metrics = metrics
         self.enable_profile = enable_profile
+        self.batcher = batcher
+        # graceful drain (resilience/overload.DrainCoordinator drives the
+        # process view; this event is the server-local view): once set,
+        # /healthz answers 503 {"draining": true} so the LB pulls this
+        # endpoint, and every reply closes its connection so kept-alive
+        # clients migrate off before the listener shuts
+        self._draining = threading.Event()
         # per-worker accept-lane depth (VERDICT r4 weak #5): admissions
         # currently being handled by this process + the high-water mark.
         # With --webhook-workers each SO_REUSEPORT process exports its
@@ -83,15 +92,17 @@ class WebhookServer:
         outer = self
 
         def _track_inflight(delta: int) -> None:
-            if outer.metrics is None:
-                return
-            from gatekeeper_tpu.metrics import registry as m
-
+            # always counted (the drain waits on it), exported only with
+            # a metrics registry
             with outer._inflight_lock:
                 outer._inflight += delta
                 if outer._inflight > outer._inflight_highwater:
                     outer._inflight_highwater = outer._inflight
                 cur, hi = outer._inflight, outer._inflight_highwater
+            if outer.metrics is None:
+                return
+            from gatekeeper_tpu.metrics import registry as m
+
             outer.metrics.set_gauge(m.WEBHOOK_INFLIGHT, cur)
             outer.metrics.set_gauge(m.WEBHOOK_INFLIGHT_HIGHWATER, hi)
 
@@ -113,6 +124,14 @@ class WebhookServer:
 
             def do_GET(self):
                 if self.path == HEALTH_PATH:
+                    if outer._draining.is_set():
+                        # drain started: 503 + explicit marker so load
+                        # balancers stop routing BEFORE the listener
+                        # closes (close=True also retires this kept-alive
+                        # probe connection)
+                        self._reply(503, {"ready": False, "draining": True},
+                                    close=True)
+                        return
                     ready = (outer.readiness_check is None
                              or outer.readiness_check())
                     body = {"ready": bool(ready)}
@@ -218,7 +237,10 @@ class WebhookServer:
 
                         fault_point("webhook.request", path=self.path)
                         if self.path == ADMIT_PATH:
-                            self._admit(body, uid)
+                            # the body's wire size is the cheap half of
+                            # the overload cost estimate (object bytes x
+                            # matched constraints)
+                            self._admit(body, uid, cost_hint=length)
                         elif self.path == MUTATE_PATH:
                             self._mutate(body, uid)
                         elif self.path == ADMIT_LABEL_PATH:
@@ -235,15 +257,23 @@ class WebhookServer:
                 finally:
                     _track_inflight(-1)
 
-            def _admit(self, body, uid):
+            def _admit(self, body, uid, cost_hint=0):
                 h = outer.validation_handler
                 if h is None:
                     self._reply(200, admission_response(uid, True))
                     return
-                v = h.handle(body)
+                v = h.handle(body, cost_hint=cost_hint)
+                headers = None
+                retry_after = getattr(v, "retry_after_s", 0.0)
+                if retry_after:
+                    # shed under failurePolicy=Fail: the AdmissionReview
+                    # carries code 429, the HTTP header tells well-behaved
+                    # callers when to retry
+                    headers = {"Retry-After":
+                               str(max(1, int(retry_after + 0.999)))}
                 self._reply(200, admission_response(
                     v.uid or uid, v.allowed, v.message, v.code, v.warnings
-                ))
+                ), headers=headers)
 
             def _mutate(self, body, uid):
                 h = outer.mutation_handler
@@ -266,17 +296,24 @@ class WebhookServer:
                 ))
 
             def _reply(self, status: int, payload: dict,
-                       close: bool = False):
+                       close: bool = False, headers: Optional[dict] = None):
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 # traceparent emit: callers correlate their timeline with
                 # the server-side request span
                 tp = tracing.format_traceparent()
                 if tp is not None:
                     tracing.set_attribute("http.status", status)
                     self.send_header(tracing.TRACEPARENT_HEADER, tp)
+                if outer._draining.is_set():
+                    # draining: retire every kept-alive connection after
+                    # its in-flight response so clients reconnect through
+                    # the LB (which already sees the 503 readiness)
+                    close = True
                 if close:
                     # send_header("Connection", "close") also sets
                     # close_connection so handle() drops the socket after
@@ -288,8 +325,13 @@ class WebhookServer:
 
         class _Server(ThreadingHTTPServer):
             # the socketserver default backlog of 5 resets bursts of
-            # concurrent connects (the apiserver opens many at once)
-            request_queue_size = 128
+            # concurrent connects (the apiserver opens many at once).
+            # --webhook-backlog sizes this KERNEL accept queue (unanswered
+            # TCP connects); it is distinct from the limiter's cost-aware
+            # admission queue (accepted requests waiting for a review
+            # slot, resilience/overload.py) — see README "Overload &
+            # drain semantics"
+            request_queue_size = backlog
 
             def server_bind(self):
                 if reuse_port:
@@ -340,8 +382,58 @@ class WebhookServer:
         self._thread.start()
         return self
 
-    def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
+    # --- graceful drain ---------------------------------------------------
+    def begin_drain(self) -> None:
+        """Flip into drain mode WITHOUT closing anything: /healthz answers
+        503 {"draining": true} and replies close their connections, but
+        the listener keeps accepting (the --shutdown-delay window where
+        the LB deregisters this endpoint)."""
+        self._draining.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def stop(self, drain_timeout: float = 5.0) -> bool:
+        """Zero-loss shutdown: stop accepting, then drain in-flight
+        handlers AND the batcher queue within ``drain_timeout`` before
+        closing.  Every admission the server accepted gets its verdict
+        written back — the pre-drain ``shutdown(); server_close()``
+        ordering silently dropped queued reviews.  Returns True when the
+        drain completed inside the budget."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        self.begin_drain()
+        with tracing.span("server.drain"):
+            self._server.shutdown()  # listener stops accepting
+            deadline = t0 + max(0.0, drain_timeout)
+            while _t.perf_counter() < deadline:
+                if self.inflight() == 0 and (
+                        self.batcher is None
+                        or self.batcher.queue_depth() == 0):
+                    break
+                _t.sleep(0.005)
+            drained = self.inflight() == 0
+            if self.batcher is not None:
+                # nothing new can arrive: drain whatever is still queued
+                # (abandoned deadline-missed entries included), bounded by
+                # the remaining budget
+                self.batcher.stop(timeout=max(
+                    0.1, deadline - _t.perf_counter()))
+                drained = drained and self.batcher.queue_depth() == 0
+            self._server.server_close()
+            tracing.set_attribute("drained", drained)
+            tracing.set_attribute("inflight_at_close", self.inflight())
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as m
+
+            self.metrics.set_gauge(m.DRAIN_SECONDS,
+                                   _t.perf_counter() - t0)
         if self._thread:
             self._thread.join(timeout=2)
+        return drained
