@@ -43,6 +43,24 @@ def main(argv=None) -> int:
     p.add_argument("--audit-interval", type=float, default=60.0)
     p.add_argument("--constraint-violations-limit", type=int, default=20)
     p.add_argument("--audit-chunk-size", type=int, default=500)
+    p.add_argument("--audit-source", default="relist",
+                   choices=["relist", "snapshot"],
+                   help="sweep input: 'relist' pages the cluster every "
+                        "pass; 'snapshot' keeps the flattened columns "
+                        "RESIDENT between sweeps, maintained by the "
+                        "watch seam — a full pass evaluates resident "
+                        "columns (no list/flatten cost) and interval "
+                        "ticks evaluate only the watch-dirtied rows "
+                        "(O(churn)); a periodic full-resync "
+                        "differential asserts snapshot == fresh relist "
+                        "bit-identical (README 'Incremental audit & "
+                        "snapshot')")
+    p.add_argument("--snapshot-resync-every", type=int, default=10,
+                   help="snapshot mode: every Nth audit interval runs "
+                        "the full-resync differential instead of an "
+                        "incremental tick (0 = never); divergence "
+                        "marks the run incomplete and rebuilds the "
+                        "snapshot")
     p.add_argument("--pipeline", default="auto",
                    choices=["auto", "on", "off", "differential"],
                    help="audit sweep schedule: 'auto' runs the staged "
@@ -410,6 +428,8 @@ def main(argv=None) -> int:
           f"constraints: {len(client.constraints())}", file=sys.stderr)
 
     audit_mgr = None
+    snapshot = None
+    snap_ingester = None
     if mgr.is_assigned("audit") or args.once:
         if args.evaluate_sidecar:
             from gatekeeper_tpu.drivers.remote import RemoteEvaluator
@@ -460,6 +480,43 @@ def main(argv=None) -> int:
                         args.audit_events_involved_namespace),
                     on_error=lambda e: print(
                         f"audit event emit failed: {e}", file=sys.stderr)))
+        audit_source = args.audit_source
+        if audit_source == "snapshot":
+            if args.evaluate_sidecar:
+                # the snapshot lane slices resident columns into device
+                # chunks locally (sweep_flatten_from_batch) — the
+                # sidecar's RPC evaluator has no such seam
+                print("--audit-source snapshot needs a local evaluator; "
+                      "falling back to relist", file=sys.stderr)
+                audit_source = "relist"
+            else:
+                from gatekeeper_tpu.snapshot import (ClusterSnapshot,
+                                                     SnapshotConfig,
+                                                     WatchIngester,
+                                                     gvks_of)
+
+                snapshot = ClusterSnapshot(evaluator, SnapshotConfig(),
+                                           metrics=metrics)
+                watch_src = kube_cluster if kube_cluster is not None \
+                    else cluster
+                if kube_cluster is not None:
+                    try:
+                        watch_gvks = kube_cluster.server_preferred_gvks()
+                    except Exception as e:
+                        print(f"snapshot discovery failed: {e}",
+                              file=sys.stderr)
+                        watch_gvks = []
+                else:
+                    watch_gvks = gvks_of(cluster.list())
+                snap_ingester = WatchIngester(
+                    snapshot, watch_src, watch_gvks,
+                    on_error=lambda e: print(
+                        f"snapshot watch subscribe failed: {e}",
+                        file=sys.stderr)).start()
+                print(f"resident snapshot active: watching "
+                      f"{len(watch_gvks)} GVKs, resync every "
+                      f"{args.snapshot_resync_every} intervals",
+                      file=sys.stderr)
         audit_mgr = AuditManager(
             client,
             lister=lister,
@@ -469,12 +526,15 @@ def main(argv=None) -> int:
                 chunk_size=args.audit_chunk_size,
                 pipeline=args.pipeline,
                 pipeline_flatten_workers=args.pipeline_flatten_workers,
+                audit_source=audit_source,
+                resync_every=args.snapshot_resync_every,
             ),
             evaluator=evaluator,
             export_system=export,  # Connection CRs register here too
             event_sink=audit_event_sink,
             log_violations=args.log_denies,
             metrics=metrics,
+            snapshot=snapshot,
         )
 
     def export_trace():
@@ -609,6 +669,7 @@ def main(argv=None) -> int:
                 trace_config=lambda: mgr.validation_traces,
                 log_stats=args.log_stats_admission,
                 overload=overload_ctl,
+                snapshot=snapshot,  # warm namespace/referential cache
             ) if mgr.is_assigned("webhook") else None,
             mutation_handler=MutationHandler(
                 mgr.mutation_system,
@@ -701,6 +762,8 @@ def main(argv=None) -> int:
                       f"{args.drain_timeout:.0f}s; in-flight work "
                       f"abandoned", file=sys.stderr)
         batcher.stop()  # idempotent (server.stop drained it already)
+        if snap_ingester is not None:
+            snap_ingester.stop()
         export_trace()  # tracer flush happens after the last span closed
         # worker children drain in sequence: each runs this same
         # machinery; the parent waits for them one at a time so every

@@ -84,6 +84,18 @@ class AuditConfig:
     # the sweep degrades to the serial schedule mid-pass.
     chunk_retries: int = 1
     pipeline_stage_retries: int = 1
+    # sweep input (--audit-source): 'relist' pages the cluster through
+    # the lister every pass (the reference shape); 'snapshot' audits the
+    # resident columnar snapshot (gatekeeper_tpu/snapshot/) — a full
+    # pass evaluates resident columns with zero list/flatten cost, and
+    # `audit_tick` evaluates only the watch-dirtied row set (O(churn)).
+    # Snapshot mode ignores match_kind_only (the router already scopes
+    # evaluation to kinds some template can match).
+    audit_source: str = "relist"
+    # snapshot mode: every Nth interval runs the full-resync
+    # differential (fresh relist + re-flatten asserted bit-identical to
+    # the resident snapshot) instead of an incremental tick; 0 = never
+    resync_every: int = 10
 
 
 @dataclass
@@ -150,6 +162,7 @@ class AuditManager:
         event_sink: Optional[Callable] = None,
         log_violations: bool = False,
         metrics=None,  # metrics.registry.MetricsRegistry (optional)
+        snapshot=None,  # snapshot.ClusterSnapshot (audit_source=snapshot)
     ):
         self.client = client
         self.lister = lister
@@ -160,6 +173,10 @@ class AuditManager:
         self.event_sink = event_sink
         self.log_violations = log_violations
         self.metrics = metrics
+        self.snapshot = snapshot
+        # human-readable first difference of the last resync differential
+        # (None = bit-identical), for tests/ops introspection
+        self.last_resync_diff: Optional[str] = None
         self._stop = threading.Event()
         # per-phase seconds for the host-side fold/render of device sweeps
         # (the evaluator tracks its own flatten/masks/wire/dispatch/collect)
@@ -171,6 +188,21 @@ class AuditManager:
 
     # --- loop (reference: auditManagerLoop, manager.go:831) -------------
     def run_forever(self):
+        if self._snapshot_mode():
+            # initial full pass builds the snapshot and evaluates every
+            # row; steady state is incremental ticks over the dirty set,
+            # with the full-resync differential every resync_every-th
+            # interval proving the snapshot still equals a fresh relist
+            self.audit()
+            n = 0
+            every = max(0, getattr(self.config, "resync_every", 0))
+            while not self._stop.wait(self.config.interval_s):
+                n += 1
+                if every and n % every == 0:
+                    self.audit_resync()
+                else:
+                    self.audit_tick()
+            return
         while not self._stop.wait(self.config.interval_s):
             self.audit()
 
@@ -185,7 +217,11 @@ class AuditManager:
         from gatekeeper_tpu.observability import tracing
 
         with tracing.span("audit.sweep") as sp:
-            run = self._audit_impl()
+            if self._snapshot_mode():
+                sp.set_attribute("source", "snapshot")
+                run = self._audit_snapshot_impl(full=True)
+            else:
+                run = self._audit_impl()
             sp.set_attribute("objects", run.total_objects)
             sp.set_attribute("duration_s", round(run.duration_s, 3))
             sp.set_attribute("violations",
@@ -311,6 +347,411 @@ class AuditManager:
         self._publish_metrics(run)
         self._finish(run)
         return run
+
+    # --- snapshot lane (gatekeeper_tpu/snapshot/) -------------------------
+    def _snapshot_mode(self) -> bool:
+        return (getattr(self.config, "audit_source", "relist")
+                == "snapshot" and self.snapshot is not None)
+
+    def audit_tick(self) -> AuditRun:
+        """Incremental snapshot audit: evaluate ONLY the dirty row set
+        (rows the watch patched since the last evaluation) — O(churn),
+        not O(cluster).  Cluster-wide totals/kept come from the
+        persistent per-row verdict store (clean rows keep their last
+        results)."""
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("audit.tick") as sp:
+            run = self._audit_snapshot_impl(full=False)
+            sp.set_attribute("objects", run.total_objects)
+            sp.set_attribute("duration_s", round(run.duration_s, 3))
+            if run.incomplete:
+                sp.set_attribute("incomplete", True)
+            return run
+
+    def _snapshot_ready(self, constraints) -> bool:
+        """Adopt the constraint set, rebuild if stale, apply queued watch
+        events.  Returns True when a rebuild happened."""
+        snap = self.snapshot
+        rebuilt = False
+        if snap.set_constraints(constraints):
+            from gatekeeper_tpu.utils.logging import log_event
+
+            n = snap.rebuild(self.lister)
+            rebuilt = True
+            log_event("info", "snapshot rebuilt",
+                      event_type="snapshot_rebuilt", rows=n,
+                      generation=snap.generation)
+        snap.pump()
+        return rebuilt
+
+    def _audit_snapshot_impl(self, full: bool) -> AuditRun:
+        t0 = time.time()
+        run = AuditRun(timestamp=_now_rfc3339())
+        constraints = [
+            c for c in self.client.constraints()
+            if c.actions_for(AUDIT_EP)
+        ]
+        if self.export_system is not None:
+            self.export_system.publish_audit_started(run.timestamp)
+        if not constraints:
+            run.duration_s = time.time() - t0
+            self._finish(run)
+            return run
+        snap = self.snapshot
+        self._snapshot_ready(constraints)
+        rows = snap.all_rows() if full else snap.dirty_rows()
+        self.perf["snapshot_rows_evaluated"] = (
+            self.perf.get("snapshot_rows_evaluated", 0.0)
+            + sum(len(v) for v in rows.values()))
+        self._snapshot_eval(rows, run)
+        run.total_objects = snap.live_count()
+        totals, kept = self._snapshot_collect(constraints)
+        run.total_violations = totals
+        run.kept = kept
+        run.duration_s = time.time() - t0
+        snap.publish_metrics()
+        self._write_statuses(run, constraints)
+        self._publish_metrics(run)
+        self._finish(run)
+        return run
+
+    def _snapshot_eval(self, rows_by_store, run) -> None:
+        """Evaluate snapshot rows group by group: resident columns slice
+        straight into device sweep chunks (zero flatten), non-lowered
+        kinds run their drivers' exact lane over the same rows; each
+        evaluated row's verdict-store entries are REPLACED.  A chunk that
+        exhausts its retries keeps its rows dirty and its previous
+        (stale-but-complete) entries, and flags the run incomplete."""
+        from collections import deque
+
+        snap = self.snapshot
+        ev = self.evaluator
+        retries = max(0, getattr(self.config, "chunk_retries", 1))
+        chunk_size = max(1, self.config.chunk_size)
+        max_inflight = max(1, self.config.submit_window)
+        from gatekeeper_tpu.observability import tracing
+
+        for store, rowlist in rows_by_store.items():
+            cons_g = store.cons
+            window: deque = deque()
+
+            def submit_chunk(gids, positions, objects):
+                batch = store.slice_rows(positions,
+                                         pad_n=ev._pad(len(positions)))
+                flat = ev.sweep_flatten_from_batch(
+                    cons_g, batch, objects, return_bits=True,
+                    alias=store.alias)
+                return ev.sweep_dispatch(flat)
+
+            def chunk_failed(exc):
+                run.failed_chunks += 1
+                run.incomplete = True
+                from gatekeeper_tpu.utils.logging import log_event
+
+                log_event("warning",
+                          "snapshot audit chunk dropped after exhausting "
+                          "retries (rows stay dirty; previous verdicts "
+                          "kept)", event_type="audit_chunk_failed",
+                          phase="snapshot", error=str(exc))
+                if self.metrics is not None:
+                    from gatekeeper_tpu.metrics import registry as M
+
+                    self.metrics.inc_counter(M.RESILIENCE_CHUNKS_FAILED)
+
+            def fold_oldest():
+                pending, gids, positions, objects, chunk_i = \
+                    window.popleft()
+                with tracing.span("audit.chunk.collect_fold",
+                                  chunk=chunk_i, objects=len(gids)):
+                    last = None
+                    swept = None
+                    for attempt in range(retries + 1):
+                        try:
+                            if attempt > 0:
+                                run.retried_chunks += 1
+                                pending = submit_chunk(gids, positions,
+                                                       objects)
+                            swept = ev.sweep_collect(pending)
+                            break
+                        except Exception as e:  # noqa: PERF203
+                            last = e
+                    else:
+                        chunk_failed(last)
+                        return
+                    try:
+                        t0 = time.perf_counter()
+                        self._fold_snapshot_chunk(swept, cons_g, gids,
+                                                  objects)
+                        snap.mark_clean(gids)
+                        self.perf["fold_render"] = (
+                            self.perf.get("fold_render", 0.0)
+                            + time.perf_counter() - t0)
+                    except Exception as e:
+                        chunk_failed(e)
+
+            for ci, i in enumerate(range(0, len(rowlist), chunk_size)):
+                chunk = rowlist[i: i + chunk_size]
+                gids = [g for g, _p in chunk]
+                positions = [p for _g, p in chunk]
+                objects = [store.row_obj(p) for p in positions]
+                pending = None
+                if store.lowered and ev is not None:
+                    with tracing.span("audit.chunk.submit", chunk=ci,
+                                      objects=len(gids)):
+                        last = None
+                        for attempt in range(retries + 1):
+                            try:
+                                if attempt > 0:
+                                    run.retried_chunks += 1
+                                pending = submit_chunk(gids, positions,
+                                                       objects)
+                                break
+                            except Exception as e:  # noqa: PERF203
+                                last = e
+                        else:
+                            chunk_failed(last)
+                            continue
+                window.append((pending, gids, positions, objects, ci))
+                while window and (len(window) > max_inflight
+                                  or _sweep_ready(window[0][0])):
+                    fold_oldest()
+            while window:
+                fold_oldest()
+
+    def _render_fn(self):
+        """(render, review_cache): the exact-engine render for one
+        (constraint, object) hit — the same path the relist fold uses,
+        so messages/details are bit-identical across audit sources."""
+        target = self.client.target
+        driver = next(
+            (d for d in self.client.drivers if hasattr(d, "query_batch")),
+            None,
+        )
+        cfg = ReviewCfg(enforcement_point=AUDIT_EP)
+        cache: dict = {}
+
+        def render(con, obj, cache_key=None):
+            self.perf["n_renders"] = self.perf.get("n_renders", 0) + 1
+            review = cache.get(cache_key) if cache_key is not None \
+                else None
+            if review is None:
+                review = target.handle_review(AugmentedUnstructured(
+                    object=obj, source=SOURCE_ORIGINAL))
+                if cache_key is not None:
+                    cache[cache_key] = review
+            if hasattr(driver, "render_query"):
+                return driver.render_query(
+                    target.name, con, review, cfg).results
+            return driver._interp.query(
+                target.name, [con], review, cfg).results
+
+        return render
+
+    def _fold_snapshot_chunk(self, swept, cons_g, gids, objects) -> None:
+        """Replace the verdict-store entries of an evaluated row set:
+        device hits from the bit-packed verdict rows (exact-totals mode
+        renders every hit now; otherwise messages render lazily at kept
+        time), non-lowered constraints via their drivers' exact lane."""
+        snap = self.snapshot
+        exact = self.config.exact_totals
+        for gid in gids:
+            snap.verdicts.clear_gid(gid)
+        render = self._render_fn()
+        k = len(gids)
+        if isinstance(swept, dict):
+            for kind, (kcons, idx, valid, counts, bits) in swept.items():
+                for ci, con in enumerate(kcons):
+                    ckey = con.key()
+                    hit = np.nonzero(
+                        np.unpackbits(bits[ci], count=k))[0]
+                    for oi in hit.tolist():
+                        if exact:
+                            results = render(con, objects[oi],
+                                             cache_key=oi)
+                            msgs = tuple(
+                                (r.msg,
+                                 (r.metadata or {}).get("details"))
+                                for r in results)
+                            snap.verdicts.set(ckey, gids[oi],
+                                              len(results), msgs)
+                        else:
+                            snap.verdicts.set(ckey, gids[oi], 1, None)
+        rest = [c for c in cons_g
+                if not isinstance(swept, dict) or c.kind not in swept]
+        if rest:
+            per_row = self._eval_rows_via_drivers(rest, objects)
+            for oi, per_con in per_row.items():
+                for ckey, results in per_con.items():
+                    snap.verdicts.set(ckey, gids[oi], len(results),
+                                      tuple(results))
+
+    def _eval_rows_via_drivers(self, constraints, objects) -> dict:
+        """Exact-lane evaluation with per-row capture:
+        {oi: {con_key: [(msg, details), ...]}} — the snapshot's analog of
+        :meth:`_eval_via_drivers` (same drivers, same matcher prefilter,
+        results keyed per row for the verdict store)."""
+        out: dict = {}
+        if not constraints:
+            return out
+        target = self.client.target
+        reviews = [
+            target.handle_review(
+                AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL))
+            for o in objects
+        ]
+        wanted = {c.key() for c in constraints}
+        by_driver: dict = {}
+        for con in constraints:
+            d = self.client._template_driver.get(con.kind)
+            if d is None:
+                continue
+            by_driver.setdefault(id(d), (d, []))[1].append(con)
+        cfg = ReviewCfg(enforcement_point=AUDIT_EP)
+        for d, cons in by_driver.values():
+            if hasattr(d, "query_batch"):
+                responses = d.query_batch(target.name, cons, reviews, cfg)
+                for oi, resp in enumerate(responses):
+                    for r in resp.results:
+                        ckey = (r.constraint.get("kind", ""),
+                                (r.constraint.get("metadata") or {})
+                                .get("name", ""))
+                        if ckey not in wanted:
+                            continue
+                        out.setdefault(oi, {}).setdefault(
+                            ckey, []).append((r.msg, r.details))
+                continue
+            for oi, review in enumerate(reviews):
+                for con in cons:
+                    if not target.to_matcher(con.match).match(review):
+                        continue
+                    qr = d.query(target.name, [con], review, cfg)
+                    if qr.results:
+                        out.setdefault(oi, {}).setdefault(
+                            con.key(), []).extend(
+                            (r.msg, r.details) for r in qr.results)
+        return out
+
+    def _snapshot_collect(self, constraints) -> tuple:
+        """(totals, kept) derived from the verdict store: totals sum
+        every row's contribution; kept takes the first ``limit`` rows in
+        stable row-id order (messages render lazily on first derivation
+        and are cached back into the store)."""
+        snap = self.snapshot
+        limit = self.config.violations_limit
+        totals = {c.key(): 0 for c in constraints}
+        kept: dict = {c.key(): [] for c in constraints}
+        render = self._render_fn()
+        for con in constraints:
+            ckey = con.key()
+            for gid, count, msgs in snap.verdicts.rows(ckey):
+                totals[ckey] += count
+                if len(kept[ckey]) >= limit:
+                    continue
+                obj = snap.obj_of(gid)
+                if msgs is None:
+                    results = render(con, obj, cache_key=gid)
+                    msgs = tuple(
+                        (r.msg, (r.metadata or {}).get("details"))
+                        for r in results)
+                    snap.verdicts.set_msgs(ckey, gid, msgs)
+                for msg, details in msgs:
+                    if len(kept[ckey]) < limit:
+                        kept[ckey].append(
+                            self._violation(con, obj, msg, details))
+        return totals, kept
+
+    def audit_resync(self) -> AuditRun:
+        """The periodic full-resync differential (snapshot mode): drain
+        the dirty set, then re-list + re-flatten fresh and assert the
+        resident snapshot is bit-identical — columns (per-row signatures
+        over the same vocab), vocab (the fresh flatten interns nothing
+        new), and verdicts (totals + kept against a fresh relist sweep
+        through the serial schedule).  Divergence marks the run
+        incomplete and invalidates the snapshot: the next sweep
+        rebuilds."""
+        from gatekeeper_tpu.observability import tracing
+
+        t0 = time.time()
+        with tracing.span("snapshot.resync") as sp:
+            run = self._audit_snapshot_impl(full=False)
+            snap = self.snapshot
+            diff = snap.resync_differential(self.lister)
+            if diff is None:
+                constraints = [
+                    c for c in self.client.constraints()
+                    if c.actions_for(AUDIT_EP)
+                ]
+                kept_f: dict = {c.key(): [] for c in constraints}
+                totals_f: dict = {c.key(): 0 for c in constraints}
+                fr = AuditRun(timestamp=run.timestamp)
+                batch_driver = next(
+                    (d for d in self.client.drivers
+                     if hasattr(d, "query_batch")), None)
+                device = (self.evaluator is not None
+                          and batch_driver is not None)
+                use_router = (
+                    device
+                    and getattr(self.evaluator, "renders", False) is False)
+                self._sweep_serial(constraints, None, use_router, device,
+                                   kept_f, totals_f,
+                                   self.config.violations_limit, [0], fr)
+                diff = self._verdicts_differ_canonical(
+                    run.kept, run.total_violations, kept_f, totals_f,
+                    self.config.violations_limit)
+            self.last_resync_diff = diff
+            dt = time.time() - t0
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as M
+
+                self.metrics.set_gauge(M.SNAPSHOT_RESYNC_SECONDS, dt)
+            if diff is not None:
+                sp.set_attribute("diverged", diff)
+                run.incomplete = True
+                snap.invalidate()
+                from gatekeeper_tpu.utils.logging import log_event
+
+                log_event("warning",
+                          "snapshot resync differential diverged; "
+                          "snapshot invalidated (next sweep rebuilds)",
+                          event_type="snapshot_resync_diverged",
+                          difference=diff)
+                if self.metrics is not None:
+                    from gatekeeper_tpu.metrics import registry as M
+
+                    self.metrics.inc_counter(
+                        M.RESILIENCE_DEGRADED,
+                        {"component": "snapshot", "to": "rebuild"})
+            self.perf["resync_ok"] = 0.0 if diff else 1.0
+            return run
+
+    @staticmethod
+    def _verdicts_differ_canonical(kept_a, totals_a, kept_b, totals_b,
+                                   limit):
+        """None when two runs' verdicts agree; kept lists compare as
+        CANONICAL (sorted) sets — chunk order legitimately differs
+        between the snapshot's row order and a relist's list order, and
+        when a constraint's violations exceed the kept limit the top-K
+        *selection* under different orders is not canonical (only the
+        kept COUNT is compared there; totals stay exact always)."""
+        if totals_a != totals_b:
+            keys = [k for k in totals_a
+                    if totals_a.get(k) != totals_b.get(k)]
+            return (f"totals differ for {keys[:3]}: "
+                    f"{[totals_a.get(k) for k in keys[:3]]} vs "
+                    f"{[totals_b.get(k) for k in keys[:3]]}")
+        if set(kept_a) != set(kept_b):
+            return "kept constraint sets differ"
+        for key in kept_a:
+            va = sorted((v.message, v.kind, v.name, v.namespace,
+                         v.enforcement_action) for v in kept_a[key])
+            vb = sorted((v.message, v.kind, v.name, v.namespace,
+                         v.enforcement_action) for v in kept_b[key])
+            if len(va) != len(vb):
+                return f"kept counts differ for {key}"
+            if len(va) < limit and va != vb:
+                return f"kept violations differ for {key}"
+        return None
 
     # --- overload brownout (resilience/overload.py) ----------------------
     def _brownout_yield(self) -> None:

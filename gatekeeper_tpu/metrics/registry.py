@@ -208,3 +208,13 @@ OVERLOAD_QUEUE_DEPTH = "overload_queue_depth"  # gauge
 OVERLOAD_BROWNOUT = "overload_brownout_level"  # gauge
 OVERLOAD_SHED = "overload_shed_count"  # {reason}
 DRAIN_SECONDS = "drain_seconds"  # gauge
+# resident columnar snapshot (gatekeeper_tpu/snapshot/): live rows,
+# rows dirtied by watch events and awaiting (re)evaluation, tombstoned
+# slot fraction (compaction folds them out past a threshold), applied
+# row patches {type=add|modify|delete}, and the wall seconds of the
+# last full-resync differential
+SNAPSHOT_ROWS = "snapshot_rows"  # gauge
+SNAPSHOT_DIRTY = "snapshot_dirty_rows"  # gauge
+SNAPSHOT_TOMBSTONE_FRACTION = "snapshot_tombstone_fraction"  # gauge
+SNAPSHOT_PATCHES = "snapshot_patch_count"  # {type}
+SNAPSHOT_RESYNC_SECONDS = "snapshot_resync_seconds"  # gauge

@@ -62,6 +62,55 @@ class Vocab:
         return len(self._to_str)
 
 
+class RowIdMap:
+    """Stable (uid -> global row id) assignment for the resident snapshot.
+
+    Ids are monotone, never reused, and survive both row-level patches
+    (a MODIFY keeps its id) and store compaction (positions move, ids do
+    not) — the identity substrate the snapshot's verdict store keys on,
+    and the prerequisite for phase-2 vocab interning keyed by row id.
+    Position bookkeeping (id -> array row) lives with the store; this map
+    owns only identity."""
+
+    def __init__(self):
+        self._next = 0
+        self._ids: dict = {}  # uid -> id
+
+    def assign(self, uid) -> tuple:
+        """(id, created): the existing id for a known uid, else a fresh
+        monotone id."""
+        i = self._ids.get(uid)
+        if i is not None:
+            return i, False
+        i = self._next
+        self._next = i + 1
+        self._ids[uid] = i
+        return i, True
+
+    def get(self, uid):
+        return self._ids.get(uid)
+
+    def forget(self, uid):
+        """Drop a uid (DELETE); its id is retired, never reissued — a
+        re-created object gets a NEW id (it is a new row)."""
+        return self._ids.pop(uid, None)
+
+    def __contains__(self, uid) -> bool:
+        return uid in self._ids
+
+    def uids(self) -> list:
+        """Known uids (insertion order)."""
+        return list(self._ids)
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    @property
+    def high_water(self) -> int:
+        """Total ids ever issued (monotone, ≥ len(self))."""
+        return self._next
+
+
 # --- column specs (requested by the lowering pass) ------------------------
 
 

@@ -803,24 +803,59 @@ class ShardedEvaluator:
         return self.sweep_dispatch(
             self.sweep_flatten(constraints, objects, return_bits))
 
-    def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
-                      return_bits: bool = False):
-        """Pipeline stage 1 (host, GIL-released C columnizer): schema
-        union + flatten + column pack/slim.  Returns a :class:`_FlatChunk`
-        for :meth:`sweep_dispatch`, or {} when no kind is lowered (the
-        caller's fallback lane handles everything)."""
+    def sweep_schema(self, constraints: Sequence) -> tuple:
+        """(by_kind, lowered_kinds, merged_schema) — the columnize plan
+        :meth:`sweep_flatten` runs; exposed so the resident-snapshot
+        store (gatekeeper_tpu/snapshot/) flattens patches with EXACTLY
+        the schema a fresh sweep of the same constraint group would use
+        (the bit-identity precondition of the resync differential).
+        ``lowered_kinds`` is empty when nothing is device-eligible."""
         by_kind: dict[str, list] = {}
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
         lowered = [k for k in by_kind
                    if k in self.driver._programs
                    and self.driver.inventory_exact(k)]
-        if not lowered:
-            return {}
-
         schema = Schema()
         for kind in lowered:
             schema.merge(self.driver._programs[kind].program.schema)
+        return by_kind, lowered, schema
+
+    def sweep_flatten_from_batch(self, constraints: Sequence, batch,
+                                 objects: Sequence[dict],
+                                 return_bits: bool = False,
+                                 alias: Optional[dict] = None):
+        """Pipeline stage 1 over a PRE-FLATTENED :class:`ColumnBatch` —
+        the resident-snapshot lane: the columns were flattened when the
+        watch patched them in, so a sweep over the snapshot pays only
+        pack/slim here (no list, no columnize).  ``alias`` is the
+        producing Flattener's prefix-axis alias map (slimming must keep
+        fields read through either name).  Returns the same
+        :class:`_FlatChunk` the columnizing lane produces."""
+        by_kind, lowered, _schema = self.sweep_schema(constraints)
+        if not lowered:
+            return {}
+        cols = slim_cols(pack_batch_cols(batch),
+                         self._needs_union(lowered, alias or {}))
+        n = len(objects)
+        if batch.has_generate_name is not None:
+            any_gen = bool(batch.has_generate_name[:n].any())
+        else:
+            any_gen = any(
+                "generateName" in (o.get("metadata") or {})
+                for o in objects)
+        return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
+                          objects, any_gen, n, batch.n, return_bits)
+
+    def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
+                      return_bits: bool = False):
+        """Pipeline stage 1 (host, GIL-released C columnizer): schema
+        union + flatten + column pack/slim.  Returns a :class:`_FlatChunk`
+        for :meth:`sweep_dispatch`, or {} when no kind is lowered (the
+        caller's fallback lane handles everything)."""
+        by_kind, lowered, schema = self.sweep_schema(constraints)
+        if not lowered:
+            return {}
         n = len(objects)
         pad_n = self._pad(n)
         from gatekeeper_tpu.observability import tracing

@@ -1,0 +1,12 @@
+"""Watch-driven incremental audit: the resident columnar cluster
+snapshot (see :mod:`gatekeeper_tpu.snapshot.store` for the design)."""
+
+from gatekeeper_tpu.snapshot.ingest import WatchIngester, gvks_of  # noqa: F401
+from gatekeeper_tpu.snapshot.store import (  # noqa: F401
+    ClusterSnapshot,
+    GroupStore,
+    SnapshotConfig,
+    VerdictStore,
+    obj_key,
+    row_signature,
+)

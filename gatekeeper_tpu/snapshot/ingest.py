@@ -1,0 +1,76 @@
+"""Watch ingestion: the snapshot's event feed off the ObjectSource seam.
+
+The reference's watch manager registers dynamic informers per GVK and
+funnels their events into the cachemanager (pkg/watch/manager.go); here
+the equivalent is :class:`WatchIngester` — one ``subscribe()`` per GVK on
+any ObjectSource (``FakeCluster``, ``KubeCluster``), callbacks ENQUEUE
+only (the source's watch threads never touch snapshot state), and the
+audit thread applies the queue as row patches via
+:meth:`ClusterSnapshot.pump`.
+
+Replay semantics make this self-healing: both sources replay current
+state as ADDED on subscribe, and ``KubeCluster`` re-replays after a 410
+Gone relist — the snapshot's no-op-patch detection (resourceVersion /
+deep equality) absorbs the churn without dirtying clean rows.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional, Sequence
+
+
+class WatchIngester:
+    """Fan-in of per-GVK watch subscriptions into a ClusterSnapshot."""
+
+    def __init__(self, snapshot, source, gvks: Sequence[tuple],
+                 on_error: Optional[Callable[[Exception], None]] = None):
+        self.snapshot = snapshot
+        self.source = source
+        self.gvks = list(gvks)
+        self.on_error = on_error
+        self._cancels: list = []
+        self._lock = threading.Lock()
+        self.events_seen = 0
+
+    def _on_event(self, ev) -> None:
+        self.events_seen += 1
+        self.snapshot.enqueue(ev.type, ev.obj)
+
+    def start(self) -> "WatchIngester":
+        with self._lock:
+            for gvk in self.gvks:
+                try:
+                    self._cancels.append(
+                        self.source.subscribe(gvk, self._on_event,
+                                              replay=True))
+                except Exception as e:  # noqa: PERF203
+                    if self.on_error is not None:
+                        self.on_error(e)
+                    else:
+                        raise
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            cancels, self._cancels = self._cancels, []
+        for cancel in cancels:
+            try:
+                cancel()
+            except Exception:
+                pass
+
+    def pump(self, max_events: Optional[int] = None) -> int:
+        """Apply queued events to the snapshot (audit-thread side)."""
+        return self.snapshot.pump(max_events=max_events)
+
+
+def gvks_of(objects: Iterable[dict]) -> list:
+    """Distinct GVKs of an object iterable (FakeCluster-style sources
+    without discovery), insertion-ordered."""
+    from gatekeeper_tpu.utils.unstructured import gvk_of
+
+    seen: dict = {}
+    for obj in objects:
+        seen.setdefault(gvk_of(obj), None)
+    return list(seen)

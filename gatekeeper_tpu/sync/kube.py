@@ -125,7 +125,9 @@ class KubeCluster:
                  watch_timeout_s: float = 300.0,
                  metrics=None,
                  retry_attempts: int = 3,
-                 raw_list: bool = True):
+                 raw_list: bool = True,
+                 watch_breaker_threshold: int = 5,
+                 watch_breaker_reset_s: float = 5.0):
         self.config = config
         self.page_limit = page_limit
         self.watch_backoff_s = watch_backoff_s
@@ -147,11 +149,19 @@ class KubeCluster:
         # seeded-jitter backoff bounded by the ambient deadline; writes
         # never auto-retry here — their conflict semantics live in
         # apply/apply_status (409 read-modify-write)
-        from gatekeeper_tpu.resilience.policy import RetryPolicy
+        from gatekeeper_tpu.resilience.policy import (CircuitBreaker,
+                                                      RetryPolicy)
 
         self._retry = RetryPolicy(attempts=max(1, retry_attempts),
                                   base_s=0.05, cap_s=1.0,
                                   dependency="apiserver", metrics=metrics)
+        # watch-seam breaker: repeated stream failures (a sick apiserver,
+        # a chaos plan on kube.watch) open it, and reconnect attempts
+        # back off for the open window instead of storming the server;
+        # 410 Gone is a real answer (relist recovery), not a failure
+        self._watch_breaker = CircuitBreaker(
+            "kube.watch", failure_threshold=max(1, watch_breaker_threshold),
+            reset_timeout_s=watch_breaker_reset_s, metrics=metrics)
 
     # --- transport ---------------------------------------------------
     @staticmethod
@@ -528,6 +538,33 @@ class KubeCluster:
                     self._watchers.remove(entry)
 
     def _watch_loop(self, gvk, callback, replay, stop, stream_ref):
+        for ev in self.watch_iter(gvk, replay=replay, stop=stop,
+                                  stream_ref=stream_ref):
+            callback(ev)
+
+    def watch_iter(self, gvk, replay: bool = True,
+                   stop: Optional[threading.Event] = None,
+                   stream_ref: Optional[list] = None) -> Iterable[Event]:
+        """THE watch seam: a generator of :class:`Event` for one GVK.
+
+        List + replay (ADDED), then a streaming WATCH whose resume
+        ``resourceVersion`` advances with every event AND every server
+        BOOKMARK (``allowWatchBookmarks``), so reconnects after a clean
+        stream end resume from the newest known rv instead of replaying
+        history.  A 410 Gone — at connect, mid-stream (ERROR event), or
+        injected — means the server compacted past our rv: the outer
+        loop relists, yields a synthetic DELETED diff for objects that
+        vanished during the outage plus ADDED/MODIFIED churn, and
+        resumes watching from the fresh list's rv.
+
+        ``fault_point("kube.watch")`` fires once per stream cycle (an
+        injected error with status 410 forces the relist-recovery path);
+        repeated stream failures trip the watch circuit breaker, whose
+        open window paces reconnect attempts."""
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        stop = stop if stop is not None else threading.Event()
+        stream_ref = stream_ref if stream_ref is not None else [None]
         known: dict = {}  # (ns, name) -> True
         first = True
         while not stop.is_set() and not self._stopped.is_set():
@@ -543,42 +580,67 @@ class KubeCluster:
                 seen.add(key)
                 if replay or not first:
                     if first or key not in known:
-                        callback(Event(ADDED, obj))
+                        yield Event(ADDED, obj)
                     else:
-                        callback(Event(MODIFIED, obj))
+                        yield Event(MODIFIED, obj)
             # objects that vanished while the watch was down (410 window)
             if not first:
                 for key in set(known) - seen:
                     ns, name = key
-                    callback(Event(DELETED, {
+                    yield Event(DELETED, {
                         "apiVersion": f"{gvk[0]}/{gvk[1]}" if gvk[0]
                         else gvk[1],
                         "kind": gvk[2],
                         "metadata": {"name": name,
                                      **({"namespace": ns} if ns else {})},
-                    }))
+                    })
             known = {k: True for k in seen}
             first = False
             # watch from the list's rv; on clean stream end reconnect from
             # the LAST seen rv (standard informer resume) — a full relist
             # (+ replay MODIFIED churn) happens only on 410 Gone
             while not stop.is_set() and not self._stopped.is_set():
+                if not self._watch_breaker.allow():
+                    wait = max(self.watch_backoff_s,
+                               self._watch_breaker.retry_after_s())
+                    if stop.wait(wait):
+                        return
+                    continue
+                state = {"rv": rv, "gone": False}
                 try:
-                    gone, rv = self._stream_watch(gvk, rv, callback, known,
-                                                  stop, stream_ref)
+                    fault_point(
+                        "kube.watch",
+                        error_factory=lambda spec: KubeError(spec.status,
+                                                             spec.error),
+                        gvk=gvk[2], rv=rv)
+                    yield from self._stream_watch_iter(gvk, rv, known,
+                                                       stop, stream_ref,
+                                                       state)
+                    self._watch_breaker.record_success()
+                except KubeError as e:
+                    if e.status == 410:
+                        # a REAL apiserver answer (compacted history):
+                        # recovery is a relist, not a breaker trip
+                        state["gone"] = True
+                        self._watch_breaker.record_success()
+                    else:
+                        self._watch_breaker.record_failure()
                 except Exception:
-                    gone = False
+                    self._watch_breaker.record_failure()
+                rv = state["rv"]
                 if stop.is_set() or self._stopped.is_set():
                     return
-                if gone:
+                if state["gone"]:
                     break  # outer loop relists and diffs
                 if stop.wait(self.watch_backoff_s):
                     return
 
-    def _stream_watch(self, gvk, rv, callback, known, stop,
-                      stream_ref) -> tuple:
-        """One watch stream; returns (gone, last_rv) — gone=True on 410
-        (the caller relists)."""
+    def _stream_watch_iter(self, gvk, rv, known, stop, stream_ref,
+                           state) -> Iterable[Event]:
+        """One watch stream as a generator; ``state['rv']`` tracks the
+        newest seen resourceVersion (events + bookmarks) and
+        ``state['gone']`` flips on 410 (connect status or mid-stream
+        ERROR event) — the caller relists."""
         path = self._collection_path(gvk)
         q = urllib.parse.urlencode({
             "watch": "1", "resourceVersion": rv,
@@ -594,31 +656,36 @@ class KubeCluster:
             resp = urllib.request.urlopen(
                 req, timeout=self.watch_timeout_s + 30, context=self._ctx)
         except urllib.error.HTTPError as e:
-            return e.code == 410, rv
+            if e.code == 410:
+                state["gone"] = True
+                return
+            raise KubeError(e.code, str(e.reason)) from None
         group, version, kind = gvk
         stream_ref[0] = resp
         try:
             with resp:
                 for raw in resp:
                     if stop.is_set() or self._stopped.is_set():
-                        return False, rv
+                        return
                     line = raw.strip()
                     if not line:
                         continue
                     try:
                         ev = json.loads(line)
                     except json.JSONDecodeError:
-                        return False, rv
+                        return
                     etype = ev.get("type", "")
                     obj = ev.get("object") or {}
                     new_rv = (obj.get("metadata", {})
                               .get("resourceVersion", ""))
                     if new_rv:
-                        rv = new_rv
+                        state["rv"] = new_rv
                     if etype == "BOOKMARK":
                         continue
                     if etype == "ERROR":
-                        return (obj.get("code") == 410), rv
+                        if obj.get("code") == 410:
+                            state["gone"] = True
+                        return
                     obj.setdefault("apiVersion",
                                    f"{group}/{version}" if group
                                    else version)
@@ -626,13 +693,12 @@ class KubeCluster:
                     key = (namespace_of(obj), name_of(obj))
                     if etype == "ADDED":
                         known[key] = True
-                        callback(Event(ADDED, obj))
+                        yield Event(ADDED, obj)
                     elif etype == "MODIFIED":
                         known[key] = True
-                        callback(Event(MODIFIED, obj))
+                        yield Event(MODIFIED, obj)
                     elif etype == "DELETED":
                         known.pop(key, None)
-                        callback(Event(DELETED, obj))
+                        yield Event(DELETED, obj)
         finally:
             stream_ref[0] = None
-        return False, rv

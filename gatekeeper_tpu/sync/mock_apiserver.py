@@ -73,6 +73,19 @@ class MockApiServer:
         self._watchers: list = []  # (kind, queue-ish list, condition)
         self._lock = threading.RLock()
         self.force_gone = False  # next watch request answers 410
+        # watch-cache compaction floor (see compact()): a watch resuming
+        # from a resourceVersion older than this answers 410 Gone, like
+        # an apiserver whose etcd history was compacted
+        self._compacted_rv = 0
+        # the watch cache: a bounded (rv, kind, event) log — a watch
+        # resuming from rv replays the events it missed while
+        # disconnected (the real apiserver's watch-cache semantics);
+        # entries older than the cap fall off and raise the 410 floor
+        self._event_log: list = []  # (rv, kind, {"type","object"})
+        self.event_log_cap = 4096
+        # emit a BOOKMARK (with the current resourceVersion) after each
+        # event batch and every this-many seconds of idle stream time
+        self.bookmark_interval_s = 1.0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -159,10 +172,20 @@ class MockApiServer:
                 self._notify("DELETED", obj)
 
     def _notify(self, etype: str, obj: dict):
+        ev = {"type": etype, "object": obj}
+        with self._lock:
+            self._event_log.append((self._rv, obj.get("kind", ""), ev))
+            while len(self._event_log) > self.event_log_cap:
+                # the oldest entry falls out of the watch cache: clients
+                # resuming from before it now get 410 (the real
+                # apiserver's cache-window behavior)
+                dropped_rv, _k, _e = self._event_log.pop(0)
+                self._compacted_rv = max(self._compacted_rv,
+                                         dropped_rv + 1)
         for kind, buf, cond in list(self._watchers):
             if kind == obj.get("kind"):
                 with cond:
-                    buf.append({"type": etype, "object": obj})
+                    buf.append(ev)
                     cond.notify_all()
 
     # --- request handling ----------------------------------------------
@@ -226,7 +249,7 @@ class MockApiServer:
                 return self._json(h, {"message": "not found"}, 404)
             return self._json(h, obj)
         if q.get("watch", ["0"])[0] in ("1", "true"):
-            return self._handle_watch(h, kind)
+            return self._handle_watch(h, kind, q)
         # paged list
         with self._lock:
             items = [o for (k, _ns, _n), o in sorted(
@@ -246,8 +269,18 @@ class MockApiServer:
             "items": page,
         })
 
-    def _handle_watch(self, h: BaseHTTPRequestHandler, kind: str):
-        if self.force_gone:
+    def _handle_watch(self, h: BaseHTTPRequestHandler, kind: str,
+                      q: Optional[dict] = None):
+        rv_req = (q or {}).get("resourceVersion", [""])[0]
+        with self._lock:
+            compacted = self._compacted_rv
+        too_old = False
+        if rv_req and compacted:
+            try:
+                too_old = int(rv_req) < compacted
+            except ValueError:
+                pass
+        if self.force_gone or too_old:
             self.force_gone = False
             return self._json(h, {"kind": "Status", "code": 410,
                                   "message": "too old resource version"},
@@ -256,6 +289,18 @@ class MockApiServer:
         cond = threading.Condition()
         entry = (kind, buf, cond)
         with self._lock:
+            # watch-cache replay: events the client missed while
+            # disconnected (rv > its resume rv) stream first; the
+            # registration happens under the same lock so live events
+            # land in ``buf`` exactly once, after the replayed window
+            replay: list = []
+            try:
+                rv_from = int(rv_req) if rv_req else None
+            except ValueError:
+                rv_from = None
+            if rv_from is not None:
+                replay = [ev for rv, k, ev in self._event_log
+                          if k == kind and rv > rv_from]
             self._watchers.append(entry)
         try:
             h.send_response(200)
@@ -269,8 +314,23 @@ class MockApiServer:
                               + b"\r\n")
                 h.wfile.flush()
 
+            def send_bookmark():
+                # allowWatchBookmarks: a synthetic event whose only
+                # payload is the current resourceVersion — clients
+                # advance their resume position without object churn
+                with self._lock:
+                    rv = str(self._rv)
+                send_line({"type": "BOOKMARK",
+                           "object": {"kind": kind,
+                                      "metadata": {"resourceVersion":
+                                                   rv}}})
+
+            for ev in replay:
+                send_line(ev)
+            send_bookmark()  # initial sync marker (post-replay rv)
             deadline = 30.0
             waited = 0.0
+            idle = 0.0
             while waited < deadline:
                 with cond:
                     if not buf:
@@ -284,8 +344,15 @@ class MockApiServer:
                         h.wfile.write(b"0\r\n\r\n")
                         return
                     send_line(ev)
-                if not events:
+                if events:
+                    idle = 0.0
+                    send_bookmark()
+                else:
                     waited += 0.2
+                    idle += 0.2
+                    if idle >= self.bookmark_interval_s:
+                        idle = 0.0
+                        send_bookmark()
             h.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -293,6 +360,17 @@ class MockApiServer:
             with self._lock:
                 if entry in self._watchers:
                     self._watchers.remove(entry)
+
+    def compact(self):
+        """Forced watch-cache compaction hook: watch requests resuming
+        from a resourceVersion older than NOW answer 410 Gone (the
+        apiserver's etcd-compaction behavior) — the client's
+        relist-recovery path is testable without a real apiserver.
+        Live streams are unaffected; pair with :meth:`break_watches` to
+        force a reconnect into the compacted window."""
+        with self._lock:
+            self._compacted_rv = self._rv
+            self._event_log.clear()
 
     def break_watches(self, kind: str):
         """Inject a mid-stream 410 into live watches of ``kind``."""

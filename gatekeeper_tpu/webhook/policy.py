@@ -92,11 +92,18 @@ class ValidationHandler:
         deadline_budget_s: float = 0.0,  # hard per-request wall budget
         failure_policy: Optional[str] = None,  # "ignore" | "fail"
         overload=None,  # resilience.overload.OverloadController
+        snapshot=None,  # snapshot.ClusterSnapshot (warm lookup cache)
     ):
         self.client = client
         self.expansion_system = expansion_system
         self.process_excluder = process_excluder
         self.namespace_lookup = namespace_lookup or (lambda name: None)
+        # warm referential cache: with the resident cluster snapshot
+        # active, namespace lookups serve from its watch-synced rows —
+        # no per-request apiserver GET on the admission hot path (the
+        # reference's cached client with API-reader fallback,
+        # policy.go:694-702, minus the fallback GET for cache hits)
+        self.snapshot = snapshot
         self.batcher = batcher
         self.log_denies = log_denies
         self.event_sink = event_sink
@@ -404,7 +411,14 @@ class ValidationHandler:
                     m.RESILIENCE_STALE_SERVED,
                     {"dependency": "webhook/namespace_lookup"})
             return self._ns_stale[name]
-        ns_obj = self.namespace_lookup(name)
+        ns_obj = None
+        if self.snapshot is not None:
+            # warm path: the watch-synced resident snapshot answers
+            # without leaving the process (returns None when stale or
+            # the namespace is unknown — fall through to the source)
+            ns_obj = self.snapshot.namespace(name)
+        if ns_obj is None:
+            ns_obj = self.namespace_lookup(name)
         if self.overload is not None:
             if len(self._ns_stale) >= 4096 and name not in self._ns_stale:
                 self._ns_stale.pop(next(iter(self._ns_stale)))

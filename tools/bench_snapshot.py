@@ -1,0 +1,212 @@
+"""Churn-replay snapshot bench: O(churn) vs O(cluster) sweep cost.
+
+Builds the library client + a synthetic cluster in a FakeCluster, then
+measures the three audit costs the ROADMAP's incremental-audit item
+cares about:
+
+- ``relist_sweep_s``   — a relist-mode full sweep (list + flatten +
+  device eval every pass, the pre-snapshot shape);
+- ``snapshot_full_s``  — a snapshot-mode full pass (resident columns
+  slice straight into device chunks: zero list/flatten);
+- ``tick_s``           — a steady-state incremental tick after a seeded
+  churn burst dirties ``churn_fraction`` of the rows (the O(churn)
+  number);
+- ``resync_s``         — the full-resync differential (fresh relist +
+  re-flatten + per-row signature compare + verdict differential), the
+  periodic consistency proof's price tag.
+
+Appends the previous latest record to the ``history`` list in
+``SNAPSHOT_BENCH.json`` (the FLATTEN_BENCH convention).  Run:
+
+    python tools/bench_snapshot.py [n_objects] [churn_fraction]
+
+A ``--smoke`` invocation (tiny corpus, one tick) runs in tier-1 via
+tests/test_snapshot.py so the bench script itself cannot rot.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_bench(n_objects: int = 20_000, churn_fraction: float = 0.01,
+              ticks: int = 3, chunk_size: int = 2048,
+              out_path: str = None, seed: int = 11,
+              write: bool = True) -> dict:
+    from gatekeeper_tpu.apis.constraints import AUDIT_EP
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+    from gatekeeper_tpu.snapshot import (ClusterSnapshot, SnapshotConfig,
+                                         WatchIngester, gvks_of)
+    from gatekeeper_tpu.sync.source import FakeCluster
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.utils.synthetic import (iter_cluster_objects,
+                                                load_library,
+                                                make_cluster_objects)
+
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[AUDIT_EP])
+    nt, nc = load_library(client)
+    objects = make_cluster_objects(n_objects, seed=seed)
+    for o in objects:
+        if o.get("kind") == "Ingress":
+            client.add_data(o)
+    cluster = FakeCluster()
+    for o in objects:
+        cluster.apply(o)
+
+    def lister():
+        return iter(cluster.list())
+
+    evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20)
+
+    # --- relist baseline (serial schedule; one warm + one timed) -------
+    relist_mgr = AuditManager(
+        client, lister=lister,
+        config=AuditConfig(chunk_size=chunk_size, exact_totals=False,
+                           pipeline="off"),
+        evaluator=evaluator)
+    relist_mgr.audit()  # compile warmup
+    t0 = time.perf_counter()
+    relist_run = relist_mgr.audit()
+    relist_s = time.perf_counter() - t0
+
+    # --- snapshot mode --------------------------------------------------
+    snapshot = ClusterSnapshot(evaluator, SnapshotConfig())
+    snap_mgr = AuditManager(
+        client, lister=lister,
+        config=AuditConfig(chunk_size=chunk_size, exact_totals=False,
+                           pipeline="off", audit_source="snapshot"),
+        evaluator=evaluator, snapshot=snapshot)
+    ingester = WatchIngester(snapshot, cluster,
+                             gvks_of(cluster.list())).start()
+    snap_mgr.audit()  # build + first full pass (also compile warmup)
+    t0 = time.perf_counter()
+    snap_run = snap_mgr.audit()
+    snap_full_s = time.perf_counter() - t0
+    assert snap_run.total_violations == relist_run.total_violations, \
+        "snapshot/relist verdict mismatch (bench aborted)"
+
+    # --- steady-state churn ticks ---------------------------------------
+    churn_n = max(1, int(n_objects * churn_fraction))
+    rng_names = [o["metadata"]["name"] for o in objects]
+    tick_times: list = []
+    tick_rows: list = []
+    fresh = iter(iter_cluster_objects(ticks * churn_n, seed=seed + 99))
+    for t in range(ticks):
+        # a churn burst: ~1/3 modifies, ~1/3 adds, ~1/3 deletes-and-readds
+        for j in range(churn_n):
+            which = j % 3
+            if which == 0:
+                o = copy.deepcopy(objects[(t * churn_n + j)
+                                          % len(objects)])
+                meta = o.setdefault("metadata", {})
+                labels = meta.setdefault("labels", {})
+                labels["churn"] = f"t{t}-{j}"
+                cluster.apply(o)
+            elif which == 1:
+                o = next(fresh)
+                o["metadata"]["name"] = \
+                    f"{o['metadata']['name']}-churn-{t}-{j}"
+                cluster.apply(o)
+            else:
+                name = rng_names[(t * churn_n + j) % len(rng_names)]
+                victim = next((ob for ob in cluster.list()
+                               if ob["metadata"].get("name") == name),
+                              None)
+                if victim is not None:
+                    cluster.delete(victim)
+        ingester.pump()
+        dirty = snapshot.dirty_count()
+        t0 = time.perf_counter()
+        snap_mgr.audit_tick()
+        tick_times.append(time.perf_counter() - t0)
+        tick_rows.append(dirty)
+
+    # --- resync differential --------------------------------------------
+    t0 = time.perf_counter()
+    snap_mgr.audit_resync()
+    resync_s = time.perf_counter() - t0
+    ingester.stop()
+
+    tick_med = statistics.median(tick_times)
+    record = {
+        "n_objects": n_objects,
+        "churn_fraction": churn_fraction,
+        "churn_per_tick": churn_n,
+        "ticks": ticks,
+        "chunk_size": chunk_size,
+        "templates": nt,
+        "constraints": nc,
+        "host_cpus": os.cpu_count() or 1,
+        "date": time.strftime("%Y-%m-%d"),
+        "relist_sweep_s": round(relist_s, 4),
+        "snapshot_full_s": round(snap_full_s, 4),
+        "tick_s_median": round(tick_med, 4),
+        "tick_s_all": [round(x, 4) for x in tick_times],
+        "tick_dirty_rows": tick_rows,
+        "resync_s": round(resync_s, 4),
+        "resync_ok": snap_mgr.last_resync_diff is None,
+        "snapshot_rows": snapshot.stats()["rows"],
+        "tick_vs_relist_speedup": round(relist_s / max(tick_med, 1e-9),
+                                        1),
+        "full_vs_relist_speedup": round(relist_s / max(snap_full_s,
+                                                       1e-9), 2),
+    }
+    if write:
+        path = out_path or os.path.join(os.path.dirname(__file__), "..",
+                                        "SNAPSHOT_BENCH.json")
+        history = []
+        try:
+            with open(path) as fh:
+                prev = json.load(fh)
+            history = prev.pop("history", [])
+            history.append(prev)  # the previous latest becomes history
+        except (OSError, ValueError):
+            pass
+        record_out = dict(record)
+        record_out["history"] = history
+        with open(path, "w") as fh:
+            json.dump(record_out, fh, indent=1)
+        print(json.dumps({
+            "metric": "incremental tick vs relist sweep",
+            "value": record["tick_vs_relist_speedup"],
+            "unit": "x faster",
+            "tick_s": record["tick_s_median"],
+            "relist_sweep_s": record["relist_sweep_s"],
+        }))
+    return record
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+    argv = [a for a in argv if a != "--smoke"]
+    if smoke:
+        rec = run_bench(n_objects=120, churn_fraction=0.05, ticks=1,
+                        chunk_size=64, write=False)
+        assert rec["resync_ok"], "smoke resync diverged"
+        print(json.dumps({"smoke": True,
+                          "tick_s": rec["tick_s_median"],
+                          "rows": rec["snapshot_rows"]}))
+        return 0
+    n = int(argv[0]) if argv else 20_000
+    churn = float(argv[1]) if len(argv) > 1 else 0.01
+    run_bench(n_objects=n, churn_fraction=churn)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
