@@ -61,6 +61,24 @@ def main(argv=None) -> int:
                         "incremental tick (0 = never); divergence "
                         "marks the run incomplete and rebuilds the "
                         "snapshot")
+    p.add_argument("--audit-expand", action="store_true",
+                   help="expansion generator stage in the audit sweep: "
+                        "generator objects (per ExpansionTemplate "
+                        "applyTo) expand through the batched mutlane "
+                        "stage and their resultants — implied Pods with "
+                        "Source=Generated mutation applied — are audited "
+                        "at sweep scale with the template's "
+                        "enforcementAction override (README 'Batched "
+                        "mutation & expansion')")
+    p.add_argument("--mutate-lane", default="batched",
+                   choices=["batched", "host", "differential"],
+                   help="/v1/mutate serving lane: 'batched' coalesces "
+                        "mutate reviews into one columnar lane pass "
+                        "(host fixed-point fallback for unsupported "
+                        "mutators); 'host' is the per-object reference "
+                        "path; 'differential' runs the batched lane AND "
+                        "asserts it bit-identical to the reference per "
+                        "batch (debugging)")
     p.add_argument("--pipeline", default="auto",
                    choices=["auto", "on", "off", "differential"],
                    help="audit sweep schedule: 'auto' runs the staged "
@@ -528,6 +546,7 @@ def main(argv=None) -> int:
                 pipeline_flatten_workers=args.pipeline_flatten_workers,
                 audit_source=audit_source,
                 resync_every=args.snapshot_resync_every,
+                expand_generated=args.audit_expand,
             ),
             evaluator=evaluator,
             export_system=export,  # Connection CRs register here too
@@ -535,6 +554,7 @@ def main(argv=None) -> int:
             log_violations=args.log_denies,
             metrics=metrics,
             snapshot=snapshot,
+            expansion_system=mgr.expansion_system,
         )
 
     def export_trace():
@@ -593,6 +613,39 @@ def main(argv=None) -> int:
     batcher = Batcher(client, stats=args.log_stats_admission,
                       small_batch=args.webhook_small_batch,
                       metrics=metrics).start()
+    mutation_batcher = None
+    mutation_handler = None
+    if mgr.is_assigned("mutation-webhook"):
+        if args.mutate_lane == "host":
+            mutation_handler = MutationHandler(
+                mgr.mutation_system,
+                namespace_lookup=namespace_lookup,
+                process_excluder=mgr.excluder,
+            )
+        else:
+            # the batched lane: mutate reviews coalesce into one
+            # columnar pass, sharing the validation path's overload gate
+            # and zero-loss drain (README 'Batched mutation & expansion')
+            from gatekeeper_tpu.mutlane import (BatchedMutationHandler,
+                                                MutationBatcher,
+                                                MutationLane)
+
+            mut_lane = MutationLane(
+                mgr.mutation_system, metrics=metrics,
+                differential=args.mutate_lane == "differential")
+            mutation_batcher = MutationBatcher(
+                mut_lane, metrics=metrics).start()
+            mutation_handler = BatchedMutationHandler(
+                mgr.mutation_system,
+                lane=mut_lane,
+                namespace_lookup=namespace_lookup,
+                process_excluder=mgr.excluder,
+                batcher=mutation_batcher,
+                metrics=metrics,
+                overload=overload_ctl,
+                failure_policy=("ignore" if args.fail_open_on_error
+                                else args.webhook_failure_policy),
+            )
     admission_sink = None
     if args.emit_admission_events:
         from gatekeeper_tpu.sync import events as _events
@@ -671,11 +724,7 @@ def main(argv=None) -> int:
                 overload=overload_ctl,
                 snapshot=snapshot,  # warm namespace/referential cache
             ) if mgr.is_assigned("webhook") else None,
-            mutation_handler=MutationHandler(
-                mgr.mutation_system,
-                namespace_lookup=namespace_lookup,
-                process_excluder=mgr.excluder,
-            ) if mgr.is_assigned("mutation-webhook") else None,
+            mutation_handler=mutation_handler,
             namespace_label_handler=NamespaceLabelHandler(
                 exempt_namespaces=args.exempt_namespace,
                 exempt_prefixes=args.exempt_namespace_prefix,
@@ -693,6 +742,7 @@ def main(argv=None) -> int:
             reuse_port=args.reuse_port,
             backlog=args.webhook_backlog,
             batcher=batcher,
+            mutation_batcher=mutation_batcher,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
         if args.certs_dir and args.cert_rotation_check_s > 0:
@@ -762,6 +812,8 @@ def main(argv=None) -> int:
                       f"{args.drain_timeout:.0f}s; in-flight work "
                       f"abandoned", file=sys.stderr)
         batcher.stop()  # idempotent (server.stop drained it already)
+        if mutation_batcher is not None:
+            mutation_batcher.stop()
         if snap_ingester is not None:
             snap_ingester.stop()
         export_trace()  # tracer flush happens after the last span closed

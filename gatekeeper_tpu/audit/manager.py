@@ -96,6 +96,15 @@ class AuditConfig:
     # differential (fresh relist + re-flatten asserted bit-identical to
     # the resident snapshot) instead of an incremental tick; 0 = never
     resync_every: int = 10
+    # expansion generator stage (--audit-expand): generator objects
+    # (Deployment etc.) listed by the sweep expand through the batched
+    # mutlane.ExpansionStage and their resultants (implied Pods, with
+    # Source=Generated mutation applied) are audited at sweep scale with
+    # the template's enforcementAction override — policies on the
+    # generated GVK see violations BEFORE any Pod exists (shift-left).
+    # Generated objects bypass match_kind_only (their kinds come from
+    # the templates, not the lister).
+    expand_generated: bool = False
 
 
 @dataclass
@@ -163,6 +172,7 @@ class AuditManager:
         log_violations: bool = False,
         metrics=None,  # metrics.registry.MetricsRegistry (optional)
         snapshot=None,  # snapshot.ClusterSnapshot (audit_source=snapshot)
+        expansion_system=None,  # expansion.ExpansionSystem (expand stage)
     ):
         self.client = client
         self.lister = lister
@@ -174,6 +184,16 @@ class AuditManager:
         self.log_violations = log_violations
         self.metrics = metrics
         self.snapshot = snapshot
+        self.expansion_system = expansion_system
+        # expansion generator stage state: the batched stage (lazy), the
+        # per-sweep generator-object tee, the Namespace inventory the
+        # expand needs, and — snapshot mode — per-parent-gid generated
+        # verdicts so the stage stays O(churn) like the base rows
+        self._expansion_stage = None
+        self._gen_buf: Optional[list] = None
+        self._gen_ns: dict = {}
+        self._gen_kinds: set = set()
+        self._gen_verdicts: dict = {}
         # human-readable first difference of the last resync differential
         # (None = bit-identical), for tests/ops introspection
         self.last_resync_diff: Optional[str] = None
@@ -258,6 +278,9 @@ class AuditManager:
         if self.config.match_kind_only:
             kind_filter = self._kinds_of(constraints)
 
+        gen_stage = self._gen_stage()
+        self._gen_reset(gen_stage is not None)
+
         limit = self.config.violations_limit
         kept: dict = {(c.kind, c.name): [] for c in constraints}
         totals: dict = {(c.kind, c.name): 0 for c in constraints}
@@ -340,6 +363,12 @@ class AuditManager:
                                device, kept, totals, limit, counter, run)
         run.total_objects = counter[0]
 
+        if gen_stage is not None and self._gen_buf:
+            # the generator stage: expanded resultants audit AFTER the
+            # base pass so base kept-ordering stays schedule-identical
+            self._sweep_generated(gen_stage, self._gen_buf, constraints,
+                                  kept, totals, limit, run)
+
         run.total_violations = totals
         run.kept = kept
         run.duration_s = time.time() - t0
@@ -379,6 +408,10 @@ class AuditManager:
 
             n = snap.rebuild(self.lister)
             rebuilt = True
+            # row ids may outlive a rebuild but the verdict store was
+            # reset — generated verdicts reset with it (the full pass
+            # recomputes them for every row)
+            self._gen_verdicts.clear()
             log_event("info", "snapshot rebuilt",
                       event_type="snapshot_rebuilt", rows=n,
                       generation=snap.generation)
@@ -405,6 +438,9 @@ class AuditManager:
             self.perf.get("snapshot_rows_evaluated", 0.0)
             + sum(len(v) for v in rows.values()))
         self._snapshot_eval(rows, run)
+        # generator stage rides the same dirty set: only (re)evaluated
+        # parents re-expand, clean parents keep their generated verdicts
+        self._snapshot_generated(rows, constraints, run)
         run.total_objects = snap.live_count()
         totals, kept = self._snapshot_collect(constraints)
         run.total_violations = totals
@@ -519,7 +555,7 @@ class AuditManager:
             while window:
                 fold_oldest()
 
-    def _render_fn(self):
+    def _render_fn(self, source=SOURCE_ORIGINAL):
         """(render, review_cache): the exact-engine render for one
         (constraint, object) hit — the same path the relist fold uses,
         so messages/details are bit-identical across audit sources."""
@@ -537,7 +573,7 @@ class AuditManager:
                 else None
             if review is None:
                 review = target.handle_review(AugmentedUnstructured(
-                    object=obj, source=SOURCE_ORIGINAL))
+                    object=obj, source=source))
                 if cache_key is not None:
                     cache[cache_key] = review
             if hasattr(driver, "render_query"):
@@ -586,7 +622,8 @@ class AuditManager:
                     snap.verdicts.set(ckey, gids[oi], len(results),
                                       tuple(results))
 
-    def _eval_rows_via_drivers(self, constraints, objects) -> dict:
+    def _eval_rows_via_drivers(self, constraints, objects,
+                               source=SOURCE_ORIGINAL) -> dict:
         """Exact-lane evaluation with per-row capture:
         {oi: {con_key: [(msg, details), ...]}} — the snapshot's analog of
         :meth:`_eval_via_drivers` (same drivers, same matcher prefilter,
@@ -597,7 +634,7 @@ class AuditManager:
         target = self.client.target
         reviews = [
             target.handle_review(
-                AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL))
+                AugmentedUnstructured(object=o, source=source))
             for o in objects
         ]
         wanted = {c.key() for c in constraints}
@@ -659,7 +696,142 @@ class AuditManager:
                     if len(kept[ckey]) < limit:
                         kept[ckey].append(
                             self._violation(con, obj, msg, details))
+        # generated resultants (expansion generator stage): per-parent
+        # entries recomputed whenever the parent row was (re)evaluated,
+        # clean parents keep their last generated verdicts — the same
+        # O(churn) contract the base rows have
+        dead = []
+        for gid, per_con in self._gen_verdicts.items():
+            if snap.obj_of(gid) is None:
+                dead.append(gid)  # parent deleted since the tick
+                continue
+            for ckey, (count, violations) in per_con.items():
+                if ckey not in totals:
+                    continue
+                totals[ckey] += count
+                for v in violations:
+                    if len(kept[ckey]) < limit:
+                        kept[ckey].append(v)
+        for gid in dead:
+            self._gen_verdicts.pop(gid, None)
         return totals, kept
+
+    def _eval_objects_capture(self, constraints, objects, source) -> tuple:
+        """({oi: {con_key: [(msg, details)]}}, lowered_kinds) — evaluate
+        arbitrary objects with per-object capture: device grid + exact
+        render for lowered kinds, driver exact lane for the rest.  The
+        expansion stage's evaluator for generated resultants."""
+        import numpy as np
+
+        out: dict = {}
+        swept: dict = {}
+        ev = self.evaluator
+        device = (ev is not None
+                  and getattr(ev, "renders", False) is False
+                  and hasattr(ev, "sweep_flatten"))
+        if device and objects:
+            flat = ev.sweep_flatten(constraints, objects,
+                                    return_bits=True, source=source)
+            if flat:
+                swept = ev.sweep_collect(ev.sweep_dispatch(flat))
+        render = self._render_fn(source=source)
+        k = len(objects)
+        if isinstance(swept, dict):
+            for _kind, (kcons, idx, valid, counts, bits) in swept.items():
+                for ci, con in enumerate(kcons):
+                    hit = np.nonzero(
+                        np.unpackbits(bits[ci], count=k))[0]
+                    for oi in hit.tolist():
+                        results = render(con, objects[oi], cache_key=oi)
+                        out.setdefault(oi, {}).setdefault(
+                            con.key(), []).extend(
+                            (r.msg, (r.metadata or {}).get("details"))
+                            for r in results)
+        rest = [c for c in constraints if c.kind not in swept]
+        if rest:
+            for oi, per_con in self._eval_rows_via_drivers(
+                    rest, objects, source=source).items():
+                for ckey, results in per_con.items():
+                    out.setdefault(oi, {}).setdefault(
+                        ckey, []).extend(results)
+        return out, set(swept.keys()) if isinstance(swept, dict) else set()
+
+    def _snapshot_generated(self, rows_by_store, constraints, run) -> None:
+        """Recompute the generated-resultant verdicts of every parent row
+        that was just (re)evaluated: expand through the batched stage,
+        evaluate resultants with Source=Generated, store per parent gid.
+        A parent that stopped being a generator (or was deleted) simply
+        loses its entry."""
+        stage = self._gen_stage()
+        if stage is None:
+            if self._gen_verdicts:
+                self._gen_verdicts.clear()
+            return
+        from gatekeeper_tpu.match.match import SOURCE_GENERATED
+        from gatekeeper_tpu.utils.logging import log_event
+        from gatekeeper_tpu.utils.unstructured import gvk_of
+
+        snap = self.snapshot
+        templates = self.expansion_system.templates()
+        gens: list = []
+        for store, rowlist in rows_by_store.items():
+            for gid, pos in rowlist:
+                obj = store.row_obj(pos)
+                self._gen_verdicts.pop(gid, None)
+                if obj is None:
+                    continue
+                for t in templates:
+                    if t.applies_to(obj):
+                        gens.append((gid, obj))
+                        break
+        if not gens:
+            return
+        cons_by_key = {c.key(): c for c in constraints}
+        exact = self.config.exact_totals
+        chunk_size = max(1, self.config.chunk_size)
+        for i in range(0, len(gens), chunk_size):
+            part = gens[i:i + chunk_size]
+            namespaces = []
+            for _gid, obj in part:
+                ns = (obj.get("metadata") or {}).get("namespace", "") or ""
+                namespaces.append(snap.namespace(ns) if ns else None)
+            results = stage.expand_batch([o for _g, o in part],
+                                         namespaces)
+            resultants: list = []  # (parent gid, obj, template, action)
+            for (gid, obj), res in zip(part, results):
+                if res.error is not None:
+                    log_event("warning",
+                              "audit expansion failed for a generator "
+                              "object", event_type="audit_expand_failed",
+                              name=(obj.get("metadata") or {})
+                              .get("name", ""), error=str(res.error))
+                    continue
+                resultants.extend(
+                    (gid, r.obj, r.template_name, r.enforcement_action)
+                    for r in res.resultants)
+            if not resultants:
+                continue
+            captured, lowered = self._eval_objects_capture(
+                constraints, [r[1] for r in resultants],
+                SOURCE_GENERATED)
+            for oi, (gid, robj, tname, action) in enumerate(resultants):
+                for ckey, results in captured.get(oi, {}).items():
+                    con = cons_by_key.get(ckey)
+                    if con is None:
+                        continue
+                    # totals parity with the relist generator stage:
+                    # non-exact device-lowered kinds count violating
+                    # OBJECTS, everything else counts results
+                    count = (len(results)
+                             if exact or con.kind not in lowered else 1)
+                    violations = [
+                        self._violation(con, robj, msg, details,
+                                        override=(tname, action))
+                        for msg, details in results]
+                    slot = self._gen_verdicts.setdefault(
+                        gid, {}).setdefault(ckey, [0, []])
+                    slot[0] += count
+                    slot[1].extend(violations)
 
     def audit_resync(self) -> AuditRun:
         """The periodic full-resync differential (snapshot mode): drain
@@ -693,9 +865,19 @@ class AuditManager:
                 use_router = (
                     device
                     and getattr(self.evaluator, "renders", False) is False)
+                gen_stage = self._gen_stage()
+                self._gen_reset(gen_stage is not None)
                 self._sweep_serial(constraints, None, use_router, device,
                                    kept_f, totals_f,
                                    self.config.violations_limit, [0], fr)
+                if gen_stage is not None and self._gen_buf:
+                    # the reference sweep must expand too, or the
+                    # differential would flag every generated verdict
+                    self._sweep_generated(gen_stage, self._gen_buf,
+                                          constraints, kept_f, totals_f,
+                                          self.config.violations_limit,
+                                          fr)
+                self._gen_reset(False)
                 diff = self._verdicts_differ_canonical(
                     run.kept, run.total_violations, kept_f, totals_f,
                     self.config.violations_limit)
@@ -766,6 +948,177 @@ class AuditManager:
             self.perf["brownout_yield_s"] = (
                 self.perf.get("brownout_yield_s", 0.0) + waited)
 
+    # --- expansion generator stage (mutlane/expand_stage.py) -------------
+    def _gen_stage(self):
+        """The batched expansion stage, or None when the generator stage
+        is off / has nothing to do."""
+        if not getattr(self.config, "expand_generated", False):
+            return None
+        if self.expansion_system is None or \
+                not self.expansion_system.templates():
+            return None
+        if self._expansion_stage is None:
+            from gatekeeper_tpu.mutlane import ExpansionStage
+
+            self._expansion_stage = ExpansionStage(
+                self.expansion_system, metrics=self.metrics)
+        return self._expansion_stage
+
+    def _gen_reset(self, active: bool) -> None:
+        """Arm (or disarm) the per-sweep generator tee."""
+        self._gen_buf = [] if active else None
+        self._gen_ns = {}
+        self._gen_kinds = set()
+        if active:
+            for t in self.expansion_system.templates():
+                for entry in t.apply_to:
+                    self._gen_kinds.update(entry.get("kinds") or [])
+
+    def _gen_tee(self, obj, kind: str) -> None:
+        """Observe one listed object: collect Namespaces (the expand's
+        namespace context) and generator objects (some template's
+        applyTo covers them).  RawJSON objects only parse when their
+        kind pre-qualifies."""
+        if self._gen_buf is None:
+            return
+        if kind == "Namespace":
+            name = (obj.get("metadata") or {}).get("name", "") or ""
+            if name:
+                self._gen_ns[name] = obj
+            return
+        if kind in self._gen_kinds:
+            for t in self.expansion_system.templates():
+                if t.applies_to(obj):
+                    self._gen_buf.append(obj)
+                    break
+
+    def _gen_namespace_of(self, obj):
+        ns = (obj.get("metadata") or {}).get("namespace", "") or ""
+        return self._gen_ns.get(ns) if ns else None
+
+    def _expand_bases(self, stage, bases) -> tuple:
+        """Expand a chunk of generator bases through the batched stage;
+        returns (resultants, errors) where each resultant is
+        ``(obj, template_name, enforcement_override, ns_obj)``."""
+        namespaces = [self._gen_namespace_of(b) for b in bases]
+        results = stage.expand_batch(bases, namespaces)
+        resultants: list = []
+        errors: list = []
+        for base, ns_obj, res in zip(bases, namespaces, results):
+            if res.error is not None:
+                errors.append((base, res.error))
+                continue
+            for r in res.resultants:
+                resultants.append((r.obj, r.template_name,
+                                   r.enforcement_action, ns_obj))
+        return resultants, errors
+
+    def _sweep_generated(self, stage, bases, constraints, kept, totals,
+                         limit, run=None) -> None:
+        """The generator stage of a relist sweep: expand the tee'd
+        generator objects in chunks, then audit every resultant at sweep
+        scale — device grid for lowered kinds (flattened with
+        Source=Generated so source-scoped matches hold), driver exact
+        lane for the rest — folding into the same kept/totals with the
+        template's enforcementAction override and the reference's
+        ``[Implied by <template>]`` message prefix."""
+        from gatekeeper_tpu.match.match import SOURCE_GENERATED
+        from gatekeeper_tpu.observability import tracing
+        from gatekeeper_tpu.utils.logging import log_event
+
+        chunk_size = max(1, self.config.chunk_size)
+        retries = max(0, getattr(self.config, "chunk_retries", 1))
+        device = (self.evaluator is not None
+                  and getattr(self.evaluator, "renders", False) is False
+                  and hasattr(self.evaluator, "sweep_flatten"))
+        router = None
+        if device:
+            from gatekeeper_tpu.parallel.sharded import make_kind_router
+
+            router = make_kind_router(constraints)
+
+        n_resultants = 0
+        with tracing.span("expansion.stage", phase="audit",
+                          bases=len(bases)) as sp:
+            for i in range(0, len(bases), chunk_size):
+                resultants, errors = self._expand_bases(
+                    stage, bases[i:i + chunk_size])
+                for base, err in errors:
+                    # mirrors the webhook's ExpansionError handling:
+                    # surfaced, never silently dropped, run keeps going
+                    log_event("warning",
+                              "audit expansion failed for a generator "
+                              "object", event_type="audit_expand_failed",
+                              name=(base.get("metadata") or {})
+                              .get("name", ""), error=str(err))
+                n_resultants += len(resultants)
+                self._eval_generated_chunks(
+                    resultants, constraints, kept, totals, limit, run,
+                    router, device, chunk_size, retries,
+                    SOURCE_GENERATED)
+            sp.set_attribute("resultants", n_resultants)
+
+    def _eval_generated_chunks(self, resultants, constraints, kept,
+                               totals, limit, run, router, device,
+                               chunk_size, retries, source) -> None:
+        """Evaluate expanded resultants grouped the way the base sweep
+        groups objects (kind-bucketed router on the device path)."""
+        from gatekeeper_tpu.utils.unstructured import gvk_of
+
+        def fold(objs, cons, overrides):
+            last = None
+            for attempt in range(retries + 1):
+                try:
+                    if run is not None and attempt > 0:
+                        run.retried_chunks += 1
+                    if device:
+                        flat = self.evaluator.sweep_flatten(
+                            cons, objs,
+                            return_bits=self.config.exact_totals,
+                            source=source)
+                        swept = self.evaluator.sweep_collect(
+                            self.evaluator.sweep_dispatch(flat))
+                        self._process_swept(swept, objs, cons, kept,
+                                            totals, limit, source=source,
+                                            overrides=overrides)
+                    else:
+                        self._audit_chunk(objs, cons, kept, totals,
+                                          limit, source=source,
+                                          overrides=overrides)
+                    return
+                except Exception as e:  # noqa: PERF203
+                    last = e
+            if run is not None:
+                run.failed_chunks += 1
+                run.incomplete = True
+            from gatekeeper_tpu.utils.logging import log_event
+
+            log_event("warning",
+                      "generated-object audit chunk dropped after "
+                      "exhausting retries",
+                      event_type="audit_chunk_failed", phase="generated",
+                      error=str(last))
+
+        if router is not None:
+            bufs: dict = {}
+            for obj, tname, action, _ns in resultants:
+                _, _, k = gvk_of(obj)
+                g = router(k)
+                if not g:
+                    continue  # no template's match reaches this kind
+                bufs.setdefault(g, []).append((obj, tname, action))
+            for g, entries in bufs.items():
+                cons_g = [c for c in constraints if c.kind in g]
+                for j in range(0, len(entries), chunk_size):
+                    part = entries[j:j + chunk_size]
+                    fold([e[0] for e in part], cons_g,
+                         [(e[1], e[2]) for e in part])
+        else:
+            for j in range(0, len(resultants), chunk_size):
+                part = resultants[j:j + chunk_size]
+                fold([e[0] for e in part], constraints,
+                     [(e[1], e[2]) for e in part])
+
     # --- sweep chunk source (shared by both schedules) -------------------
     def _chunk_source(self, constraints, kind_filter, use_router, counter):
         """Yield ``(objects, constraint_subset)`` sweep chunks in the ONE
@@ -780,6 +1133,10 @@ class AuditManager:
         container columns, and objects no template can match skip the
         device entirely.  ``counter[0]`` accumulates listed (post
         kind-filter) objects."""
+        if self._gen_buf is not None:
+            # one tee per sweep pass: the differential schedule runs
+            # this generator twice — a stale buffer would double-expand
+            self._gen_buf = []
         if use_router:
             from gatekeeper_tpu.parallel.sharded import make_kind_router
             from gatekeeper_tpu.utils.rawjson import peek_kind
@@ -789,6 +1146,7 @@ class AuditManager:
             bufs: dict = {}  # group -> pending chunk
             for obj in self.lister():
                 k = peek_kind(obj)
+                self._gen_tee(obj, k)
                 if kind_filter is not None and k not in kind_filter:
                     continue
                 counter[0] += 1
@@ -811,9 +1169,10 @@ class AuditManager:
         else:
             chunk: list = []
             for obj in self.lister():
-                if kind_filter is not None:
+                if self._gen_buf is not None or kind_filter is not None:
                     _, _, k = gvk_of(obj)
-                    if k not in kind_filter:
+                    self._gen_tee(obj, k)
+                    if kind_filter is not None and k not in kind_filter:
                         continue
                 chunk.append(obj)
                 counter[0] += 1
@@ -1202,21 +1561,22 @@ class AuditManager:
 
     # --- chunk evaluation ------------------------------------------------
 
-    def _audit_chunk(self, objects, constraints, kept, totals, limit):
+    def _audit_chunk(self, objects, constraints, kept, totals, limit,
+                     source=SOURCE_ORIGINAL, overrides=None):
         """No-evaluator path: every constraint goes through its template's
         own driver (batched where the driver supports it)."""
         target = self.client.target
         reviews = [
             target.handle_review(
-                AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
+                AugmentedUnstructured(object=o, source=source)
             )
             for o in objects
         ]
         self._eval_via_drivers(constraints, objects, reviews, kept, totals,
-                               limit)
+                               limit, overrides=overrides)
 
     def _eval_via_drivers(self, constraints, objects, reviews, kept, totals,
-                          limit):
+                          limit, overrides=None):
         """Evaluate constraints through their own template's driver: the
         batch path for batch-capable drivers, a matcher-prefiltered per-object
         query loop otherwise.  This is the lane for every constraint the
@@ -1235,7 +1595,8 @@ class AuditManager:
         for d, cons in by_driver.values():
             if hasattr(d, "query_batch"):
                 self._chunk_via_query_batch(d, cons, objects, reviews, kept,
-                                            totals, limit)
+                                            totals, limit,
+                                            overrides=overrides)
                 continue
             for oi, obj in enumerate(objects):
                 review = reviews[oi]
@@ -1251,7 +1612,10 @@ class AuditManager:
                     for r in qr.results:
                         if len(kept[key]) < limit:
                             kept[key].append(
-                                self._violation(con, obj, r.msg, r.details))
+                                self._violation(con, obj, r.msg, r.details,
+                                                override=(overrides[oi]
+                                                          if overrides
+                                                          else None)))
 
     @staticmethod
     def fold_swept(swept, n_objects, render, limit, exact, budget=None):
@@ -1302,9 +1666,11 @@ class AuditManager:
                 yield con, total, kept_list
 
     def _process_swept(self, swept, objects, constraints, kept, totals,
-                       limit):
+                       limit, source=SOURCE_ORIGINAL, overrides=None):
         """Fold one chunk's device results into the run state and run the
-        fallback kinds through the exact engine."""
+        fallback kinds through the exact engine.  ``source``/``overrides``
+        carry the expansion generator stage's context (Generated reviews,
+        per-object (template, enforcementAction) overrides)."""
         if getattr(self.evaluator, "renders", False):
             # sidecar lane: the sweep RPC already rendered kept violations
             # and covered every constraint (incl. non-lowered kinds)
@@ -1332,7 +1698,7 @@ class AuditManager:
             r = review_cache.get(oi)
             if r is None:
                 r = target.handle_review(AugmentedUnstructured(
-                    object=objects[oi], source=SOURCE_ORIGINAL))
+                    object=objects[oi], source=source))
                 review_cache[oi] = r
             return r
 
@@ -1360,17 +1726,19 @@ class AuditManager:
             for oi, msg, details in kept_list:
                 if len(kept[key]) < limit:
                     kept[key].append(
-                        self._violation(con, objects[oi], msg, details))
+                        self._violation(con, objects[oi], msg, details,
+                                        override=(overrides[oi]
+                                                  if overrides else None)))
         # everything the device sweep did not cover (non-lowered kinds, CEL
         # templates owned by another driver, inventory-inexact referential
         # kinds) goes through its own driver's exact path
         rest = [c for c in constraints if c.kind not in swept]
         if rest:
             self._eval_via_drivers(rest, objects, get_reviews(), kept,
-                                   totals, limit)
+                                   totals, limit, overrides=overrides)
 
     def _chunk_via_query_batch(self, driver, constraints, objects, reviews,
-                               kept, totals, limit):
+                               kept, totals, limit, overrides=None):
         responses = driver.query_batch(
             self.client.target.name, constraints, reviews,
             ReviewCfg(enforcement_point=AUDIT_EP),
@@ -1386,17 +1754,32 @@ class AuditManager:
                 if len(kept[key]) < limit:
                     con = self.client.get_constraint(ckind, cname)
                     kept[key].append(
-                        self._violation(con, objects[oi], r.msg, r.details)
+                        self._violation(con, objects[oi], r.msg, r.details,
+                                        override=(overrides[oi]
+                                                  if overrides else None))
                     )
 
-    def _violation(self, con, obj, msg, details) -> Violation:
+    def _violation(self, con, obj, msg, details,
+                   override=None) -> Violation:
         group, version, kind = gvk_of(obj)
         meta = obj.get("metadata") or {}
         actions = con.actions_for(AUDIT_EP)
+        action = actions[0] if actions else con.enforcement_action
+        if override is not None:
+            # expansion generator stage: the [Implied by <template>]
+            # message prefix and the template's enforcementAction
+            # override (reference: expansion/aggregate.go semantics)
+            template_name, override_action = override
+            from gatekeeper_tpu.expansion.aggregate import \
+                CHILD_MSG_PREFIX
+
+            msg = f"{CHILD_MSG_PREFIX % template_name} {msg}"
+            if override_action:
+                action = override_action
         return Violation(
             constraint=con,
             message=msg,
-            enforcement_action=actions[0] if actions else con.enforcement_action,
+            enforcement_action=action,
             group=group,
             version=version,
             kind=kind,

@@ -22,6 +22,14 @@ def run_cli(argv: list[str]) -> int:
     p.add_argument("--output", "-o", default="",
                    help="write to file instead of stdout")
     p.add_argument("--format", default="yaml", choices=["yaml", "json"])
+    p.add_argument("--lane", default="host",
+                   choices=["host", "batched", "differential"],
+                   help="'host' walks the recursive per-object reference "
+                        "path; 'batched' expands level-synchronously "
+                        "through the mutlane expansion stage (resultants "
+                        "batch-mutate in one columnar pass per level); "
+                        "'differential' runs BOTH and asserts identical "
+                        "resultants")
     args = p.parse_args(argv)
 
     try:
@@ -34,15 +42,15 @@ def run_cli(argv: list[str]) -> int:
         return 1
 
     try:
-        expander = Expander(objs)
-        resultants = []
-        for obj in objs:
-            resultants.extend(expander.expand(obj))
+        resultants = _expand(objs, args.lane)
     except Exception as e:
         print(f"error: expanding resources: {e}", file=sys.stderr)
         return 1
 
     docs = [r.obj for r in resultants]
+    if args.lane == "differential":
+        print(f"differential: batched lane identical to the host walk "
+              f"({len(docs)} resultants)", file=sys.stderr)
     if args.format == "json":
         import json
 
@@ -58,3 +66,43 @@ def run_cli(argv: list[str]) -> int:
     else:
         sys.stdout.write(out)
     return 0
+
+
+def _expand(objs, lane: str) -> list:
+    """Resultants of every base under the chosen lane (the CLI's
+    sequential per-object order)."""
+    import copy
+
+    def host(objects):
+        expander = Expander(objects)
+        out = []
+        for obj in objects:
+            out.extend(expander.expand(obj))
+        return out
+
+    if lane == "host":
+        return host(objs)
+    from gatekeeper_tpu.mutlane import BatchedExpander
+
+    # the host walk mutates bases in place; isolate each lane's input so
+    # a differential run compares two independent expansions
+    batched_objs = copy.deepcopy(objs) if lane == "differential" else objs
+    batched = BatchedExpander(
+        batched_objs, differential=lane == "differential")
+    resultants = batched.expand_all(batched_objs)
+    if lane == "differential":
+        want = host(objs)
+        got_docs = [r.obj for r in resultants]
+        want_docs = [r.obj for r in want]
+        if got_docs != want_docs:
+            raise AssertionError(
+                "expansion differential mismatch: batched lane diverged "
+                f"from the host walk ({len(got_docs)} vs "
+                f"{len(want_docs)} resultants)")
+        for g, w in zip(resultants, want):
+            if (g.template_name, g.enforcement_action) != \
+                    (w.template_name, w.enforcement_action):
+                raise AssertionError(
+                    "expansion differential mismatch: template/"
+                    "enforcement metadata diverged")
+    return resultants

@@ -218,3 +218,11 @@ SNAPSHOT_DIRTY = "snapshot_dirty_rows"  # gauge
 SNAPSHOT_TOMBSTONE_FRACTION = "snapshot_tombstone_fraction"  # gauge
 SNAPSHOT_PATCHES = "snapshot_patch_count"  # {type}
 SNAPSHOT_RESYNC_SECONDS = "snapshot_resync_seconds"  # gauge
+# batched mutation + expansion lane (gatekeeper_tpu/mutlane/): batched
+# lane passes, objects routed to the authoritative host walk {reason},
+# emitted RFC-6902 patch ops, and convergence iterations per applied
+# object (1 = already at fixed point)
+MUTATION_BATCH = "mutation_batch_count"
+MUTATION_FALLBACK = "mutation_fallback_count"  # {reason}
+MUTATION_PATCH_OPS = "mutation_patch_ops_count"
+MUTATION_CONVERGENCE = "mutation_convergence_iterations"  # summary

@@ -210,6 +210,14 @@ class MutationPrefilter:
             if getattr(mutator, "tester", None) is not None and \
                     getattr(mutator.tester, "_by_depth", None):
                 raise ValueError("path tests")
+            if isinstance(value, (int, float)) and \
+                    not isinstance(value, bool) and \
+                    float(np.float32(value)) != float(value):
+                # device equality compares float32 columns: a value that
+                # doesn't round-trip f32 exactly could report "equal"
+                # (no change) where the host's exact compare mutates —
+                # keep such mutators host-authoritative
+                raise ValueError("non-float32-exact numeric value")
             low = _PathLowerer(self.vocab)
             change, err = low.lower(mutator.path, value, add_only)
             self._programs[key] = (
@@ -218,6 +226,14 @@ class MutationPrefilter:
                     params=(), schema=low.schema)),
                 CompiledProgram(N.Program(
                     template_kind=f"mutator-err:{key}", expr=err,
+                    params=(), schema=low.schema)),
+                # change ∨ error in ONE program: the batched lane's
+                # relevance test needs only this grid per mutator; the
+                # err split runs lazily for mutators that actually have
+                # relevant objects (halves the per-burst program runs)
+                CompiledProgram(N.Program(
+                    template_kind=f"mutator-rel:{key}",
+                    expr=_or(change, err),
                     params=(), schema=low.schema)),
             )
             self._unsupported.pop(key, None)
@@ -254,6 +270,79 @@ class MutationPrefilter:
             out[mi] = grid[0, :n]
         return out
 
+    def grids_and_batch(self, mutators: Sequence, objects: Sequence[dict],
+                        pad_n: Optional[int] = None) -> tuple:
+        """(change [M, N], error [M, N], ColumnBatch) with ONE flatten —
+        the batched mutation lane's entry point: change/error programs
+        run over a shared columnize pass, and the host-side batch stays
+        available for columnar patch emission (presence/kind reads)."""
+        n = len(objects)
+        change = np.zeros((len(mutators), n), bool)
+        err = np.zeros((len(mutators), n), bool)
+        todo = [(mi, m) for mi, m in enumerate(mutators)
+                if m.id in self._programs]
+        if not todo or n == 0:
+            return change, err, None
+        schema = Schema()
+        for _mi, m in todo:
+            for prog in self._programs[m.id]:
+                schema.merge(prog.program.schema)
+        pad = pad_n or max(8, 1 << (n - 1).bit_length())
+        batch = Flattener(schema, self.vocab).flatten(objects, pad_n=pad)
+        for mi, m in todo:
+            change[mi] = self._run_on_batch(m, 0, batch, n)
+            err[mi] = self._run_on_batch(m, 1, batch, n)
+        return change, err, batch
+
+    def _run_on_batch(self, mutator, which: int, batch, n: int):
+        """One program row ([N] bool) over an already-flattened batch.
+
+        Mutator predicate programs are tiny (a handful of presence/kind/
+        equality gates); at webhook-burst sizes the jitted jax dispatch
+        costs ~100x the arithmetic, so a direct numpy interpretation of
+        the SAME expr tree is the fast path — semantics mirror
+        ir/program.py:eval_expr for the fragment node set, and any node
+        outside it falls back to the compiled program (differential
+        parity is pinned either way)."""
+        prog = self._programs[mutator.id][which]
+        try:
+            out = _np_eval(prog.program.expr, batch, n)
+        except _NpUnsupported:
+            table = build_param_table(prog.program, [_NoParams()],
+                                      self.vocab)
+            return prog.run(batch, table, vocab=self.vocab)[0, :n]
+        return np.broadcast_to(np.asarray(out, bool), (n,))
+
+    def relevance_and_batch(self, mutators: Sequence,
+                            objects: Sequence[dict],
+                            pad_n: Optional[int] = None) -> tuple:
+        """(change∨error [M, N], ColumnBatch) with ONE flatten — the
+        batched mutation lane's entry point: ONE combined relevance
+        program runs per mutator over a shared columnize pass, and the
+        host-side batch stays available for columnar patch emission
+        (presence/kind reads) and the lazy error split
+        (:meth:`error_row`)."""
+        n = len(objects)
+        rel = np.zeros((len(mutators), n), bool)
+        todo = [(mi, m) for mi, m in enumerate(mutators)
+                if m.id in self._programs]
+        if not todo or n == 0:
+            return rel, None
+        schema = Schema()
+        for _mi, m in todo:
+            for prog in self._programs[m.id]:
+                schema.merge(prog.program.schema)
+        pad = pad_n or max(8, 1 << (n - 1).bit_length())
+        batch = Flattener(schema, self.vocab).flatten(objects, pad_n=pad)
+        for mi, m in todo:
+            rel[mi] = self._run_on_batch(m, 2, batch, n)
+        return rel, batch
+
+    def error_row(self, mutator, batch, n: int):
+        """[N] bool error row over the shared batch (lazy: only runs
+        for mutators that actually have relevant objects)."""
+        return self._run_on_batch(mutator, 1, batch, n)
+
     def would_change(self, mutators: Sequence, objects: Sequence[dict],
                      pad_n: Optional[int] = None) -> np.ndarray:
         """[M, N] bool: grid[m, n] ⇔ the host walk would change object n
@@ -272,3 +361,85 @@ class _NoParams:
     """Parameter-less constraint stand-in for build_param_table."""
 
     parameters: dict = {}
+
+
+class _NpUnsupported(Exception):
+    """Expr node outside the numpy fast path's fragment."""
+
+
+def _np_eval(expr, batch, n: int):
+    """Numpy interpretation of a mutator predicate over a host-side
+    ColumnBatch — the node-for-node mirror of eval_expr (ir/program.py)
+    restricted to the fragment _PathLowerer emits: ConstBool / And / Or
+    / Not / Present / KindIs / EqStr(FeatSid, ConstSid) /
+    CmpNum(eq, FeatNum, ConstNum) / AnyAxis."""
+
+    def feat(col, field, in_axis):
+        store = batch.raggeds if isinstance(col, RaggedCol) \
+            else batch.scalars
+        c = store.get(col)
+        if c is None:
+            raise _NpUnsupported(str(col))
+        a = getattr(c, field)[:n]
+        if in_axis and not isinstance(col, RaggedCol):
+            a = a[:, None]  # _expand_for_ctx: scalar under an axis
+        return a
+
+    def sidlike(e, in_axis):
+        if isinstance(e, N.FeatSid):
+            kind = feat(e.col, "kind", in_axis)
+            return feat(e.col, "sid", in_axis), kind == 4  # K_STR
+        if isinstance(e, N.ConstSid):
+            return np.int32(e.sid), np.bool_(True)
+        raise _NpUnsupported(type(e).__name__)
+
+    def ev(e, in_axis):
+        if isinstance(e, N.ConstBool):
+            return np.bool_(e.value)
+        if isinstance(e, N.Not):
+            return np.logical_not(ev(e.inner, in_axis))
+        if isinstance(e, N.And):
+            out = None
+            for t in e.terms:
+                v = ev(t, in_axis)
+                out = v if out is None else out & v
+            return out if out is not None else np.bool_(True)
+        if isinstance(e, N.Or):
+            out = None
+            for t in e.terms:
+                v = ev(t, in_axis)
+                out = v if out is None else out | v
+            return out if out is not None else np.bool_(False)
+        if isinstance(e, N.Present):
+            return feat(e.col, "kind", in_axis) > 0
+        if isinstance(e, N.KindIs):
+            return feat(e.col, "kind", in_axis) == e.kind
+        if isinstance(e, N.EqStr):
+            if e.negate:
+                raise _NpUnsupported("EqStr negate")
+            lv, lok = sidlike(e.lhs, in_axis)
+            rv, rok = sidlike(e.rhs, in_axis)
+            return lok & rok & (lv == rv)
+        if isinstance(e, N.CmpNum):
+            if e.op != "eq" or not isinstance(e.lhs, N.FeatNum) or \
+                    not isinstance(e.rhs, N.ConstNum):
+                raise _NpUnsupported("CmpNum")
+            kind = feat(e.lhs.col, "kind", in_axis)
+            num = feat(e.lhs.col, "num", in_axis)
+            return (kind == K_NUM) & (num == np.float32(e.rhs.value))
+        if isinstance(e, N.AnyAxis):
+            if in_axis:
+                raise _NpUnsupported("nested AnyAxis")
+            counts = batch.axis_counts.get(e.axis)
+            if counts is None:
+                raise _NpUnsupported(str(e.axis))
+            counts = counts[:n]
+            inner = ev(e.inner, True)
+            if getattr(inner, "ndim", 0) < 2:
+                return np.asarray(inner) & (counts > 0)
+            m = inner.shape[1]
+            valid = np.arange(m) < counts[:, None]
+            return np.any(inner & valid, axis=1)
+        raise _NpUnsupported(type(e).__name__)
+
+    return ev(expr, False)

@@ -31,11 +31,26 @@ class MutationSystem:
         self._conflicts: set[MutatorID] = set()
         self.reporter = reporter
         self.provider_cache = provider_cache
+        # monotone registry revision: every upsert/remove bumps it, and
+        # compiled artifacts (the batched-lane program, the device
+        # prefilter) key their caches on it so mutator churn invalidates
+        # them.  Initialized here — the old lazy __dict__.get conjuring
+        # meant a never-mutated system had NO _revision attribute at all
+        # and cache keys silently defaulted
+        self._revision = 0
+        # iterations the last ``mutate`` ran until convergence (1 = the
+        # object was already at fixed point); the batched lane observes
+        # this into gatekeeper_mutation_convergence_iterations
+        self.last_iterations = 0
+
+    def revision(self) -> int:
+        """Registry revision, the compiled-lane cache key."""
+        return self._revision
 
     # --- registry (reference: Upsert system.go:80, Remove :121) ----------
     def upsert(self, mutator: BaseMutator) -> None:
         self._mutators[mutator.id] = mutator
-        self._revision = self.__dict__.get("_revision", 0) + 1
+        self._revision += 1
         self._recompute_conflicts()
 
     def upsert_unstructured(self, obj: dict) -> BaseMutator:
@@ -45,7 +60,7 @@ class MutationSystem:
 
     def remove(self, mutator_id: MutatorID) -> None:
         self._mutators.pop(mutator_id, None)
-        self._revision = self.__dict__.get("_revision", 0) + 1
+        self._revision += 1
         self._recompute_conflicts()
 
     def get(self, mutator_id: MutatorID) -> Optional[BaseMutator]:
@@ -54,6 +69,11 @@ class MutationSystem:
     def mutators(self) -> list[BaseMutator]:
         return [self._mutators[k] for k in sorted(self._mutators,
                                                   key=str)]
+
+    def active(self) -> list[BaseMutator]:
+        """Mutators that may run: registry order minus schema conflicts
+        (the set both the fixed-point loop and the batched lane apply)."""
+        return [m for m in self.mutators() if m.id not in self._conflicts]
 
     def conflicts(self) -> set:
         return set(self._conflicts)
@@ -96,13 +116,14 @@ class MutationSystem:
                source: str = "") -> bool:
         """Fixed-point application; mutates ``obj`` in place, returns
         changed?"""
-        active = [m for m in self.mutators() if m.id not in self._conflicts]
+        active = self.active()
+        self.last_iterations = 0
         if not active:
             return False
         original = copy.deepcopy(obj)
         max_iterations = len(active) + 1
         any_change = False
-        for _ in range(max_iterations):
+        for it in range(max_iterations):
             iteration_changed = False
             for m in active:
                 if not m.matches(obj, namespace=namespace, source=source):
@@ -112,6 +133,7 @@ class MutationSystem:
                     iteration_changed = True
                     any_change = True
             if not iteration_changed:
+                self.last_iterations = it + 1
                 self._resolve_placeholders(obj)
                 return any_change
         # restore: a non-converging system must not half-mutate (the
@@ -130,14 +152,14 @@ class MutationSystem:
         host fixed-point walk runs ONLY on objects some mutator would
         actually touch (plus every object when non-lowerable mutators
         exist — they stay host-authoritative).  Returns changed flags."""
-        active = [m for m in self.mutators() if m.id not in self._conflicts]
+        active = self.active()
         if not active or not objects:
             return [False] * len(objects)
         from gatekeeper_tpu.mutation.device import MutationPrefilter
 
         # cache keyed on the system REVISION (not just ids: an in-place
         # upsert changing a mutator's value/location must recompile)
-        rev = self.__dict__.get("_revision", 0)
+        rev = self._revision
         pre = self.__dict__.get("_prefilter")
         if pre is None or self.__dict__.get("_prefilter_rev") != rev:
             pre = MutationPrefilter()

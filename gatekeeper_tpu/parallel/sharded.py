@@ -511,10 +511,10 @@ class _FlatChunk:
     and the dispatch stage (masks + wire pack + device_put + jit call)."""
 
     __slots__ = ("by_kind", "kinds", "cols", "batch", "objects", "any_gen",
-                 "n", "pad_n", "return_bits")
+                 "n", "pad_n", "return_bits", "source")
 
     def __init__(self, by_kind, kinds, cols, batch, objects, any_gen, n,
-                 pad_n, return_bits):
+                 pad_n, return_bits, source=""):
         self.by_kind = by_kind
         self.kinds = kinds
         self.cols = cols
@@ -524,6 +524,11 @@ class _FlatChunk:
         self.n = n
         self.pad_n = pad_n
         self.return_bits = return_bits
+        # review source ("Original"/"Generated") the chunk evaluates
+        # under — expansion-stage chunks carry Generated so source-scoped
+        # constraint matches see shift-left resultants correctly; ""
+        # keeps the legacy mask behavior byte-for-byte
+        self.source = source
 
 
 class ShardedEvaluator:
@@ -824,7 +829,8 @@ class ShardedEvaluator:
     def sweep_flatten_from_batch(self, constraints: Sequence, batch,
                                  objects: Sequence[dict],
                                  return_bits: bool = False,
-                                 alias: Optional[dict] = None):
+                                 alias: Optional[dict] = None,
+                                 source: str = ""):
         """Pipeline stage 1 over a PRE-FLATTENED :class:`ColumnBatch` —
         the resident-snapshot lane: the columns were flattened when the
         watch patched them in, so a sweep over the snapshot pays only
@@ -845,10 +851,11 @@ class ShardedEvaluator:
                 "generateName" in (o.get("metadata") or {})
                 for o in objects)
         return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
-                          objects, any_gen, n, batch.n, return_bits)
+                          objects, any_gen, n, batch.n, return_bits,
+                          source=source)
 
     def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
-                      return_bits: bool = False):
+                      return_bits: bool = False, source: str = ""):
         """Pipeline stage 1 (host, GIL-released C columnizer): schema
         union + flatten + column pack/slim.  Returns a :class:`_FlatChunk`
         for :meth:`sweep_dispatch`, or {} when no kind is lowered (the
@@ -892,7 +899,8 @@ class ShardedEvaluator:
                 "generateName" in (o.get("metadata") or {})
                 for o in objects)
         return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
-                          objects, any_gen, n, pad_n, return_bits)
+                          objects, any_gen, n, pad_n, return_bits,
+                          source=source)
 
     def sweep_dispatch(self, flat):
         """Pipeline stage 2 (host->device): match masks + param tables +
@@ -934,6 +942,8 @@ class ShardedEvaluator:
                                             self.driver.vocab))
             mask_rows.append(masks_mod.constraint_masks(
                 cons, batch, self.driver.vocab, objects,
+                sources=([flat.source] * len(objects)
+                         if flat.source else None),
                 any_generate_name=any_gen,
             ))
             offsets[kind] = (c_off, c_off + len(cons))

@@ -736,8 +736,14 @@ class ClusterSnapshot:
             return sum(s.live_count for s in self._groups.values())
 
     def obj_of(self, gid: int):
+        """Live object of a global row id, or None when the row was
+        deleted (tombstoned or compacted away) — callers use the None
+        to retire per-gid state (e.g. generated-resultant verdicts)."""
         with self.lock:
-            store, pos = self._pos[gid]
+            hit = self._pos.get(gid)
+            if hit is None:
+                return None
+            store, pos = hit
             return store.row_obj(pos)
 
     # --- warm cache (webhook referential/namespace lookups) -------------

@@ -24,6 +24,11 @@ class MutationResponse:
     patch: Optional[list] = None  # JSON-patch ops
     message: str = ""
     uid: str = ""
+    code: int = 200
+    warnings: list = field(default_factory=list)
+    # shed under failurePolicy=Fail (batched lane): the server emits an
+    # HTTP Retry-After header with this hint (0 = no header)
+    retry_after_s: float = 0.0
 
 
 def json_escape_pointer(seg: str) -> str:

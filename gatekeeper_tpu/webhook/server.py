@@ -67,6 +67,7 @@ class WebhookServer:
         reuse_port: bool = False,  # SO_REUSEPORT multi-worker serving
         backlog: int = 128,  # --webhook-backlog: kernel accept queue
         batcher=None,  # Batcher to drain inside stop() (zero-loss shutdown)
+        mutation_batcher=None,  # MutationBatcher, drained the same way
     ):
         self.validation_handler = validation_handler
         self.mutation_handler = mutation_handler
@@ -76,6 +77,7 @@ class WebhookServer:
         self.metrics = metrics
         self.enable_profile = enable_profile
         self.batcher = batcher
+        self.mutation_batcher = mutation_batcher
         # graceful drain (resilience/overload.DrainCoordinator drives the
         # process view; this event is the server-local view): once set,
         # /healthz answers 503 {"draining": true} so the LB pulls this
@@ -242,7 +244,7 @@ class WebhookServer:
                             # matched constraints)
                             self._admit(body, uid, cost_hint=length)
                         elif self.path == MUTATE_PATH:
-                            self._mutate(body, uid)
+                            self._mutate(body, uid, cost_hint=length)
                         elif self.path == ADMIT_LABEL_PATH:
                             self._admit_label(body, uid)
                         else:
@@ -275,15 +277,40 @@ class WebhookServer:
                     v.uid or uid, v.allowed, v.message, v.code, v.warnings
                 ), headers=headers)
 
-            def _mutate(self, body, uid):
+            def _mutate(self, body, uid, cost_hint=0):
                 h = outer.mutation_handler
                 if h is None:
                     self._reply(200, admission_response(uid, True))
                     return
-                m = h.handle(body)
+                # batched handler takes the wire size as the overload
+                # cost hint; the legacy per-object handler does not (a
+                # TypeError probe would swallow real handler bugs, so
+                # inspect once and cache on the handler)
+                accepts = getattr(h, "_accepts_cost_hint", None)
+                if accepts is None:
+                    import inspect
+
+                    try:
+                        accepts = "cost_hint" in inspect.signature(
+                            h.handle).parameters
+                    except (TypeError, ValueError):
+                        accepts = False
+                    try:
+                        h._accepts_cost_hint = accepts
+                    except Exception:
+                        pass
+                m = (h.handle(body, cost_hint=cost_hint) if accepts
+                     else h.handle(body))
+                headers = None
+                retry_after = getattr(m, "retry_after_s", 0.0)
+                if retry_after:
+                    headers = {"Retry-After":
+                               str(max(1, int(retry_after + 0.999)))}
                 self._reply(200, admission_response(
-                    m.uid or uid, m.allowed, m.message, patch=m.patch
-                ))
+                    m.uid or uid, m.allowed, m.message,
+                    getattr(m, "code", 200),
+                    warnings=getattr(m, "warnings", None), patch=m.patch,
+                ), headers=headers)
 
             def _admit_label(self, body, uid):
                 h = outer.namespace_label_handler
@@ -409,23 +436,24 @@ class WebhookServer:
 
         t0 = _t.perf_counter()
         self.begin_drain()
+        batchers = [b for b in (self.batcher, self.mutation_batcher)
+                    if b is not None]
         with tracing.span("server.drain"):
             self._server.shutdown()  # listener stops accepting
             deadline = t0 + max(0.0, drain_timeout)
             while _t.perf_counter() < deadline:
-                if self.inflight() == 0 and (
-                        self.batcher is None
-                        or self.batcher.queue_depth() == 0):
+                if self.inflight() == 0 and all(
+                        b.queue_depth() == 0 for b in batchers):
                     break
                 _t.sleep(0.005)
             drained = self.inflight() == 0
-            if self.batcher is not None:
+            for b in batchers:
                 # nothing new can arrive: drain whatever is still queued
                 # (abandoned deadline-missed entries included), bounded by
-                # the remaining budget
-                self.batcher.stop(timeout=max(
-                    0.1, deadline - _t.perf_counter()))
-                drained = drained and self.batcher.queue_depth() == 0
+                # the remaining budget — the validation batcher AND the
+                # mutate batcher both flush (zero-loss covers /v1/mutate)
+                b.stop(timeout=max(0.1, deadline - _t.perf_counter()))
+                drained = drained and b.queue_depth() == 0
             self._server.server_close()
             tracing.set_attribute("drained", drained)
             tracing.set_attribute("inflight_at_close", self.inflight())

@@ -296,6 +296,111 @@ def test_chaos_burst_sigterm_zero_loss_with_overload():
     assert reg.get_gauge(M.WEBHOOK_INFLIGHT) == 0
 
 
+# --- the mutate endpoint shares the zero-loss drain ------------------------
+
+def test_server_stop_mid_burst_answers_every_accepted_mutation():
+    """SIGTERM-equivalent mid-burst on `/v1/mutate`: the mutation
+    batcher is drained inside server.stop exactly like the validation
+    batcher — every ACCEPTED mutate review is ANSWERED with its own uid
+    and patch, in-flight and batcher-queued entries included."""
+    import base64
+
+    from gatekeeper_tpu.mutation.system import MutationSystem
+    from gatekeeper_tpu.mutlane import (BatchedMutationHandler,
+                                        MutationBatcher, MutationLane)
+    from gatekeeper_tpu.resilience.faults import FaultPlan, inject
+
+    system = MutationSystem()
+    system.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1", "kind": "Assign",
+        "metadata": {"name": "host-network"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.hostNetwork",
+                 "parameters": {"assign": {"value": False}}},
+    })
+    reg = MetricsRegistry()
+    lane = MutationLane(system, metrics=reg)
+    # tiny batches + a chaos-slowed lane: the burst piles up queued
+    # entries behind in-flight flushes, the drain must answer them all
+    batcher = MutationBatcher(lane, max_batch=2, metrics=reg).start()
+    handler = BatchedMutationHandler(system, lane=lane, batcher=batcher,
+                                     metrics=reg)
+    accepted: list = []
+    accept_lock = threading.Lock()
+    inner_handle = handler.handle
+
+    def tracking_handle(body, cost_hint=0):
+        with accept_lock:
+            accepted.append(body["request"]["uid"])
+        return inner_handle(body, cost_hint=cost_hint)
+
+    handler.handle = tracking_handle
+    srv = WebhookServer(mutation_handler=handler, port=0, metrics=reg,
+                        mutation_batcher=batcher).start()
+
+    answered: dict = {}
+    failures: list = []
+    lock = threading.Lock()
+
+    def mutate_body(uid):
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": uid, "operation": "CREATE",
+                        "kind": {"group": "", "version": "v1",
+                                 "kind": "Pod"},
+                        "userInfo": {"username": "drain"},
+                        "object": {"apiVersion": "v1", "kind": "Pod",
+                                   "metadata": {"name": uid},
+                                   "spec": {}}},
+        }
+
+    def post(i):
+        uid = f"mut-{i}"
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=20)
+            c.request("POST", "/v1/mutate",
+                      json.dumps(mutate_body(uid)).encode(),
+                      {"Content-Type": "application/json"})
+            doc = json.loads(c.getresponse().read())
+            with lock:
+                answered[uid] = doc["response"]
+            c.close()
+        except Exception as e:
+            with lock:
+                failures.append((uid, e))
+
+    plan = FaultPlan([{"site": "mutation.batch", "mode": "sleep",
+                       "delay_s": 0.08}])
+    with inject(plan):
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(14)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # mid-burst: flushes in flight + entries queued
+        drained = srv.stop(drain_timeout=15)
+        for t in threads:
+            t.join(20)
+
+    assert drained, "mutate drain must complete inside the budget"
+    with accept_lock:
+        accepted_set = set(accepted)
+    assert accepted_set, "the mutate burst must have been accepted"
+    lost = accepted_set - set(answered)
+    assert lost == set(), f"accepted but never answered: {sorted(lost)}"
+    for uid in accepted_set:
+        resp = answered[uid]
+        assert resp["uid"] == uid
+        assert resp["allowed"] is True
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        assert patch == [{"op": "add", "path": "/spec/hostNetwork",
+                          "value": False}]
+    assert {u for u, _ in failures} & accepted_set == set()
+    assert batcher.queue_depth() == 0
+
+
 # --- real-process SIGTERM (slow lane) --------------------------------------
 
 @pytest.mark.slow
