@@ -231,6 +231,40 @@ def main(argv=None) -> int:
                    help="seed the trace/span ID generator and sampler "
                         "(deterministic IDs for differential runs; "
                         "default: OS entropy)")
+    p.add_argument("--cost-attribution", default="on",
+                   choices=["on", "off"],
+                   help="per-template cost attribution: shared device "
+                        "passes apportion wall time across the "
+                        "constraint grid by row occupancy "
+                        "(gatekeeper_constraint_eval_seconds, "
+                        "/debug/cost, `gator bench --attribution`)")
+    p.add_argument("--slo", default="on", choices=["on", "off"],
+                   help="in-process SLO engine: declarative objectives "
+                        "(admission/mutate P99, shed rate, audit "
+                        "staleness) with multi-window burn rates — "
+                        "gatekeeper_slo_* gauges, /debug/slo, breach "
+                        "span events")
+    p.add_argument("--slo-config", default="",
+                   help="JSON file of SLO objectives (and optional burn "
+                        "tiers) replacing the built-in defaults — see "
+                        "README 'Observability' for the format")
+    p.add_argument("--slo-interval", type=float, default=10.0,
+                   help="seconds between SLO engine evaluations")
+    p.add_argument("--slo-brownout", action="store_true",
+                   help="feed SLO burn into the overload brownout "
+                        "ladder: a burning latency objective browns out "
+                        "optional work (stale lookups, audit device-"
+                        "lane yield) BEFORE the admission queue backs "
+                        "up (off keeps the ladder queue-driven only)")
+    p.add_argument("--flight-recorder", type=int, default=2048,
+                   help="admission flight recorder: ring capacity of "
+                        "structured admission/mutation/shed decision "
+                        "records served at /debug/decisions?uid= "
+                        "(0 disables)")
+    p.add_argument("--flight-recorder-sink", default="",
+                   help="append every flight-recorder decision to this "
+                        "JSONL file (the operator's black box; decision "
+                        "metadata only, never object bodies)")
     p.add_argument("--webhook-deadline", type=float, default=0.0,
                    help="per-admission wall-clock budget in seconds; on "
                         "expiry the request resolves per "
@@ -386,6 +420,40 @@ def main(argv=None) -> int:
             ),
             metrics=metrics)
         _overload.install(overload_ctl)
+    # the L6 observability trio (README "Observability"): cost
+    # attribution + SLO engine + flight recorder, all metric-registry
+    # backed and served from the /debug endpoints next to /metrics
+    from gatekeeper_tpu.observability import costattr as _costattr
+    from gatekeeper_tpu.observability import flightrec as _flightrec
+    from gatekeeper_tpu.observability import slo as _slo
+
+    cost_attr = None
+    if args.cost_attribution == "on":
+        cost_attr = _costattr.CostAttribution(metrics=metrics)
+        _costattr.install(cost_attr)
+    flight_rec = None
+    if args.flight_recorder > 0 and not args.once:
+        flight_rec = _flightrec.FlightRecorder(
+            capacity=args.flight_recorder,
+            sink_path=args.flight_recorder_sink or None,
+            metrics=metrics)
+        _flightrec.install(flight_rec)
+    slo_engine = None
+    if args.slo == "on" and not args.once:
+        slo_kw: dict = {}
+        if args.slo_config:
+            cfg = _slo.load_config(args.slo_config)
+            slo_kw["objectives"] = cfg["objectives"]
+            if cfg["tiers"]:
+                slo_kw["tiers"] = cfg["tiers"]
+        slo_engine = _slo.SLOEngine(metrics, brownout=overload_ctl,
+                                    **slo_kw)
+        if args.slo_brownout and overload_ctl is not None:
+            overload_ctl.set_slo_input(slo_engine.pressure)
+        slo_engine.start(interval_s=args.slo_interval)
+        print(f"SLO engine active: "
+              f"{len(slo_engine.objectives)} objectives, tick every "
+              f"{args.slo_interval:.0f}s (/debug/slo)", file=sys.stderr)
     cel = CELDriver()
     if args.evaluate_sidecar:
         from gatekeeper_tpu.drivers.remote import RemoteDriver
@@ -743,6 +811,9 @@ def main(argv=None) -> int:
             backlog=args.webhook_backlog,
             batcher=batcher,
             mutation_batcher=mutation_batcher,
+            cost_attribution=cost_attr,
+            slo_engine=slo_engine,
+            flight_recorder=flight_rec,
         ).start()
         print(f"webhook serving on :{server.port}", file=sys.stderr)
         if args.certs_dir and args.cert_rotation_check_s > 0:
@@ -816,6 +887,10 @@ def main(argv=None) -> int:
             mutation_batcher.stop()
         if snap_ingester is not None:
             snap_ingester.stop()
+        if slo_engine is not None:
+            slo_engine.stop()
+        if flight_rec is not None:
+            flight_rec.close()  # flush the JSONL black box
         export_trace()  # tracer flush happens after the last span closed
         # worker children drain in sequence: each runs this same
         # machinery; the parent waits for them one at a time so every

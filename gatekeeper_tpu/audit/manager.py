@@ -569,6 +569,7 @@ class AuditManager:
 
         def render(con, obj, cache_key=None):
             self.perf["n_renders"] = self.perf.get("n_renders", 0) + 1
+            t0 = time.perf_counter()
             review = cache.get(cache_key) if cache_key is not None \
                 else None
             if review is None:
@@ -577,12 +578,27 @@ class AuditManager:
                 if cache_key is not None:
                     cache[cache_key] = review
             if hasattr(driver, "render_query"):
-                return driver.render_query(
+                results = driver.render_query(
                     target.name, con, review, cfg).results
-            return driver._interp.query(
-                target.name, [con], review, cfg).results
+            else:
+                results = driver._interp.query(
+                    target.name, [con], review, cfg).results
+            self._attr_render(con, time.perf_counter() - t0)
+            return results
 
         return render
+
+    @staticmethod
+    def _attr_render(con, dt: float) -> None:
+        """Exact per-template attribution of one exact-engine render
+        (the host-side cost of a device hit) — no apportioning needed,
+        the call IS template-scoped."""
+        from gatekeeper_tpu.observability import costattr
+
+        attr = costattr.active()
+        if attr is not None:
+            attr.record(con.kind, costattr.EP_AUDIT,
+                        costattr.PHASE_RENDER, dt, rows=1)
 
     def _fold_snapshot_chunk(self, swept, cons_g, gids, objects) -> None:
         """Replace the verdict-store entries of an evaluated row set:
@@ -1520,7 +1536,11 @@ class AuditManager:
         from gatekeeper_tpu.metrics import registry as M
 
         self.metrics.observe(M.AUDIT_DURATION, run.duration_s)
-        self.metrics.set_gauge(M.AUDIT_LAST_RUN, time.time())
+        now = time.time()
+        self.metrics.set_gauge(M.AUDIT_LAST_RUN, now - run.duration_s)
+        # end-of-sweep timestamp: the SLO engine's audit-staleness
+        # objective ages against this (declared since PR 3, never set)
+        self.metrics.set_gauge(M.AUDIT_LAST_RUN_END, now)
         self.metrics.set_gauge(M.AUDIT_LAST_RUN_INCOMPLETE,
                                1.0 if run.incomplete else 0.0)
         if not self.pipe_stats:
@@ -1710,13 +1730,17 @@ class AuditManager:
 
         def render(con, oi):
             self.perf["n_renders"] = self.perf.get("n_renders", 0) + 1
+            t0 = time.perf_counter()
             if hasattr(driver, "render_query"):
-                return driver.render_query(
+                results = driver.render_query(
                     self.client.target.name, con, get_review(oi), cfg
                 ).results
-            return driver._interp.query(
-                self.client.target.name, [con], get_review(oi), cfg
-            ).results
+            else:
+                results = driver._interp.query(
+                    self.client.target.name, [con], get_review(oi), cfg
+                ).results
+            self._attr_render(con, time.perf_counter() - t0)
+            return results
 
         for con, total, kept_list in self.fold_swept(
                 swept, len(objects), render, limit, exact,

@@ -432,15 +432,30 @@ class TpuDriver:
         Returns one QueryResponse per review.  This is the kernel behind the
         audit sweep (SURVEY.md §3.2) and the webhook batcher.
         """
-        from gatekeeper_tpu.observability import tracing
+        from gatekeeper_tpu.observability import costattr, tracing
 
+        t0 = time.perf_counter()
+        occ: dict = {}
         with tracing.span("device.query_batch", n=len(reviews),
                           constraints=len(constraints)):
-            return self._query_batch_impl(target, constraints, reviews,
-                                          cfg, render_messages)
+            out = self._query_batch_impl(target, constraints, reviews,
+                                         cfg, render_messages,
+                                         occ_out=occ)
+        attr = costattr.active()
+        if attr is not None and occ:
+            # the shared admission pass (flatten + grid + render) splits
+            # across templates by mask row occupancy — per-template
+            # shares sum back to this span's wall time
+            attr.attribute(time.perf_counter() - t0,
+                           {k: 1.0 + v for k, v in occ.items()},
+                           costattr.EP_WEBHOOK, costattr.PHASE_DISPATCH,
+                           rows=occ)
+        return out
 
     def _query_batch_impl(self, target, constraints, reviews, cfg,
-                          render_messages) -> list[QueryResponse]:
+                          render_messages,
+                          occ_out: Optional[dict] = None
+                          ) -> list[QueryResponse]:
         cfg = cfg or ReviewCfg()
         n = len(reviews)
         responses = [QueryResponse() for _ in range(n)]
@@ -515,6 +530,8 @@ class TpuDriver:
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )
+            if occ_out is not None:
+                occ_out[kind] = int(mask.sum())
             grid = grid[:, : batch.n] & mask
             if kind in self._cel_kinds and cel_delete_idx:
                 for ci, con in enumerate(cons):
@@ -557,6 +574,8 @@ class TpuDriver:
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources
             )
+            if occ_out is not None:
+                occ_out[kind] = int(mask[:, :n].sum())
             for ci, con in enumerate(cons):
                 for oi in np.nonzero(mask[ci, :n])[0].tolist():
                     qr = engine(target, [con], reviews[oi], cfg)

@@ -392,6 +392,11 @@ def run_cli(argv: list[str]) -> int:
     p.add_argument("--trace", default="",
                    help="export a Chrome trace-event JSON of the bench "
                         "run's spans to this path (Perfetto-loadable)")
+    p.add_argument("--attribution", action="store_true",
+                   help="per-template cost attribution table after the "
+                        "run: each engine's shared passes apportioned "
+                        "across the constraint grid by row occupancy "
+                        "(the /debug/cost view, offline)")
     args = p.parse_args(argv)
 
     try:
@@ -418,6 +423,16 @@ def run_cli(argv: list[str]) -> int:
         tracer = tracing.Tracer(seed=0)
         tracing.install(tracer)
         installed = True
+    from gatekeeper_tpu.observability import costattr as _costattr
+
+    attr = None
+    attr_installed = False
+    if args.attribution:
+        attr = _costattr.active()
+        if attr is None:
+            attr = _costattr.CostAttribution()
+            _costattr.install(attr)
+            attr_installed = True
     results = []
     try:
         for engine in engines:
@@ -441,8 +456,16 @@ def run_cli(argv: list[str]) -> int:
     finally:
         if installed:
             tracing.uninstall()
+        if attr_installed:
+            _costattr.uninstall()
     if args.output == "json":
-        print(json.dumps([r.to_dict() for r in results], indent=2))
+        out = [r.to_dict() for r in results]
+        if attr is not None:
+            out.append({"attribution": attr.snapshot()})
+        print(json.dumps(out, indent=2))
     else:
         print(format_text(results))
+        if attr is not None:
+            print("cost attribution (per template, all engines):")
+            print(attr.table())
     return 0

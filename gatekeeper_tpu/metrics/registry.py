@@ -6,44 +6,113 @@ last_run_time/violations, constraint counts, sync gauges — names per
 website/docs/metrics.md).  Here: a dependency-free registry producing the
 Prometheus exposition format, served by the webhook server or scraped via
 ``render()``.
+
+Distributions are **fixed-bucket histograms** (the earlier reservoir
+summary computed quantiles over a ``deque(maxlen=4096)`` window while
+``_sum``/``_count`` were lifetime — a biased pairing once the series
+outlived the window).  Buckets are lifetime-cumulative like the sums, so
+``_bucket``/``_sum``/``_count`` always describe the same population;
+the old ``name{quantile="..."}`` series stay as a compat shim estimated
+from the buckets.  Each bucket carries an optional **exemplar** (the
+trace id of the most recent observation that landed in it) so a slow
+P99 bucket links straight to a ``/debug/traces`` span; exemplars render
+in the OpenMetrics format (negotiated by Accept on ``/metrics``).
+
+Label sets are **bounded per metric name** (``max_label_sets``): at
+production churn an unbounded ``{template}``/``{tenant}`` label set is a
+memory leak, so overflow series fold into an ``other`` label value and
+``gatekeeper_metrics_dropped_labels_count`` counts the folds.
 """
 
 from __future__ import annotations
 
-import threading
+import bisect
+import math
 import time
-from collections import defaultdict, deque
-from typing import Optional
+from collections import defaultdict
+from typing import Optional, Sequence
 
-_HIST_WINDOW = 4096  # bounded reservoir per series (webhook hot path)
+import threading
 
 PREFIX = "gatekeeper_"
+
+# default bucket bounds: *_seconds metrics get latency-shaped buckets
+# (sub-ms to tens of seconds — admission reviews sit in the ms decades,
+# audit sweeps in the seconds decades); everything else (batch sizes,
+# convergence iterations) gets power-of-two count buckets
+DURATION_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 1024.0)
+
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 
 def _labels_key(labels: dict) -> tuple:
     return tuple(sorted((labels or {}).items()))
 
 
+# exemplar source: the ambient span's trace id (resolved lazily so the
+# registry has no import-time dependency on the tracer; with no tracer
+# installed current_span() is one contextvar read returning None)
+_cur_span_fn = None
+
+
+def _exemplar_trace_id() -> str:
+    global _cur_span_fn
+    if _cur_span_fn is None:
+        try:
+            from gatekeeper_tpu.observability.tracing import current_span
+        except Exception:  # pragma: no cover — package half-installed
+            return ""
+        _cur_span_fn = current_span
+    s = _cur_span_fn()
+    if s is None:
+        return ""
+    return getattr(s, "trace_id", "") or ""
+
+
 class MetricsRegistry:
-    def __init__(self):
+    def __init__(self, max_label_sets: int = 128):
         self._counters: dict = defaultdict(float)
         self._gauges: dict = {}
-        self._hist: dict = defaultdict(
-            lambda: {"count": 0, "sum": 0.0,
-                     "window": deque(maxlen=_HIST_WINDOW)}
-        )
+        self._hist: dict = {}
+        # per-metric-name distinct-labelset registry (cardinality guard)
+        self.max_label_sets = max(1, int(max_label_sets))
+        self._series_labels: dict = {}
+        self._bucket_overrides: dict = {}
         self._lock = threading.Lock()
+
+    # --- cardinality guard ---------------------------------------------
+    def _bounded_labels(self, name: str, labels: Optional[dict]) -> tuple:
+        """Label key for storage, bounded per metric name: a labelset
+        beyond ``max_label_sets`` folds every value into ``other`` and
+        counts the fold (call under self._lock)."""
+        lk = _labels_key(labels)
+        if not lk:
+            return lk
+        seen = self._series_labels.setdefault(name, set())
+        if lk in seen:
+            return lk
+        if len(seen) >= self.max_label_sets:
+            self._counters[(DROPPED_LABELS, ())] += 1
+            return tuple((k, "other") for k, _v in lk)
+        seen.add(lk)
+        return lk
 
     # --- instruments --------------------------------------------------
     def inc_counter(self, name: str, labels: Optional[dict] = None,
                     value: float = 1.0) -> None:
         with self._lock:
-            self._counters[(name, _labels_key(labels))] += value
+            self._counters[(name, self._bounded_labels(name, labels))] \
+                += value
 
     def set_gauge(self, name: str, value: float,
                   labels: Optional[dict] = None) -> None:
         with self._lock:
-            self._gauges[(name, _labels_key(labels))] = value
+            self._gauges[(name, self._bounded_labels(name, labels))] = value
 
     def counter_total(self, name: str) -> float:
         """Sum of a counter across all label sets (test/introspection)."""
@@ -51,13 +120,49 @@ class MetricsRegistry:
             return sum(v for (n, _), v in self._counters.items()
                        if n == name)
 
+    def set_buckets(self, name: str, bounds: Sequence[float]) -> None:
+        """Override the bucket bounds a metric name will use.  Applies to
+        series created AFTER the call (histogram state is per-series and
+        bounds are fixed at first observation)."""
+        with self._lock:
+            self._bucket_overrides[name] = tuple(sorted(float(b)
+                                                        for b in bounds))
+
+    def buckets_for(self, name: str) -> tuple:
+        ov = self._bucket_overrides.get(name)
+        if ov is not None:
+            return ov
+        return DURATION_BUCKETS if name.endswith("_seconds") \
+            else COUNT_BUCKETS
+
     def observe(self, name: str, value: float,
                 labels: Optional[dict] = None) -> None:
+        tid = _exemplar_trace_id()
         with self._lock:
-            h = self._hist[(name, _labels_key(labels))]
+            key = (name, self._bounded_labels(name, labels))
+            h = self._hist.get(key)
+            if h is None:
+                bounds = self.buckets_for(name)
+                h = self._hist[key] = {
+                    "count": 0, "sum": 0.0, "min": None, "max": None,
+                    "bounds": bounds,
+                    # per-bucket (NOT cumulative) counts; index len(bounds)
+                    # is the +Inf bucket.  Cumulation happens at render.
+                    "buckets": [0] * (len(bounds) + 1),
+                    # exemplar per bucket: (trace_id, value, unix_ts) of
+                    # the most recent traced observation that landed there
+                    "exemplars": [None] * (len(bounds) + 1),
+                }
             h["count"] += 1
             h["sum"] += value
-            h["window"].append(value)
+            if h["min"] is None or value < h["min"]:
+                h["min"] = value
+            if h["max"] is None or value > h["max"]:
+                h["max"] = value
+            i = bisect.bisect_left(h["bounds"], value)
+            h["buckets"][i] += 1
+            if tid:
+                h["exemplars"][i] = (tid, float(value), time.time())
 
     def timed(self, name: str, labels: Optional[dict] = None):
         registry = self
@@ -73,8 +178,14 @@ class MetricsRegistry:
         return _Timer()
 
     # --- exposition ----------------------------------------------------
-    def render(self) -> str:
-        """Prometheus text format (the prometheus exporter equivalent)."""
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text format (the prometheus exporter equivalent).
+
+        ``openmetrics=True`` renders the OpenMetrics flavor (negotiated
+        by the Accept header on ``/metrics``): exemplars ride the
+        ``_bucket`` lines and the page ends with ``# EOF``; the legacy
+        flavor instead appends the compat ``name{quantile=...}`` series
+        estimated from the buckets (the pre-histogram summary names)."""
         lines = []
         typed: set = set()  # one # TYPE line per metric name
 
@@ -91,18 +202,36 @@ class MetricsRegistry:
                 type_line(name, "gauge")
                 lines.append(f"{PREFIX}{name}{_fmt(labels)} {_num(v)}")
             for (name, labels), h in sorted(self._hist.items()):
-                type_line(name, "summary")
-                lines.append(
-                    f"{PREFIX}{name}_count{_fmt(labels)} {h['count']}")
+                type_line(name, "histogram")
+                cum = 0
+                for i, n in enumerate(h["buckets"]):
+                    cum += n
+                    bounds = h["bounds"]
+                    le = _num(bounds[i]) if i < len(bounds) else "+Inf"
+                    line = (f"{PREFIX}{name}_bucket"
+                            f"{_fmt(labels + (('le', le),))} {cum}")
+                    ex = h["exemplars"][i]
+                    if openmetrics and ex is not None:
+                        tid, val, ts = ex
+                        line += (f' # {{trace_id="{_escape_label(tid)}"}} '
+                                 f"{_num(val)} {ts:.3f}")
+                    lines.append(line)
                 lines.append(
                     f"{PREFIX}{name}_sum{_fmt(labels)} {_num(h['sum'])}")
-                sv = sorted(h["window"])  # quantiles over the recent window
-                if sv:
+                lines.append(
+                    f"{PREFIX}{name}_count{_fmt(labels)} {h['count']}")
+                if not openmetrics and h["count"]:
+                    # compat shim: the summary-era quantile series, now
+                    # estimated from the lifetime buckets (the reservoir
+                    # window's recency bias is gone — quantiles and
+                    # sum/count describe the same population)
                     for q in (0.5, 0.9, 0.99):
-                        idx = min(int(q * len(sv)), len(sv) - 1)
                         ql = labels + (("quantile", str(q)),)
+                        est = _bucket_quantile(h, q)
                         lines.append(
-                            f"{PREFIX}{name}{_fmt(ql)} {_num(sv[idx])}")
+                            f"{PREFIX}{name}{_fmt(ql)} {_num(est)}")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def get_counter(self, name: str, labels: Optional[dict] = None) -> float:
@@ -110,6 +239,45 @@ class MetricsRegistry:
 
     def get_gauge(self, name: str, labels: Optional[dict] = None):
         return self._gauges.get((name, _labels_key(labels)))
+
+    def get_histogram(self, name: str,
+                      labels: Optional[dict] = None) -> Optional[dict]:
+        """Histogram state snapshot for one series (test/introspection):
+        {count, sum, min, max, bounds, buckets (non-cumulative),
+        exemplars}; None when the series does not exist."""
+        with self._lock:
+            h = self._hist.get((name, _labels_key(labels)))
+            if h is None:
+                return None
+            out = dict(h)
+            out["buckets"] = list(h["buckets"])
+            out["exemplars"] = list(h["exemplars"])
+            return out
+
+
+def _bucket_quantile(h: dict, q: float) -> float:
+    """Quantile estimate from bucket counts, linearly interpolated
+    within the landing bucket (the histogram_quantile shape); the +Inf
+    bucket clamps to the observed max."""
+    count = h["count"]
+    if not count:
+        return 0.0
+    target = q * count
+    bounds = h["bounds"]
+    cum = 0
+    for i, n in enumerate(h["buckets"]):
+        if not n:
+            continue
+        prev = cum
+        cum += n
+        if cum >= target:
+            hi = bounds[i] if i < len(bounds) else (h["max"] or 0.0)
+            lo = bounds[i - 1] if 0 < i <= len(bounds) else 0.0
+            if not math.isfinite(hi):
+                return h["max"] or lo
+            frac = (target - prev) / n
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+    return h["max"] or 0.0
 
 
 def _escape_label(v) -> str:
@@ -135,6 +303,7 @@ def _num(v: float) -> str:
 REQUEST_COUNT = "validation_request_count"
 REQUEST_DURATION = "validation_request_duration_seconds"
 MUTATION_REQUEST_COUNT = "mutation_request_count"
+MUTATION_REQUEST_DURATION = "mutation_request_duration_seconds"
 VIOLATIONS = "violations"
 AUDIT_DURATION = "audit_duration_seconds"
 AUDIT_LAST_RUN = "audit_last_run_time"
@@ -196,8 +365,8 @@ FLATTEN_OBJECTS_PER_SECOND = "flatten_objects_per_second"
 # enough to tell an accept-queue convoy from device-lane convoying
 WEBHOOK_INFLIGHT = "webhook_inflight_requests"  # gauge (per process)
 WEBHOOK_INFLIGHT_HIGHWATER = "webhook_inflight_highwater"  # gauge
-WEBHOOK_QUEUE_WAIT = "webhook_batch_queue_wait_seconds"  # summary
-WEBHOOK_BATCH_SIZE = "webhook_batch_size"  # summary
+WEBHOOK_QUEUE_WAIT = "webhook_batch_queue_wait_seconds"  # histogram
+WEBHOOK_BATCH_SIZE = "webhook_batch_size"  # histogram
 # overload protection (resilience/overload.py): the adaptive limiter's
 # current in-flight limit, the cost-aware admission queue's depth, the
 # brownout ladder level (0 = normal, 1 = optional work stale, 2 = audit
@@ -225,4 +394,24 @@ SNAPSHOT_RESYNC_SECONDS = "snapshot_resync_seconds"  # gauge
 MUTATION_BATCH = "mutation_batch_count"
 MUTATION_FALLBACK = "mutation_fallback_count"  # {reason}
 MUTATION_PATCH_OPS = "mutation_patch_ops_count"
-MUTATION_CONVERGENCE = "mutation_convergence_iterations"  # summary
+MUTATION_CONVERGENCE = "mutation_convergence_iterations"  # histogram
+# registry self-observation: labelset folds by the cardinality guard
+# (an unbounded {template}/{tenant} label set is a memory leak at
+# production churn; overflow series fold into an `other` label value)
+DROPPED_LABELS = "metrics_dropped_labels_count"
+# per-template cost attribution (observability/costattr.py): device
+# dispatch / host flatten / exact-render wall seconds apportioned across
+# the constraint grid {template, enforcement_point, phase} — "which
+# policy is expensive" as a query (served at /debug/cost, summarized by
+# `gator bench --attribution`)
+CONSTRAINT_EVAL = "constraint_eval_seconds"
+# SLO engine (observability/slo.py): declarative objectives evaluated
+# in-process — the SLI value, multi-window burn rates {objective,
+# window}, compliance gauge, and breach transitions
+SLO_SLI = "slo_sli_value"  # gauge {objective}
+SLO_BURN_RATE = "slo_burn_rate"  # gauge {objective, window}
+SLO_COMPLIANT = "slo_compliant"  # gauge {objective} (1 in-SLO)
+SLO_BREACHES = "slo_breach_count"  # {objective}
+# admission flight recorder (observability/flightrec.py): decisions
+# captured into the bounded ring (served at /debug/decisions)
+FLIGHTREC_DECISIONS = "flightrec_decisions_recorded_count"  # {decision}

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import copy
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -181,10 +182,22 @@ class MutationLane:
         parallel list of Namespace objects (or None)."""
         from gatekeeper_tpu.observability import tracing
 
+        from gatekeeper_tpu.observability import costattr
+
         with tracing.span("mutlane.apply", n=len(objects),
                           source=source) as sp:
+            t0 = time.perf_counter()
+            occ: dict = {}
             outcomes = self._mutate_impl(objects, namespaces, source,
-                                         want_objects)
+                                         want_objects, occ_out=occ)
+            attr = costattr.active()
+            if attr is not None and occ:
+                # the shared lane pass splits across mutators by match
+                # occupancy (objects each mutator was relevant to)
+                attr.attribute(time.perf_counter() - t0,
+                               {k: 1.0 + v for k, v in occ.items()},
+                               costattr.EP_MUTATION,
+                               costattr.PHASE_APPLY, rows=occ)
             lanes: dict = {}
             for o in outcomes:
                 lanes[o.lane] = lanes.get(o.lane, 0) + 1
@@ -196,7 +209,7 @@ class MutationLane:
         return outcomes
 
     def _mutate_impl(self, objects, namespaces, source,
-                     want_objects) -> list:
+                     want_objects, occ_out: Optional[dict] = None) -> list:
         import numpy as np
 
         from gatekeeper_tpu.resilience.faults import fault_point
@@ -248,6 +261,11 @@ class MutationLane:
                                                source)
                 except Exception:
                     raised[oi] = True
+        if occ_out is not None:
+            for mi, m in enumerate(c.lowered):
+                occ_out[str(m.id)] = int(lmatch[mi].sum())
+            for hi, b in enumerate(c.host_only):
+                occ_out[str(b.id)] = int(hmatch[hi].sum())
 
         rel = lmatch & rel_grid
         # lazy error split: the err program only runs for mutators that

@@ -187,26 +187,68 @@ class BatchedMutationHandler:
     # --- the handler -------------------------------------------------------
     def handle(self, review_body: dict,
                cost_hint: int = 0) -> MutationResponse:
+        import time as _t
+
         from gatekeeper_tpu.observability import tracing
 
         uid = ((review_body.get("request") or {}).get("uid", "")) or ""
+        t0 = _t.perf_counter()
         with tracing.span("webhook.mutate", uid=uid):
             if self.metrics is not None:
                 from gatekeeper_tpu.metrics import registry as M
 
                 self.metrics.inc_counter(M.MUTATION_REQUEST_COUNT)
-            if self.overload is not None:
-                from gatekeeper_tpu.resilience.overload import (
-                    Shed, estimate_cost)
+            cost = 0.0
+            try:
+                if self.overload is not None:
+                    from gatekeeper_tpu.resilience.overload import (
+                        Shed, estimate_cost)
 
-                try:
-                    cost = estimate_cost(review_body, cost_hint,
-                                         self._mutator_estimate)
-                    with self.overload.admit(cost):
-                        return self._handle(review_body)
-                except Shed as shed:
-                    return self._shed_response(review_body, shed)
-            return self._handle(review_body)
+                    try:
+                        cost = estimate_cost(review_body, cost_hint,
+                                             self._mutator_estimate)
+                        with self.overload.admit(cost):
+                            resp = self._handle(review_body)
+                    except Shed as shed:
+                        resp = self._shed_response(review_body, shed)
+                        self._record_decision(review_body, resp, cost,
+                                              shed_reason=shed.reason)
+                        return resp
+                else:
+                    resp = self._handle(review_body)
+            finally:
+                if self.metrics is not None:
+                    self.metrics.observe(M.MUTATION_REQUEST_DURATION,
+                                         _t.perf_counter() - t0)
+            self._record_decision(review_body, resp, cost)
+            return resp
+
+    def _record_decision(self, review_body: dict, resp,
+                         cost: float = 0.0, shed_reason: str = "") -> None:
+        from gatekeeper_tpu.observability import flightrec
+
+        rec = flightrec.active()
+        if rec is None:
+            return
+        req = review_body.get("request") or {}
+        decision = "shed" if shed_reason else (
+            "allow" if resp.allowed else "deny")
+        if not shed_reason and resp.message:
+            decision = "error"  # mutate errors answer allowed + message
+        rec.record(
+            "mutate", decision,
+            uid=resp.uid or req.get("uid", "") or "",
+            obj_kind=(req.get("kind") or {}).get("kind", ""),
+            name=req.get("name", "") or "",
+            namespace=req.get("namespace", "") or "",
+            operation=req.get("operation", "") or "",
+            message=resp.message,
+            cost=cost,
+            reason=shed_reason,
+            lane=getattr(resp, "lane", "") or "",
+            patch_ops=len(resp.patch or []) if resp.patch else 0,
+            overload=self.overload,
+        )
 
     def _shed_response(self, review_body, shed) -> MutationResponse:
         uid = ((review_body.get("request") or {}).get("uid", "")) or ""
@@ -256,5 +298,7 @@ class BatchedMutationHandler:
         if outcome.error is not None:
             return MutationResponse(allowed=True, message=outcome.error,
                                     uid=req.uid)
-        return MutationResponse(allowed=True, patch=outcome.patch,
+        resp = MutationResponse(allowed=True, patch=outcome.patch,
                                 uid=req.uid)
+        resp.lane = outcome.lane  # flight-recorder context (non-wire)
+        return resp

@@ -1,0 +1,186 @@
+"""Per-template / per-constraint cost attribution.
+
+"Which policy makes admission slow" should be a query, not a guess.  The
+batched lanes deliberately evaluate MANY templates in one fused device
+pass (`device.query_batch`, `device.sweep_dispatch`), so no single
+template ever owns a span — this module apportions each shared pass's
+wall time across the constraint grid:
+
+- **dispatch/query time** splits by *row occupancy*: the number of
+  (constraint, object) cells of each template's match mask that were
+  actually live in the pass (a template matching every Pod in a 10k-row
+  chunk carries more of the pass than one matching three ConfigMaps).
+- **flatten/columnize time** splits across the templates whose schemas
+  the union flatten served, weighted by constraint count (columns are
+  schema-driven; rows are shared).
+- **render time** (the exact-interpreter message rendering of device
+  hits) is attributed *exactly* — each render call is timed and charged
+  to its constraint's template.
+
+Every apportionment distributes the measured wall time completely, so
+per-template `gatekeeper_constraint_eval_seconds` sums reproduce the
+parent span's wall time (the closure property the tests assert) and the
+top entry of ``/debug/cost`` is the template to go look at.
+
+Activation mirrors ``resilience/faults.py``: :func:`install` is the
+process-global switch, :func:`activate` the scoped test variant,
+:func:`active` the hot-path read (one global list read when off).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+# enforcement points (metric label values)
+EP_WEBHOOK = "webhook"
+EP_AUDIT = "audit"
+EP_MUTATION = "mutation"
+
+# phases (metric label values)
+PHASE_DISPATCH = "dispatch"
+PHASE_FLATTEN = "flatten"
+PHASE_RENDER = "render"
+PHASE_APPLY = "apply"
+
+
+class CostAttribution:
+    """Accumulates apportioned wall seconds per (template,
+    enforcement_point, phase); optionally mirrors into the metrics
+    registry as `gatekeeper_constraint_eval_seconds`."""
+
+    def __init__(self, metrics=None, max_templates: int = 512):
+        self.metrics = metrics
+        self.max_templates = max_templates
+        self._lock = threading.Lock()
+        # (template, ep, phase) -> [seconds, passes, rows]
+        self._cells: dict = {}
+
+    # --- recording -----------------------------------------------------
+    def record(self, template: str, enforcement_point: str, phase: str,
+               seconds: float, rows: int = 0) -> None:
+        key = (template, enforcement_point, phase)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                if len(self._cells) >= self.max_templates * 4:
+                    key = ("other", enforcement_point, phase)
+                    cell = self._cells.get(key)
+                if cell is None:
+                    cell = self._cells[key] = [0.0, 0, 0]
+            cell[0] += seconds
+            cell[1] += 1
+            cell[2] += rows
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(
+                M.CONSTRAINT_EVAL,
+                {"template": key[0], "enforcement_point": enforcement_point,
+                 "phase": phase},
+                value=seconds)
+
+    def attribute(self, wall_s: float, weights: dict,
+                  enforcement_point: str, phase: str,
+                  rows: Optional[dict] = None) -> None:
+        """Apportion ``wall_s`` across ``weights`` ({template: weight});
+        the shares always sum to ``wall_s`` exactly (closure).  Zero or
+        empty weights fall back to an even split."""
+        if wall_s <= 0 or not weights:
+            return
+        total = float(sum(max(0.0, w) for w in weights.values()))
+        n = len(weights)
+        for template, w in weights.items():
+            share = (wall_s * max(0.0, float(w)) / total) if total > 0 \
+                else wall_s / n
+            self.record(template, enforcement_point, phase, share,
+                        rows=int((rows or {}).get(template, 0)))
+
+    # --- introspection --------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``/debug/cost`` payload: raw cells plus a per-template
+        roll-up sorted most-expensive-first."""
+        with self._lock:
+            cells = [
+                {"template": t, "enforcement_point": ep, "phase": ph,
+                 "seconds": round(s, 6), "passes": c, "rows": r}
+                for (t, ep, ph), (s, c, r) in self._cells.items()
+            ]
+        by_template: dict = {}
+        for cell in cells:
+            agg = by_template.setdefault(
+                cell["template"],
+                {"template": cell["template"], "seconds": 0.0,
+                 "passes": 0, "rows": 0, "phases": {}})
+            agg["seconds"] = round(agg["seconds"] + cell["seconds"], 6)
+            agg["passes"] += cell["passes"]
+            agg["rows"] += cell["rows"]
+            ph = agg["phases"]
+            ph[cell["phase"]] = round(
+                ph.get(cell["phase"], 0.0) + cell["seconds"], 6)
+        top = sorted(by_template.values(),
+                     key=lambda a: -a["seconds"])
+        return {"top": top, "cells": sorted(
+            cells, key=lambda c: -c["seconds"])}
+
+    def total_seconds(self, enforcement_point: Optional[str] = None,
+                      phase: Optional[str] = None) -> float:
+        """Summed attributed seconds, optionally filtered — the closure
+        check's left-hand side."""
+        with self._lock:
+            return sum(
+                s for (t, ep, ph), (s, c, r) in self._cells.items()
+                if (enforcement_point is None or ep == enforcement_point)
+                and (phase is None or ph == phase))
+
+    def table(self, limit: int = 15) -> str:
+        """Human table for ``gator bench --attribution``."""
+        snap = self.snapshot()
+        rows = snap["top"][:limit]
+        if not rows:
+            return "cost attribution: (no passes recorded)"
+        w = max([len("template")] + [len(r["template"]) for r in rows])
+        lines = [f"{'template':<{w}}  {'seconds':>9}  {'passes':>6}  "
+                 f"{'rows':>9}  phases"]
+        for r in rows:
+            phases = " ".join(
+                f"{k}={v:.3f}" for k, v in sorted(r["phases"].items()))
+            lines.append(f"{r['template']:<{w}}  {r['seconds']:>9.3f}  "
+                         f"{r['passes']:>6}  {r['rows']:>9}  {phases}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells.clear()
+
+
+# --- activation (the faults.py pattern) -----------------------------------
+
+_global: list = [None]
+
+
+def install(attr: Optional[CostAttribution]) -> None:
+    """Process-global activation (the CLI / serving entrypoint)."""
+    _global[0] = attr
+
+
+def uninstall() -> None:
+    _global[0] = None
+
+
+def active() -> Optional[CostAttribution]:
+    """The hot-path read: one global list access; None = attribution off
+    (call sites skip weight computation entirely)."""
+    return _global[0]
+
+
+@contextmanager
+def activate(attr: CostAttribution):
+    """Scoped activation for tests; restores the previous instance."""
+    prev = _global[0]
+    _global[0] = attr
+    try:
+        yield attr
+    finally:
+        _global[0] = prev

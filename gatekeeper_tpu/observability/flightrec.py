@@ -1,0 +1,176 @@
+"""Admission flight recorder: "why was THIS request shed at 14:02".
+
+A bounded structured ring of every admission / mutation / shed decision
+the serving path makes, with enough context to reconstruct the decision
+after the fact: uid, verdict, matched-template messages (truncated),
+lane, admission cost, trace id (the link into ``/debug/traces``), and
+the overload state at decision time (brownout level, in-flight limit,
+queue depth).  Served at ``/debug/decisions?uid=``; optionally mirrored
+to a JSONL file sink (the ``export/`` seam's disk shape — one line per
+decision, append-only, the operator's black box).
+
+Privacy: the recorder stores decision METADATA only — kind, name,
+namespace, uid, messages — never the object body (admission payloads
+carry Secrets).  Messages truncate at ``max_message``.
+
+Activation mirrors ``resilience/faults.py``: :func:`install` process-
+global, :func:`activate` scoped for tests, :func:`active` the hot-path
+read.  Recording is one dict build + deque append under a lock —
+nanoseconds against a millisecond admission path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 2048,
+                 sink_path: Optional[str] = None,
+                 metrics=None,
+                 wall=time.time,
+                 max_message: int = 512):
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self.metrics = metrics
+        self._wall = wall
+        self.max_message = max_message
+        self.recorded = 0
+        self._sink = None
+        self.sink_path = sink_path
+        if sink_path:
+            self._sink = open(sink_path, "a", buffering=1)  # line-buffered
+
+    # --- recording -----------------------------------------------------
+    def record(self, endpoint: str, decision: str, uid: str = "",
+               obj_kind: str = "", name: str = "", namespace: str = "",
+               operation: str = "", message: str = "", lane: str = "",
+               cost: float = 0.0, reason: str = "",
+               warnings: int = 0, code: int = 0,
+               overload=None, **extra) -> dict:
+        """One decision.  ``endpoint``: validate|mutate; ``decision``:
+        allow|deny|shed|error|deadline.  ``overload`` is the
+        OverloadController whose state gets snapshotted (or None)."""
+        from gatekeeper_tpu.observability import tracing
+
+        span = tracing.current_span()
+        entry = {
+            "ts": self._wall(),
+            "endpoint": endpoint,
+            "decision": decision,
+            "uid": uid,
+            "kind": obj_kind,
+            "name": name,
+            "namespace": namespace,
+        }
+        if operation:
+            entry["operation"] = operation
+        if message:
+            entry["message"] = message[: self.max_message]
+        if lane:
+            entry["lane"] = lane
+        if cost:
+            entry["cost"] = round(float(cost), 1)
+        if reason:
+            entry["reason"] = reason
+        if warnings:
+            entry["warnings"] = warnings
+        if code:
+            entry["code"] = code
+        if span is not None and getattr(span, "trace_id", ""):
+            entry["trace_id"] = span.trace_id
+        if overload is not None:
+            try:
+                entry["overload"] = {
+                    "brownout": overload.brownout_level(),
+                    "inflight_limit": overload.limiter.limit,
+                    "queue_depth": overload.queue_depth(),
+                }
+            except Exception:
+                pass
+        for k, v in extra.items():
+            if v not in (None, "", 0):
+                entry[k] = v
+        with self._lock:
+            self._ring.append(entry)
+            self.recorded += 1
+            sink = self._sink
+        if sink is not None:
+            try:
+                sink.write(json.dumps(entry, default=str) + "\n")
+            except Exception:
+                pass  # the recorder must never fail an admission
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(M.FLIGHTREC_DECISIONS,
+                                     {"decision": decision})
+        return entry
+
+    # --- lookup ---------------------------------------------------------
+    def by_uid(self, uid: str) -> list:
+        with self._lock:
+            return [e for e in self._ring if e.get("uid") == uid]
+
+    def decisions(self, limit: int = 100) -> list:
+        """Most recent first."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[: max(0, limit)]
+
+    def snapshot(self, uid: Optional[str] = None,
+                 limit: int = 100) -> dict:
+        """The ``/debug/decisions`` payload."""
+        with self._lock:
+            ring = list(self._ring)
+        if uid:
+            matched = [e for e in ring if e.get("uid") == uid]
+            return {"uid": uid, "recorded": self.recorded,
+                    "decisions": matched}
+        ring.reverse()
+        return {"recorded": self.recorded,
+                "capacity": self._ring.maxlen,
+                "sink": self.sink_path or "",
+                "decisions": ring[: max(0, limit)]}
+
+    def close(self) -> None:
+        with self._lock:
+            sink, self._sink = self._sink, None
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:
+                pass
+
+
+# --- activation (the faults.py pattern) -----------------------------------
+
+_global: list = [None]
+
+
+def install(rec: Optional[FlightRecorder]) -> None:
+    _global[0] = rec
+
+
+def uninstall() -> None:
+    _global[0] = None
+
+
+def active() -> Optional[FlightRecorder]:
+    return _global[0]
+
+
+@contextmanager
+def activate(rec: FlightRecorder):
+    prev = _global[0]
+    _global[0] = rec
+    try:
+        yield rec
+    finally:
+        _global[0] = prev

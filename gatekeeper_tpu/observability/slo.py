@@ -1,0 +1,358 @@
+"""In-process SLO engine: declarative objectives, multi-window burn rates.
+
+"Are we inside our latency/staleness objective" becomes a scrape (the
+``gatekeeper_slo_*`` gauges) and an endpoint (``/debug/slo``) instead of
+a dashboard-side query.  Objectives are declarative dicts (JSON-able —
+the ``--slo-config`` file format), three types:
+
+- ``latency`` — a histogram metric + a threshold: the SLI is the
+  fraction of observations answered within ``threshold`` seconds
+  (computed from the lifetime buckets, so it pairs exactly with the
+  exemplar-carrying series on ``/metrics``); ``target`` is the
+  objective (e.g. 0.99 = "99% under threshold").
+- ``ratio`` — a bad-event counter over a total counter (e.g. shed rate):
+  the SLI is the good fraction, ``target`` the floor.
+- ``staleness`` — a unix-timestamp gauge (e.g. the audit sweep's last
+  end time): the SLI is its age in seconds, ``threshold`` the ceiling.
+
+Burn rate follows the SRE-workbook shape: over a lookback window, the
+bad fraction divided by the error budget ``(1 - target)``; 1.0 burns the
+budget exactly at the objective's natural rate, 14.4 burns a 30-day
+budget in 2 days.  Each *tier* pairs a short and a long window with a
+burn threshold — a breach needs BOTH windows hot (the long window
+filters blips, the short one ends the alert quickly once recovered).
+
+Each :meth:`SLOEngine.tick` samples the registry into a bounded ring,
+evaluates every objective, exports ``gatekeeper_slo_{sli_value,
+burn_rate,compliant,breach_count}``, emits an ``slo.breach`` span on the
+enter transition, and refreshes the overload controller's pressure when
+wired (``OverloadController.set_slo_input`` — the PR 5 brownout ladder
+consumes SLO burn as one more pressure signal).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+# the default objective set: names are part of the observability
+# registry (tools/observability_registry.md, cross-checked by
+# tools/lint_observability.py) — new objectives must land there too
+DEFAULT_OBJECTIVES = [
+    {
+        "name": "admission-latency-p99",
+        "type": "latency",
+        "metric": "validation_request_duration_seconds",
+        "threshold": 0.25,
+        "target": 0.99,
+        "description": "99% of admission reviews answer within 250ms",
+    },
+    {
+        "name": "mutation-latency-p99",
+        "type": "latency",
+        "metric": "mutation_request_duration_seconds",
+        "threshold": 0.25,
+        "target": 0.99,
+        "description": "99% of mutate reviews answer within 250ms",
+    },
+    {
+        "name": "admission-shed-rate",
+        "type": "ratio",
+        "bad_metric": "validation_request_count",
+        "bad_labels": {"admission_status": "shed"},
+        "total_metric": "validation_request_count",
+        "target": 0.99,
+        "description": "at most 1% of admissions shed under overload",
+    },
+    {
+        "name": "audit-snapshot-staleness",
+        "type": "staleness",
+        "gauge": "audit_last_run_end_time",
+        "threshold": 600.0,
+        "description": "audit verdicts at most 10 minutes stale",
+    },
+]
+
+# burn-rate alert tiers: (name, short window s, long window s, burn
+# threshold) — the SRE-workbook page/ticket pair scaled to a 30d budget
+DEFAULT_TIERS = (
+    {"name": "page", "short_s": 300.0, "long_s": 3600.0, "burn": 14.4},
+    {"name": "ticket", "short_s": 1800.0, "long_s": 21600.0, "burn": 6.0},
+)
+
+
+class SLOObjective:
+    """One parsed objective (see module docstring for the dict format)."""
+
+    def __init__(self, spec: dict):
+        self.spec = dict(spec)
+        self.name = spec["name"]
+        self.type = spec.get("type", "latency")
+        if self.type not in ("latency", "ratio", "staleness"):
+            raise ValueError(f"objective {self.name!r}: unknown type "
+                             f"{self.type!r}")
+        self.description = spec.get("description", "")
+        self.target = float(spec.get("target", 0.99))
+        self.threshold = float(spec.get("threshold", 0.0))
+        self.metric = spec.get("metric", "")
+        self.labels = spec.get("labels")
+        self.bad_metric = spec.get("bad_metric", "")
+        self.bad_labels = spec.get("bad_labels")
+        self.total_metric = spec.get("total_metric", "")
+        self.total_labels = spec.get("total_labels")
+        self.gauge = spec.get("gauge", "")
+        self.budget = max(1e-9, 1.0 - self.target)
+
+    # --- cumulative (bad, total) sampling --------------------------------
+    def sample(self, metrics, wall: float):
+        """Cumulative (bad, total) counters at this instant — the ring
+        entries burn rates difference over.  Staleness objectives return
+        their instantaneous age instead (no accumulation)."""
+        if self.type == "latency":
+            h = metrics.get_histogram(self.metric, self.labels)
+            if h is None:
+                return (0.0, 0.0)
+            within = 0
+            cum = 0
+            for i, n in enumerate(h["buckets"]):
+                cum += n
+                if i < len(h["bounds"]) and \
+                        h["bounds"][i] <= self.threshold + 1e-12:
+                    within = cum
+            return (float(h["count"] - within), float(h["count"]))
+        if self.type == "ratio":
+            bad = metrics.get_counter(self.bad_metric, self.bad_labels)
+            if self.total_labels is None:
+                total = metrics.counter_total(self.total_metric)
+            else:
+                total = metrics.get_counter(self.total_metric,
+                                            self.total_labels)
+            return (float(bad), float(total))
+        # staleness: age of the gauge timestamp (gauge unset = age 0 —
+        # nothing has run yet, nothing is stale yet)
+        ts = metrics.get_gauge(self.gauge, self.labels)
+        age = max(0.0, wall - float(ts)) if ts else 0.0
+        return (age, -1.0)  # total=-1 marks "instantaneous value"
+
+
+class SLOEngine:
+    """Evaluates objectives against the metrics registry on ``tick()``.
+
+    ``clock`` is the monotonic ring clock and ``wall`` the exemplar /
+    staleness clock — injectable so tests replay exact trajectories."""
+
+    def __init__(self, metrics, objectives: Optional[Sequence] = None,
+                 tiers: Optional[Sequence] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 ring_capacity: int = 4096,
+                 brownout=None):
+        self.metrics = metrics
+        self.objectives = [
+            o if isinstance(o, SLOObjective) else SLOObjective(o)
+            for o in (objectives if objectives is not None
+                      else DEFAULT_OBJECTIVES)]
+        names = [o.name for o in self.objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names: {names}")
+        self.tiers = [dict(t) for t in (tiers or DEFAULT_TIERS)]
+        self._clock = clock
+        self._wall = wall
+        # ring of (t, {objective: (bad_cum, total_cum)}) samples
+        self._ring: deque = deque(maxlen=ring_capacity)
+        self._breached: dict = {}  # objective -> bool (edge detection)
+        self._last_eval: dict = {}
+        self._lock = threading.Lock()
+        # optional OverloadController: tick() refreshes its pressure so
+        # SLO burn feeds the brownout ladder (set_slo_input must point
+        # back at self.pressure for the signal to be consumed)
+        self.brownout = brownout
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # --- loop ------------------------------------------------------------
+    def start(self, interval_s: float = 10.0) -> "SLOEngine":
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    pass  # the SLO engine must never take the server down
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="slo-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # --- evaluation -------------------------------------------------------
+    def tick(self) -> dict:
+        """Sample + evaluate + export; returns the ``/debug/slo``
+        payload for this instant."""
+        from gatekeeper_tpu.metrics import registry as M
+        from gatekeeper_tpu.observability import tracing
+
+        now = self._clock()
+        wall = self._wall()
+        sample = {o.name: o.sample(self.metrics, wall)
+                  for o in self.objectives}
+        with self._lock:
+            self._ring.append((now, sample))
+            evals = [self._evaluate_locked(o, now, sample[o.name])
+                     for o in self.objectives]
+        for ev in evals:
+            o_name = ev["name"]
+            self.metrics.set_gauge(M.SLO_SLI, ev["sli"],
+                                   {"objective": o_name})
+            self.metrics.set_gauge(M.SLO_COMPLIANT,
+                                   1.0 if ev["compliant"] else 0.0,
+                                   {"objective": o_name})
+            for wname, rate in ev["burn"].items():
+                self.metrics.set_gauge(M.SLO_BURN_RATE, rate,
+                                       {"objective": o_name,
+                                        "window": wname})
+            was = self._breached.get(o_name, False)
+            if ev["breach"] and not was:
+                self.metrics.inc_counter(M.SLO_BREACHES,
+                                         {"objective": o_name})
+                # breach transitions land in the trace timeline too: a
+                # root span (visible without any ambient request) plus an
+                # event on whatever span is ambient
+                with tracing.span("slo.breach", objective=o_name,
+                                  sli=ev["sli"], tier=ev["breach_tier"]):
+                    pass
+                tracing.add_event("slo_breach", objective=o_name,
+                                  sli=ev["sli"])
+                try:
+                    from gatekeeper_tpu.utils.logging import log_event
+
+                    log_event("warning", "SLO burn-rate breach",
+                              event_type="slo_breach", objective=o_name,
+                              sli=ev["sli"], tier=ev["breach_tier"])
+                except Exception:
+                    pass
+            self._breached[o_name] = ev["breach"]
+        payload = {
+            "generated_at": wall,
+            "pressure": self._pressure_from(evals),
+            "tiers": self.tiers,
+            "objectives": evals,
+        }
+        with self._lock:
+            self._last_eval = payload
+        if self.brownout is not None:
+            try:
+                self.brownout.refresh_pressure()
+            except Exception:
+                pass
+        return payload
+
+    def _window_burn(self, objective: SLOObjective, now: float,
+                     window_s: float, cur) -> float:
+        """Burn rate over the trailing window: Δbad/Δtotal scaled by the
+        error budget.  Staleness objectives burn as age/threshold."""
+        bad, total = cur
+        if total < 0:  # instantaneous (staleness)
+            return (bad / objective.threshold) if objective.threshold \
+                else 0.0
+        base = None
+        older = None  # newest sample just OUTSIDE the window
+        for t, sample in self._ring:
+            if now - t <= window_s:
+                base = sample.get(objective.name)
+                break
+            older = sample.get(objective.name)
+        if base is None:
+            # tick gap wider than the window: difference against the
+            # newest pre-window sample instead of the whole lifetime
+            base = older if older is not None else (0.0, 0.0)
+        d_bad = max(0.0, bad - base[0])
+        d_total = max(0.0, total - base[1])
+        if d_total <= 0:
+            return 0.0
+        return (d_bad / d_total) / objective.budget
+
+    def _evaluate_locked(self, o: SLOObjective, now: float, cur) -> dict:
+        bad, total = cur
+        if total < 0:
+            sli = bad  # staleness: the age itself
+            compliant = sli <= o.threshold
+        elif total > 0:
+            sli = 1.0 - bad / total  # good fraction, lifetime
+            compliant = sli >= o.target
+        else:
+            sli = 1.0
+            compliant = True
+        burns: dict = {}
+        breach = False
+        breach_tier = ""
+        for tier in self.tiers:
+            bs = self._window_burn(o, now, tier["short_s"], cur)
+            bl = self._window_burn(o, now, tier["long_s"], cur)
+            burns[f"{int(tier['short_s'])}s"] = round(bs, 4)
+            burns[f"{int(tier['long_s'])}s"] = round(bl, 4)
+            if bs >= tier["burn"] and bl >= tier["burn"] and not breach:
+                breach = True
+                breach_tier = tier["name"]
+        if total < 0 and not compliant:
+            # staleness has no budget to burn down: out of objective IS
+            # the breach (age past the ceiling pages immediately)
+            breach = True
+            breach_tier = breach_tier or "page"
+        return {
+            "name": o.name,
+            "type": o.type,
+            "description": o.description,
+            "target": o.target,
+            "threshold": o.threshold,
+            "sli": round(sli, 6),
+            "compliant": compliant,
+            "burn": burns,
+            "breach": breach,
+            "breach_tier": breach_tier,
+        }
+
+    def _pressure_from(self, evals) -> float:
+        """0..1 brownout input: the hottest objective's fastest-tier burn
+        relative to that tier's threshold, capped at 1 — at 1.0 the
+        ladder sees SLO burn as a full queue would look."""
+        if not self.tiers:
+            return 0.0
+        tier = self.tiers[0]
+        wname = f"{int(tier['short_s'])}s"
+        p = 0.0
+        for ev in evals:
+            p = max(p, ev["burn"].get(wname, 0.0) / tier["burn"])
+        return min(1.0, p)
+
+    # --- consumers --------------------------------------------------------
+    def pressure(self) -> float:
+        """The brownout-ladder input (see ``_pressure_from``); reads the
+        last tick's evaluation — wire via
+        ``OverloadController.set_slo_input(engine.pressure)``."""
+        with self._lock:
+            return float(self._last_eval.get("pressure", 0.0))
+
+    def snapshot(self) -> dict:
+        """The ``/debug/slo`` payload (last tick; {} before the first)."""
+        with self._lock:
+            return dict(self._last_eval)
+
+
+def load_config(path: str) -> dict:
+    """{"objectives": [SLOObjective...], "tiers": [...] or None}."""
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return {"objectives": [SLOObjective(o) for o in doc],
+                "tiers": None}
+    return {"objectives": [SLOObjective(o)
+                           for o in doc.get("objectives", [])],
+            "tiers": doc.get("tiers") or None}

@@ -494,15 +494,21 @@ def make_kind_router(constraints):
 
 
 class _PendingSweep:
-    __slots__ = ("result", "kinds", "offsets", "by_kind", "n", "return_bits")
+    __slots__ = ("result", "kinds", "offsets", "by_kind", "n",
+                 "return_bits", "attr_weights", "attr_rows")
 
-    def __init__(self, result, kinds, offsets, by_kind, n, return_bits):
+    def __init__(self, result, kinds, offsets, by_kind, n, return_bits,
+                 attr_weights=None, attr_rows=None):
         self.result = result
         self.kinds = kinds
         self.offsets = offsets
         self.by_kind = by_kind
         self.n = n
         self.return_bits = return_bits
+        # per-template dispatch-share weights (mask row occupancy),
+        # computed only while cost attribution is installed
+        self.attr_weights = attr_weights
+        self.attr_rows = attr_rows
 
 
 class _FlatChunk:
@@ -877,6 +883,16 @@ class ShardedEvaluator:
         self._perf_add("flatten", dt)
         for k, v in fl.perf.items():  # sub-phases of the flatten above
             self._perf_add("fl_" + k, v)
+        from gatekeeper_tpu.observability import costattr
+
+        attr = costattr.active()
+        if attr is not None:
+            # flatten/columnize time splits across the templates whose
+            # union schema the flatten served, by constraint count (the
+            # rows are shared; the columns are schema-driven)
+            attr.attribute(
+                dt, {k: float(len(by_kind[k])) for k in lowered},
+                costattr.EP_AUDIT, costattr.PHASE_FLATTEN)
         if self.metrics is not None:
             from gatekeeper_tpu.metrics import registry as M
 
@@ -908,11 +924,23 @@ class ShardedEvaluator:
         :meth:`sweep_flatten`'s output; {} passes through (empty submit)."""
         if not isinstance(flat, _FlatChunk):
             return flat if isinstance(flat, dict) else {}
-        from gatekeeper_tpu.observability import tracing
+        from gatekeeper_tpu.observability import costattr, tracing
 
+        t0 = time.perf_counter()
         with tracing.span("device.sweep_dispatch", n=flat.n,
                           kinds=len(flat.kinds)):
-            return self._sweep_dispatch_impl(flat)
+            pending = self._sweep_dispatch_impl(flat)
+        attr = costattr.active()
+        if attr is not None and isinstance(pending, _PendingSweep) \
+                and pending.attr_weights:
+            # the whole fused pass's wall time apportioned by mask row
+            # occupancy — per-template shares sum back to the parent
+            # span's wall time (the closure the tests assert)
+            attr.attribute(time.perf_counter() - t0,
+                           pending.attr_weights,
+                           costattr.EP_AUDIT, costattr.PHASE_DISPATCH,
+                           rows=pending.attr_rows)
+        return pending
 
     def _sweep_dispatch_impl(self, flat):
         from gatekeeper_tpu.resilience.faults import fault_point
@@ -949,6 +977,16 @@ class ShardedEvaluator:
             offsets[kind] = (c_off, c_off + len(cons))
             c_off += len(cons)
         self._perf_add("masks", time.perf_counter() - t0)
+        from gatekeeper_tpu.observability import costattr
+
+        attr_weights = attr_rows = None
+        if costattr.active() is not None:
+            # row occupancy per template: live (constraint, object) mask
+            # cells — the dispatch-share weight.  +1 keeps an all-masked
+            # template visible (it still pays fixed per-template cost).
+            attr_rows = {k: int(np.asarray(m).sum())
+                         for k, m in zip(kinds, mask_rows)}
+            attr_weights = {k: 1.0 + r for k, r in attr_rows.items()}
         table_cols: dict = {}
         for kind in kinds:
             for tk, tv in vocab_tables(
@@ -1002,7 +1040,9 @@ class ShardedEvaluator:
             tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev
         )
         self._perf_add("dispatch", time.perf_counter() - t0)
-        return _PendingSweep(result, kinds, offsets, by_kind, n, return_bits)
+        return _PendingSweep(result, kinds, offsets, by_kind, n,
+                             return_bits, attr_weights=attr_weights,
+                             attr_rows=attr_rows)
 
     def sweep_collect(self, pending):
         """Fetch + unpack a submitted sweep (the single device->host

@@ -233,6 +233,12 @@ class OverloadController:
         self._queue_cost = 0.0
         self._brownout = 0
         self.shed_count = 0  # total sheds (tests/introspection)
+        # optional SLO-burn pressure input (observability/slo.py): a
+        # callable -> 0..1 folded into the brownout fill alongside queue
+        # pressure, so a burning latency objective can brown out optional
+        # work BEFORE the queue itself backs up.  None (the default)
+        # keeps the PR 5 behavior bit-identical.
+        self._slo_input = None
 
     # --- admission -------------------------------------------------------
     @contextmanager
@@ -312,6 +318,11 @@ class OverloadController:
             fill = max(fill, self._queue_len / c.queue_depth)
         if c.queue_cost > 0:
             fill = max(fill, self._queue_cost / c.queue_cost)
+        if self._slo_input is not None:
+            try:
+                fill = max(fill, min(1.0, float(self._slo_input())))
+            except Exception:
+                pass  # the SLO engine must never break admission
         lvl = self._brownout
         if fill >= c.brownout2_enter or \
                 (lvl >= 2 and fill > c.brownout2_exit):
@@ -338,6 +349,21 @@ class OverloadController:
 
             self.metrics.set_gauge(M.OVERLOAD_QUEUE_DEPTH, self._queue_len)
             self.metrics.set_gauge(M.OVERLOAD_BROWNOUT, self._brownout)
+
+    def set_slo_input(self, fn) -> None:
+        """Wire an SLO-burn pressure source (callable -> 0..1, e.g.
+        ``SLOEngine.pressure``); None disconnects."""
+        with self._cv:
+            self._slo_input = fn
+            self._pressure_locked()
+
+    def refresh_pressure(self) -> int:
+        """Recompute the brownout level outside a queue event (the SLO
+        engine calls this each tick so burn changes move the ladder even
+        while the queue is idle).  Returns the level."""
+        with self._cv:
+            self._pressure_locked()
+            return self._brownout
 
     def brownout_level(self) -> int:
         with self._cv:

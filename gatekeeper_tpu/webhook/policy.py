@@ -135,6 +135,7 @@ class ValidationHandler:
     # --- the handler (reference: validationHandler.Handle, policy.go:139) -
     def handle(self, review_body: dict,
                cost_hint: int = 0) -> ValidationResponse:
+        cost = 0.0
         if self.overload is not None:
             from gatekeeper_tpu.resilience.overload import (Shed,
                                                             estimate_cost)
@@ -143,10 +144,51 @@ class ValidationHandler:
                 cost = estimate_cost(review_body, cost_hint,
                                      self._constraint_estimate)
                 with self.overload.admit(cost):
-                    return self._counted(review_body)
+                    resp = self._counted(review_body)
             except Shed as shed:
-                return self._shed_response(review_body, shed)
-        return self._counted(review_body)
+                resp = self._shed_response(review_body, shed)
+                self._record_decision(review_body, resp, cost,
+                                      shed_reason=shed.reason)
+                return resp
+        else:
+            resp = self._counted(review_body)
+        self._record_decision(review_body, resp, cost)
+        return resp
+
+    def _record_decision(self, review_body: dict, resp,
+                         cost: float = 0.0, shed_reason: str = "") -> None:
+        """Flight-recorder seam: one structured entry per decision (a
+        no-op without an installed recorder)."""
+        from gatekeeper_tpu.observability import flightrec
+
+        rec = flightrec.active()
+        if rec is None:
+            return
+        req = review_body.get("request") or {}
+        if shed_reason:
+            decision = "shed"
+        elif resp.allowed:
+            decision = "allow"
+        elif resp.code == 500:
+            decision = "error"
+        elif resp.code == 504:
+            decision = "deadline"
+        else:
+            decision = "deny"
+        rec.record(
+            "validate", decision,
+            uid=resp.uid or req.get("uid", "") or "",
+            obj_kind=(req.get("kind") or {}).get("kind", ""),
+            name=req.get("name", "") or "",
+            namespace=req.get("namespace", "") or "",
+            operation=req.get("operation", "") or "",
+            message=resp.message,
+            cost=cost,
+            reason=shed_reason,
+            warnings=len(resp.warnings or []),
+            code=resp.code if not resp.allowed else 0,
+            overload=self.overload,
+        )
 
     def _counted(self, review_body: dict) -> ValidationResponse:
         if self.metrics is None:

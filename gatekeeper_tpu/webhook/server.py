@@ -26,6 +26,9 @@ HEALTH_PATH = "/healthz"
 METRICS_PATH = "/metrics"
 PROFILE_PATH = "/debug/profile"
 TRACES_PATH = "/debug/traces"
+COST_PATH = "/debug/cost"
+SLO_PATH = "/debug/slo"
+DECISIONS_PATH = "/debug/decisions"
 
 
 def admission_response(uid: str, allowed: bool, message: str = "",
@@ -68,6 +71,9 @@ class WebhookServer:
         backlog: int = 128,  # --webhook-backlog: kernel accept queue
         batcher=None,  # Batcher to drain inside stop() (zero-loss shutdown)
         mutation_batcher=None,  # MutationBatcher, drained the same way
+        cost_attribution=None,  # CostAttribution for /debug/cost
+        slo_engine=None,  # SLOEngine for /debug/slo
+        flight_recorder=None,  # FlightRecorder for /debug/decisions
     ):
         self.validation_handler = validation_handler
         self.mutation_handler = mutation_handler
@@ -78,6 +84,12 @@ class WebhookServer:
         self.enable_profile = enable_profile
         self.batcher = batcher
         self.mutation_batcher = mutation_batcher
+        # the observability debug surface next to /metrics: explicit
+        # instances win; None falls back to the process-global actives
+        # (the install() pattern every observability piece shares)
+        self._cost_attribution = cost_attribution
+        self._slo_engine = slo_engine
+        self._flight_recorder = flight_recorder
         # graceful drain (resilience/overload.DrainCoordinator drives the
         # process view; this event is the server-local view): once set,
         # /healthz answers 503 {"draining": true} so the LB pulls this
@@ -157,11 +169,66 @@ class WebhookServer:
                                                    "(run with --trace)"})
                     else:
                         self._reply(200, tracer.snapshot())
+                elif self.path == COST_PATH:
+                    # per-template cost attribution roll-up: "which
+                    # policy is expensive" (observability/costattr.py)
+                    from gatekeeper_tpu.observability import costattr
+
+                    attr = outer._cost_attribution or costattr.active()
+                    if attr is None:
+                        self._reply(404, {"error": "cost attribution not "
+                                                   "enabled (run with "
+                                                   "--cost-attribution on)"})
+                    else:
+                        self._reply(200, attr.snapshot())
+                elif self.path == SLO_PATH:
+                    # the SLO engine's last evaluation: objectives, SLI
+                    # values, multi-window burn rates, breach state
+                    eng = outer._slo_engine
+                    if eng is None:
+                        self._reply(404, {"error": "SLO engine not "
+                                                   "enabled (run with "
+                                                   "--slo on)"})
+                    else:
+                        snap = eng.snapshot() or eng.tick()
+                        self._reply(200, snap)
+                elif self.path.startswith(DECISIONS_PATH):
+                    # the admission flight recorder: every decision in
+                    # the ring, or one uid's history (?uid=)
+                    from urllib.parse import parse_qs, urlparse
+
+                    from gatekeeper_tpu.observability import flightrec
+
+                    rec = outer._flight_recorder or flightrec.active()
+                    if rec is None:
+                        self._reply(404, {"error": "flight recorder not "
+                                                   "enabled (run with "
+                                                   "--flight-recorder N)"})
+                    else:
+                        q = parse_qs(urlparse(self.path).query)
+                        uid = (q.get("uid") or [""])[0]
+                        try:
+                            limit = int((q.get("limit") or ["100"])[0])
+                        except ValueError:
+                            self._reply(400, {"error": "bad limit"})
+                            return
+                        self._reply(200, rec.snapshot(uid=uid or None,
+                                                      limit=limit))
                 elif self.path == METRICS_PATH and outer.metrics is not None:
-                    data = outer.metrics.render().encode()
+                    # content negotiation: OpenMetrics (exemplars on the
+                    # histogram buckets + # EOF) when the scraper asks
+                    # for it, the classic text format otherwise
+                    accept = self.headers.get("Accept", "") or ""
+                    om = "application/openmetrics-text" in accept
+                    data = outer.metrics.render(openmetrics=om).encode()
+                    from gatekeeper_tpu.metrics.registry import (
+                        OPENMETRICS_CONTENT_TYPE, TEXT_CONTENT_TYPE)
+
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    self.send_header(
+                        "Content-Type",
+                        OPENMETRICS_CONTENT_TYPE if om
+                        else TEXT_CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)

@@ -1,0 +1,186 @@
+"""Per-template cost attribution: apportionment closure (shares sum
+back to the measured wall), the sweep-path closure against the parent
+device.sweep_dispatch spans (the acceptance bound: within 5%), the
+webhook query_batch path, render-exact attribution, and /debug/cost."""
+
+import json
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import costattr, tracing
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.synthetic import load_library, make_cluster_objects
+from gatekeeper_tpu.webhook.server import WebhookServer
+
+
+# --- unit ------------------------------------------------------------------
+
+def test_attribute_distributes_wall_exactly():
+    a = costattr.CostAttribution()
+    a.attribute(2.0, {"A": 3.0, "B": 1.0}, "audit", "dispatch",
+                rows={"A": 300, "B": 100})
+    assert a.total_seconds() == pytest.approx(2.0)
+    top = a.snapshot()["top"]
+    assert top[0]["template"] == "A"
+    assert top[0]["seconds"] == pytest.approx(1.5)
+    assert top[1]["seconds"] == pytest.approx(0.5)
+    assert top[0]["rows"] == 300
+
+
+def test_attribute_zero_weights_fall_back_to_even_split():
+    a = costattr.CostAttribution()
+    a.attribute(1.0, {"A": 0.0, "B": 0.0}, "audit", "dispatch")
+    assert a.total_seconds() == pytest.approx(1.0)
+    by = {t["template"]: t["seconds"] for t in a.snapshot()["top"]}
+    assert by["A"] == pytest.approx(0.5)
+    assert by["B"] == pytest.approx(0.5)
+
+
+def test_record_mirrors_into_metrics():
+    m = MetricsRegistry()
+    a = costattr.CostAttribution(metrics=m)
+    a.record("K8sThing", "webhook", "dispatch", 0.25, rows=10)
+    assert m.get_counter(M.CONSTRAINT_EVAL, {
+        "template": "K8sThing", "enforcement_point": "webhook",
+        "phase": "dispatch"}) == pytest.approx(0.25)
+
+
+def test_table_renders():
+    a = costattr.CostAttribution()
+    assert "no passes" in a.table()
+    a.record("K8sX", "audit", "dispatch", 0.5, rows=3)
+    out = a.table()
+    assert "K8sX" in out and "dispatch=0.500" in out
+
+
+# --- the sweep closure (acceptance criterion) ------------------------------
+
+@pytest.fixture(scope="module")
+def library_sweep():
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[AUDIT_EP])
+    load_library(client)
+    objects = make_cluster_objects(120, seed=11)
+    mgr = AuditManager(
+        client, lister=lambda: iter(objects),
+        config=AuditConfig(chunk_size=48, exact_totals=False,
+                           pipeline="off"),
+        evaluator=ShardedEvaluator(tpu, make_mesh(),
+                                   violations_limit=20),
+    )
+    return mgr
+
+
+def test_sweep_dispatch_attribution_closes_to_span_wall(library_sweep):
+    """THE closure: per-template gatekeeper_constraint_eval_seconds
+    (phase=dispatch) summed over a library-corpus sweep reproduces the
+    parent device.sweep_dispatch spans' total wall time within 5%."""
+    mgr = library_sweep
+    mgr.audit()  # warmup compile OUTSIDE the attributed run
+    attr = costattr.CostAttribution()
+    tracer = tracing.Tracer(seed=0, ring_capacity=64)
+    with costattr.activate(attr), tracing.activate(tracer):
+        run = mgr.audit()
+    assert sum(run.total_violations.values()) > 0  # non-vacuous
+    span_wall = sum(
+        s["duration_s"]
+        for tr in tracer.traces() for s in tr["spans"]
+        if s["name"] == "device.sweep_dispatch")
+    assert span_wall > 0
+    attributed = attr.total_seconds(costattr.EP_AUDIT,
+                                    costattr.PHASE_DISPATCH)
+    assert attributed == pytest.approx(span_wall, rel=0.05)
+    # flatten and render phases attributed too (the /debug/cost view is
+    # the whole host+device story, not just dispatch)
+    assert attr.total_seconds(costattr.EP_AUDIT,
+                              costattr.PHASE_FLATTEN) > 0
+    assert attr.total_seconds(costattr.EP_AUDIT,
+                              costattr.PHASE_RENDER) > 0
+    # every top entry is a real template kind of the library
+    kinds = {c.kind for c in mgr.client.constraints()}
+    for entry in attr.snapshot()["top"]:
+        assert entry["template"] in kinds
+
+
+def test_attribution_off_adds_no_cells(library_sweep):
+    mgr = library_sweep
+    assert costattr.active() is None
+    mgr.audit()
+    # nothing installed: the sweep ran clean with no attribution seam
+    a = costattr.CostAttribution()
+    assert a.snapshot()["top"] == []
+
+
+# --- the webhook path ------------------------------------------------------
+
+def test_query_batch_attributes_webhook_ep(library_sweep):
+    from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+    from gatekeeper_tpu.target.review import AugmentedUnstructured
+
+    mgr = library_sweep
+    client = mgr.client
+    reviews = [AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
+               for o in make_cluster_objects(24, seed=3)]
+    attr = costattr.CostAttribution()
+    with costattr.activate(attr):
+        client.review_batch(reviews)
+    assert attr.total_seconds(costattr.EP_WEBHOOK) > 0
+    cells = attr.snapshot()["cells"]
+    assert any(c["enforcement_point"] == "webhook" and
+               c["phase"] == "dispatch" for c in cells)
+
+
+# --- /debug/cost -----------------------------------------------------------
+
+def test_debug_cost_endpoint():
+    attr = costattr.CostAttribution()
+    attr.record("K8sHot", "audit", "dispatch", 1.25, rows=99)
+    srv = WebhookServer(port=0, cost_attribution=attr).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/cost") as r:
+            doc = json.loads(r.read())
+        assert doc["top"][0]["template"] == "K8sHot"
+        assert doc["top"][0]["seconds"] == pytest.approx(1.25)
+    finally:
+        srv.stop()
+
+
+def test_gator_bench_attribution_table(capsys):
+    """`gator bench --attribution` prints the per-template cost table
+    (the /debug/cost view, offline)."""
+    from gatekeeper_tpu.gator.bench import run_cli
+
+    lib = "/root/repo/library/general/allowedrepos"
+    rc = run_cli(["-f", f"{lib}/template.yaml",
+                  "-f", f"{lib}/samples/constraint.yaml",
+                  "-f", f"{lib}/samples/example_disallowed.yaml",
+                  "--engine", "tpu", "-n", "2", "--attribution"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cost attribution" in out
+    assert "K8sAllowedRepos" in out
+    assert "dispatch=" in out
+
+
+def test_debug_cost_404_when_off():
+    srv = WebhookServer(port=0).start()
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/debug/cost")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        assert "cost attribution" in json.loads(e.read())["error"]
+    finally:
+        srv.stop()

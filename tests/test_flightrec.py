@@ -1,0 +1,230 @@
+"""Admission flight recorder: ring semantics, JSONL sink, the
+ValidationHandler / mutation-handler wiring (allow/deny/shed decisions
+with overload state + trace id), and /debug/decisions?uid= lookup."""
+
+import json
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import flightrec, tracing
+from gatekeeper_tpu.resilience import overload as ovl
+from gatekeeper_tpu.resilience.faults import FaultPlan, inject
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+from gatekeeper_tpu.webhook.policy import ValidationHandler
+from gatekeeper_tpu.webhook.server import WebhookServer
+
+LIB = "/root/repo/library/general"
+
+
+# --- recorder unit ---------------------------------------------------------
+
+def test_ring_bounds_and_uid_lookup():
+    rec = flightrec.FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record("validate", "allow", uid=f"u{i}")
+    assert rec.recorded == 5
+    snap = rec.snapshot()
+    assert [e["uid"] for e in snap["decisions"]] == ["u4", "u3", "u2"]
+    assert rec.by_uid("u0") == []  # evicted by the bound
+    assert rec.by_uid("u4")[0]["decision"] == "allow"
+    assert rec.snapshot(uid="u3")["decisions"][0]["uid"] == "u3"
+
+
+def test_message_truncation_and_no_object_body():
+    rec = flightrec.FlightRecorder(max_message=16)
+    rec.record("validate", "deny", uid="u", message="x" * 100,
+               obj_kind="Pod", name="p", namespace="ns")
+    e = rec.by_uid("u")[0]
+    assert len(e["message"]) == 16
+    assert "object" not in e  # metadata only, never the body
+
+
+def test_jsonl_sink(tmp_path):
+    path = tmp_path / "decisions.jsonl"
+    rec = flightrec.FlightRecorder(capacity=8, sink_path=str(path))
+    rec.record("validate", "allow", uid="a")
+    rec.record("mutate", "shed", uid="b", reason="queue_full")
+    rec.close()
+    lines = [json.loads(ln) for ln in
+             path.read_text().strip().splitlines()]
+    assert [e["uid"] for e in lines] == ["a", "b"]
+    assert lines[1]["reason"] == "queue_full"
+
+
+def test_trace_id_and_overload_state_captured():
+    ctl = ovl.OverloadController(ovl.OverloadConfig())
+    rec = flightrec.FlightRecorder()
+    tracer = tracing.Tracer(seed=0)
+    with tracing.activate(tracer):
+        with tracing.span("webhook.request") as sp:
+            rec.record("validate", "shed", uid="u", reason="chaos",
+                       overload=ctl)
+            tid = sp.trace_id
+    e = rec.by_uid("u")[0]
+    assert e["trace_id"] == tid
+    assert e["overload"]["brownout"] == 0
+    assert e["overload"]["inflight_limit"] >= 1
+
+
+def test_metrics_counter():
+    m = MetricsRegistry()
+    rec = flightrec.FlightRecorder(metrics=m)
+    rec.record("validate", "allow")
+    rec.record("validate", "deny")
+    rec.record("validate", "deny")
+    assert m.get_counter(M.FLIGHTREC_DECISIONS,
+                         {"decision": "deny"}) == 2
+
+
+# --- handler wiring --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def handler_client():
+    client = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                    enforcement_points=["validation.gatekeeper.sh"])
+    client.add_template(load_yaml_file(
+        f"{LIB}/requiredlabels/template.yaml")[0])
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "ns-must-have-gk"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Namespace"]}]},
+                 "parameters": {"labels": [{"key": "gatekeeper"}]}},
+    })
+    return client
+
+
+def _body(uid, labeled):
+    meta = {"name": "n"}
+    if labeled:
+        meta["labels"] = {"gatekeeper": "yes"}
+    return {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": uid, "operation": "CREATE",
+            "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+            "name": "n", "namespace": "",
+            "userInfo": {"username": "alice"},
+            "object": {"apiVersion": "v1", "kind": "Namespace",
+                       "metadata": meta},
+        },
+    }
+
+
+def test_validation_decisions_recorded(handler_client):
+    rec = flightrec.FlightRecorder()
+    h = ValidationHandler(handler_client)
+    with flightrec.activate(rec):
+        h.handle(_body("ok-1", labeled=True))
+        h.handle(_body("bad-1", labeled=False))
+    allow = rec.by_uid("ok-1")[0]
+    deny = rec.by_uid("bad-1")[0]
+    assert allow["decision"] == "allow" and allow["kind"] == "Namespace"
+    assert deny["decision"] == "deny" and deny["code"] == 403
+    assert "you must provide labels" in deny["message"]
+
+
+def test_shed_decision_recorded_with_overload_state(handler_client):
+    """The "why was THIS request shed at 14:02" answer: a chaos-forced
+    shed lands in the recorder with its reason, cost, and the overload
+    state at decision time."""
+    rec = flightrec.FlightRecorder()
+    ctl = ovl.OverloadController(ovl.OverloadConfig())
+    h = ValidationHandler(handler_client, overload=ctl,
+                          failure_policy="fail")
+    plan = FaultPlan([{"site": "webhook.overload", "mode": "error",
+                       "times": 1}])
+    with flightrec.activate(rec), inject(plan), ovl.activate(ctl):
+        shed = h.handle(_body("shed-1", labeled=True))
+        ok = h.handle(_body("ok-2", labeled=True))
+    assert shed.code == 429 and ok.allowed
+    e = rec.by_uid("shed-1")[0]
+    assert e["decision"] == "shed"
+    assert e["reason"] == "chaos"
+    assert e["cost"] > 0
+    assert e["overload"]["inflight_limit"] >= 1
+    assert rec.by_uid("ok-2")[0]["decision"] == "allow"
+
+
+def test_mutate_decision_recorded():
+    from gatekeeper_tpu.mutation.system import MutationSystem
+    from gatekeeper_tpu.mutlane import BatchedMutationHandler
+
+    system = MutationSystem()
+    system.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1",
+        "kind": "Assign",
+        "metadata": {"name": "set-policy"},
+        "spec": {
+            "applyTo": [{"groups": [""], "versions": ["v1"],
+                         "kinds": ["Pod"]}],
+            "location": "spec.priorityClassName",
+            "parameters": {"assign": {"value": "low"}},
+        },
+    })
+    m = MetricsRegistry()
+    h = BatchedMutationHandler(system, metrics=m)
+    rec = flightrec.FlightRecorder()
+    body = {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": "mu-1", "operation": "CREATE",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": "p", "namespace": "default",
+            "object": {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "p",
+                                    "namespace": "default"},
+                       "spec": {"containers": [
+                           {"name": "c", "image": "i"}]}},
+        },
+    }
+    with flightrec.activate(rec):
+        resp = h.handle(body)
+    assert resp.allowed and resp.patch
+    e = rec.by_uid("mu-1")[0]
+    assert e["endpoint"] == "mutate"
+    assert e["decision"] == "allow"
+    assert e["lane"] in ("device", "solo", "host")
+    assert e["patch_ops"] == len(resp.patch)
+    # the new mutate-latency histogram observed the request
+    assert m.get_histogram(M.MUTATION_REQUEST_DURATION)["count"] == 1
+
+
+# --- /debug/decisions ------------------------------------------------------
+
+def test_debug_decisions_endpoint():
+    rec = flightrec.FlightRecorder()
+    rec.record("validate", "shed", uid="target-uid", reason="queue_full")
+    rec.record("validate", "allow", uid="other")
+    srv = WebhookServer(port=0, flight_recorder=rec).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/debug/decisions"
+        with urllib.request.urlopen(base) as r:
+            doc = json.loads(r.read())
+        assert doc["recorded"] == 2
+        assert len(doc["decisions"]) == 2
+        with urllib.request.urlopen(f"{base}?uid=target-uid") as r:
+            doc = json.loads(r.read())
+        assert len(doc["decisions"]) == 1
+        assert doc["decisions"][0]["reason"] == "queue_full"
+    finally:
+        srv.stop()
+
+
+def test_debug_decisions_404_when_off():
+    srv = WebhookServer(port=0).start()
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/decisions")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.stop()

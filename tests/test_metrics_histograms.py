@@ -1,0 +1,195 @@
+"""Exposition-format coverage for the bucketed-histogram registry:
+reservoir-bias fix (quantiles and sum/count describe the same lifetime
+population), exemplars linking buckets to trace ids, OpenMetrics
+content-type negotiation on /metrics, and the label-cardinality guard."""
+
+import json
+import urllib.request
+
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import (COUNT_BUCKETS,
+                                             DURATION_BUCKETS,
+                                             MetricsRegistry, PREFIX)
+from gatekeeper_tpu.observability import tracing
+from gatekeeper_tpu.webhook.server import WebhookServer
+
+
+# --- bucketed histograms ---------------------------------------------------
+
+def test_lifetime_buckets_replace_the_reservoir_window():
+    """The reservoir bias: the old summary computed quantiles over a
+    deque(maxlen=4096) window while sum/count were lifetime — a late
+    burst dominated the quantiles while count said otherwise.  Buckets
+    are lifetime like the sums: 9000 fast observations outweigh a late
+    100-observation slow burst at P50 AND the +Inf cumulative equals
+    count."""
+    reg = MetricsRegistry()
+    for _ in range(9000):
+        reg.observe("lat_seconds", 0.001)
+    for _ in range(100):
+        reg.observe("lat_seconds", 9.0)
+    h = reg.get_histogram("lat_seconds")
+    assert h["count"] == 9100
+    assert sum(h["buckets"]) == 9100  # buckets ARE the population
+    lines = reg.render().splitlines()
+    inf_line = next(ln for ln in lines
+                    if ln.startswith(f'{PREFIX}lat_seconds_bucket')
+                    and 'le="+Inf"' in ln)
+    assert inf_line.endswith(" 9100")
+    # the compat quantile shim reads the lifetime distribution: P50 is
+    # in the fast decade, not the late slow burst's
+    p50 = next(float(ln.rsplit(" ", 1)[1]) for ln in lines
+               if ln.startswith(f'{PREFIX}lat_seconds{{quantile="0.5"}}'))
+    assert p50 <= 0.005
+    # and P99.. the slow tail is still visible at the right rank: 100 of
+    # 9100 is ~1.1%, so P99 lands at the fast/slow boundary or above
+    p99 = next(float(ln.rsplit(" ", 1)[1]) for ln in lines
+               if ln.startswith(f'{PREFIX}lat_seconds{{quantile="0.99"}}'))
+    assert p99 >= 0.001
+
+
+def test_bucket_bounds_by_name_and_override():
+    reg = MetricsRegistry()
+    reg.observe("x_seconds", 0.01)
+    reg.observe("batch_size", 7)
+    assert reg.get_histogram("x_seconds")["bounds"] == DURATION_BUCKETS
+    assert reg.get_histogram("batch_size")["bounds"] == COUNT_BUCKETS
+    reg.set_buckets("depth", (5.0, 50.0))
+    reg.observe("depth", 7)
+    h = reg.get_histogram("depth")
+    assert h["bounds"] == (5.0, 50.0)
+    assert h["buckets"] == [0, 1, 0]  # (<=5, <=50, +Inf)
+
+
+def test_cumulative_le_series_shape():
+    reg = MetricsRegistry()
+    for v in (0.0004, 0.002, 0.002, 7.0, 40.0):
+        reg.observe("d_seconds", v, {"p": "x"})
+    lines = [ln for ln in reg.render().splitlines()
+             if ln.startswith(f'{PREFIX}d_seconds_bucket')]
+    # le rides LAST after the user labels; counts are cumulative
+    assert lines[0].startswith(f'{PREFIX}d_seconds_bucket'
+                               f'{{p="x",le="0.0005"}} 1')
+    by_le = {ln.split('le="')[1].split('"')[0]: int(ln.rsplit(" ", 1)[1])
+             for ln in lines}
+    assert by_le["0.0025"] == 3
+    assert by_le["10"] == 4
+    assert by_le["30"] == 4
+    assert by_le["+Inf"] == 5
+
+
+# --- exemplars -------------------------------------------------------------
+
+def test_exemplars_carry_the_ambient_trace_id():
+    reg = MetricsRegistry()
+    tracer = tracing.Tracer(seed=7)
+    with tracing.activate(tracer):
+        with tracing.span("req") as sp:
+            reg.observe("lat_seconds", 0.03)
+            tid = sp.trace_id
+    h = reg.get_histogram("lat_seconds")
+    exemplars = [e for e in h["exemplars"] if e is not None]
+    assert len(exemplars) == 1
+    assert exemplars[0][0] == tid
+    assert exemplars[0][1] == 0.03
+    # exemplars render ONLY in the OpenMetrics flavor
+    om = reg.render(openmetrics=True)
+    assert f'# {{trace_id="{tid}"}} 0.03' in om
+    assert om.rstrip().endswith("# EOF")
+    legacy = reg.render()
+    assert "trace_id" not in legacy
+    assert "# EOF" not in legacy
+
+
+def test_no_tracer_no_exemplar():
+    reg = MetricsRegistry()
+    reg.observe("lat_seconds", 0.03)
+    h = reg.get_histogram("lat_seconds")
+    assert all(e is None for e in h["exemplars"])
+
+
+# --- label-cardinality guard ----------------------------------------------
+
+def test_label_overflow_folds_into_other():
+    reg = MetricsRegistry(max_label_sets=3)
+    for i in range(6):
+        reg.inc_counter("per_template_count", {"template": f"T{i}"})
+    # first 3 labelsets stored verbatim, the rest folded
+    for i in range(3):
+        assert reg.get_counter("per_template_count",
+                               {"template": f"T{i}"}) == 1
+    assert reg.get_counter("per_template_count",
+                           {"template": "other"}) == 3
+    assert reg.get_counter(M.DROPPED_LABELS) == 3
+    # totals survive the fold
+    assert reg.counter_total("per_template_count") == 6
+
+
+def test_cardinality_guard_is_per_metric_name_and_keeps_repeats():
+    reg = MetricsRegistry(max_label_sets=2)
+    reg.inc_counter("a_count", {"k": "x"})
+    reg.inc_counter("a_count", {"k": "y"})
+    reg.inc_counter("a_count", {"k": "x"})  # existing set: no fold
+    reg.inc_counter("b_count", {"k": "z"})  # different metric: own budget
+    assert reg.get_counter("a_count", {"k": "x"}) == 2
+    assert reg.get_counter("b_count", {"k": "z"}) == 1
+    assert reg.get_counter(M.DROPPED_LABELS) == 0
+    reg.observe("h_seconds", 1.0, {"k": "p"})
+    reg.observe("h_seconds", 1.0, {"k": "q"})
+    reg.observe("h_seconds", 1.0, {"k": "r"})  # folds
+    assert reg.get_histogram("h_seconds", {"k": "other"})["count"] == 1
+    assert reg.get_counter(M.DROPPED_LABELS) == 1
+
+
+# --- /metrics content negotiation -----------------------------------------
+
+def test_metrics_endpoint_negotiates_openmetrics():
+    reg = MetricsRegistry()
+    reg.inc_counter("requests_count")
+    tracer = tracing.Tracer(seed=0)
+    with tracing.activate(tracer):
+        with tracing.span("x"):
+            reg.observe("lat_seconds", 0.02)
+    srv = WebhookServer(metrics=reg, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(urllib.request.Request(url)) as r:
+            assert r.headers["Content-Type"] == \
+                "text/plain; version=0.0.4"
+            body = r.read().decode()
+        assert "# EOF" not in body
+        assert f"# TYPE {PREFIX}lat_seconds histogram" in body
+        req = urllib.request.Request(url, headers={
+            "Accept": "application/openmetrics-text; version=1.0.0"})
+        with urllib.request.urlopen(req) as r:
+            assert r.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            om = r.read().decode()
+        assert om.rstrip().endswith("# EOF")
+        assert "trace_id=" in om  # the exemplar made it to the wire
+    finally:
+        srv.stop()
+
+
+def test_openmetrics_escapes_label_values_in_exemplars():
+    reg = MetricsRegistry()
+    reg.inc_counter("errs_count", {"msg": 'say "hi"\nback\\slash'})
+    om = reg.render(openmetrics=True)
+    line = next(ln for ln in om.splitlines()
+                if ln.startswith(f"{PREFIX}errs_count"))
+    assert '\\"hi\\"' in line and "\nback" not in line
+
+
+def test_render_parses_as_name_labels_value():
+    """Every sample line keeps the NAME{LABELS} VALUE shape (exemplar
+    suffixes only in OpenMetrics, after ' # ')."""
+    reg = MetricsRegistry()
+    reg.inc_counter("c_count", {"a": "b"})
+    reg.set_gauge("g", 2)
+    reg.observe("h_seconds", 0.1, {"x": "y"})
+    for ln in reg.render().splitlines():
+        if ln.startswith("#"):
+            continue
+        name_part, value = ln.rsplit(" ", 1)
+        assert value != ""
+        float(value)  # parses

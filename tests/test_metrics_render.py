@@ -15,7 +15,7 @@ def test_counter_gauge_summary_type_lines():
     lines = out.splitlines()
     assert f"# TYPE {PREFIX}requests_count counter" in lines
     assert f"# TYPE {PREFIX}depth gauge" in lines
-    assert f"# TYPE {PREFIX}latency_seconds summary" in lines
+    assert f"# TYPE {PREFIX}latency_seconds histogram" in lines
     # exactly ONE TYPE line per metric name, before its first sample
     assert sum(1 for ln in lines if ln.startswith("# TYPE")) == 3
     assert f'{PREFIX}requests_count{{status="allow"}} 1' in lines
@@ -24,14 +24,21 @@ def test_counter_gauge_summary_type_lines():
     assert out.endswith("\n")
 
 
-def test_summary_count_sum_and_quantile_label_ordering():
+def test_histogram_count_sum_and_quantile_label_ordering():
     reg = MetricsRegistry()
     for v in (0.1, 0.2, 0.3, 0.4, 1.0):
         reg.observe("dur_seconds", v, {"stage": "flatten"})
     lines = reg.render().splitlines()
     assert f'{PREFIX}dur_seconds_count{{stage="flatten"}} 5' in lines
     assert f'{PREFIX}dur_seconds_sum{{stage="flatten"}} 2' in lines
-    # quantile rides LAST, after the sorted user labels
+    # bucketed histogram: cumulative le series incl. +Inf
+    assert any(ln.startswith(f'{PREFIX}dur_seconds_bucket'
+                             f'{{stage="flatten",le="0.1"}} ')
+               for ln in lines), lines
+    assert f'{PREFIX}dur_seconds_bucket{{stage="flatten",le="+Inf"}} 5' \
+        in lines
+    # compat shim: the summary-era quantile series still render, LAST
+    # after the sorted user labels, now estimated from lifetime buckets
     for q in ("0.5", "0.9", "0.99"):
         assert any(
             ln.startswith(f'{PREFIX}dur_seconds{{stage="flatten",'

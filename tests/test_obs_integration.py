@@ -1,0 +1,304 @@
+"""Observability integration: (1) THE differential — attribution + SLO
+engine + flight recorder + tracer fully ON vs fully OFF is verdict- and
+patch-bit-identical over the library corpus (observability must never
+perturb enforcement); (2) the end-to-end identifiability chain — a
+deliberately slow, high-occupancy template walks from the P99 histogram
+bucket's exemplar trace id to its /debug/traces span, tops /debug/cost,
+and the burst's shed decision is explained in /debug/decisions."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import costattr, flightrec, slo, tracing
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.resilience import overload as ovl
+from gatekeeper_tpu.resilience.faults import FaultPlan, inject
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.synthetic import load_library, make_cluster_objects
+from gatekeeper_tpu.utils.unstructured import gvk_of, load_yaml_file
+from gatekeeper_tpu.webhook.policy import Batcher, ValidationHandler
+from gatekeeper_tpu.webhook.server import WebhookServer
+
+LIB = "/root/repo/library/general"
+
+
+# --- (1) the on-vs-off differential ---------------------------------------
+
+@pytest.fixture(scope="module")
+def library_setup():
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+    load_library(client)
+    objects = make_cluster_objects(90, seed=29)
+    return client, tpu, objects
+
+
+def _sweep_signature(run):
+    return (
+        dict(run.total_violations),
+        {k: [(v.message, v.kind, v.name, v.namespace,
+              v.enforcement_action) for v in vs]
+         for k, vs in run.kept.items()},
+    )
+
+
+def _admission_bodies(objects):
+    bodies = []
+    for i, obj in enumerate(objects):
+        g, v, k = gvk_of(obj)
+        bodies.append({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": f"u{i}", "operation": "CREATE",
+                "kind": {"group": g, "version": v, "kind": k},
+                "name": (obj.get("metadata") or {}).get("name", ""),
+                "namespace": (obj.get("metadata") or {}).get(
+                    "namespace", ""),
+                "userInfo": {"username": "differential"},
+                "object": obj,
+            },
+        })
+    return bodies
+
+
+def _resp_signature(resp):
+    return (resp.allowed, resp.message, resp.code, tuple(resp.warnings),
+            resp.uid)
+
+
+def test_observability_on_vs_off_bit_identical(library_setup, tmp_path):
+    """Sweep verdicts, admission responses and mutation patches with the
+    whole observability stack installed equal the bare run bit-for-bit."""
+    client, tpu, objects = library_setup
+    bodies = _admission_bodies(objects[:40])
+
+    def sweep(metrics=None):
+        mgr = AuditManager(
+            client, lister=lambda: iter(objects),
+            config=AuditConfig(chunk_size=32, exact_totals=False,
+                               pipeline="off"),
+            evaluator=ShardedEvaluator(tpu, make_mesh(),
+                                       violations_limit=20),
+            metrics=metrics,
+        )
+        return _sweep_signature(mgr.audit())
+
+    def admissions(handler):
+        return [_resp_signature(handler.handle(b)) for b in bodies]
+
+    # OFF: no tracer, no attribution, no recorder, no metrics
+    base_sweep = sweep()
+    base_adm = admissions(ValidationHandler(client))
+    assert any(not s[0] for s in base_adm)  # non-vacuous: real denies
+    assert sum(base_sweep[0].values()) > 0
+
+    # ON: everything installed — tracer (keep-all), attribution, flight
+    # recorder with a JSONL sink, metrics, SLO engine ticking mid-run
+    m = MetricsRegistry()
+    attr = costattr.CostAttribution(metrics=m)
+    rec = flightrec.FlightRecorder(
+        metrics=m, sink_path=str(tmp_path / "d.jsonl"))
+    eng = slo.SLOEngine(m)
+    tracer = tracing.Tracer(seed=0, ring_capacity=512)
+    with tracing.activate(tracer), costattr.activate(attr), \
+            flightrec.activate(rec):
+        eng.tick()
+        on_sweep = sweep(metrics=m)
+        eng.tick()
+        on_adm = admissions(ValidationHandler(client, metrics=m))
+        eng.tick()
+
+    assert on_sweep == base_sweep
+    assert on_adm == base_adm
+    # and the observability actually observed: spans kept, costs
+    # attributed, every admission decision recorded, SLOs evaluated
+    assert tracer.kept > 0
+    assert attr.total_seconds() > 0
+    assert rec.recorded == len(bodies)
+    assert eng.snapshot()["objectives"]
+
+
+def test_mutation_on_vs_off_bit_identical():
+    from gatekeeper_tpu.mutation.system import MutationSystem
+    from gatekeeper_tpu.mutlane import MutationLane
+
+    system = MutationSystem()
+    system.upsert_unstructured({
+        "apiVersion": "mutations.gatekeeper.sh/v1", "kind": "Assign",
+        "metadata": {"name": "set-policy"},
+        "spec": {"applyTo": [{"groups": [""], "versions": ["v1"],
+                              "kinds": ["Pod"]}],
+                 "location": "spec.priorityClassName",
+                 "parameters": {"assign": {"value": "low"}}},
+    })
+    objects = [o for o in make_cluster_objects(60, seed=5)
+               if o.get("kind") == "Pod"]
+    assert objects
+    lane = MutationLane(system)
+
+    def signature():
+        return [(o.changed, o.patch, o.error, o.lane)
+                for o in lane.mutate_objects(objects)]
+
+    base = signature()
+    m = MetricsRegistry()
+    attr = costattr.CostAttribution(metrics=m)
+    tracer = tracing.Tracer(seed=1)
+    with tracing.activate(tracer), costattr.activate(attr):
+        on = signature()
+    assert on == base
+    assert any(p for _c, p, _e, _l in base)  # real patches emitted
+    assert attr.total_seconds(costattr.EP_MUTATION) > 0
+
+
+# --- (2) the end-to-end identifiability chain ------------------------------
+
+def test_slow_template_identifiable_end_to_end(tmp_path):
+    """A deliberately slow admission against a high-occupancy template:
+    P99 histogram bucket -> exemplar trace id -> /debug/traces span ->
+    /debug/cost top entry -> the burst's shed decision visible in
+    /debug/decisions.  One flow through the live HTTP surface."""
+    client = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                    enforcement_points=[WEBHOOK_EP])
+    # the HOT template: K8sRequiredLabels matching every kind (no kinds
+    # matcher) — it occupies every mask cell of every request, so it
+    # must top /debug/cost.  The cold one only ever matches Pods.
+    client.add_template(load_yaml_file(
+        f"{LIB}/requiredlabels/template.yaml")[0])
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "everything-labeled"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}},
+    })
+    client.add_template(load_yaml_file(
+        f"{LIB}/containerlimits/template.yaml")[0])
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sContainerLimits",
+        "metadata": {"name": "pod-limits"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Pod"]}]},
+                 "parameters": {"cpu": "200m", "memory": "1Gi"}},
+    })
+
+    m = MetricsRegistry()
+    attr = costattr.CostAttribution(metrics=m)
+    rec = flightrec.FlightRecorder(metrics=m)
+    ctl = ovl.OverloadController(ovl.OverloadConfig(), metrics=m)
+    tracer = tracing.Tracer(seed=0, ring_capacity=256)
+    # small_batch=0: every admission takes the device grid, so webhook
+    # attribution flows through device.query_batch
+    batcher = Batcher(client, small_batch=0, metrics=m).start()
+    handler = ValidationHandler(client, batcher=batcher, metrics=m,
+                                overload=ctl, failure_policy="fail")
+    srv = WebhookServer(validation_handler=handler, metrics=m, port=0,
+                        cost_attribution=attr, slo_engine=None,
+                        flight_recorder=rec).start()
+
+    def post(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/admit",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def get(path, accept=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}",
+            headers={"Accept": accept} if accept else {})
+        with urllib.request.urlopen(req) as r:
+            return r.read().decode()
+
+    def body(uid, kind="Namespace"):
+        obj = {"apiVersion": "v1", "kind": kind,
+               "metadata": {"name": uid}}
+        if kind == "Pod":
+            obj["spec"] = {"containers": [{"name": "c", "image": "i"}]}
+        return {"apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": uid, "operation": "CREATE",
+                            "kind": {"group": "", "version": "v1",
+                                     "kind": kind},
+                            "name": uid, "namespace": "",
+                            "userInfo": {"username": "it"},
+                            "object": obj}}
+
+    # chaos: the FIRST review is slow (the P99 outlier); the 10th
+    # admission gate call sheds (the overload story to explain later)
+    plan = FaultPlan([
+        {"site": "webhook.review", "mode": "sleep", "delay_s": 0.4,
+         "times": 1},
+        {"site": "webhook.overload", "mode": "error", "after": 9,
+         "times": 1},
+    ])
+    try:
+        with tracing.activate(tracer), costattr.activate(attr), \
+                flightrec.activate(rec), ovl.activate(ctl), inject(plan):
+            out = post(body("slow-0"))
+            assert out["response"]["allowed"] is False  # missing label
+            for i in range(1, 8):
+                post(body(f"ns-{i}"))
+            post(body("pod-8", kind="Pod"))
+            shed_out = post(body("shed-9"))
+            assert shed_out["response"]["status"]["code"] == 429
+
+            # 1) the P99 bucket carries an exemplar: OpenMetrics render
+            om = get("/metrics",
+                     accept="application/openmetrics-text; version=1.0.0")
+            slow_lines = [
+                ln for ln in om.splitlines()
+                if ln.startswith("gatekeeper_validation_request_"
+                                 "duration_seconds_bucket")
+                and "trace_id=" in ln
+                and float(ln.split('le="')[1].split('"')[0]
+                          .replace("+Inf", "inf")) >= 0.4]
+            assert slow_lines, om
+            slow_tid = slow_lines[0].split('trace_id="')[1].split('"')[0]
+
+            # 2) that trace id resolves in /debug/traces, and its
+            # timeline shows WHERE the time went (webhook.review slow)
+            traces = json.loads(get("/debug/traces"))["traces"]
+            tr = next(t for t in traces if t["trace_id"] == slow_tid)
+            assert tr["duration_s"] >= 0.4
+            review = next(s for s in tr["spans"]
+                          if s["name"] == "webhook.review")
+            assert review["duration_s"] >= 0.4
+            assert next(s for s in tr["spans"]
+                        if s["name"] == "webhook.request")[
+                "attributes"]["uid"] == "slow-0"
+
+            # 3) /debug/cost: the high-occupancy template tops the table
+            cost = json.loads(get("/debug/cost"))
+            assert cost["top"][0]["template"] == "K8sRequiredLabels"
+            templates = {t["template"] for t in cost["top"]}
+            assert "K8sContainerLimits" in templates
+
+            # 4) the shed decision is explained in /debug/decisions
+            dec = json.loads(get("/debug/decisions?uid=shed-9"))
+            e = dec["decisions"][0]
+            assert e["decision"] == "shed"
+            assert e["reason"] == "chaos"
+            assert e["overload"]["inflight_limit"] >= 1
+            assert e["trace_id"]  # links back into the timeline
+            # and the slow request's decision is there too
+            slow_dec = json.loads(
+                get("/debug/decisions?uid=slow-0"))["decisions"][0]
+            assert slow_dec["decision"] == "deny"
+            assert slow_dec["trace_id"] == slow_tid
+    finally:
+        srv.stop()
+        batcher.stop()

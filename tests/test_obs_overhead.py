@@ -1,0 +1,60 @@
+"""Tier-1 observability-overhead smoke (ISSUE 8 satellite): the full
+stack (bucketed-histogram metrics + cost attribution + flight recorder
++ keep-all tracer) must cost < 3% on the serial 1-core path, webhook
+and sweep alike.
+
+Medians over interleaved bare/instrumented passes cancel drift; when
+the host itself is too noisy to resolve 3% (bare-pass spread above the
+guard), the assertion is skipped rather than turned into a coin flip —
+the full bench (tools/bench_obs_overhead.py, BENCH_TPU.json history)
+is the durable record."""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+OVERHEAD_BOUND_PCT = 3.0
+# noise_spread_pct is a median-absolute-deviation measure (robust to
+# one outlier pass); above this the median comparison itself is mush
+NOISE_GUARD_PCT = 5.0
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_obs_overhead", _TOOLS / "bench_obs_overhead.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_observability_overhead_under_bound():
+    """Best-of-3 attempts: scheduler noise only ever INFLATES a measured
+    overhead (the instrumented pass that catches a reschedule looks
+    slower), so the least-noisy attempt is the honest upper-bound
+    estimate — a real >3% regression fails all three."""
+    bench = _load_bench()
+    entries = []
+    for _ in range(3):
+        entry = bench.run(n_objects=140, passes=5, append=False)
+        # sanity: both variants really ran (non-degenerate times)
+        assert entry["webhook_bare_s"] > 0 and entry["sweep_bare_s"] > 0
+        entries.append(entry)
+        # min-of-passes overheads: scheduler noise strictly adds time,
+        # so the fastest pass per variant is the cleanest comparison
+        if entry["webhook_overhead_min_pct"] < OVERHEAD_BOUND_PCT and \
+                entry["sweep_overhead_min_pct"] < OVERHEAD_BOUND_PCT:
+            return
+    if all(e["noise_spread_pct"] > NOISE_GUARD_PCT for e in entries):
+        pytest.skip(
+            f"host too noisy to resolve {OVERHEAD_BOUND_PCT}% in 3 "
+            f"attempts (spreads "
+            f"{[e['noise_spread_pct'] for e in entries]}%); see "
+            f"tools/bench_obs_overhead.py for the durable record")
+    raise AssertionError(
+        f"observability overhead above {OVERHEAD_BOUND_PCT}% in every "
+        f"attempt: " + str([(e["webhook_overhead_min_pct"],
+                             e["sweep_overhead_min_pct"],
+                             e["noise_spread_pct"]) for e in entries]))

@@ -1,5 +1,6 @@
-"""Tier-1 gate: every fault_point site and every gatekeeper_* metric
-constant must be documented in tools/observability_registry.md."""
+"""Tier-1 gate: every fault_point site, every gatekeeper_* metric
+constant, every tracer span name and every built-in SLO objective must
+be documented in tools/observability_registry.md."""
 
 import importlib.util
 import pathlib
@@ -35,13 +36,23 @@ def test_source_scan_sees_known_sites_and_metrics():
     assert "gatekeeper_audit_pipeline_stage_busy_sum_seconds" in metrics
     # PREFIX itself is configuration, not a metric
     assert "gatekeeper_gatekeeper_" not in metrics
+    # content-type constants are strings too but not metric names
+    assert not any("openmetrics" in m for m in metrics)
+    spans = lint.span_names_in_source()
+    # the f-string pipeline span and a cross-module name must resolve
+    assert "pipeline.stage.*" in spans
+    assert "webhook.request" in spans
+    assert "device.sweep_dispatch" in spans
+    slo = lint.slo_objectives_in_source()
+    assert "admission-latency-p99" in slo
+    assert "audit-snapshot-staleness" in slo
 
 
 def test_lint_flags_undocumented_additions(tmp_path, monkeypatch):
     """An undocumented site or metric must produce a problem (the gate
     actually gates)."""
     lint = _load_lint()
-    doc_sites, doc_metrics = lint.documented()
+    doc_sites, doc_metrics, doc_spans, doc_slo = lint.documented()
 
     monkeypatch.setattr(
         lint, "fault_sites_in_source",
@@ -51,16 +62,28 @@ def test_lint_flags_undocumented_additions(tmp_path, monkeypatch):
         lint, "metric_names_in_source",
         lambda: {**{m: "OK" for m in doc_metrics},
                  "gatekeeper_rogue_count": "ROGUE"})
+    monkeypatch.setattr(
+        lint, "span_names_in_source",
+        lambda: {**{s: ["x:1"] for s in doc_spans},
+                 "rogue.span": ["gatekeeper_tpu/rogue.py:2"]})
+    monkeypatch.setattr(
+        lint, "slo_objectives_in_source",
+        lambda: {**{s: "slo.py" for s in doc_slo},
+                 "rogue-objective": "slo.py"})
     problems = lint.check()
     assert any("rogue.site" in p for p in problems)
     assert any("gatekeeper_rogue_count" in p for p in problems)
+    assert any("rogue.span" in p for p in problems)
+    assert any("rogue-objective" in p for p in problems)
 
 
 def test_lint_flags_stale_documentation(monkeypatch):
     lint = _load_lint()
-    doc_sites, doc_metrics = lint.documented()
+    doc_sites, doc_metrics, doc_spans, doc_slo = lint.documented()
     monkeypatch.setattr(
         lint, "documented",
-        lambda: (doc_sites | {"gone.site"}, doc_metrics))
+        lambda: (doc_sites | {"gone.site"}, doc_metrics,
+                 doc_spans | {"gone.span"}, doc_slo))
     problems = lint.check()
     assert any("gone.site" in p and "stale" in p for p in problems)
+    assert any("gone.span" in p and "stale" in p for p in problems)

@@ -345,6 +345,6 @@ def test_batcher_queue_wait_metrics_show_device_lane_convoy():
     assert "webhook_batch_queue_wait_seconds_count" in rendered
     assert "webhook_batch_size_count" in rendered
     # 6 requests against a 50ms serial lane: the later ones waited
-    waits = reg._hist[(M.WEBHOOK_QUEUE_WAIT, ())]
+    waits = reg.get_histogram(M.WEBHOOK_QUEUE_WAIT)
     assert waits["count"] == 6
-    assert max(waits["window"]) > 0.04
+    assert waits["max"] > 0.04

@@ -1,0 +1,194 @@
+"""SLO engine: objective parsing, SLI computation from the histogram
+buckets, multi-window burn rates on an injected clock, breach edge
+(span + counter), staleness aging, and the brownout-ladder pressure
+input."""
+
+import pytest
+
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import slo, tracing
+from gatekeeper_tpu.resilience import overload as ovl
+
+LAT = {
+    "name": "lat-p90", "type": "latency", "metric": "lat_seconds",
+    "threshold": 0.1, "target": 0.9,
+}
+TIER = [{"name": "page", "short_s": 60.0, "long_s": 300.0, "burn": 2.0}]
+
+
+def _engine(m, objectives=(LAT,), clock=None, wall=None, **kw):
+    fake = {"t": 0.0, "w": 1_000_000.0}
+    eng = slo.SLOEngine(
+        m, objectives=list(objectives), tiers=TIER,
+        clock=clock or (lambda: fake["t"]),
+        wall=wall or (lambda: fake["w"]), **kw)
+    return eng, fake
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        slo.SLOObjective({"name": "x", "type": "nope"})
+    with pytest.raises(ValueError):
+        slo.SLOEngine(MetricsRegistry(), objectives=[LAT, LAT])
+
+
+def test_latency_sli_from_buckets_and_gauges():
+    m = MetricsRegistry()
+    eng, fake = _engine(m)
+    for _ in range(9):
+        m.observe("lat_seconds", 0.01)
+    m.observe("lat_seconds", 5.0)
+    out = eng.tick()
+    ev = out["objectives"][0]
+    assert ev["sli"] == pytest.approx(0.9)
+    assert ev["compliant"] is True  # exactly at target
+    assert m.get_gauge(M.SLO_SLI, {"objective": "lat-p90"}) == \
+        pytest.approx(0.9)
+    assert m.get_gauge(M.SLO_COMPLIANT, {"objective": "lat-p90"}) == 1.0
+
+
+def test_burn_rate_windows_and_breach_edge():
+    m = MetricsRegistry()
+    eng, fake = _engine(m)
+    tracer = tracing.Tracer(seed=0)
+    with tracing.activate(tracer):
+        eng.tick()  # t=0 baseline (no data)
+        # a healthy minute
+        for _ in range(20):
+            m.observe("lat_seconds", 0.01)
+        fake["t"] = 60.0
+        out = eng.tick()
+        assert out["objectives"][0]["burn"]["60s"] == 0.0
+        assert not out["objectives"][0]["breach"]
+        # then a fully-bad minute: bad fraction 1.0 over the short
+        # window = burn 10x the 0.1 budget; the long window sees the
+        # mixed history but still far over the 2.0 tier threshold
+        for _ in range(40):
+            m.observe("lat_seconds", 3.0)
+        fake["t"] = 120.0
+        out = eng.tick()
+        ev = out["objectives"][0]
+        assert ev["burn"]["60s"] == pytest.approx(10.0)
+        assert ev["burn"]["300s"] == pytest.approx(
+            (40 / 60) / 0.1, rel=1e-3)
+        assert ev["breach"] and ev["breach_tier"] == "page"
+        assert m.get_counter(M.SLO_BREACHES,
+                             {"objective": "lat-p90"}) == 1
+        # the breach landed in the trace timeline as its own root span
+        names = [s["name"] for tr in tracer.traces()
+                 for s in tr["spans"]]
+        assert "slo.breach" in names
+        # still breached next tick: the counter counts TRANSITIONS
+        fake["t"] = 121.0
+        for _ in range(5):
+            m.observe("lat_seconds", 3.0)
+        eng.tick()
+        assert m.get_counter(M.SLO_BREACHES,
+                             {"objective": "lat-p90"}) == 1
+        # recovery: a fast-only minute ends the short-window burn
+        for _ in range(200):
+            m.observe("lat_seconds", 0.01)
+        fake["t"] = 200.0
+        out = eng.tick()
+        assert not out["objectives"][0]["breach"]
+
+
+def test_ratio_objective_shed_rate():
+    m = MetricsRegistry()
+    obj = {"name": "shed-rate", "type": "ratio",
+           "bad_metric": "validation_request_count",
+           "bad_labels": {"admission_status": "shed"},
+           "total_metric": "validation_request_count",
+           "target": 0.99}
+    eng, fake = _engine(m, objectives=[obj])
+    eng.tick()
+    for _ in range(98):
+        m.inc_counter("validation_request_count",
+                      {"admission_status": "allow"})
+    m.inc_counter("validation_request_count",
+                  {"admission_status": "shed"}, value=2)
+    fake["t"] = 60.0
+    out = eng.tick()
+    ev = out["objectives"][0]
+    assert ev["sli"] == pytest.approx(0.98)
+    assert ev["compliant"] is False
+    assert ev["burn"]["60s"] == pytest.approx(2.0)
+
+
+def test_staleness_objective_ages_a_timestamp_gauge():
+    m = MetricsRegistry()
+    obj = {"name": "stale", "type": "staleness",
+           "gauge": "audit_last_run_end_time", "threshold": 300.0}
+    eng, fake = _engine(m, objectives=[obj])
+    out = eng.tick()  # gauge unset: nothing has run, nothing is stale
+    assert out["objectives"][0]["sli"] == 0.0
+    assert out["objectives"][0]["compliant"] is True
+    m.set_gauge("audit_last_run_end_time", fake["w"] - 100.0)
+    out = eng.tick()
+    assert out["objectives"][0]["sli"] == pytest.approx(100.0)
+    assert out["objectives"][0]["compliant"] is True
+    m.set_gauge("audit_last_run_end_time", fake["w"] - 700.0)
+    out = eng.tick()
+    ev = out["objectives"][0]
+    assert ev["sli"] == pytest.approx(700.0)
+    assert not ev["compliant"]
+    assert ev["breach"]  # stale past the ceiling pages immediately
+    assert m.get_counter(M.SLO_BREACHES, {"objective": "stale"}) == 1
+
+
+def test_pressure_feeds_the_brownout_ladder():
+    """The PR 5 integration: SLO burn as a brownout input — a burning
+    latency objective browns out optional work even while the admission
+    queue itself is empty, and recovery releases the ladder."""
+    m = MetricsRegistry()
+    ctl = ovl.OverloadController(ovl.OverloadConfig())
+    eng, fake = _engine(m, brownout=ctl)
+    ctl.set_slo_input(eng.pressure)
+    eng.tick()
+    assert ctl.brownout_level() == 0
+    for _ in range(50):
+        m.observe("lat_seconds", 3.0)  # everything slow
+    fake["t"] = 60.0
+    eng.tick()  # burn 10 / tier 2.0 -> pressure 1.0 -> level 2
+    assert eng.pressure() == 1.0
+    assert ctl.brownout_level() == 2
+    # recovery: fast-only window drops pressure to 0 -> ladder releases
+    for _ in range(500):
+        m.observe("lat_seconds", 0.01)
+    fake["t"] = 130.0
+    eng.tick()
+    assert eng.pressure() == 0.0
+    assert ctl.brownout_level() == 0
+
+
+def test_default_objectives_parse_and_tick():
+    m = MetricsRegistry()
+    eng = slo.SLOEngine(m)
+    out = eng.tick()
+    names = {ev["name"] for ev in out["objectives"]}
+    assert names == {"admission-latency-p99", "mutation-latency-p99",
+                     "admission-shed-rate", "audit-snapshot-staleness"}
+    assert all(ev["compliant"] for ev in out["objectives"])
+    assert eng.snapshot()["objectives"]
+
+
+def test_load_config(tmp_path):
+    import json
+
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps({
+        "objectives": [{"name": "o1", "type": "latency",
+                        "metric": "x_seconds", "threshold": 1.0}],
+        "tiers": [{"name": "t", "short_s": 10, "long_s": 20,
+                   "burn": 3.0}],
+    }))
+    cfg = slo.load_config(str(p))
+    assert [o.name for o in cfg["objectives"]] == ["o1"]
+    assert cfg["tiers"][0]["burn"] == 3.0
+    p2 = tmp_path / "slo_list.json"
+    p2.write_text(json.dumps([{"name": "o2", "type": "latency",
+                               "metric": "y_seconds"}]))
+    cfg2 = slo.load_config(str(p2))
+    assert [o.name for o in cfg2["objectives"]] == ["o2"]
+    assert cfg2["tiers"] is None

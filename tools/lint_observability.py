@@ -8,8 +8,14 @@ registry (``tools/observability_registry.md``):
   normalized to their ``pipeline.stage.*`` pattern);
 - every metric-name constant in ``gatekeeper_tpu/metrics/registry.py``
   must be documented under its exposed ``gatekeeper_*`` name;
-- stale documentation (a documented site/metric that no longer exists
-  in the source) fails too, so the registry can be trusted.
+- every tracer span name (``span("...")`` call sites) must be
+  documented — the trace timeline is an API surface too;
+- every built-in SLO objective name
+  (``observability/slo.py:DEFAULT_OBJECTIVES``) must be documented —
+  dashboards key on ``gatekeeper_slo_*{objective=...}`` values;
+- stale documentation (a documented site/metric/span/objective that no
+  longer exists in the source) fails too, so the registry can be
+  trusted.
 
 Run standalone (``python tools/lint_observability.py``) or via tier-1
 (``tests/test_observability_lint.py``).
@@ -26,16 +32,23 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "gatekeeper_tpu"
 REGISTRY_MD = REPO / "tools" / "observability_registry.md"
 METRICS_PY = PKG / "metrics" / "registry.py"
+SLO_PY = PKG / "observability" / "slo.py"
 
 _FAULT_CALL = re.compile(r'fault_point\(\s*(f?)"([^"]+)"')
+# tracer span call sites: tracing.span("..."), otel.span("..."),
+# tracer.start_span("...") — the \s* spans a line wrap after the paren
+_SPAN_CALL = re.compile(r'\b(?:span|start_span)\(\s*(f?)"([^"]+)"')
 _DOC_ENTRY = re.compile(r"^\s*-\s+`([^`]+)`")
 _FSTRING_FIELD = re.compile(r"\{[^}]*\}")
 
 
-def documented() -> tuple[set, set]:
-    """(fault sites, metric names) parsed from the registry markdown."""
+def documented() -> tuple[set, set, set, set]:
+    """(fault sites, metric names, span names, slo objectives) parsed
+    from the registry markdown."""
     sites: set = set()
     metrics: set = set()
+    spans: set = set()
+    objectives: set = set()
     section = ""
     for line in REGISTRY_MD.read_text().splitlines():
         if line.startswith("## "):
@@ -48,7 +61,11 @@ def documented() -> tuple[set, set]:
             sites.add(m.group(1))
         elif section.startswith("metrics"):
             metrics.add(m.group(1))
-    return sites, metrics
+        elif section.startswith("spans"):
+            spans.add(m.group(1))
+        elif section.startswith("slo objectives"):
+            objectives.add(m.group(1))
+    return sites, metrics, spans, objectives
 
 
 def fault_sites_in_source() -> dict:
@@ -70,6 +87,49 @@ def fault_sites_in_source() -> dict:
     return out
 
 
+def span_names_in_source() -> dict:
+    """span name -> [file:line] for every ``span("...")`` /
+    ``start_span("...")`` literal in the package.  F-string names
+    (``pipeline.stage.{name}``) normalize their dynamic segments to
+    ``*`` patterns, like fault sites."""
+    out: dict = {}
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        for m in _SPAN_CALL.finditer(text):
+            name = m.group(2)
+            if m.group(1):
+                name = _FSTRING_FIELD.sub("*", name)
+            line = text.count("\n", 0, m.start()) + 1
+            out.setdefault(name, []).append(
+                f"{path.relative_to(REPO)}:{line}")
+    return out
+
+
+def slo_objectives_in_source() -> dict:
+    """objective name -> "slo.py" for every entry of
+    ``DEFAULT_OBJECTIVES`` (AST scan of the literal list — the names are
+    the values dashboards and the breach counter key on)."""
+    tree = ast.parse(SLO_PY.read_text())
+    out: dict = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) or \
+                target.id != "DEFAULT_OBJECTIVES":
+            continue
+        if not isinstance(node.value, ast.List):
+            continue
+        for elt in node.value.elts:
+            if not isinstance(elt, ast.Dict):
+                continue
+            for k, v in zip(elt.keys, elt.values):
+                if isinstance(k, ast.Constant) and k.value == "name" \
+                        and isinstance(v, ast.Constant):
+                    out[v.value] = str(SLO_PY.relative_to(REPO))
+    return out
+
+
 def metric_names_in_source() -> dict:
     """exposed name ('gatekeeper_' + value) -> constant name, from the
     module-level string constants of metrics/registry.py."""
@@ -87,7 +147,10 @@ def metric_names_in_source() -> dict:
                 prefix = node.value.value
             continue
         if isinstance(node.value, ast.Constant) and \
-                isinstance(node.value.value, str):
+                isinstance(node.value.value, str) and \
+                re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", node.value.value):
+            # shape filter: module constants that aren't metric names
+            # (content-type strings etc.) don't belong in the registry
             out[prefix + node.value.value] = target.id
     return out
 
@@ -95,9 +158,11 @@ def metric_names_in_source() -> dict:
 def check() -> list:
     """List of problem strings; empty means the registry is in sync."""
     problems: list = []
-    doc_sites, doc_metrics = documented()
+    doc_sites, doc_metrics, doc_spans, doc_slo = documented()
     src_sites = fault_sites_in_source()
     src_metrics = metric_names_in_source()
+    src_spans = span_names_in_source()
+    src_slo = slo_objectives_in_source()
     for site, where in sorted(src_sites.items()):
         if site not in doc_sites:
             problems.append(
@@ -117,6 +182,25 @@ def check() -> list:
         problems.append(
             f"stale documented metric {name!r} — no matching constant in "
             f"{METRICS_PY.relative_to(REPO)}; remove it from the registry")
+    for name, where in sorted(src_spans.items()):
+        if name not in doc_spans:
+            problems.append(
+                f"undocumented span name {name!r} ({where[0]}) — add it "
+                f"to {REGISTRY_MD.relative_to(REPO)}")
+    for name in sorted(doc_spans - set(src_spans)):
+        problems.append(
+            f"stale documented span name {name!r} — no span() call site "
+            "matches; remove it from the registry")
+    for name, where in sorted(src_slo.items()):
+        if name not in doc_slo:
+            problems.append(
+                f"undocumented SLO objective {name!r} ({where}) — add it "
+                f"to {REGISTRY_MD.relative_to(REPO)}")
+    for name in sorted(doc_slo - set(src_slo)):
+        problems.append(
+            f"stale documented SLO objective {name!r} — not in "
+            f"{SLO_PY.relative_to(REPO)}:DEFAULT_OBJECTIVES; remove it "
+            "from the registry")
     return problems
 
 
@@ -125,9 +209,10 @@ def main() -> int:
     for p in problems:
         print(f"lint: {p}", file=sys.stderr)
     if not problems:
-        sites, metrics = documented()
+        sites, metrics, spans, slo = documented()
         print(f"observability registry in sync: {len(sites)} fault "
-              f"sites, {len(metrics)} metrics")
+              f"sites, {len(metrics)} metrics, {len(spans)} spans, "
+              f"{len(slo)} SLO objectives")
     return 1 if problems else 0
 
 
