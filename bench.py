@@ -42,6 +42,11 @@ PIPELINE_MODE = "auto"
 # --flatten-lane=auto|dict|raw|py|differential (sweep columnizer lane;
 # auto = raw bytes through the threaded C columnizer when available)
 FLATTEN_LANE = "auto"
+# --collect=reduced|masks|differential (sweep collect lane; reduced
+# folds totals/top-k/occupancy on device and ships O(kept) bytes, masks
+# is the host-fold reference, differential runs both and asserts
+# bit-identical)
+COLLECT_LANE = "reduced"
 # --trace out.json: span-trace the timed sweeps and export a Chrome
 # trace-event file at exit (Perfetto-loadable device timeline)
 TRACE_PATH = ""
@@ -57,7 +62,7 @@ def _parse_pipeline_flag(argv: list) -> list:
     the JSON artifact); --trace installs the span tracer (seeded, full
     sampling) and writes the Chrome trace-event artifact — with --chaos
     the injected faults show up as instant events on the spans they hit."""
-    global PIPELINE_MODE, TRACE_PATH, FLATTEN_LANE
+    global PIPELINE_MODE, TRACE_PATH, FLATTEN_LANE, COLLECT_LANE
     out = []
     chaos = ""
     it = iter(argv)
@@ -70,6 +75,10 @@ def _parse_pipeline_flag(argv: list) -> list:
             FLATTEN_LANE = next(it, "auto")
         elif a.startswith("--flatten-lane="):
             FLATTEN_LANE = a.split("=", 1)[1]
+        elif a == "--collect":
+            COLLECT_LANE = next(it, "reduced")
+        elif a.startswith("--collect="):
+            COLLECT_LANE = a.split("=", 1)[1]
         elif a == "--chaos":
             chaos = next(it, "")
         elif a.startswith("--chaos="):
@@ -339,7 +348,8 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
     evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
-                                 flatten_lane=FLATTEN_LANE)
+                                 flatten_lane=FLATTEN_LANE,
+                                 collect=COLLECT_LANE)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
                       exact_totals=False, submit_window=submit_window,
                       pipeline=PIPELINE_MODE)
@@ -364,6 +374,10 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
     phases = {k: round(v, 2) for k, v in evaluator.perf.items()}
     phases.update({k: round(v, 2) for k, v in mgr.perf.items()})
     phases["wire_mb"] = round(phases.pop("wire_bytes", 0.0) / 1e6, 1)
+    # host-vs-device bytes per direction: wire_mb is H2D (packed columns
+    # + tables + masks), d2h_kb is what collect fetched back — the
+    # reduced lane's O(kept) contract shows up here
+    phases["d2h_kb"] = round(phases.pop("d2h_bytes", 0.0) / 1e3, 2)
     # sum over constraints of violating-object counts: an object violating
     # k constraints contributes k (a violation count, not distinct objects)
     violations = sum(run.total_violations.values())
@@ -398,15 +412,50 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
                                     if mgr.perf.get("pipelined")
                                     else "serial")}
     out["flatten_lane"] = FLATTEN_LANE
+    out["collect"] = COLLECT_LANE
     if mgr.pipe_stats:
         out["pipeline"].update(mgr.pipe_stats)
     if cpu_fallback:
         out["cpu_fallback"] = True
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "SWEEP1M.json"), "w") as f:
-        f.write(_json.dumps(out) + "\n")
+    sweep_history_append(out)
     export_trace()
     print(_json.dumps(out))
+
+
+def sweep_history_append(entry: dict) -> None:
+    """SWEEP1M.json keeps a run history like BENCH_TPU.json: every run
+    appends (with its collect/flatten lanes and both transfer-direction
+    byte counts), the top-level headline only moves for real-TPU runs —
+    CPU-fallback measurements on the bench host must not overwrite the
+    per-chip record."""
+    import json as _json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SWEEP1M.json")
+    try:
+        with open(path) as f:
+            doc = _json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    history = doc.pop("history", [])
+    if doc and "metric" in doc:
+        headline = doc
+    else:
+        headline = {}
+    entry = dict(entry)
+    entry["date"] = time.strftime("%Y-%m-%d")
+    history.append(entry)
+    if entry.get("platform") == "tpu" and not entry.get("cpu_fallback"):
+        headline = {k: v for k, v in entry.items() if k != "date"}
+    out_doc = dict(headline)
+    out_doc["history"] = history
+    try:
+        with open(path, "w") as f:
+            _json.dump(out_doc, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        log(f"SWEEP1M.json append failed: {e}")
 
 
 def legacy_lane(n: int = 100_000):
@@ -585,7 +634,8 @@ def main():
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
     evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
-                                 flatten_lane=FLATTEN_LANE)
+                                 flatten_lane=FLATTEN_LANE,
+                                 collect=COLLECT_LANE)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
                       exact_totals=False, pipeline=PIPELINE_MODE)
     mgr = AuditManager(client, lister=lambda: iter(objects), config=cfg,
@@ -620,6 +670,7 @@ def main():
         ph = {k: round(v, 3) for k, v in evaluator.perf.items()}
         ph.update({k: round(v, 3) for k, v in mgr.perf.items()})
         ph["wire_mb"] = round(ph.pop("wire_bytes", 0.0) / 1e6, 1)
+        ph["d2h_kb"] = round(ph.pop("d2h_bytes", 0.0) / 1e3, 2)
         pass_phases.append(ph)
         pass_pipes.append(mgr.pipe_stats)
         runs.append(run)
@@ -668,6 +719,7 @@ def main():
                                     if phases.get("pipelined")
                                     else "serial")}
     out["flatten_lane"] = FLATTEN_LANE
+    out["collect"] = COLLECT_LANE
     if pipe_stats:
         out["pipeline"].update(pipe_stats)
     if cpu_fallback:
@@ -684,6 +736,7 @@ def main():
         "pass_iqr_s": iqr,
         "date": time.strftime("%Y-%m-%d"),
         "flatten_lane": FLATTEN_LANE,
+        "collect": COLLECT_LANE,
         "host_cpus": os.cpu_count(),
         **({"cpu_fallback": True} if cpu_fallback else {}),
     })

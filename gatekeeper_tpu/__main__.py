@@ -103,6 +103,16 @@ def main(argv=None) -> int:
                         "Python); 'raw'/'dict'/'py' force a lane; "
                         "'differential' runs raw THEN dict per chunk "
                         "and asserts bit-identical columns (debugging)")
+    p.add_argument("--collect", default="reduced",
+                   choices=["reduced", "masks", "differential"],
+                   help="sweep collect lane: 'reduced' folds verdicts ON "
+                        "DEVICE (per-constraint totals + top-k kept "
+                        "selection + mask occupancy in one small packed "
+                        "transfer — O(kept) device->host bytes, not "
+                        "O(objects x constraints)); 'masks' ships the "
+                        "bit grid and folds on the host (the reference "
+                        "lane); 'differential' runs both per chunk and "
+                        "asserts totals/kept/occupancy bit-identical")
     p.add_argument("--export-dir", default="",
                    help="enable disk export of audit violations")
     p.add_argument("--log-denies", action="store_true",
@@ -534,7 +544,8 @@ def main(argv=None) -> int:
                 tpu, make_mesh(),
                 violations_limit=args.constraint_violations_limit,
                 flatten_lane=args.flatten_lane,
-                metrics=metrics)
+                metrics=metrics,
+                collect=args.collect)
 
         if kube_cluster is not None:
             # discovery-driven audit listing (auditResources,

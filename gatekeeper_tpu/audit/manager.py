@@ -136,6 +136,19 @@ class AuditRun:
     retried_chunks: int = 0
 
 
+def violation_rows(bits_or_hits, ci: int, n: int) -> np.ndarray:
+    """Violating object indices of local constraint ``ci`` from either
+    collect shape: bit-packed verdict rows (the masks lane) or a
+    device-reduced ``HitRows`` coordinate list (``--collect=reduced``;
+    duck-typed so this module stays jax-free for the sidecar control
+    plane).  The single accessor every exact/snapshot fold shares — both
+    collect lanes are bit-identical through it by construction."""
+    rows = getattr(bits_or_hits, "rows", None)
+    if rows is not None:
+        return rows(ci)
+    return np.nonzero(np.unpackbits(bits_or_hits[ci], count=n))[0]
+
+
 def _sweep_ready(pending) -> bool:
     """True when a submitted sweep's result needs no further wait
     (non-blocking).  Empty submits ({}) are always ready; RPC futures
@@ -615,8 +628,7 @@ class AuditManager:
             for kind, (kcons, idx, valid, counts, bits) in swept.items():
                 for ci, con in enumerate(kcons):
                     ckey = con.key()
-                    hit = np.nonzero(
-                        np.unpackbits(bits[ci], count=k))[0]
+                    hit = violation_rows(bits, ci, k)
                     for oi in hit.tolist():
                         if exact:
                             results = render(con, objects[oi],
@@ -755,8 +767,7 @@ class AuditManager:
         if isinstance(swept, dict):
             for _kind, (kcons, idx, valid, counts, bits) in swept.items():
                 for ci, con in enumerate(kcons):
-                    hit = np.nonzero(
-                        np.unpackbits(bits[ci], count=k))[0]
+                    hit = violation_rows(bits, ci, k)
                     for oi in hit.tolist():
                         results = render(con, objects[oi], cache_key=oi)
                         out.setdefault(oi, {}).setdefault(
@@ -1091,7 +1102,9 @@ class AuditManager:
                         flat = self.evaluator.sweep_flatten(
                             cons, objs,
                             return_bits=self.config.exact_totals,
-                            source=source)
+                            source=source,
+                            budget=lambda con: limit - len(
+                                kept.get(con.key(), ())))
                         swept = self.evaluator.sweep_collect(
                             self.evaluator.sweep_dispatch(flat))
                         self._process_swept(swept, objs, cons, kept,
@@ -1220,6 +1233,17 @@ class AuditManager:
         window: deque = deque()  # (pending, objects, constraint subset)
         max_inflight = max(1, self.config.submit_window)
 
+        # reduced-collect kept budget: each dispatch tells the device how
+        # many kept slots per constraint remain, so drained constraints
+        # ship ZERO kept coordinates.  Read at dispatch time the budget
+        # is always >= the fold-time remainder (folds only shrink it), so
+        # the device selection stays a superset of what the fold keeps —
+        # output is bit-identical to the unbudgeted masks fold.
+        budget_fn = None
+        if device and hasattr(self.evaluator, "sweep_flatten"):
+            budget_fn = (lambda con:
+                         limit - len(kept.get(con.key(), ())))
+
         # tunnel-drain waiter: tunneled TPU backends buffer H2D uploads
         # and defer the wire drain until something BLOCKS on a result —
         # is_ready() alone never fires mid-listing, so every chunk's
@@ -1303,7 +1327,9 @@ class AuditManager:
                             chunk_retry(last, "collect")
                             pending = self.evaluator.sweep_submit(
                                 cons, objs,
-                                return_bits=self.config.exact_totals)
+                                return_bits=self.config.exact_totals,
+                                **({"budget": budget_fn}
+                                   if budget_fn is not None else {}))
                         swept = self.evaluator.sweep_collect(pending)
                         break
                     except Exception as e:  # noqa: PERF203
@@ -1332,7 +1358,9 @@ class AuditManager:
                                 chunk_retry(last, "submit")
                             pending = self.evaluator.sweep_submit(
                                 cons, objects,
-                                return_bits=self.config.exact_totals)
+                                return_bits=self.config.exact_totals,
+                                **({"budget": budget_fn}
+                                   if budget_fn is not None else {}))
                             break
                         except Exception as e:  # noqa: PERF203
                             last = e
@@ -1428,9 +1456,18 @@ class AuditManager:
         cfg = self.config
         rb = cfg.exact_totals
 
+        # reduced-collect kept budget (see _sweep_serial): evaluated at
+        # DISPATCH on the dispatch stage thread while the fold stage
+        # mutates kept — dict/list length reads are atomic under the GIL
+        # and budgets only shrink, so a stale read over-ships, never
+        # under-ships
+        def budget_fn(con):
+            return cfg.violations_limit - len(kept.get(con.key(), ()))
+
         def fl(item):
             objs, cons = item
-            return ev.sweep_flatten(cons, objs, return_bits=rb), objs, cons
+            return (ev.sweep_flatten(cons, objs, return_bits=rb,
+                                     budget=budget_fn), objs, cons)
 
         def disp(item):
             flat, objs, cons = item
@@ -1661,8 +1698,7 @@ class AuditManager:
                 if exact and bits is not None:
                     # exact totals count RESULTS: every hit must render
                     # regardless of remaining kept budget
-                    hit_idx = np.nonzero(
-                        np.unpackbits(bits[ci], count=n_objects))[0]
+                    hit_idx = violation_rows(bits, ci, n_objects)
                     total = 0
                     for oi in hit_idx.tolist():
                         results = render(con, oi)

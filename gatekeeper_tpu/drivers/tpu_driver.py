@@ -100,6 +100,10 @@ class TpuDriver:
         # counters — a user template silently falling back to the
         # interpreter loses the device speedup, and nothing else reports it
         self.metrics = metrics
+        # device->host transfer accounting for the webhook query_batch
+        # lane (grid fetches), the admission-side twin of the audit
+        # evaluator's perf["d2h_bytes"]; read by bench/ops tooling
+        self.perf: dict = {}
 
     def _count_lowering(self, kind: str, engine: str, lowered: bool) -> None:
         if self.metrics is None:
@@ -532,6 +536,11 @@ class TpuDriver:
             )
             if occ_out is not None:
                 occ_out[kind] = int(mask.sum())
+            # the admission grid is host-folded (batches are <=64 wide;
+            # per-request rendering needs every hit anyway) — account the
+            # fetch so d2h pressure is visible next to the audit lane's
+            self.perf["d2h_bytes"] = (self.perf.get("d2h_bytes", 0.0)
+                                      + grid.nbytes)
             grid = grid[:, : batch.n] & mask
             if kind in self._cel_kinds and cel_delete_idx:
                 for ci, con in enumerate(cons):

@@ -68,7 +68,8 @@ def _drivers_for(engine: str):
 
 def run_bench(objs, engine: str, iterations: int,
               pipeline: str = "auto",
-              flatten_lane: str = "auto") -> BenchResult:
+              flatten_lane: str = "auto",
+              collect: str = "reduced") -> BenchResult:
     templates = [o for o in objs if reader.is_template(o)]
     constraints = [o for o in objs if reader.is_constraint(o)]
     data = [o for o in objs
@@ -114,7 +115,7 @@ def run_bench(objs, engine: str, iterations: int,
 
     if engine == "sweep":
         return _run_sweep_bench(r, client, data, iterations, pipeline,
-                                flatten_lane)
+                                flatten_lane, collect)
 
     from gatekeeper_tpu.target.review import AugmentedReview
     from gatekeeper_tpu.webhook.policy import parse_admission_review
@@ -268,7 +269,8 @@ def _fill_latencies(r: BenchResult, latencies: list) -> None:
 
 def _run_sweep_bench(r: BenchResult, client: Client, data: list,
                      iterations: int, pipeline: str,
-                     flatten_lane: str = "auto") -> BenchResult:
+                     flatten_lane: str = "auto",
+                     collect: str = "reduced") -> BenchResult:
     """The ``sweep`` engine: the production audit lane (AuditManager +
     ShardedEvaluator) over the fixture's data objects, scheduled through
     the staged host pipeline per ``--pipeline``.  One latency sample per
@@ -285,7 +287,8 @@ def _run_sweep_bench(r: BenchResult, client: Client, data: list,
         client, lister=lambda: iter(corpus),
         config=AuditConfig(pipeline=pipeline),
         evaluator=ShardedEvaluator(tpu, make_mesh(),
-                                   flatten_lane=flatten_lane),
+                                   flatten_lane=flatten_lane,
+                                   collect=collect),
     )
     latencies = []
     violations = 0
@@ -389,6 +392,13 @@ def run_cli(argv: list[str]) -> int:
                         "vs the GIL-bound dict walker (dict) vs Python "
                         "(py); differential runs raw THEN dict and "
                         "asserts bit-identical columns")
+    p.add_argument("--collect", default="reduced",
+                   choices=["reduced", "masks", "differential"],
+                   help="sweep-engine collect lane: device-side verdict "
+                        "reduction (reduced — O(kept) device->host "
+                        "bytes) vs the host-fold bit grid (masks); "
+                        "differential runs both per chunk and asserts "
+                        "totals/kept/occupancy bit-identical")
     p.add_argument("--trace", default="",
                    help="export a Chrome trace-event JSON of the bench "
                         "run's spans to this path (Perfetto-loadable)")
@@ -440,7 +450,8 @@ def run_cli(argv: list[str]) -> int:
             try:
                 results.append(run_bench(objs, engine, args.iterations,
                                          pipeline=args.pipeline,
-                                         flatten_lane=args.flatten_lane))
+                                         flatten_lane=args.flatten_lane,
+                                         collect=args.collect))
             except Exception as e:
                 print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
                 return 1

@@ -387,6 +387,11 @@ SNAPSHOT_DIRTY = "snapshot_dirty_rows"  # gauge
 SNAPSHOT_TOMBSTONE_FRACTION = "snapshot_tombstone_fraction"  # gauge
 SNAPSHOT_PATCHES = "snapshot_patch_count"  # {type}
 SNAPSHOT_RESYNC_SECONDS = "snapshot_resync_seconds"  # gauge
+# phase-2 interning (ops.flatten.flatten_phase2): distinct patch-batch
+# strings resolved from the row-id-keyed owned-string cache vs. strings
+# that had to probe/intern into the cluster-sized global vocab
+SNAPSHOT_INTERN_HITS = "snapshot_intern_cache_hits"  # gauge
+SNAPSHOT_INTERN_PROBES = "snapshot_intern_global_probes"  # gauge
 # batched mutation + expansion lane (gatekeeper_tpu/mutlane/): batched
 # lane passes, objects routed to the authoritative host walk {reason},
 # emitted RFC-6902 patch ops, and convergence iterations per applied

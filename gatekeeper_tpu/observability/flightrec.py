@@ -125,19 +125,38 @@ class FlightRecorder:
         return out[: max(0, limit)]
 
     def snapshot(self, uid: Optional[str] = None,
-                 limit: int = 100) -> dict:
-        """The ``/debug/decisions`` payload."""
+                 limit: int = 100,
+                 since: Optional[float] = None,
+                 until: Optional[float] = None,
+                 kinds: Optional[set] = None) -> dict:
+        """The ``/debug/decisions`` payload.
+
+        ``since``/``until`` bound the decision timestamp (unix seconds,
+        half-open ``[since, until)``); ``kinds`` keeps only the named
+        decision kinds (allow|deny|shed|error|deadline).  Filters
+        compose with each other and with ``uid``, so "every shed between
+        14:02 and 14:03" is one query instead of a ring dump."""
         with self._lock:
             ring = list(self._ring)
+        filtered = since is not None or until is not None or kinds
+        if filtered:
+            ring = [e for e in ring
+                    if (since is None or e.get("ts", 0.0) >= since)
+                    and (until is None or e.get("ts", 0.0) < until)
+                    and (not kinds or e.get("decision") in kinds)]
         if uid:
             matched = [e for e in ring if e.get("uid") == uid]
             return {"uid": uid, "recorded": self.recorded,
+                    **({"matched": len(matched)} if filtered else {}),
                     "decisions": matched}
         ring.reverse()
-        return {"recorded": self.recorded,
-                "capacity": self._ring.maxlen,
-                "sink": self.sink_path or "",
-                "decisions": ring[: max(0, limit)]}
+        out = {"recorded": self.recorded,
+               "capacity": self._ring.maxlen,
+               "sink": self.sink_path or "",
+               "decisions": ring[: max(0, limit)]}
+        if filtered:
+            out["matched"] = len(ring)
+        return out
 
     def close(self) -> None:
         with self._lock:

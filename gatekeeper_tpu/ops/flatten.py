@@ -111,6 +111,138 @@ class RowIdMap:
         return self._next
 
 
+class RowInternCache:
+    """Phase-2 intern state for the snapshot patch lane, keyed by the
+    stable global row ids a :class:`RowIdMap` issues.
+
+    Each entry maps a row id to the ``{string: global sid}`` facts its
+    last flatten established; a repatch of a known row resolves every
+    string the resident rows already own WITHOUT touching the global
+    vocab dict (``hits``), and only genuinely new strings pay the
+    global intern probe (``probes``).  Entries for a patch micro-batch
+    share one dict object, so memory is O(distinct strings per batch),
+    not O(rows x strings)."""
+
+    def __init__(self):
+        self._owned: dict = {}  # gid -> {str: global sid}
+        self.hits = 0  # strings resolved from owned rows (no global probe)
+        self.probes = 0  # strings that went to the global vocab
+
+    def owned_union(self, gids) -> dict:
+        dicts = []
+        seen: set = set()
+        for gid in gids:
+            d = self._owned.get(gid)
+            if d is not None and id(d) not in seen:
+                seen.add(id(d))
+                dicts.append(d)
+        if not dicts:
+            return {}
+        if len(dicts) == 1:
+            return dicts[0]
+        out: dict = {}
+        for d in dicts:
+            out.update(d)
+        return out
+
+    def adopt(self, gid, owned: dict) -> None:
+        self._owned[gid] = owned
+
+    def forget(self, gid) -> None:
+        self._owned.pop(gid, None)
+
+    def clear(self) -> None:
+        self._owned.clear()
+
+    def __len__(self) -> int:
+        return len(self._owned)
+
+
+def _remap_sid_arrays(batch, remap: "np.ndarray") -> None:
+    """Rewrite every string-id array of ``batch`` through ``remap``
+    (index shifted by 2 so the -1 absent / -2 error sentinels map to
+    themselves).  Prefix-axis aliases share array objects — the identity
+    set keeps a shared array from remapping twice."""
+    seen: set = set()
+
+    def rm(arr):
+        if arr is None or id(arr) in seen:
+            return
+        seen.add(id(arr))
+        arr[...] = remap[arr + 2]
+
+    rm(batch.group_sid)
+    rm(batch.kind_sid)
+    rm(batch.ns_sid)
+    rm(batch.name_sid)
+    for col in batch.scalars.values():
+        rm(col.sid)
+    for col in batch.raggeds.values():
+        rm(col.sid)
+    for col in batch.keysets.values():
+        rm(col.sid)
+    for col in getattr(batch, "ragged_keysets", {}).values():
+        rm(col.sid)
+    for col in getattr(batch, "map_keys", {}).values():
+        rm(col.sid)
+    for arr in getattr(batch, "canons", {}).values():
+        rm(arr)
+
+
+def flatten_phase2(flattener: "Flattener", objects, gids,
+                   cache: RowInternCache):
+    """Two-phase patch-lane flatten (incremental-audit NEXT 1): phase 1
+    columnizes against a FRESH batch-local vocab, so per-string intern
+    probes hit a dict sized by the patch batch instead of the cluster
+    vocabulary; phase 2 resolves each DISTINCT string once — from the
+    patched rows' owned-string cache when the resident rows already own
+    it (zero global-vocab traffic), else one global intern — and remaps
+    the sid arrays in place.  New strings intern in first-occurrence
+    order, exactly the order a direct flatten would have used, so vocab
+    and columns are bit-identical (the resync differential's
+    precondition).
+
+    Batches that would take the raw-bytes lane skip phase 2: the C
+    columnizer already resolves interning through its persistent global
+    vocab mirror (native/flattenjsonmod.c), and a per-call local vocab
+    would thrash that cache."""
+    from gatekeeper_tpu.utils.rawjson import RawJSON
+
+    if flattener.lane not in ("auto", "dict", "py") or not objects:
+        return flattener.flatten(objects)
+    if flattener.lane == "auto" and flattener.use_native and all(
+            isinstance(o, RawJSON) for o in objects):
+        from gatekeeper_tpu.ops import native
+
+        if native.load_json() is not None:
+            return flattener.flatten(objects)
+    local = Vocab()
+    saved = flattener.vocab
+    flattener.vocab = local
+    try:
+        batch = flattener.flatten(objects)
+    finally:
+        flattener.vocab = saved
+    owned = cache.owned_union(gids)
+    remap = np.empty(len(local._to_str) + 2, np.int32)
+    remap[0] = -2
+    remap[1] = -1
+    new_owned: dict = {}
+    for i, s in enumerate(local._to_str):
+        g = owned.get(s)
+        if g is None:
+            g = saved.intern(s)
+            cache.probes += 1
+        else:
+            cache.hits += 1
+        remap[i + 2] = g
+        new_owned[s] = g
+    for gid in gids:
+        cache.adopt(gid, new_owned)
+    _remap_sid_arrays(batch, remap)
+    return batch
+
+
 # --- column specs (requested by the lowering pass) ------------------------
 
 

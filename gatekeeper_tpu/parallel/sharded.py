@@ -420,6 +420,62 @@ def shard_param_table(table: dict, mesh: Mesh, shard_constraints: bool) -> dict:
     return out
 
 
+COLLECT_LANES = ("reduced", "masks", "differential")
+
+# budgeted-lane hit-buffer steps: each distinct size is one jit variant
+# of the fused sweep (compiled once, warmable), so the ladder is short —
+# 0 for drained-budget chunks, three small steps for the steady-state
+# trickle, then the full per-chunk kept capacity
+_HIT_STEPS = (0, 16, 64, 256)
+
+
+def hit_bucket(need: int, cap: int) -> int:
+    """Smallest static hit-buffer size covering ``need`` selected hits
+    (``cap`` = the exhaustive bound, e.g. C*k for a kept selection)."""
+    if need <= 0:
+        return 0
+    for b in _HIT_STEPS[1:]:
+        if need <= b < cap:
+            return b
+    return cap
+
+
+class HitRows:
+    """Device-reduced violation coordinates for one kind's constraint
+    rows: flat ``ci * pad_n + oi`` coords, canonically sorted
+    (constraint-major, ascending object index) — the O(violations)
+    replacement for the bit-packed verdict rows in the 5th slot of a
+    sweep_collect entry.  ``rows(ci)`` yields the violating object
+    indices of local constraint ``ci`` exactly as
+    ``np.nonzero(np.unpackbits(bits[ci], count=n))[0]`` would."""
+
+    __slots__ = ("flat", "pad_n", "n", "c", "_starts")
+
+    def __init__(self, flat: np.ndarray, pad_n: int, n: int, c: int):
+        self.flat = flat
+        self.pad_n = pad_n
+        self.n = n
+        self.c = c
+        self._starts = np.searchsorted(
+            flat, np.arange(c + 1, dtype=np.int64) * pad_n)
+
+    def rows(self, ci: int) -> np.ndarray:
+        lo, hi = self._starts[ci], self._starts[ci + 1]
+        oi = self.flat[lo:hi] - ci * self.pad_n
+        return oi[oi < self.n]
+
+
+def violation_rows(bits_or_hits, ci: int, n: int) -> np.ndarray:
+    """Violating object indices of local constraint ``ci`` from either
+    collect shape: bit-packed verdict rows (masks lane) or
+    :class:`HitRows` (reduced lane) — the single fold-side accessor all
+    exact/snapshot folds share, so both lanes are bit-identical by
+    construction."""
+    if isinstance(bits_or_hits, HitRows):
+        return bits_or_hits.rows(ci)
+    return np.nonzero(np.unpackbits(bits_or_hits[ci], count=n))[0]
+
+
 def topk_violations(verdicts: jnp.ndarray, k: int) -> tuple:
     """Per-constraint top-k violating object indices, lowest-index-first —
     the device analog of the reference's LimitQueue (bounded max-heap,
@@ -495,10 +551,13 @@ def make_kind_router(constraints):
 
 class _PendingSweep:
     __slots__ = ("result", "kinds", "offsets", "by_kind", "n",
-                 "return_bits", "attr_weights", "attr_rows")
+                 "return_bits", "attr_weights", "attr_rows",
+                 "lane", "pad_n", "hit_cap", "flat", "ref",
+                 "dispatch_wall", "host_occ", "budget_np")
 
     def __init__(self, result, kinds, offsets, by_kind, n, return_bits,
-                 attr_weights=None, attr_rows=None):
+                 attr_weights=None, attr_rows=None, lane="masks",
+                 pad_n=0, hit_cap=0, flat=None):
         self.result = result
         self.kinds = kinds
         self.offsets = offsets
@@ -509,6 +568,26 @@ class _PendingSweep:
         # computed only while cost attribution is installed
         self.attr_weights = attr_weights
         self.attr_rows = attr_rows
+        # collect lane this dispatch ran ('masks'|'reduced'|'differential')
+        self.lane = lane
+        self.pad_n = pad_n
+        # reduced lane: static hit-buffer size of the fused program; the
+        # retained _FlatChunk backs the masks-lane fallback re-dispatch
+        # when a chunk's true hit count overflows it (dropped at collect)
+        self.hit_cap = hit_cap
+        self.flat = flat
+        # differential lane: the masks-lane reference dispatch
+        self.ref = None
+        # reduced lane: dispatch wall seconds, attributed at collect time
+        # once the DEVICE occupancy counts arrive (masks lane attributes
+        # at dispatch from the host-visible mask rows)
+        self.dispatch_wall = 0.0
+        # differential lane: host-side per-constraint mask occupancy, the
+        # reference the device counts are asserted against
+        self.host_occ = None
+        # budgeted reduced dispatch: the per-constraint kept budgets the
+        # device selection was clipped to (None = complete variant)
+        self.budget_np = None
 
 
 class _FlatChunk:
@@ -517,10 +596,10 @@ class _FlatChunk:
     and the dispatch stage (masks + wire pack + device_put + jit call)."""
 
     __slots__ = ("by_kind", "kinds", "cols", "batch", "objects", "any_gen",
-                 "n", "pad_n", "return_bits", "source")
+                 "n", "pad_n", "return_bits", "source", "budget")
 
     def __init__(self, by_kind, kinds, cols, batch, objects, any_gen, n,
-                 pad_n, return_bits, source=""):
+                 pad_n, return_bits, source="", budget=None):
         self.by_kind = by_kind
         self.kinds = kinds
         self.cols = cols
@@ -535,6 +614,11 @@ class _FlatChunk:
         # constraint matches see shift-left resultants correctly; ""
         # keeps the legacy mask behavior byte-for-byte
         self.source = source
+        # reduced lane, budgeted variant: con -> remaining run-level kept
+        # slots (evaluated at dispatch — always >= the fold-time budget,
+        # so the device selection is a superset of what the fold keeps);
+        # None = full render cap for every constraint
+        self.budget = budget
 
 
 class ShardedEvaluator:
@@ -545,7 +629,8 @@ class ShardedEvaluator:
     """
 
     def __init__(self, driver, mesh: Mesh, violations_limit: int = 20,
-                 flatten_lane: str = "auto", metrics=None):
+                 flatten_lane: str = "auto", metrics=None,
+                 collect: str = "reduced"):
         self.driver = driver
         self.mesh = mesh
         self.violations_limit = violations_limit
@@ -554,7 +639,25 @@ class ShardedEvaluator:
         # the lister hands over bytes and the native module built
         self.flatten_lane = flatten_lane
         self.metrics = metrics
+        # --collect: what a sweep chunk transfers device->host.
+        # 'reduced' folds the verdict grid ON DEVICE (per-constraint
+        # totals, top-k kept selection under the render cap, mask-row
+        # occupancy) and ships one small packed array — O(kept) bytes,
+        # not O(objects x constraints); exact/snapshot chunks
+        # (return_bits) ship the complete hit-coordinate list instead of
+        # the bit grid, with an adaptive buffer that falls back to the
+        # masks lane per chunk on overflow (and pins dense corpora to
+        # masks when coordinates would outweigh the bits).  'masks' is
+        # the host-fold reference lane (the bit-identity oracle);
+        # 'differential' runs BOTH per chunk and asserts totals, kept
+        # selections and occupancy identical.
+        if collect not in COLLECT_LANES:
+            raise ValueError(f"unknown collect lane {collect!r}")
+        self.collect = collect
         self._sweep_fns: dict = {}
+        # reduced lane adaptive state per (kinds, pad_n): hit-buffer size
+        # for complete-hits chunks, masks-lane pinning, low-water streak
+        self._hit_state: dict = {}
         self._table_dev_cache: dict = {}  # key -> (host_array, dev_array)
         self._param_dev_cache: dict = {}  # digest -> dev uint8 buffer
         # corpus-wide per-column (min, max, const) from warm_pass: drives
@@ -662,6 +765,103 @@ class ShardedEvaluator:
                     grid.astype(jnp.uint8), axis=1
                 )
             return packed
+
+        fn = jax.jit(fused)
+        self._sweep_fns[key] = fn
+        return fn
+
+    def _sweep_fn_reduced(self, kinds: tuple, k: int, complete: bool,
+                          hit_cap: int, cols_layout: tuple,
+                          tables_layout: tuple, pad_n: int):
+        """The device-side verdict REDUCTION twin of :meth:`_sweep_fn`:
+        the fused grid never leaves the chip — per-constraint violation
+        totals (segmented sum over the masked grid), the kept selection
+        (``jax.lax.top_k`` under the render cap and the canonical
+        lowest-index-first ordering key, clipped to the caller's
+        remaining kept budget), and the mask-row occupancy counts cost
+        attribution apportions by, all compacted into ONE small int32
+        array ``[counts(C) | occ(C) | nsel | hits(hit_cap)]``.
+
+        ``complete`` (exact-totals / snapshot chunks): ``hits`` carries
+        EVERY violating ``ci*pad_n+oi`` coordinate instead of the kept
+        selection — the verdict-store / exact-render consumers need the
+        full hit set, just never the O(C x N) grid.  ``nsel`` is the true
+        selected count; a value above ``hit_cap`` means the buffer
+        truncated and the collect side must fall back to the masks lane
+        for this chunk."""
+        key = ("reduced", kinds, k, complete, hit_cap, cols_layout,
+               tables_layout, pad_n)
+        fn = self._sweep_fns.get(key)
+        if fn is not None:
+            return fn
+        builders = [self.driver._programs[kind]._build() for kind in kinds]
+
+        if self.mesh.size == 1 and not complete:
+            from gatekeeper_tpu.ops.pallas_topk import (
+                pallas_supported, topk_violations_counts_pallas)
+
+            use_pallas = pallas_supported()
+        else:
+            use_pallas = False
+
+        def fused(tables_buf, cols_buf, table_cols: dict, mask_bits,
+                  budget):
+            cols = unpack_transfer_cols(cols_buf, cols_layout, pad_n)
+            cols.update(table_cols)
+            tables = unpack_flat_tables(tables_buf, tables_layout,
+                                        len(kinds))
+            mask = jnp.unpackbits(mask_bits, axis=1,
+                                  count=pad_n).astype(jnp.bool_)
+            grids = [b(t, cols) for b, t in zip(builders, tables)]
+            grid = jnp.concatenate(grids, axis=0) & mask
+            c_total = grid.shape[0]
+            counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+            occ = jnp.sum(mask, axis=1, dtype=jnp.int32)
+            if pad_n <= 0xFFFF:
+                # counts and occupancy are both <= pad_n: one u16|u16
+                # word per constraint halves the per-chunk floor (the
+                # D2H twin of the H2D wire-dtype narrowing)
+                head = [jax.lax.bitcast_convert_type(
+                    counts.astype(jnp.uint32)
+                    | (occ.astype(jnp.uint32) << 16), jnp.int32)]
+            else:
+                head = [counts, occ]
+            sentinel = c_total * pad_n
+            if complete:
+                nsel = jnp.sum(counts)
+                if hit_cap:
+                    # row-major nonzero == canonical (constraint,
+                    # ascending index) order; fill coords sort last so
+                    # the real hits are the nsel-prefix
+                    (hits,) = jnp.nonzero(grid.reshape(-1), size=hit_cap,
+                                          fill_value=sentinel)
+                    hits = hits.astype(jnp.int32)
+                else:
+                    hits = jnp.zeros((0,), jnp.int32)
+            else:
+                if use_pallas:
+                    idx, valid, counts = topk_violations_counts_pallas(
+                        grid, k)
+                else:
+                    idx, valid = topk_violations(grid, k)
+                k_eff = idx.shape[1]
+                want = jnp.minimum(counts, budget)
+                sel = valid & (jnp.arange(k_eff, dtype=jnp.int32)[None, :]
+                               < want[:, None])
+                nsel = jnp.sum(sel, dtype=jnp.int32)
+                if hit_cap:
+                    (pos,) = jnp.nonzero(sel.reshape(-1), size=hit_cap,
+                                         fill_value=c_total * k_eff)
+                    safe = jnp.minimum(pos, c_total * k_eff - 1)
+                    oi = jnp.take(idx.reshape(-1), safe)
+                    hits = jnp.where(
+                        pos < c_total * k_eff,
+                        (pos // k_eff).astype(jnp.int32) * pad_n + oi,
+                        sentinel).astype(jnp.int32)
+                else:
+                    hits = jnp.zeros((0,), jnp.int32)
+            return jnp.concatenate(
+                head + [jnp.reshape(nsel, (1,)).astype(jnp.int32), hits])
 
         fn = jax.jit(fused)
         self._sweep_fns[key] = fn
@@ -784,8 +984,33 @@ class ShardedEvaluator:
         a full warmup sweep with a collect would permanently degrade
         upload bandwidth ~40x for the rest of the process."""
         pending = self.sweep_submit(constraints, objects, return_bits)
-        if isinstance(pending, _PendingSweep):
-            jax.block_until_ready(pending.result)
+        if not isinstance(pending, _PendingSweep):
+            return
+        jax.block_until_ready(pending.result)
+        if pending.ref is not None:
+            jax.block_until_ready(pending.ref.result)
+        if self.collect in ("reduced", "differential") and not return_bits:
+            # pre-compile the budgeted hit-buffer ladder (hit_bucket):
+            # the timed run's chunks move DOWN the ladder as run-level
+            # kept budgets drain, and a mid-sweep retrace would poison
+            # the steady state the warm pass exists to protect
+            def warm_budget(total):
+                left = [total]
+
+                def b(_con):
+                    v = min(self.violations_limit, left[0])
+                    left[0] -= v
+                    return v
+
+                return b
+
+            for total in _HIT_STEPS:
+                p = self.sweep_submit(constraints, objects, return_bits,
+                                      budget=warm_budget(total))
+                if isinstance(p, _PendingSweep):
+                    jax.block_until_ready(p.result)
+                    if p.ref is not None:
+                        jax.block_until_ready(p.ref.result)
 
     def sweep(self, constraints: Sequence, objects: Sequence[dict],
               return_bits: bool = False):
@@ -801,7 +1026,7 @@ class ShardedEvaluator:
             self.sweep_submit(constraints, objects, return_bits))
 
     def sweep_submit(self, constraints: Sequence, objects: Sequence[dict],
-                     return_bits: bool = False):
+                     return_bits: bool = False, budget=None):
         """Flatten + dispatch without fetching: jit dispatch is async, so
         the caller can flatten/submit the NEXT chunk while the device works
         (the pipeline-parallel fix for the reference's fully-sequential
@@ -812,7 +1037,8 @@ class ShardedEvaluator:
         the serial schedule and the staged pipeline run the exact same
         code."""
         return self.sweep_dispatch(
-            self.sweep_flatten(constraints, objects, return_bits))
+            self.sweep_flatten(constraints, objects, return_bits,
+                               budget=budget))
 
     def sweep_schema(self, constraints: Sequence) -> tuple:
         """(by_kind, lowered_kinds, merged_schema) — the columnize plan
@@ -836,7 +1062,7 @@ class ShardedEvaluator:
                                  objects: Sequence[dict],
                                  return_bits: bool = False,
                                  alias: Optional[dict] = None,
-                                 source: str = ""):
+                                 source: str = "", budget=None):
         """Pipeline stage 1 over a PRE-FLATTENED :class:`ColumnBatch` —
         the resident-snapshot lane: the columns were flattened when the
         watch patched them in, so a sweep over the snapshot pays only
@@ -858,10 +1084,11 @@ class ShardedEvaluator:
                 for o in objects)
         return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
                           objects, any_gen, n, batch.n, return_bits,
-                          source=source)
+                          source=source, budget=budget)
 
     def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
-                      return_bits: bool = False, source: str = ""):
+                      return_bits: bool = False, source: str = "",
+                      budget=None):
         """Pipeline stage 1 (host, GIL-released C columnizer): schema
         union + flatten + column pack/slim.  Returns a :class:`_FlatChunk`
         for :meth:`sweep_dispatch`, or {} when no kind is lowered (the
@@ -916,33 +1143,60 @@ class ShardedEvaluator:
                 for o in objects)
         return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
                           objects, any_gen, n, pad_n, return_bits,
-                          source=source)
+                          source=source, budget=budget)
 
     def sweep_dispatch(self, flat):
         """Pipeline stage 2 (host->device): match masks + param tables +
         wire packing + sharded device_put + async jit dispatch.  Accepts
-        :meth:`sweep_flatten`'s output; {} passes through (empty submit)."""
+        :meth:`sweep_flatten`'s output; {} passes through (empty submit).
+
+        The collect lane is resolved here (``self.collect``): the
+        differential lane dispatches the chunk through BOTH the reduced
+        and the masks program so collect can assert them identical."""
         if not isinstance(flat, _FlatChunk):
             return flat if isinstance(flat, dict) else {}
         from gatekeeper_tpu.observability import costattr, tracing
 
+        lane = self.collect
         t0 = time.perf_counter()
         with tracing.span("device.sweep_dispatch", n=flat.n,
-                          kinds=len(flat.kinds)):
-            pending = self._sweep_dispatch_impl(flat)
+                          kinds=len(flat.kinds), collect=lane):
+            if lane == "differential":
+                pending = self._sweep_dispatch_impl(flat, lane="reduced",
+                                                    host_occ=True)
+                if pending.lane == "reduced":
+                    pending.ref = self._sweep_dispatch_impl(
+                        flat, lane="masks", host_occ=True)
+                    pending.lane = "differential"
+            else:
+                pending = self._sweep_dispatch_impl(flat, lane=lane)
+        wall = time.perf_counter() - t0
+        if isinstance(pending, _PendingSweep):
+            pending.dispatch_wall = wall
         attr = costattr.active()
         if attr is not None and isinstance(pending, _PendingSweep) \
                 and pending.attr_weights:
             # the whole fused pass's wall time apportioned by mask row
             # occupancy — per-template shares sum back to the parent
-            # span's wall time (the closure the tests assert)
-            attr.attribute(time.perf_counter() - t0,
-                           pending.attr_weights,
+            # span's wall time (the closure the tests assert).  The
+            # reduced lane has no host-visible masks: its attr_weights
+            # are None here and the attribution happens at collect, from
+            # the device occupancy counts, over the same wall.
+            attr.attribute(wall, pending.attr_weights,
                            costattr.EP_AUDIT, costattr.PHASE_DISPATCH,
                            rows=pending.attr_rows)
         return pending
 
-    def _sweep_dispatch_impl(self, flat):
+    def _hit_state_for(self, kinds: tuple, pad_n: int) -> dict:
+        key = (kinds, pad_n)
+        st = self._hit_state.get(key)
+        if st is None:
+            st = self._hit_state[key] = {"cap": 256, "low": 0,
+                                         "pinned": False, "blast": None}
+        return st
+
+    def _sweep_dispatch_impl(self, flat, lane: str = "masks",
+                             host_occ: bool = False):
         from gatekeeper_tpu.resilience.faults import fault_point
 
         fault_point("device.dispatch", lane="sweep", n=flat.n)
@@ -979,14 +1233,29 @@ class ShardedEvaluator:
         self._perf_add("masks", time.perf_counter() - t0)
         from gatekeeper_tpu.observability import costattr
 
+        complete = bool(return_bits)
+        if lane == "reduced" and complete \
+                and self._hit_state_for(kinds, pad_n)["pinned"]:
+            # dense corpus: complete hit coordinates would outweigh the
+            # bit grid — this (kinds, pad) shape ships masks from now on
+            lane = "masks"
         attr_weights = attr_rows = None
-        if costattr.active() is not None:
+        if lane != "reduced" and costattr.active() is not None:
             # row occupancy per template: live (constraint, object) mask
             # cells — the dispatch-share weight.  +1 keeps an all-masked
             # template visible (it still pays fixed per-template cost).
+            # The reduced lane reads the SAME counts off the device
+            # result at collect instead (no host mask walk).
             attr_rows = {k: int(np.asarray(m).sum())
                          for k, m in zip(kinds, mask_rows)}
             attr_weights = {k: 1.0 + r for k, r in attr_rows.items()}
+        host_occ_np = None
+        if host_occ:
+            # differential reference: per-constraint live mask cells in
+            # constraint-grid order, asserted equal to the device occ
+            host_occ_np = np.concatenate(
+                [np.asarray(m).sum(axis=1, dtype=np.int64)
+                 for m in mask_rows]).astype(np.int32)
         table_cols: dict = {}
         for kind in kinds:
             for tk, tv in vocab_tables(
@@ -1035,14 +1304,58 @@ class ShardedEvaluator:
         mask_dev = jax.device_put(
             mask, NamedSharding(self.mesh, P(None, "data"))
         )
+        if lane == "reduced":
+            k_eff = min(k, pad_n)
+            if complete:
+                budget_np = np.zeros(c_off, np.int32)  # unused on device
+                st = self._hit_state_for(kinds, pad_n)
+                hit_cap = min(st["cap"], c_off * pad_n)
+            else:
+                if flat.budget is None:
+                    budget_np = np.full(c_off, k_eff, np.int32)
+                else:
+                    budget_np = np.fromiter(
+                        (min(k_eff, max(0, int(flat.budget(con))))
+                         for kind in kinds for con in by_kind[kind]),
+                        np.int32, count=c_off)
+                # buffer sizing: sum(budgets) bounds the selection, but
+                # constraints that never reach the run cap keep their
+                # budget forever — sizing by the PREVIOUS chunk's
+                # observed selection (2x margin) ships near-empty
+                # buffers in steady state; a chunk that suddenly selects
+                # more overflows into the masks-lane fallback once and
+                # resizes
+                need = int(budget_np.sum())
+                blast = self._hit_state_for(kinds, pad_n)["blast"]
+                guess = need if blast is None else \
+                    min(need, max(_HIT_STEPS[1], 2 * blast))
+                hit_cap = hit_bucket(guess, c_off * k_eff)
+            budget_dev = jax.device_put(
+                budget_np, NamedSharding(self.mesh, P(None)))
+            result = self._sweep_fn_reduced(
+                kinds, k, complete, hit_cap, cols_layout, tables_layout,
+                pad_n)(
+                tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev,
+                budget_dev
+            )
+            self._perf_add("dispatch", time.perf_counter() - t0)
+            pending = _PendingSweep(result, kinds, offsets, by_kind, n,
+                                    return_bits, lane="reduced",
+                                    pad_n=pad_n, hit_cap=hit_cap,
+                                    flat=flat)
+            pending.host_occ = host_occ_np
+            pending.budget_np = None if complete else budget_np
+            return pending
         result = self._sweep_fn(kinds, k, return_bits, cols_layout,
                                 tables_layout, pad_n)(
             tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev
         )
         self._perf_add("dispatch", time.perf_counter() - t0)
-        return _PendingSweep(result, kinds, offsets, by_kind, n,
-                             return_bits, attr_weights=attr_weights,
-                             attr_rows=attr_rows)
+        pending = _PendingSweep(result, kinds, offsets, by_kind, n,
+                                return_bits, attr_weights=attr_weights,
+                                attr_rows=attr_rows, pad_n=pad_n)
+        pending.host_occ = host_occ_np
+        return pending
 
     def sweep_collect(self, pending):
         """Fetch + unpack a submitted sweep (the single device->host
@@ -1057,13 +1370,22 @@ class ShardedEvaluator:
             return self._sweep_collect_impl(pending)
 
     def _sweep_collect_impl(self, pending):
+        if pending.lane == "differential":
+            return self._collect_differential(pending)
+        if pending.lane == "reduced":
+            return self._collect_reduced(pending)
+        return self._collect_masks(pending)
+
+    def _collect_masks(self, pending):
         t0 = time.perf_counter()
         if pending.return_bits:
             packed_np = np.asarray(pending.result[0])
             bits_np = np.asarray(pending.result[1])
+            self._perf_add("d2h_bytes", packed_np.nbytes + bits_np.nbytes)
         else:
             packed_np = np.asarray(pending.result)
             bits_np = None
+            self._perf_add("d2h_bytes", packed_np.nbytes)
 
         # top_k clamps k to the padded batch width; recover the effective k
         # from the packed layout [idx(k') | valid(k') | count]
@@ -1080,6 +1402,177 @@ class ShardedEvaluator:
                          kb)
         self._perf_add("collect", time.perf_counter() - t0)
         return out
+
+    @staticmethod
+    def _kept_from_hits(sub: np.ndarray, ck: int, pad_n: int, k_eff: int,
+                        n: int) -> tuple:
+        """(idx [ck, k_eff], valid) rebuilt from a kind's sorted local
+        hit coords — the same layout the masks-lane packed result
+        carries, so every downstream fold runs unchanged."""
+        idx = np.zeros((ck, k_eff), np.int32)
+        valid = np.zeros((ck, k_eff), bool)
+        if sub.size:
+            ci = (sub // pad_n).astype(np.intp)
+            oi = (sub % pad_n).astype(np.int32)
+            starts = np.searchsorted(ci, np.arange(ck))
+            j = np.arange(sub.size) - starts[ci]
+            ok = (j < k_eff) & (oi < n)
+            idx[ci[ok], j[ok]] = oi[ok]
+            valid[ci[ok], j[ok]] = True
+        return idx, valid
+
+    def _collect_reduced(self, pending, _aux: bool = False):
+        """Unpack one device-reduced chunk result: O(kept/violations)
+        bytes off the wire, occupancy-weighted cost attribution from the
+        on-device counts, masks-lane fallback when a complete-hits
+        buffer overflowed (dense chunk), adaptive buffer sizing for the
+        chunks after it."""
+        from gatekeeper_tpu.observability import costattr
+
+        t0 = time.perf_counter()
+        arr = np.asarray(pending.result)
+        self._perf_add("d2h_bytes", arr.nbytes)
+        c_total = max(hi for _lo, hi in pending.offsets.values())
+        pad_n, n = pending.pad_n, pending.n
+        if pad_n <= 0xFFFF:
+            co = arr[:c_total].view(np.uint32)
+            counts_all = (co & 0xFFFF).astype(np.int32)
+            occ_all = (co >> 16).astype(np.int32)
+            base = c_total
+        else:
+            counts_all = arr[:c_total]
+            occ_all = arr[c_total: 2 * c_total]
+            base = 2 * c_total
+        nsel = int(arr[base])
+        hits = arr[base + 1:]
+        complete = pending.return_bits
+        st = self._hit_state_for(pending.kinds, pad_n)
+        if not complete:
+            # budgeted buffer sizing feedback for the NEXT chunk
+            st["blast"] = nsel
+        if nsel > pending.hit_cap:
+            # the chunk's true hit count overflowed the static buffer:
+            # re-dispatch THIS chunk through the masks lane (bit grid,
+            # always complete), and grow — or, past the point where
+            # coordinates outweigh the grid, pin — the shape's buffer
+            self._perf_add("collect_fallbacks", 1.0)
+            if complete:
+                cap = 256
+                while cap < 2 * nsel:
+                    cap *= 2
+                if 4 * cap > (c_total * pad_n) // 8:
+                    st["pinned"] = True
+                else:
+                    st["cap"] = cap
+                st["low"] = 0
+            flat, pending.flat = pending.flat, None
+            fb = self._sweep_dispatch_impl(flat, lane="masks")
+            attr = costattr.active()
+            if attr is not None and fb.attr_weights:
+                attr.attribute(pending.dispatch_wall, fb.attr_weights,
+                               costattr.EP_AUDIT, costattr.PHASE_DISPATCH,
+                               rows=fb.attr_rows)
+            out = self._collect_masks(fb)
+            return (out, None) if _aux else out
+        if complete and not st["pinned"]:
+            # de-escalate a buffer the corpus stopped filling (16-chunk
+            # hysteresis; compiled variants stay cached either way)
+            if st["cap"] > 256 and 4 * nsel < st["cap"]:
+                st["low"] += 1
+                if st["low"] >= 16:
+                    st["cap"] //= 2
+                    st["low"] = 0
+            else:
+                st["low"] = 0
+        hits = hits[: min(nsel, hits.size)]
+        k_eff = min(self.violations_limit, pad_n)
+        out = {}
+        for kind in pending.kinds:
+            lo, hi = pending.offsets[kind]
+            ck = hi - lo
+            sub = (hits[(hits >= lo * pad_n) & (hits < hi * pad_n)]
+                   .astype(np.int64) - lo * pad_n)
+            idx_np, valid_np = self._kept_from_hits(sub, ck, pad_n,
+                                                    k_eff, n)
+            kb = HitRows(sub, pad_n, n, ck) if complete else None
+            out[kind] = (pending.by_kind[kind], idx_np, valid_np,
+                         counts_all[lo:hi], kb)
+        attr = costattr.active()
+        if attr is not None and pending.dispatch_wall > 0:
+            # satellite of the reduced lane: occupancy weights come from
+            # the DEVICE counts (host never saw the masks), apportioning
+            # the dispatch wall exactly as the masks lane does
+            rows = {kind: int(occ_all[lo:hi].sum())
+                    for kind, (lo, hi) in pending.offsets.items()}
+            attr.attribute(pending.dispatch_wall,
+                           {kind: 1.0 + r for kind, r in rows.items()},
+                           costattr.EP_AUDIT, costattr.PHASE_DISPATCH,
+                           rows=rows)
+        pending.flat = None
+        self._perf_add("collect", time.perf_counter() - t0)
+        if _aux:
+            return out, {"counts": counts_all, "occ": occ_all,
+                         "nsel": nsel, "hits": hits}
+        return out
+
+    def _collect_differential(self, pending):
+        """``--collect=differential``: the reduced result must match the
+        masks-lane host fold bit-for-bit — violation totals, canonical
+        kept selections (the device top-k under the same budget), the
+        complete hit sets of exact/snapshot chunks, and per-constraint
+        mask occupancy.  Raises on the first divergence."""
+        ref = self._collect_masks(pending.ref)
+        red = self._collect_reduced(pending, _aux=True)
+        out, aux = red
+        if aux is None:
+            # complete-hits overflow inside the differential: the
+            # reduced side already fell back to a second masks pass —
+            # compare the two masks folds (still a real assertion of
+            # dispatch determinism) and note the skip
+            self._perf_add("collect_differential_fallbacks", 1.0)
+        if pending.host_occ is not None and aux is not None:
+            if not np.array_equal(aux["occ"], pending.host_occ):
+                raise RuntimeError(
+                    "collect differential: device occupancy != host mask "
+                    f"occupancy ({aux['occ'].tolist()[:8]} vs "
+                    f"{pending.host_occ.tolist()[:8]})")
+        n = pending.n
+        for kind, (cons, idx_m, valid_m, counts_m, bits_m) in ref.items():
+            cons_r, idx_r, valid_r, counts_r, kb_r = out[kind]
+            if not np.array_equal(np.asarray(counts_m),
+                                  np.asarray(counts_r)):
+                raise RuntimeError(
+                    f"collect differential: totals differ for {kind}")
+            for ci in range(len(cons)):
+                if bits_m is not None:
+                    ref_rows = violation_rows(bits_m, ci, n)
+                    if kb_r is not None and not np.array_equal(
+                            ref_rows, violation_rows(kb_r, ci, n)):
+                        raise RuntimeError(
+                            "collect differential: hit rows differ for "
+                            f"{kind}[{ci}]")
+                else:
+                    ref_rows = np.asarray(idx_m[ci])[
+                        np.asarray(valid_m[ci])]
+                # kept selection: the reduced lane keeps the FIRST
+                # min(count, budget, k) canonical hits; the masks lane's
+                # selection clipped the same way must agree exactly
+                want = int(np.asarray(counts_m)[ci])
+                bud = pending.budget_np
+                if bud is not None:
+                    lo = pending.offsets[kind][0]
+                    want = min(want, int(bud[lo + ci]))
+                want = min(want, idx_r.shape[1])
+                kept_ref = np.sort(ref_rows[:want]) if want else \
+                    np.zeros(0, np.int64)
+                kept_red = np.sort(idx_r[ci][valid_r[ci]])
+                if not np.array_equal(kept_ref,
+                                      kept_red.astype(np.int64)):
+                    raise RuntimeError(
+                        "collect differential: kept selection differs "
+                        f"for {kind}[{ci}]")
+        self._perf_add("collect_differential_ok", 1.0)
+        return ref
 
     def _pad(self, n: int) -> int:
         base = self.mesh.shape["data"] * 8

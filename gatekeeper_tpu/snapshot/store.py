@@ -42,7 +42,7 @@ import numpy as np
 
 from gatekeeper_tpu.ops.flatten import ColumnBatch, KeySetColumn, \
     MapKeyColumn, ParentIdxColumn, RaggedColumn, RaggedKeySetColumn, \
-    RowIdMap, ScalarColumn
+    RowIdMap, RowInternCache, ScalarColumn, flatten_phase2
 from gatekeeper_tpu.utils.rawjson import RawJSON, peek_kind
 from gatekeeper_tpu.utils.unstructured import gvk_of, name_of, namespace_of
 
@@ -60,6 +60,12 @@ class SnapshotConfig:
     # pending watch events applied per flatten call (row patches
     # columnize in micro-batches so the C lane amortizes per-call cost)
     micro_batch: int = 512
+    # phase-2 vocab interning keyed by stable global row ids
+    # (ops.flatten.flatten_phase2): patch-lane flattens columnize against
+    # a batch-local vocab and resolve strings the resident rows already
+    # own from the RowInternCache — no per-occurrence probe of the
+    # cluster-sized vocab dict, bit-identical ids
+    phase2_intern: bool = True
 
 
 def obj_key(obj) -> tuple:
@@ -226,10 +232,12 @@ class GroupStore:
     never flattened or evaluated."""
 
     def __init__(self, group: frozenset, constraints: Sequence,
-                 evaluator):
+                 evaluator, intern_cache=None):
         self.group = group
         self.cons = [c for c in constraints if c.kind in group]
         self.evaluator = evaluator
+        # shared RowInternCache (phase-2 interning) or None = direct
+        self.intern_cache = intern_cache
         if self.cons and evaluator is not None:
             _bk, lowered, schema = evaluator.sweep_schema(self.cons)
         else:
@@ -349,7 +357,13 @@ class GroupStore:
         n_new = sum(1 for pos, _g, _o in entries if pos is None)
         need = self.n_rows + n_new
         if self.flattener is not None:
-            local = self.flattener.flatten(objs)
+            if self.intern_cache is not None:
+                local = flatten_phase2(
+                    self.flattener, objs,
+                    [gid for _pos, gid, _obj in entries],
+                    self.intern_cache)
+            else:
+                local = self.flattener.flatten(objs)
             if local.has_generate_name is None:
                 local.has_generate_name = np.array(
                     [1 if "generateName" in (o.get("metadata") or {})
@@ -485,6 +499,10 @@ class ClusterSnapshot:
         self.lock = threading.RLock()
         self.ids = RowIdMap()
         self.verdicts = VerdictStore()
+        # phase-2 interning (ops.flatten.flatten_phase2), keyed by the
+        # RowIdMap's stable gids; None disables (direct global interning)
+        self.intern_cache = RowInternCache() \
+            if self.config.phase2_intern else None
         self._groups: dict = {}  # frozenset -> GroupStore
         self._router = None
         self._constraints: list = []
@@ -537,13 +555,16 @@ class ClusterSnapshot:
         self._pos = {}
         self._dirty = set()
         self.verdicts.clear()
+        if self.intern_cache is not None:
+            self.intern_cache.clear()
         self.stale = True
 
     def _store_for(self, kind: str) -> GroupStore:
         g = self._router(kind) if self._router is not None else frozenset()
         store = self._groups.get(g)
         if store is None:
-            store = GroupStore(g, self._constraints, self.evaluator)
+            store = GroupStore(g, self._constraints, self.evaluator,
+                               intern_cache=self.intern_cache)
             self._groups[g] = store
         return store
 
@@ -593,6 +614,8 @@ class ClusterSnapshot:
         self.ids.forget(key)
         store, pos = self._pos.pop(gid)
         store.tombstone(pos)
+        if self.intern_cache is not None:
+            self.intern_cache.forget(gid)
         self.verdicts.clear_gid(gid)
         self._dirty.discard(gid)
         self.patch_count += 1
@@ -869,3 +892,8 @@ class ClusterSnapshot:
         self.metrics.set_gauge(M.SNAPSHOT_DIRTY, st["dirty_rows"])
         self.metrics.set_gauge(M.SNAPSHOT_TOMBSTONE_FRACTION,
                                st["tombstone_fraction"])
+        if self.intern_cache is not None:
+            self.metrics.set_gauge(M.SNAPSHOT_INTERN_HITS,
+                                   self.intern_cache.hits)
+            self.metrics.set_gauge(M.SNAPSHOT_INTERN_PROBES,
+                                   self.intern_cache.probes)

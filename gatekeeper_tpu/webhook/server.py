@@ -209,11 +209,22 @@ class WebhookServer:
                         uid = (q.get("uid") or [""])[0]
                         try:
                             limit = int((q.get("limit") or ["100"])[0])
+                            # time-range filter: unix seconds, half-open
+                            # [since, until); decision-kind filter takes
+                            # ?decision=shed&decision=deny or a comma list
+                            since = (q.get("since") or [None])[0]
+                            until = (q.get("until") or [None])[0]
+                            since = float(since) if since else None
+                            until = float(until) if until else None
                         except ValueError:
-                            self._reply(400, {"error": "bad limit"})
+                            self._reply(400, {"error": "bad limit/since/"
+                                                       "until"})
                             return
-                        self._reply(200, rec.snapshot(uid=uid or None,
-                                                      limit=limit))
+                        kinds = {k for v in (q.get("decision") or [])
+                                 for k in v.split(",") if k}
+                        self._reply(200, rec.snapshot(
+                            uid=uid or None, limit=limit, since=since,
+                            until=until, kinds=kinds or None))
                 elif self.path == METRICS_PATH and outer.metrics is not None:
                     # content negotiation: OpenMetrics (exemplars on the
                     # histogram buckets + # EOF) when the scraper asks

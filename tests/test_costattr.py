@@ -113,6 +113,40 @@ def test_sweep_dispatch_attribution_closes_to_span_wall(library_sweep):
         assert entry["template"] in kinds
 
 
+def test_reduced_collect_occupancy_matches_masks_lane(library_sweep):
+    """--collect=reduced closure satellite: the reduced lane attributes
+    the dispatch wall from the ON-DEVICE occupancy counts (the host
+    never materializes the masks) — the accumulated per-template row
+    occupancy must equal the masks lane's host-side mask sums exactly,
+    and the closure to the dispatch span wall must hold on both lanes."""
+    mgr = library_sweep
+    assert mgr.evaluator.collect == "reduced"  # the default lane
+    mgr_masks = AuditManager(
+        mgr.client, lister=mgr.lister, config=mgr.config,
+        evaluator=ShardedEvaluator(mgr.evaluator.driver, make_mesh(),
+                                   violations_limit=20, collect="masks"))
+    mgr.audit()  # compile both lanes OUTSIDE the attributed runs
+    mgr_masks.audit()
+
+    def dispatch_rows(m):
+        attr = costattr.CostAttribution()
+        tracer = tracing.Tracer(seed=0, ring_capacity=64)
+        with costattr.activate(attr), tracing.activate(tracer):
+            m.audit()
+        span_wall = sum(
+            s["duration_s"]
+            for tr in tracer.traces() for s in tr["spans"]
+            if s["name"] == "device.sweep_dispatch")
+        attributed = attr.total_seconds(costattr.EP_AUDIT,
+                                        costattr.PHASE_DISPATCH)
+        assert attributed == pytest.approx(span_wall, rel=0.05)
+        return {t: cell[2] for (t, ep, ph), cell in attr._cells.items()
+                if ep == costattr.EP_AUDIT
+                and ph == costattr.PHASE_DISPATCH}
+
+    assert dispatch_rows(mgr) == dispatch_rows(mgr_masks)
+
+
 def test_attribution_off_adds_no_cells(library_sweep):
     mgr = library_sweep
     assert costattr.active() is None

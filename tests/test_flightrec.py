@@ -228,3 +228,62 @@ def test_debug_decisions_404_when_off():
         assert e.code == 404
     finally:
         srv.stop()
+
+
+# --- time-range + decision-kind filters ------------------------------------
+
+def test_snapshot_time_range_and_kind_filters():
+    clock = [1000.0]
+    rec = flightrec.FlightRecorder(wall=lambda: clock[0])
+    for i, decision in enumerate(
+            ["allow", "shed", "deny", "shed", "allow"]):
+        clock[0] = 1000.0 + i
+        rec.record("validate", decision, uid=f"u{i}")
+    # half-open [since, until): 1001 and 1002 only
+    snap = rec.snapshot(since=1001.0, until=1003.0)
+    assert [e["uid"] for e in snap["decisions"]] == ["u2", "u1"]
+    assert snap["matched"] == 2
+    # decision-kind filter composes with the range
+    snap = rec.snapshot(since=1001.0, kinds={"shed"})
+    assert [e["uid"] for e in snap["decisions"]] == ["u3", "u1"]
+    # kinds alone
+    snap = rec.snapshot(kinds={"allow", "deny"})
+    assert [e["decision"] for e in snap["decisions"]] == \
+        ["allow", "deny", "allow"]
+    # uid composes with filters
+    snap = rec.snapshot(uid="u1", kinds={"shed"})
+    assert len(snap["decisions"]) == 1
+    assert rec.snapshot(uid="u1", kinds={"allow"})["decisions"] == []
+
+
+def test_debug_decisions_endpoint_filters():
+    clock = [2000.0]
+    rec = flightrec.FlightRecorder(wall=lambda: clock[0])
+    for i, decision in enumerate(["allow", "shed", "deny", "shed"]):
+        clock[0] = 2000.0 + i
+        rec.record("validate", decision, uid=f"u{i}")
+    srv = WebhookServer(port=0, flight_recorder=rec).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/debug/decisions"
+        with urllib.request.urlopen(
+                f"{base}?since=2001&until=2003") as r:
+            doc = json.loads(r.read())
+        assert [e["uid"] for e in doc["decisions"]] == ["u2", "u1"]
+        with urllib.request.urlopen(f"{base}?decision=shed") as r:
+            doc = json.loads(r.read())
+        assert [e["uid"] for e in doc["decisions"]] == ["u3", "u1"]
+        # comma-list and repeated params both parse
+        with urllib.request.urlopen(f"{base}?decision=deny,shed") as r:
+            doc = json.loads(r.read())
+        assert doc["matched"] == 3
+        with urllib.request.urlopen(
+                f"{base}?decision=deny&decision=shed&since=2002") as r:
+            doc = json.loads(r.read())
+        assert [e["uid"] for e in doc["decisions"]] == ["u3", "u2"]
+        try:
+            urllib.request.urlopen(f"{base}?since=notanumber")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()
