@@ -486,6 +486,94 @@ def legacy_lane(n: int = 100_000):
     return rate
 
 
+def make_tenant_body(i: int, namespace: str) -> bytes:
+    """A loadtest admission body re-homed into ``namespace`` (both the
+    request and the object), so the QoS tenant key and the policy
+    matchers see one coherent tenant."""
+    from tools.loadtest_webhook import make_body
+
+    doc = json.loads(make_body(i))
+    doc["request"]["namespace"] = namespace
+    obj = doc["request"].get("object") or {}
+    obj.setdefault("metadata", {})["namespace"] = namespace
+    return json.dumps(doc).encode()
+
+
+def drive_tenant_mix(port: int, plan: list, bodies: dict,
+                     timeout_s: float = 60.0) -> dict:
+    """Offer a multi-tenant load mix against a running webhook and
+    report per-tenant latency/shed stats.
+
+    ``plan``: [{"name": tenant, "conc": N, "n": total requests}, ...] —
+    every tenant's workers run concurrently (the contention IS the
+    measurement); ``bodies``: {tenant: [request bytes, ...]}.  Returns
+    {tenant: {requests, accepted, shed, shed_rate, p50_ms, p99_ms,
+    mean_ms, errors}} — accepted-request latency only, sheds counted
+    separately (the PR 5 burst-lane convention)."""
+    import http.client
+    import statistics
+    import threading
+
+    stats = {t["name"]: {"lat": [], "shed": 0, "errors": []}
+             for t in plan}
+    lock = threading.Lock()
+
+    def worker(tenant: str, wid: int, conc: int, n: int):
+        tb = bodies[tenant]
+        st = stats[tenant]
+        c = http.client.HTTPConnection("127.0.0.1", port,
+                                       timeout=timeout_s)
+        try:
+            for i in range(max(1, n // conc)):
+                body = tb[(wid + i * conc) % len(tb)]
+                t0 = time.perf_counter()
+                c.request("POST", "/v1/admit", body=body,
+                          headers={"Content-Type": "application/json"})
+                resp = json.loads(c.getresponse().read())
+                dt = (time.perf_counter() - t0) * 1000
+                r = resp["response"]
+                shed = (r.get("status", {}).get("code") == 429
+                        or any("overload" in w
+                               for w in r.get("warnings", [])))
+                with lock:
+                    if shed:
+                        st["shed"] += 1
+                    else:
+                        st["lat"].append(dt)
+        except Exception as e:
+            with lock:
+                st["errors"].append(f"{wid}: {type(e).__name__}: {e}")
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker,
+                                args=(t["name"], w, t["conc"], t["n"]))
+               for t in plan for w in range(t["conc"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = {}
+    for t in plan:
+        st = stats[t["name"]]
+        sv = sorted(st["lat"])
+
+        def pct(p):
+            return round(sv[min(len(sv) - 1,
+                                int(p / 100 * len(sv)))], 2) if sv else 0.0
+
+        total = len(sv) + st["shed"]
+        out[t["name"]] = {
+            "concurrency": t["conc"], "requests": total,
+            "accepted": len(sv), "shed": st["shed"],
+            "shed_rate": round(st["shed"] / total, 4) if total else 0.0,
+            "p50_ms": pct(50), "p99_ms": pct(99),
+            "mean_ms": (round(statistics.mean(sv), 2) if sv else 0.0),
+            "errors": st["errors"],
+        }
+    return out
+
+
 def burst_main(n_base: int = 240, conc_base: int = 2,
                burst_mult: int = 8):
     """``--burst``: offered-load step pattern against the real webhook
@@ -595,6 +683,63 @@ def burst_main(n_base: int = 240, conc_base: int = 2,
     burst = drive(n_base * burst_mult, conc_burst)
     log(f"  p50 {burst['p50_ms']}ms p99 {burst['p99_ms']}ms "
         f"shed {burst['shed']} ({burst['shed_rate']:.1%})")
+
+    # step 3: multi-tenant offered-load mix under QoS — tenant A bursts
+    # at burst_mult x tenant B's load plus a system-lane trickle, the
+    # isolation_ratio is B's accepted P99 under attack over B unloaded
+    # (1.0 = perfect isolation; the tier-1 chaos test pins <= 2.0 with
+    # a tight limiter)
+    from gatekeeper_tpu.resilience.qos import QoSConfig
+
+    # tight like steps 1-2: cap 1 slot per tenant and a short queue so
+    # the attacker SHEDS instead of convoying the (1-core) host — the
+    # isolation number then measures the scheduler, not CPU contention
+    qos_ctl = _overload.OverloadController(_overload.OverloadConfig(
+        min_inflight=1, max_inflight=4, initial_inflight=4,
+        queue_depth=16, queue_timeout_s=0.25,
+        qos=QoSConfig(tenant_inflight_cap=1, quantum=16384.0)),
+        metrics=metrics)
+    handler.overload = qos_ctl
+    _overload.install(qos_ctl)
+    tenant_bodies = {
+        "tenant-a": [make_tenant_body(i, "tenant-a") for i in range(32)],
+        "tenant-b": [make_tenant_body(i, "tenant-b") for i in range(32)],
+        "kube-system": [make_tenant_body(i, "kube-system")
+                        for i in range(8)],
+    }
+    log(f"step 3: multi-tenant mix (QoS on: tenant-a {burst_mult}x "
+        f"tenant-b + system trickle)...")
+    anchor = drive_tenant_mix(srv.port, [
+        {"name": "tenant-b", "conc": conc_base, "n": n_base}],
+        tenant_bodies)
+    mix = drive_tenant_mix(srv.port, [
+        {"name": "tenant-a", "conc": conc_base * burst_mult,
+         "n": n_base * burst_mult},
+        {"name": "tenant-b", "conc": conc_base, "n": n_base},
+        {"name": "kube-system", "conc": 1, "n": max(8, n_base // 8)},
+    ], tenant_bodies)
+    b_unloaded_p99 = anchor["tenant-b"]["p99_ms"]
+    isolation_ratio = (round(mix["tenant-b"]["p99_ms"] / b_unloaded_p99, 2)
+                       if b_unloaded_p99 else None)
+    for tn, st in sorted(mix.items()):
+        log(f"  {tn}: p50 {st['p50_ms']}ms p99 {st['p99_ms']}ms "
+            f"shed {st['shed']} ({st['shed_rate']:.1%})")
+    log(f"  isolation_ratio (tenant-b p99 attacked/unloaded): "
+        f"{isolation_ratio}")
+    tenant_mix = {
+        "qos": {"lanes": "system|break-glass|user",
+                "tenant_inflight_cap": 1, "quantum": 16384,
+                "queue_depth": 16, "queue_timeout_s": 0.25},
+        "note": "1-core host: reviews are CPU-bound, so B's attacked "
+                "P99 includes core contention the scheduler cannot "
+                "remove; the pinned <=2x isolation bound is proven "
+                "with controlled service times in tests/test_qos.py",
+        "unloaded_b": anchor["tenant-b"],
+        "mix": mix,
+        "isolation_ratio": isolation_ratio,
+        "sheds_by_tenant": {
+            tn: st["shed"] for tn, st in sorted(mix.items())},
+    }
     srv.stop(drain_timeout=5.0)
     _overload.uninstall()
 
@@ -606,6 +751,7 @@ def burst_main(n_base: int = 240, conc_base: int = 2,
                     "final_limit": ctl.limiter.limit},
         "unloaded": unloaded,
         "burst": burst,
+        "tenant_mix": tenant_mix,
         "p99_ratio": (round(burst["p99_ms"] / unloaded["p99_ms"], 2)
                       if unloaded["p99_ms"] else None),
         "note": f"offered-load step {conc_base}->{conc_burst} conns; "

@@ -61,6 +61,16 @@ def main(argv=None) -> int:
                         "incremental tick (0 = never); divergence "
                         "marks the run incomplete and rebuilds the "
                         "snapshot")
+    p.add_argument("--snapshot-resync-rotate", type=int, default=0,
+                   help="rotate the resync differential over 1/K of "
+                        "the keyspace per resync interval: each resync "
+                        "re-flattens only its deterministic key-hash "
+                        "slice, so the bit-identity proof amortizes "
+                        "(K consecutive resyncs cover every row) "
+                        "instead of re-flattening the whole cluster in "
+                        "one generation; 0/1 = off (one-shot full "
+                        "differential incl. the cluster-global verdict "
+                        "check)")
     p.add_argument("--audit-expand", action="store_true",
                    help="expansion generator stage in the audit sweep: "
                         "generator objects (per ExpansionTemplate "
@@ -178,6 +188,26 @@ def main(argv=None) -> int:
                    help="max summed admission cost (object bytes x "
                         "matched-constraint estimate) queued before "
                         "sheds begin")
+    p.add_argument("--qos", default="off", choices=["on", "off"],
+                   help="per-tenant / per-priority admission QoS on the "
+                        "overload path: priority lanes (system / "
+                        "break-glass ahead of user traffic, shed last), "
+                        "weighted-fair (deficit-round-robin) dequeue "
+                        "across tenants, per-tenant inflight caps + "
+                        "queue-cost budgets, and tenant-aware "
+                        "displacement (the heaviest tenant sheds "
+                        "first).  'off' (the compat default) keeps the "
+                        "single cost-aware FIFO bit-identical to "
+                        "previous releases (README 'Tenant QoS & "
+                        "fairness')")
+    p.add_argument("--qos-config", default="",
+                   help="JSON file of QoS priority levels / tenant "
+                        "weights / caps, mirroring the apiserver APF "
+                        "PriorityLevel shape (see README 'Tenant QoS & "
+                        "fairness'); empty = the built-in lane set "
+                        "(kube-system + gatekeeper-system + system: "
+                        "users ahead of break-glass ahead of everyone, "
+                        "namespace as the tenant key)")
     p.add_argument("--enable-profile", action="store_true",
                    help="serve /debug/profile?seconds=N (pprof equivalent)")
     p.add_argument("--fail-open-on-error", action="store_true",
@@ -422,14 +452,25 @@ def main(argv=None) -> int:
     drain = _overload.DrainCoordinator(metrics=metrics)
     overload_ctl = None
     if args.overload_limiter == "on" and not args.once:
+        from gatekeeper_tpu.resilience.qos import qos_from_args
+
+        qos_cfg = qos_from_args(args.qos, args.qos_config)
         overload_ctl = _overload.OverloadController(
             _overload.OverloadConfig(
                 max_inflight=args.overload_max_inflight,
                 queue_depth=args.overload_queue_depth,
                 queue_cost=args.overload_queue_cost,
+                qos=qos_cfg,
             ),
             metrics=metrics)
         _overload.install(overload_ctl)
+        if qos_cfg is not None:
+            print(f"admission QoS active: "
+                  f"{len(qos_cfg.levels)} priority lanes "
+                  f"({', '.join(lv.name for lv in qos_cfg.levels)}), "
+                  f"tenant key {qos_cfg.tenant_key}, "
+                  f"inflight cap {qos_cfg.tenant_inflight_cap or 'none'} "
+                  f"(/debug/overload)", file=sys.stderr)
     # the L6 observability trio (README "Observability"): cost
     # attribution + SLO engine + flight recorder, all metric-registry
     # backed and served from the /debug endpoints next to /metrics
@@ -441,6 +482,11 @@ def main(argv=None) -> int:
     if args.cost_attribution == "on":
         cost_attr = _costattr.CostAttribution(metrics=metrics)
         _costattr.install(cost_attr)
+        if overload_ctl is not None and args.qos == "on":
+            # the {tenant} axis feeds QoS displacement: measured
+            # per-tenant eval cost decides who is "heaviest", not
+            # arrival order
+            overload_ctl.set_tenant_cost_input(cost_attr.tenant_totals)
     flight_rec = None
     if args.flight_recorder > 0 and not args.once:
         flight_rec = _flightrec.FlightRecorder(
@@ -625,6 +671,7 @@ def main(argv=None) -> int:
                 pipeline_flatten_workers=args.pipeline_flatten_workers,
                 audit_source=audit_source,
                 resync_every=args.snapshot_resync_every,
+                resync_rotate=args.snapshot_resync_rotate,
                 expand_generated=args.audit_expand,
             ),
             evaluator=evaluator,

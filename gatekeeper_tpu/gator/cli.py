@@ -140,6 +140,7 @@ cmd_expand = _delegate("expand_cmd")
 cmd_bench = _delegate("bench")
 cmd_sync = _delegate("sync_cmd")
 cmd_policy = _delegate("policy_cmd")
+cmd_decisions = _delegate("decisions_cmd")
 
 
 COMMANDS = {
@@ -149,6 +150,7 @@ COMMANDS = {
     "bench": cmd_bench,
     "sync": cmd_sync,
     "policy": cmd_policy,
+    "decisions": cmd_decisions,
 }
 
 
@@ -157,7 +159,7 @@ def main(argv=None) -> int:
     # JAX_PLATFORMS honored at package import (gatekeeper_tpu/__init__.py)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: gator [--chaos spec.json] "
-              "{test|verify|expand|bench|sync|policy} [options]")
+              "{test|verify|expand|bench|sync|policy|decisions} [options]")
         return 0
     # global --chaos spec.json: install the deterministic fault-injection
     # plan before any subcommand runs (README 'Failure semantics')
@@ -179,7 +181,7 @@ def main(argv=None) -> int:
         print(f"chaos harness active: {chaos}", file=sys.stderr)
     if not argv:
         print("usage: gator [--chaos spec.json] "
-              "{test|verify|expand|bench|sync|policy} [options]")
+              "{test|verify|expand|bench|sync|policy|decisions} [options]")
         return 0
     cmd = argv[0]
     fn = COMMANDS.get(cmd)

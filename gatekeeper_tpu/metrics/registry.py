@@ -375,7 +375,14 @@ WEBHOOK_BATCH_SIZE = "webhook_batch_size"  # histogram
 OVERLOAD_INFLIGHT_LIMIT = "overload_inflight_limit"  # gauge
 OVERLOAD_QUEUE_DEPTH = "overload_queue_depth"  # gauge
 OVERLOAD_BROWNOUT = "overload_brownout_level"  # gauge
-OVERLOAD_SHED = "overload_shed_count"  # {reason}
+OVERLOAD_SHED = "overload_shed_count"  # {reason[, tenant, priority]}
+# per-tenant / per-priority QoS (resilience/qos.py, --qos on): queued
+# admissions per priority lane, queued admission cost and in-flight
+# reviews per tenant — the isolation story ("is tenant A starving B")
+# as three scrapeable series, all bounded by the cardinality guard
+OVERLOAD_LANE_DEPTH = "overload_lane_queue_depth"  # gauge {priority}
+OVERLOAD_TENANT_COST = "overload_tenant_queue_cost"  # gauge {tenant}
+OVERLOAD_TENANT_INFLIGHT = "overload_tenant_inflight"  # gauge {tenant}
 DRAIN_SECONDS = "drain_seconds"  # gauge
 # resident columnar snapshot (gatekeeper_tpu/snapshot/): live rows,
 # rows dirtied by watch events and awaiting (re)evaluation, tombstoned

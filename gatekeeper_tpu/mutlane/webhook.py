@@ -199,6 +199,7 @@ class BatchedMutationHandler:
 
                 self.metrics.inc_counter(M.MUTATION_REQUEST_COUNT)
             cost = 0.0
+            tenant, lane = self._route(review_body)
             try:
                 if self.overload is not None:
                     from gatekeeper_tpu.resilience.overload import (
@@ -207,12 +208,22 @@ class BatchedMutationHandler:
                     try:
                         cost = estimate_cost(review_body, cost_hint,
                                              self._mutator_estimate)
-                        with self.overload.admit(cost):
+                        # QoS kwargs only when routing produced a lane:
+                        # legacy gates (and test doubles) keep their
+                        # admit(cost) shape
+                        gate = (self.overload.admit(
+                            cost, tenant=tenant, priority=lane)
+                            if lane is not None
+                            else self.overload.admit(cost))
+                        with gate:
                             resp = self._handle(review_body)
                     except Shed as shed:
                         resp = self._shed_response(review_body, shed)
                         self._record_decision(review_body, resp, cost,
-                                              shed_reason=shed.reason)
+                                              shed_reason=shed.reason,
+                                              tenant=tenant, lane=lane)
+                        self._attr_tenant(tenant,
+                                          _t.perf_counter() - t0, cost)
                         return resp
                 else:
                     resp = self._handle(review_body)
@@ -220,11 +231,42 @@ class BatchedMutationHandler:
                 if self.metrics is not None:
                     self.metrics.observe(M.MUTATION_REQUEST_DURATION,
                                          _t.perf_counter() - t0)
-            self._record_decision(review_body, resp, cost)
+            self._record_decision(review_body, resp, cost,
+                                  tenant=tenant, lane=lane)
+            self._attr_tenant(tenant, _t.perf_counter() - t0, cost)
             return resp
 
+    def _route(self, review_body: dict) -> tuple:
+        """(tenant, PriorityLevel-or-None): QoS routing when enabled,
+        else the plain tenant key for the flight-recorder / cost-grid
+        attribution axis (mirrors ValidationHandler._route)."""
+        # duck-typed: test doubles / custom gates may not speak QoS
+        route = getattr(self.overload, "route", None)
+        if route is not None:
+            tenant, lane = route(review_body)
+            if lane is not None:
+                return tenant, lane
+        from gatekeeper_tpu.observability import costattr, flightrec
+        from gatekeeper_tpu.resilience.qos import tenant_of_request
+
+        if flightrec.active() is None and costattr.active() is None:
+            return "", None
+        return tenant_of_request(review_body.get("request") or {}), None
+
+    def _attr_tenant(self, tenant: str, seconds: float,
+                     cost: float) -> None:
+        if not tenant:
+            return
+        from gatekeeper_tpu.observability import costattr
+
+        attr = costattr.active()
+        if attr is not None:
+            attr.record_tenant(tenant, costattr.EP_MUTATION, seconds,
+                               cost=cost)
+
     def _record_decision(self, review_body: dict, resp,
-                         cost: float = 0.0, shed_reason: str = "") -> None:
+                         cost: float = 0.0, shed_reason: str = "",
+                         tenant: str = "", lane=None) -> None:
         from gatekeeper_tpu.observability import flightrec
 
         rec = flightrec.active()
@@ -248,6 +290,8 @@ class BatchedMutationHandler:
             lane=getattr(resp, "lane", "") or "",
             patch_ops=len(resp.patch or []) if resp.patch else 0,
             overload=self.overload,
+            tenant=tenant,
+            priority=getattr(lane, "name", "") or "",
         )
 
     def _shed_response(self, review_body, shed) -> MutationResponse:

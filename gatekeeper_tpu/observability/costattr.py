@@ -50,12 +50,20 @@ class CostAttribution:
     enforcement_point, phase); optionally mirrors into the metrics
     registry as `gatekeeper_constraint_eval_seconds`."""
 
-    def __init__(self, metrics=None, max_templates: int = 512):
+    def __init__(self, metrics=None, max_templates: int = 512,
+                 max_tenants: int = 512):
         self.metrics = metrics
         self.max_templates = max_templates
+        self.max_tenants = max_tenants
         self._lock = threading.Lock()
         # (template, ep, phase) -> [seconds, passes, rows]
         self._cells: dict = {}
+        # the {tenant} axis (observability NEXT #1): (tenant, ep) ->
+        # [seconds, requests, admission cost].  Kept SEPARATE from the
+        # template cells so the per-template closure property (shares
+        # sum to the parent pass's wall) is untouched — tenant seconds
+        # are request wall, a different population.
+        self._tenant_cells: dict = {}
 
     # --- recording -----------------------------------------------------
     def record(self, template: str, enforcement_point: str, phase: str,
@@ -80,6 +88,49 @@ class CostAttribution:
                 {"template": key[0], "enforcement_point": enforcement_point,
                  "phase": phase},
                 value=seconds)
+
+    def record_tenant(self, tenant: str, enforcement_point: str,
+                      seconds: float, cost: float = 0.0) -> None:
+        """One admission's wall seconds + admission cost charged to its
+        tenant — the ``{tenant}`` axis on
+        ``gatekeeper_constraint_eval_seconds``.  The metric rides
+        separate series ``{tenant, enforcement_point, phase="admission"}``
+        (no template label) so cardinality stays ADDITIVE (templates +
+        tenants, not their product); past ``max_tenants`` new tenants
+        fold into ``other`` here, and the registry's label-cardinality
+        guard bounds the exposed series regardless."""
+        key = (tenant, enforcement_point)
+        with self._lock:
+            cell = self._tenant_cells.get(key)
+            if cell is None:
+                if len(self._tenant_cells) >= self.max_tenants:
+                    key = ("other", enforcement_point)
+                    cell = self._tenant_cells.get(key)
+                if cell is None:
+                    cell = self._tenant_cells[key] = [0.0, 0, 0.0]
+            cell[0] += seconds
+            cell[1] += 1
+            cell[2] += cost
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(
+                M.CONSTRAINT_EVAL,
+                {"tenant": key[0], "enforcement_point": enforcement_point,
+                 "phase": "admission"},
+                value=seconds)
+
+    def tenant_totals(self, enforcement_point: Optional[str] = None
+                      ) -> dict:
+        """{tenant: attributed seconds} — the "who is heaviest" input
+        the QoS displacement ladder consumes
+        (``OverloadController.set_tenant_cost_input``)."""
+        out: dict = {}
+        with self._lock:
+            for (tenant, ep), (s, _n, _c) in self._tenant_cells.items():
+                if enforcement_point is None or ep == enforcement_point:
+                    out[tenant] = out.get(tenant, 0.0) + s
+        return out
 
     def attribute(self, wall_s: float, weights: dict,
                   enforcement_point: str, phase: str,
@@ -121,7 +172,14 @@ class CostAttribution:
                 ph.get(cell["phase"], 0.0) + cell["seconds"], 6)
         top = sorted(by_template.values(),
                      key=lambda a: -a["seconds"])
-        return {"top": top, "cells": sorted(
+        with self._lock:
+            tenants = sorted(
+                ({"tenant": t, "enforcement_point": ep,
+                  "seconds": round(s, 6), "requests": n,
+                  "admission_cost": round(c, 1)}
+                 for (t, ep), (s, n, c) in self._tenant_cells.items()),
+                key=lambda a: -a["seconds"])
+        return {"top": top, "tenants": tenants, "cells": sorted(
             cells, key=lambda c: -c["seconds"])}
 
     def total_seconds(self, enforcement_point: Optional[str] = None,
@@ -153,6 +211,7 @@ class CostAttribution:
     def reset(self) -> None:
         with self._lock:
             self._cells.clear()
+            self._tenant_cells.clear()
 
 
 # --- activation (the faults.py pattern) -----------------------------------

@@ -71,6 +71,14 @@ class Shed(Exception):
 class OverloadConfig:
     """Knobs for the limiter + admission queue + brownout ladder."""
 
+    # per-tenant / per-priority QoS (resilience/qos.py): a parsed
+    # QoSConfig replaces the single FIFO with priority lanes +
+    # weighted-fair (deficit-round-robin) dequeue across tenants,
+    # per-tenant inflight caps / queue-cost budgets, and tenant-aware
+    # displacement.  None (the compat default, `--qos off`) keeps the
+    # PR 5 single-FIFO path bit-identical (differential-tested).
+    qos: Optional[object] = None
+
     # adaptive concurrency (AIMD)
     min_inflight: int = 1
     max_inflight: int = 64
@@ -146,6 +154,13 @@ class AdaptiveLimiter:
                 self._inflight += 1
                 return True
             return False
+
+    def cancel(self) -> None:
+        """Give back a slot WITHOUT a latency sample (the QoS
+        dispatcher speculatively acquires before picking a ticket; a
+        pick that comes back empty must not feed the AIMD window)."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
 
     def release(self, latency_s: float) -> None:
         c = self.config
@@ -239,13 +254,42 @@ class OverloadController:
         # work BEFORE the queue itself backs up.  None (the default)
         # keeps the PR 5 behavior bit-identical.
         self._slo_input = None
+        # per-tenant / per-priority QoS (resilience/qos.py): when the
+        # config carries a QoSConfig the admission queue is the
+        # priority-lane DRR queue; None keeps the PR 5 single FIFO
+        self._queue_qos = None
+        self._tenant_inflight: dict = {}
+        self._exported_tenants: set = set()
+        self._seq = 0
+        self._tenant_cost_input = None
+        # the deterministic dequeue/shed trajectory (QoS mode only):
+        # ("grant", seq, tenant, priority) / ("shed", seq, tenant,
+        # reason) in decision order — identical (config, seed, arrival
+        # order) replays it exactly (pinned in tests; /debug/overload
+        # reports its length)
+        from collections import deque as _deque
+
+        self.trajectory = _deque(maxlen=16384)
+        self._ledger_qos = None
+        if self.config.qos is not None:
+            from gatekeeper_tpu.resilience.qos import (QoSQueue,
+                                                       TenantCostLedger)
+
+            self._ledger_qos = TenantCostLedger()
+            self._queue_qos = QoSQueue(self.config.qos,
+                                       heaviness=self._heaviness)
 
     # --- admission -------------------------------------------------------
     @contextmanager
-    def admit(self, cost: float = 1.0):
+    def admit(self, cost: float = 1.0, tenant: str = "", priority=None):
         """Admission gate: acquire a limiter slot (immediately or via the
         bounded queue) or raise :class:`Shed`.  The body's wall time is
-        the limiter's latency sample."""
+        the limiter's latency sample.
+
+        ``tenant``/``priority`` (a :class:`qos.PriorityLevel`) engage
+        the QoS queue when the controller was built with a QoSConfig
+        (see :meth:`route`); with QoS off both are ignored and the path
+        is the PR 5 single FIFO, bit-identical."""
         from gatekeeper_tpu.resilience.faults import fault_point
 
         # the chaos seam for this tier: error mode forces a shed (the
@@ -255,15 +299,164 @@ class OverloadController:
                     error_factory=lambda spec: Shed(
                         reason="chaos",
                         retry_after_s=spec.delay_s or 1.0))
-        if not self.limiter.try_acquire():
-            self._queue_for_slot(cost)  # raises Shed on refusal
+        if self._queue_qos is None:
+            if not self.limiter.try_acquire():
+                self._queue_for_slot(cost)  # raises Shed on refusal
+        else:
+            from gatekeeper_tpu.resilience import qos as _qos
+
+            tenant = tenant or _qos.CLUSTER_TENANT
+            if priority is None:
+                priority = self.config.qos.classify("", "")
+            self._qos_admit(cost, tenant, priority)  # raises Shed
         t0 = self._clock()
         try:
             yield
         finally:
             self.limiter.release(self._clock() - t0)
             with self._cv:
-                self._cv.notify()
+                if self._queue_qos is not None:
+                    n = self._tenant_inflight.get(tenant, 0) - 1
+                    if n <= 0:
+                        self._tenant_inflight.pop(tenant, None)
+                    else:
+                        self._tenant_inflight[tenant] = n
+                    self._dispatch_locked()
+                    self._pressure_locked()
+                    self._cv.notify_all()
+                else:
+                    self._cv.notify()
+
+    # --- QoS path (resilience/qos.py) ------------------------------------
+    def route(self, review_body: dict) -> tuple:
+        """(tenant, PriorityLevel) of an AdmissionReview body under the
+        active QoS config; ("", None) with QoS off.  The webhook
+        handlers call this once and pass the result to :meth:`admit`
+        (and to the flight recorder / cost grid as the tenant axis)."""
+        if self._queue_qos is None:
+            return "", None
+        from gatekeeper_tpu.resilience import qos as _qos
+
+        req = review_body.get("request") or {}
+        cfg = self.config.qos
+        tenant = _qos.tenant_of_request(req, cfg.tenant_key)
+        level = cfg.classify(
+            req.get("namespace", "") or "",
+            ((req.get("userInfo") or {}).get("username", "")) or "")
+        return tenant, level
+
+    def _heaviness(self, tenant: str) -> float:
+        """Displacement ranking: the internal decayed admitted-cost
+        ledger plus (when wired) the PR 8 cost-attribution ``{tenant}``
+        axis — "shed the heaviest tenant first" keys on measured cost,
+        not arrival order."""
+        h = self._ledger_qos.heaviness(tenant) \
+            if self._ledger_qos is not None else 0.0
+        if self._tenant_cost_input is not None:
+            try:
+                ext = self._tenant_cost_input() or {}
+                # seconds-scale attribution vs bytes-scale ledger: weigh
+                # the external axis up so measured eval cost dominates
+                # once present
+                h += float(ext.get(tenant, 0.0)) * 1e6
+            except Exception:
+                pass  # attribution must never break admission
+        return h
+
+    def set_tenant_cost_input(self, fn) -> None:
+        """Wire a per-tenant cost source (callable -> {tenant: cost},
+        e.g. ``CostAttribution.tenant_totals``); None disconnects."""
+        with self._cv:
+            self._tenant_cost_input = fn
+
+    def _qos_admit(self, cost: float, tenant: str, level) -> None:
+        from gatekeeper_tpu.resilience.qos import Ticket
+
+        c = self.config
+        cap = c.qos.tenant_inflight_cap
+        with self._cv:
+            t = Ticket(self._seq, tenant, level, cost)
+            self._seq += 1
+            # fast path: nothing queued ahead, tenant under its cap, a
+            # free slot — grant without touching the queue (an idle
+            # server admits with zero scheduling overhead)
+            if self._queue_qos.depth == 0 and not (
+                    cap > 0
+                    and self._tenant_inflight.get(tenant, 0) >= cap) \
+                    and self.limiter.try_acquire():
+                self._grant_locked(t)
+                return
+            admitted, victim, reason = self._queue_qos.enqueue(
+                t, c.queue_depth, c.queue_cost)
+            if victim is not None:
+                # tenant-aware displacement: the heaviest tenant's
+                # newest ticket pays instead of this arrival
+                self.trajectory.append(
+                    ("shed", victim.seq, victim.tenant, "displaced"))
+                self._cv.notify_all()
+            if not admitted:
+                self.trajectory.append(("shed", t.seq, tenant, reason))
+                self._pressure_locked()
+                self._shed_locked(reason, tenant=tenant,
+                                  priority=level.name)
+            self._pressure_locked()
+            self._dispatch_locked()
+            end = self._clock() + max(0.0, c.queue_timeout_s)
+            try:
+                while not t.granted and t.shed is None:
+                    remaining = end - self._clock()
+                    if remaining <= 0:
+                        # remove() False means the dispatcher granted or
+                        # displaced this ticket concurrently with the
+                        # timeout expiry — shedding then would leak the
+                        # already-acquired slot; fall through and let
+                        # the ticket's own state decide
+                        if self._queue_qos.remove(t):
+                            self.trajectory.append(
+                                ("shed", t.seq, tenant, "queue_timeout"))
+                            self._shed_locked("queue_timeout",
+                                              tenant=tenant,
+                                              priority=level.name)
+                        break
+                    self._cv.wait(min(remaining, 0.05))
+                if t.shed is not None:
+                    # displaced while waiting (trajectory already
+                    # recorded at the displacement decision)
+                    self._shed_locked(t.shed, tenant=tenant,
+                                      priority=level.name)
+            finally:
+                self._pressure_locked()
+
+    def _grant_locked(self, t) -> None:
+        t.granted = True
+        self._tenant_inflight[t.tenant] = \
+            self._tenant_inflight.get(t.tenant, 0) + 1
+        if self._ledger_qos is not None:
+            self._ledger_qos.charge(t.tenant, t.cost)
+        self.trajectory.append(
+            ("grant", t.seq, t.tenant, t.level.name))
+
+    def _dispatch_locked(self) -> None:
+        """Hand freed limiter slots to queued tickets in QoS order:
+        strict priority across lanes, DRR across tenants, per-tenant
+        inflight caps honored (call under ``_cv``)."""
+        q = self._queue_qos
+        granted = False
+        while q.depth:
+            if not self.limiter.try_acquire():
+                break
+            t = q.pick_next(
+                lambda tn: self._tenant_inflight.get(tn, 0))
+            if t is None:
+                # every queued tenant is at its inflight cap: the slot
+                # goes back without an AIMD sample
+                self.limiter.cancel()
+                break
+            self._grant_locked(t)
+            granted = True
+        if granted:
+            self._pressure_locked()
+            self._cv.notify_all()
 
     def _queue_for_slot(self, cost: float) -> None:
         c = self.config
@@ -291,19 +484,30 @@ class OverloadController:
                 self._queue_cost -= cost
                 self._pressure_locked()
 
-    def _shed_locked(self, reason: str) -> None:
+    def _shed_locked(self, reason: str, tenant: str = "",
+                     priority: str = "") -> None:
         self.shed_count += 1
         if self.metrics is not None:
             from gatekeeper_tpu.metrics import registry as M
 
-            self.metrics.inc_counter(M.OVERLOAD_SHED, {"reason": reason})
+            labels = {"reason": reason}
+            # QoS mode: the shed counter grows {tenant, priority} axes
+            # (bounded by the registry's cardinality guard); the legacy
+            # path keeps the PR 5 {reason}-only labelset bit-identical
+            if tenant:
+                labels["tenant"] = tenant
+            if priority:
+                labels["priority"] = priority
+            self.metrics.inc_counter(M.OVERLOAD_SHED, labels)
         try:
             from gatekeeper_tpu.utils.logging import log_event
 
             log_event("warning", "request shed under overload",
                       event_type="overload_shed", reason=reason,
                       queue_depth=self._queue_len,
-                      inflight_limit=self.limiter.limit)
+                      inflight_limit=self.limiter.limit,
+                      **({"tenant": tenant} if tenant else {}),
+                      **({"priority": priority} if priority else {}))
         except Exception:
             pass
         raise Shed(reason=reason,
@@ -313,6 +517,13 @@ class OverloadController:
     def _pressure_locked(self) -> None:
         """Recompute queue fill + brownout level (call under _cv)."""
         c = self.config
+        if self._queue_qos is not None:
+            # the QoS queue owns depth/cost; mirror into the legacy
+            # fields so the ladder math (and its metrics) stay one code
+            # path for both modes
+            self._queue_len = self._queue_qos.depth
+            self._queue_cost = self._queue_qos.cost_total
+            self._export_qos_locked()
         fill = 0.0
         if c.queue_depth > 0:
             fill = max(fill, self._queue_len / c.queue_depth)
@@ -365,13 +576,77 @@ class OverloadController:
             self._pressure_locked()
             return self._brownout
 
+    def _export_qos_locked(self) -> None:
+        """Per-lane / per-tenant gauges (QoS mode; call under _cv).
+        Tenants that left the queue zero out instead of lingering at
+        their last value."""
+        if self.metrics is None:
+            return
+        from gatekeeper_tpu.metrics import registry as M
+
+        q = self._queue_qos
+        for lane in q.lanes:
+            self.metrics.set_gauge(M.OVERLOAD_LANE_DEPTH, lane.depth(),
+                                   {"priority": lane.level.name})
+        current = set(q.tenant_cost) | set(self._tenant_inflight)
+        for tn in self._exported_tenants - current:
+            self.metrics.set_gauge(M.OVERLOAD_TENANT_COST, 0.0,
+                                   {"tenant": tn})
+            self.metrics.set_gauge(M.OVERLOAD_TENANT_INFLIGHT, 0,
+                                   {"tenant": tn})
+        for tn in current:
+            self.metrics.set_gauge(M.OVERLOAD_TENANT_COST,
+                                   q.tenant_cost.get(tn, 0.0),
+                                   {"tenant": tn})
+            self.metrics.set_gauge(M.OVERLOAD_TENANT_INFLIGHT,
+                                   self._tenant_inflight.get(tn, 0),
+                                   {"tenant": tn})
+        self._exported_tenants = current
+
     def brownout_level(self) -> int:
         with self._cv:
             return self._brownout
 
     def queue_depth(self) -> int:
         with self._cv:
+            if self._queue_qos is not None:
+                return self._queue_qos.depth
             return self._queue_len
+
+    def snapshot(self) -> dict:
+        """The ``/debug/overload`` payload: limiter + ladder state, and
+        (QoS mode) the full lane view — per-priority queue depths,
+        per-tenant queued cost / deficit / weight / inflight, the
+        heaviness ranking displacement keys on, and the trajectory
+        length (the deterministic dequeue/shed event count)."""
+        with self._cv:
+            out = {
+                "mode": "qos" if self._queue_qos is not None else "fifo",
+                "brownout": self._brownout,
+                "inflight": self.limiter.inflight,
+                "inflight_limit": self.limiter.limit,
+                "queue_depth": (self._queue_qos.depth
+                                if self._queue_qos is not None
+                                else self._queue_len),
+                "queue_cost": round(
+                    self._queue_qos.cost_total
+                    if self._queue_qos is not None
+                    else self._queue_cost, 1),
+                "shed_count": self.shed_count,
+            }
+            if self._queue_qos is not None:
+                cfg = self.config.qos
+                out["qos"] = self._queue_qos.snapshot()
+                out["qos"]["tenant_inflight"] = dict(self._tenant_inflight)
+                out["qos"]["tenant_inflight_cap"] = cfg.tenant_inflight_cap
+                out["qos"]["tenant_queue_cost"] = cfg.tenant_queue_cost
+                if self._ledger_qos is not None:
+                    out["qos"]["tenant_heaviness"] = {
+                        t: round(v, 1) for t, v in sorted(
+                            self._ledger_qos.totals().items(),
+                            key=lambda kv: -kv[1])[:32]}
+                out["qos"]["trajectory_len"] = len(self.trajectory)
+            return out
 
 
 # --- activation (mirrors faults.py: process-global + scoped) --------------

@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
+import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Iterable, Optional, Sequence
@@ -73,6 +74,16 @@ def obj_key(obj) -> tuple:
     FakeCluster's store key; uids are not guaranteed off a real
     apiserver's test doubles)."""
     return (gvk_of(obj), namespace_of(obj), name_of(obj))
+
+
+def resync_slice(key: tuple, phase: int, k: int) -> bool:
+    """Rotor membership of an object key for the rotated resync
+    differential: a stable content hash (crc32 of the canonical key
+    repr) mod K — independent of gid assignment order and of Python's
+    per-process string-hash seed, so the K slices partition the
+    keyspace identically across restarts and both directions of the
+    membership check agree."""
+    return zlib.crc32(repr(key).encode()) % k == phase
 
 
 # --- tall-batch array plumbing --------------------------------------------
@@ -787,7 +798,9 @@ class ClusterSnapshot:
         return self.get(("", "v1", "Namespace"), "", name)
 
     # --- resync differential ---------------------------------------------
-    def resync_differential(self, lister) -> Optional[str]:
+    def resync_differential(self, lister,
+                            rotor: Optional[tuple] = None
+                            ) -> Optional[str]:
         """Re-list + re-flatten fresh and compare against the resident
         columns row by row: membership, routing, and the full per-row
         column signature (identity, counts, every family trimmed to real
@@ -795,7 +808,17 @@ class ClusterSnapshot:
         — by resync time every string is interned, so a vocab that grows
         here is itself a divergence.  Returns None when bit-identical,
         else a first-difference description.  O(cluster) by design (the
-        periodic proof)."""
+        periodic proof).
+
+        ``rotor=(phase, K)`` restricts the proof to the 1/K slice of the
+        keyspace whose deterministic key hash lands on ``phase``
+        (:func:`resync_slice`): only slice objects re-flatten and only
+        slice identities must be present/absent, so K consecutive
+        rotated calls cover every row at ~1/K the re-flatten cost each
+        (``--snapshot-resync-rotate``).  The hash keys on the object
+        key, not the gid, so membership-in-slice is stable for rows the
+        snapshot has never seen (a missed add diverges within K
+        intervals)."""
         from gatekeeper_tpu.observability import tracing
 
         with tracing.span("snapshot.resync"), self.lock:
@@ -836,6 +859,9 @@ class ClusterSnapshot:
 
             for obj in lister():
                 key = obj_key(obj)
+                if rotor is not None and \
+                        not resync_slice(key, rotor[0], rotor[1]):
+                    continue  # out of rotation this interval
                 seen.add(key)
                 if diff:
                     break
@@ -858,7 +884,9 @@ class ClusterSnapshot:
                     if objs and not diff:
                         check_chunk(store, objs, keys)
             if not diff:
-                extra = [k for k in self.ids.uids() if k not in seen]
+                extra = [k for k in self.ids.uids() if k not in seen
+                         and (rotor is None
+                              or resync_slice(k, rotor[0], rotor[1]))]
                 if extra:
                     diff.append(f"snapshot row {extra[0]!r} not in the "
                                 f"fresh relist")

@@ -136,6 +136,8 @@ class ValidationHandler:
     def handle(self, review_body: dict,
                cost_hint: int = 0) -> ValidationResponse:
         cost = 0.0
+        tenant, lane = self._route(review_body)
+        t0 = time.perf_counter()
         if self.overload is not None:
             from gatekeeper_tpu.resilience.overload import (Shed,
                                                             estimate_cost)
@@ -143,20 +145,64 @@ class ValidationHandler:
             try:
                 cost = estimate_cost(review_body, cost_hint,
                                      self._constraint_estimate)
-                with self.overload.admit(cost):
+                # QoS kwargs only when routing produced a lane: legacy
+                # gates (and test doubles) keep their admit(cost) shape
+                gate = (self.overload.admit(cost, tenant=tenant,
+                                            priority=lane)
+                        if lane is not None
+                        else self.overload.admit(cost))
+                with gate:
                     resp = self._counted(review_body)
             except Shed as shed:
                 resp = self._shed_response(review_body, shed)
                 self._record_decision(review_body, resp, cost,
-                                      shed_reason=shed.reason)
+                                      shed_reason=shed.reason,
+                                      tenant=tenant, lane=lane)
+                self._attr_tenant(tenant, time.perf_counter() - t0, cost)
                 return resp
         else:
             resp = self._counted(review_body)
-        self._record_decision(review_body, resp, cost)
+        self._record_decision(review_body, resp, cost,
+                              tenant=tenant, lane=lane)
+        self._attr_tenant(tenant, time.perf_counter() - t0, cost)
         return resp
 
+    def _route(self, review_body: dict) -> tuple:
+        """(tenant, PriorityLevel-or-None) for this request: the QoS
+        routing when the controller carries a QoS config, else the plain
+        namespace/serviceaccount tenant key — the shared attribution
+        axis for the flight recorder and the cost grid (observability
+        NEXT #1), present with or without QoS."""
+        # duck-typed: test doubles / custom gates may not speak QoS
+        route = getattr(self.overload, "route", None)
+        if route is not None:
+            tenant, lane = route(review_body)
+            if lane is not None:
+                return tenant, lane
+        from gatekeeper_tpu.observability import costattr, flightrec
+        from gatekeeper_tpu.resilience.qos import tenant_of_request
+
+        if flightrec.active() is None and costattr.active() is None:
+            return "", None  # nobody consumes the axis: skip the lookup
+        return tenant_of_request(review_body.get("request") or {}), None
+
+    def _attr_tenant(self, tenant: str, seconds: float,
+                     cost: float) -> None:
+        """Per-tenant admission cost attribution (the ``{tenant}`` axis
+        on ``gatekeeper_constraint_eval_seconds``): one wall-time sample
+        per admission, charged to the request's tenant."""
+        if not tenant:
+            return
+        from gatekeeper_tpu.observability import costattr
+
+        attr = costattr.active()
+        if attr is not None:
+            attr.record_tenant(tenant, costattr.EP_WEBHOOK, seconds,
+                               cost=cost)
+
     def _record_decision(self, review_body: dict, resp,
-                         cost: float = 0.0, shed_reason: str = "") -> None:
+                         cost: float = 0.0, shed_reason: str = "",
+                         tenant: str = "", lane=None) -> None:
         """Flight-recorder seam: one structured entry per decision (a
         no-op without an installed recorder)."""
         from gatekeeper_tpu.observability import flightrec
@@ -188,6 +234,8 @@ class ValidationHandler:
             warnings=len(resp.warnings or []),
             code=resp.code if not resp.allowed else 0,
             overload=self.overload,
+            tenant=tenant,
+            priority=getattr(lane, "name", "") or "",
         )
 
     def _counted(self, review_body: dict) -> ValidationResponse:

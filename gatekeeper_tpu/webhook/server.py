@@ -29,6 +29,7 @@ TRACES_PATH = "/debug/traces"
 COST_PATH = "/debug/cost"
 SLO_PATH = "/debug/slo"
 DECISIONS_PATH = "/debug/decisions"
+OVERLOAD_PATH = "/debug/overload"
 
 
 def admission_response(uid: str, allowed: bool, message: str = "",
@@ -192,6 +193,21 @@ class WebhookServer:
                     else:
                         snap = eng.snapshot() or eng.tick()
                         self._reply(200, snap)
+                elif self.path == OVERLOAD_PATH:
+                    # the overload gate's lane view: limiter + brownout
+                    # state, and with --qos on the per-priority /
+                    # per-tenant queue, deficit, cap and heaviness state
+                    # (resilience/overload.OverloadController.snapshot)
+                    from gatekeeper_tpu.resilience import overload as ovl
+
+                    ctl = ovl.active_controller()
+                    if ctl is None:
+                        self._reply(404, {"error": "overload limiter not "
+                                                   "enabled (run with "
+                                                   "--overload-limiter "
+                                                   "on)"})
+                    else:
+                        self._reply(200, ctl.snapshot())
                 elif self.path.startswith(DECISIONS_PATH):
                     # the admission flight recorder: every decision in
                     # the ring, or one uid's history (?uid=)
@@ -222,9 +238,11 @@ class WebhookServer:
                             return
                         kinds = {k for v in (q.get("decision") or [])
                                  for k in v.split(",") if k}
+                        tenant = (q.get("tenant") or [None])[0]
                         self._reply(200, rec.snapshot(
                             uid=uid or None, limit=limit, since=since,
-                            until=until, kinds=kinds or None))
+                            until=until, kinds=kinds or None,
+                            tenant=tenant))
                 elif self.path == METRICS_PATH and outer.metrics is not None:
                     # content negotiation: OpenMetrics (exemplars on the
                     # histogram buckets + # EOF) when the scraper asks

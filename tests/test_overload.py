@@ -493,6 +493,39 @@ def test_limiter_on_unloaded_bit_identical_to_off(library_setup):
     assert any(not sig[0] for sig in baseline)  # non-vacuous: real denies
 
 
+def test_qos_off_bit_identical_to_pr5_fifo_over_library(library_setup):
+    """The ISSUE 10 compat differential: qos=None (the ``--qos off``
+    default) IS the PR 5 single-FIFO code path — verdict-for-verdict
+    identical to no limiter at all over the library corpus; and QoS ON
+    while unloaded perturbs nothing either (zero sheds, brownout 0,
+    same signatures, every trajectory event a grant)."""
+    from gatekeeper_tpu.resilience.qos import QoSConfig
+
+    client, objects = library_setup
+    bodies = _admission_bodies(objects)
+    baseline = [_signature(ValidationHandler(client).handle(b))
+                for b in bodies]
+    off_ctl = ovl.OverloadController(ovl.OverloadConfig())
+    assert off_ctl._queue_qos is None  # the PR 5 branch, literally
+    with ovl.activate(off_ctl):
+        off_sigs = [_signature(
+            ValidationHandler(client, overload=off_ctl).handle(b))
+            for b in bodies]
+    assert off_sigs == baseline
+    assert off_ctl.shed_count == 0 and len(off_ctl.trajectory) == 0
+    qos_ctl = ovl.OverloadController(ovl.OverloadConfig(
+        qos=QoSConfig()))
+    with ovl.activate(qos_ctl):
+        qos_sigs = [_signature(
+            ValidationHandler(client, overload=qos_ctl).handle(b))
+            for b in bodies]
+    assert qos_sigs == baseline
+    assert qos_ctl.shed_count == 0
+    assert qos_ctl.brownout_level() == 0
+    assert all(e[0] == "grant" for e in qos_ctl.trajectory)
+    assert any(not sig[0] for sig in baseline)  # non-vacuous: real denies
+
+
 def test_burst_p99_bounded_and_sheds_policy_correct(library_setup):
     """4x offered-load burst against a chaos-slowed review: accepted P99
     stays within 2x the unloaded P99, every shed is failurePolicy-shaped,

@@ -472,6 +472,59 @@ def test_chaos_churn_over_kube_watch_faults(corpus, server):
         kube.close()
 
 
+# --- 4c. rotated resync (ISSUE 10 satellite) -------------------------------
+
+def test_resync_rotation_partitions_keyspace_and_stays_clean(corpus):
+    """``--snapshot-resync-rotate K``: the K key-hash slices partition
+    the keyspace exactly (every key in one slice, no slice empty at
+    this corpus size), each rotated resync proves only its slice, and a
+    clean snapshot passes a full rotation."""
+    from gatekeeper_tpu.snapshot.store import obj_key, resync_slice
+
+    client, _tpu, objects, evaluator = corpus
+    cluster = _fake_cluster(objects[:90])
+    snapshot, snap_mgr, _relist = _managers(client, evaluator, cluster,
+                                            resync_rotate=4)
+    snap_mgr.audit()
+    keys = [obj_key(o) for o in cluster.list()]
+    per_slice = [sum(1 for k in keys if resync_slice(k, p, 4))
+                 for p in range(4)]
+    assert sum(per_slice) == len(keys)  # a partition, not a sample
+    assert all(n > 0 for n in per_slice)
+    for _ in range(4):  # one full rotation: every slice proves clean
+        run = snap_mgr.audit_resync()
+        assert snap_mgr.last_resync_diff is None
+        assert not run.incomplete
+        assert snap_mgr.perf["resync_scope"] == 0.25
+
+
+def test_resync_rotation_catches_divergence_within_k_intervals(corpus):
+    """Corrupt ONE resident row: the rotated resync flags it no later
+    than the pass whose slice holds the row (within K intervals),
+    invalidates the snapshot, and the post-rebuild rotation is clean."""
+    client, _tpu, objects, evaluator = corpus
+    cluster = _fake_cluster(objects[:60])
+    snapshot, snap_mgr, _relist = _managers(client, evaluator, cluster,
+                                            resync_rotate=3)
+    snap_mgr.audit()
+    store = next(s for s in snapshot.routed_stores() if s.n_rows)
+    store.batch.kind_sid[0] += 1  # flip one identity column value
+    caught_at = None
+    for i in range(3):
+        snap_mgr.audit_resync()
+        if snap_mgr.last_resync_diff is not None:
+            caught_at = i
+            break
+    assert caught_at is not None, \
+        "a full rotation must visit the corrupted row's slice"
+    assert snapshot.stale  # invalidated: the next sweep rebuilds
+    snap_mgr.audit()  # rebuild
+    for _ in range(3):  # post-rebuild rotation proves clean again
+        run = snap_mgr.audit_resync()
+        assert snap_mgr.last_resync_diff is None
+        assert not run.incomplete
+
+
 # --- 5. webhook warm cache -------------------------------------------------
 
 def test_webhook_namespace_lookup_served_from_snapshot(corpus):
