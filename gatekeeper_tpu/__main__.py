@@ -113,6 +113,21 @@ def main(argv=None) -> int:
                         "Python); 'raw'/'dict'/'py' force a lane; "
                         "'differential' runs raw THEN dict per chunk "
                         "and asserts bit-identical columns (debugging)")
+    p.add_argument("--extdata-lane", default="batched",
+                   choices=["batched", "perkey", "differential"],
+                   help="external-data resolution lane: 'batched' dedupes "
+                        "provider keys across each admission burst / audit "
+                        "chunk, bulk-fetches per provider into resident "
+                        "columns and joins verdicts on device; 'perkey' "
+                        "keeps the per-key ProviderCache reference path "
+                        "(external-data templates stay on the exact "
+                        "interpreter); 'differential' runs batched AND "
+                        "asserts verdicts + resolved values bit-identical "
+                        "to per-key")
+    p.add_argument("--extdata-max-keys", type=int, default=256,
+                   help="max keys per bulk provider call (the batched "
+                        "lane chunks larger deduped miss lists into "
+                        "multiple transport sends)")
     p.add_argument("--collect", default="reduced",
                    choices=["reduced", "masks", "differential"],
                    help="sweep collect lane: 'reduced' folds verdicts ON "
@@ -556,7 +571,24 @@ def main(argv=None) -> int:
     export = ExportSystem()
     if args.export_dir:
         export.upsert_connection("disk", "disk", {"path": args.export_dir})
+    # batched external-data join lane (extdata/lane.py): one process-wide
+    # lane over the manager's provider cache — the webhook's device grid,
+    # the audit sweep and mutation-placeholder resolution all dedupe
+    # their keys through it; 'perkey' keeps the PR 2 per-key reference
+    # behavior (external-data templates stay on the interpreter)
+    from gatekeeper_tpu.externaldata.providers import ProviderCache
+    from gatekeeper_tpu.extdata import lane as _extlane
+
+    provider_cache = ProviderCache(metrics=metrics)
+    extdata_lane = _extlane.ExtDataLane(
+        provider_cache, mode=args.extdata_lane,
+        max_keys_per_call=args.extdata_max_keys, metrics=metrics)
+    _extlane.install(extdata_lane)
+    if args.extdata_lane != "batched":
+        print(f"extdata lane: {args.extdata_lane}", file=sys.stderr)
     mgr = Manager(client, cluster, operations=operations,
+                  provider_cache=provider_cache,
+                  extdata_lane=extdata_lane,
                   export_system=export, metrics=metrics,
                   readiness_retries=args.readiness_retries).start()
 

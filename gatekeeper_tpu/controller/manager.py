@@ -74,6 +74,7 @@ class Manager:
         mutation_system: Optional[MutationSystem] = None,
         expansion_system: Optional[ExpansionSystem] = None,
         provider_cache: Optional[ProviderCache] = None,
+        extdata_lane=None,  # extdata/lane.ExtDataLane
         export_system=None,
         metrics=None,
         pod_name: Optional[str] = None,
@@ -90,6 +91,10 @@ class Manager:
         self.excluder = ProcessExcluder()
         self.webhookconfig_cache = None  # validating webhook match scope
         self.provider_cache = provider_cache or ProviderCache()
+        # batched external-data join lane (extdata/lane.py): Provider
+        # reconciles invalidate its resident columns so spec changes
+        # (URL, CA, timeout) can't serve stale join answers
+        self.extdata_lane = extdata_lane
         self.mutation_system = mutation_system or MutationSystem(
             provider_cache=self.provider_cache)
         self.expansion_system = expansion_system or ExpansionSystem(
@@ -373,11 +378,16 @@ class Manager:
             self.tracker.observe("expansions", name_of(event.obj))
 
     def _reconcile_provider(self, event: Event) -> None:
+        name = name_of(event.obj)
         if event.type == DELETED:
-            self.provider_cache.remove(name_of(event.obj))
+            self.provider_cache.remove(name)
         else:
             self.provider_cache.upsert(event.obj)
-            self.tracker.observe("providers", name_of(event.obj))
+            self.tracker.observe("providers", name)
+        if self.extdata_lane is not None:
+            # belt-and-braces with the ProviderCache listener: a lane
+            # wired to a DIFFERENT cache still invalidates on reconcile
+            self.extdata_lane.invalidate(name)
 
     def _reconcile_connection(self, event: Event) -> None:
         if self.export_system is None:

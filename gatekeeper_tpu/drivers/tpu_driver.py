@@ -34,8 +34,10 @@ from gatekeeper_tpu.drivers.rego_driver import RegoDriver
 from gatekeeper_tpu.ir import masks as masks_mod
 from gatekeeper_tpu.ir.lower_rego import lower_template
 from gatekeeper_tpu.ir.program import (CompiledProgram, LowerError,
-                                        build_param_table, walk_join_values)
-from gatekeeper_tpu.ops.flatten import Flattener, Schema, Vocab, round_up
+                                        build_param_table, extdata_key_cols,
+                                        walk_join_values)
+from gatekeeper_tpu.ops.flatten import (K_STR, Flattener, Schema, Vocab,
+                                        round_up)
 from gatekeeper_tpu.target.review import GkReview
 
 DRIVER_NAME = "TPU"
@@ -95,6 +97,9 @@ class TpuDriver:
         self._render_specs: dict = {}  # kind -> Optional[list[(spec, col)]]
         self._render_idx: dict = {}  # spec.key() -> (version, value -> entries)
         self._dev_cache: dict = {}  # host array id -> device array (bounded)
+        # extdata/lane.ExtDataLane: explicit attachment wins over the
+        # process-active lane (see _active_extdata)
+        self.extdata_lane = None
         self.batch_bucket = batch_bucket
         # metrics.registry.MetricsRegistry (optional): lowering coverage
         # counters — a user template silently falling back to the
@@ -279,6 +284,89 @@ class TpuDriver:
         current inventory exactly (non-string join values): callers must
         route the kind through the interpreter for this data version."""
         return self.inventory_cols(kind)[1]
+
+    # --- external-data join tables (extdata/lane.py) --------------------
+    def _active_extdata(self):
+        """The lane this driver joins through: an explicitly attached one
+        (tests) or the process/context-active lane (--extdata-lane)."""
+        lane = getattr(self, "extdata_lane", None)
+        if lane is not None:
+            return lane
+        from gatekeeper_tpu.extdata import lane as lane_mod
+
+        return lane_mod.active()
+
+    def extdata_ready(self, kind: str) -> bool:
+        """True when the kind may ride the device grid w.r.t. external
+        data: no external-data joins at all, or an active lane in a
+        device-join mode (batched/differential) with extractable key
+        columns.  perkey mode (the authoritative reference) and lane-less
+        processes route external-data kinds through the interpreter —
+        whose ``external_data`` builtin resolves per key."""
+        prog = self._programs.get(kind)
+        if prog is None:
+            return True
+        keymap, extractable = extdata_key_cols(prog.program)
+        if not keymap and extractable:
+            return True
+        lane = self._active_extdata()
+        return (extractable and lane is not None and lane.device_join())
+
+    def extdata_cols(self, kind: str, batch) -> tuple:
+        """(cols, ready) — vocab-padded ``ext:`` join tables covering
+        every key THIS batch's subject columns reference: per provider,
+        the key strings dedupe across the whole batch off the flattened
+        sid arrays, the lane bulk-fetches the misses (one transport call
+        per max_keys_per_call chunk; warm columns make zero), and the
+        resident column serves the arrays.  Value strings intern here —
+        callers must build vocab-derived tables (pred matrices) AFTER
+        this call."""
+        prog = self._programs.get(kind)
+        if prog is None:
+            return {}, True
+        keymap, extractable = extdata_key_cols(prog.program)
+        if not keymap and extractable:
+            return {}, True
+        lane = self._active_extdata()
+        if lane is None or not lane.device_join() or not extractable:
+            return {}, False
+        import numpy as _np
+
+        cols: dict = {}
+        for provider in sorted(keymap):
+            sids: set = set()
+            for spec in keymap[provider]:
+                col = batch.scalars.get(spec)
+                if col is None:
+                    col = batch.raggeds.get(spec)
+                if col is None:
+                    continue  # column absent from this batch's schema
+                s = col.sid[col.kind == K_STR]
+                if s.size:
+                    sids.update(int(x) for x in _np.unique(s) if x >= 0)
+            keys = sorted(self.vocab.string(s) for s in sids)
+            cols.update(lane.tables_for(provider, keys, self.vocab))
+        return cols, True
+
+    def extdata_differential(self, target, kind, cons, reviews, grid,
+                             mask, cfg) -> None:
+        """``--extdata-lane=differential``: the device join's verdicts
+        must match the exact interpreter (whose external_data builtin
+        resolved through the same lane, per-key-cross-checked) on every
+        live (constraint, review) mask cell."""
+        from gatekeeper_tpu.extdata.lane import ExtDataDivergence
+
+        for ci, con in enumerate(cons):
+            for oi in np.nonzero(mask[ci, : len(reviews)])[0].tolist():
+                ref = self._interp.query(target, [con], reviews[oi], cfg)
+                want = bool(ref.results)
+                got = bool(grid[ci, oi])
+                if want != got:
+                    r = reviews[oi]
+                    raise ExtDataDivergence(
+                        f"extdata differential: {kind}/{con.name} on "
+                        f"{r.request.namespace}/{r.request.name}: "
+                        f"device={got} interpreter={want}")
 
     def query(self, target, constraints, review, cfg=None) -> QueryResponse:
         cel_cons = [c for c in constraints if c.kind in self._cel_kinds]
@@ -478,7 +566,8 @@ class TpuDriver:
             by_kind.setdefault(con.kind, []).append(con)
 
         lowered_kinds = [k for k in by_kind
-                         if k in self._programs and self.inventory_exact(k)]
+                         if k in self._programs and self.inventory_exact(k)
+                         and self.extdata_ready(k)]
         fallback_kinds = [k for k in by_kind if k not in lowered_kinds]
 
         t0 = time.perf_counter_ns()
@@ -527,8 +616,14 @@ class TpuDriver:
             prog = self._programs[kind]
             cons = by_kind[kind]
             table = build_param_table(prog.program, cons, self.vocab)
+            # extdata tables BEFORE run: the build interns value strings
+            # the vocab tables inside run must cover
+            ext_cols, _ext_ok = self.extdata_cols(kind, batch)
+            extra = self.inventory_cols(kind)[0]
+            if ext_cols:
+                extra = {**extra, **ext_cols}
             grid = prog.run(batch, table, vocab=self.vocab,
-                            extra_cols=self.inventory_cols(kind)[0],
+                            extra_cols=extra,
                             dev_cache=self._dev_cache,
                             batch_cache=batch_memo)
             mask = masks_mod.constraint_masks(
@@ -542,6 +637,11 @@ class TpuDriver:
             self.perf["d2h_bytes"] = (self.perf.get("d2h_bytes", 0.0)
                                       + grid.nbytes)
             grid = grid[:, : batch.n] & mask
+            if ext_cols:
+                lane = self._active_extdata()
+                if lane is not None and lane.mode == "differential":
+                    self.extdata_differential(target, kind, cons, reviews,
+                                              grid, mask, cfg)
             if kind in self._cel_kinds and cel_delete_idx:
                 for ci, con in enumerate(cons):
                     for oi in cel_delete_idx:

@@ -1,0 +1,92 @@
+"""ProviderColumn: the resident keyed store of one provider's responses.
+
+The snapshot-store idea (PR 6) applied to external data: responses stay
+RESIDENT between bursts/chunks keyed by the raw key string, so a
+steady-state burst whose keys are already landed makes zero transport
+calls.  Entries expire by TTL (the refresh re-lands them through the
+bulk path) and the whole column invalidates when its Provider object is
+reconciled (spec change = the cached answers may no longer hold).
+
+A monotone ``version`` bumps on every landing / invalidation; the lane's
+vocab-padded device tables key their caches on it, so a warm column
+serves the SAME numpy arrays chunk over chunk (the driver's device LRU
+then skips the host->device upload too).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class ProviderColumn:
+    def __init__(self, provider: str, ttl_s: float = 180.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.provider = provider
+        self.ttl_s = ttl_s
+        self._clock = clock
+        # key -> (landed_at, value, error-or-None).  A stale-served
+        # refresh re-lands with a fresh stamp: the column's staleness
+        # window stacks on the transport cache's own TTL model (bounded,
+        # and the breaker paces the retries underneath).
+        self._entries: dict = {}
+        self._version = 0
+        self._lock = threading.Lock()
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def missing(self, keys) -> list:
+        """Keys not resident (or past TTL), first-occurrence order,
+        deduped — the bulk fetch list."""
+        now = self._clock()
+        out: list = []
+        seen: set = set()
+        with self._lock:
+            for k in keys:
+                if k in seen:
+                    continue
+                seen.add(k)
+                hit = self._entries.get(k)
+                if hit is None or now - hit[0] >= self.ttl_s:
+                    out.append(k)
+        return out
+
+    def land(self, results: dict) -> None:
+        """Store ``key -> (value, error-or-None)`` pairs; bumps the
+        version (device tables rebuild lazily)."""
+        if not results:
+            return
+        now = self._clock()
+        with self._lock:
+            for k, (v, e) in results.items():
+                self._entries[k] = (now, v, e)
+            self._version += 1
+
+    def get(self, key) -> Optional[tuple]:
+        """(value, error-or-None) for a resident key, None if never
+        landed.  Freshness is ensure()'s job — a key that survived a
+        failed refresh reads its last landed value (the stale-serve
+        semantics of the transport cache, kept resident)."""
+        with self._lock:
+            hit = self._entries.get(key)
+            return None if hit is None else (hit[1], hit[2])
+
+    def snapshot(self) -> dict:
+        """key -> (value, error-or-None) — the table-build read."""
+        with self._lock:
+            return {k: (v, e) for k, (_t, v, e) in self._entries.items()}
+
+    def invalidate(self) -> None:
+        """Provider reconcile: drop everything (the next batch refetches
+        through the bulk path)."""
+        with self._lock:
+            self._entries.clear()
+            self._version += 1

@@ -1,0 +1,379 @@
+"""ExtDataLane: dedupe once per batch, call once per provider, join on
+device.
+
+PAPER.md L5 makes external data a first-class input to BOTH validation
+and mutation, but the per-key path (``ProviderCache.fetch`` with one key
+per call) is a per-object interpreter loop in disguise: at burst scale
+the provider round-trips dominate the verdict math.  This lane gives the
+external-data join the treatment mutation got in PR 7:
+
+- **key extraction + dedupe** — provider keys referenced by lowered
+  templates are pulled from the already-interned vocab sids of the
+  flattened batch (drivers/tpu_driver.extdata_cols) and deduped across
+  the whole admission burst / audit chunk; mutation placeholders dedupe
+  across a convergence pass the same way.
+- **one bulk call per (provider, batch)** — ``ensure`` funnels the
+  deduped miss list through ``ProviderCache.fetch`` in
+  ``max_keys_per_call`` chunks: ONE transport send per chunk, riding the
+  existing ``externaldata.send`` span/fault site with the retry /
+  breaker / stale-fallback / brownout semantics preserved PER KEY
+  (transport failure = per-key stale or error entries, exactly what the
+  per-key path would have produced).
+- **resident columns** — responses land in :class:`ProviderColumn`
+  (TTL + invalidation on Provider reconcile), so steady-state bursts
+  hit warm columns with zero transport calls.
+- **device join** — ``tables_for`` turns a column into vocab-padded
+  ``ext:<provider>:{ok,val}`` arrays the constraint grid reads through
+  ir/nodes.ExtDataOk / ExtDataValueSid.
+
+Lane modes (``--extdata-lane``):
+
+- ``batched``: all of the above (the default).
+- ``perkey``: the authoritative reference — every resolution is a
+  single-key ``ProviderCache.fetch`` and external-data templates stay on
+  the exact interpreter (no device tables).
+- ``differential``: batched AND per-key per resolution, resolved values
+  asserted identical (:class:`ExtDataDivergence` on mismatch); the TPU
+  driver additionally asserts device verdicts == interpreter verdicts
+  for external-data templates.
+
+Activation mirrors resilience/faults.py: :func:`install` for the
+process (``--extdata-lane`` CLI), :func:`activate` for scoped tests; the
+Rego ``external_data`` builtin and the mutation system read
+:func:`active`.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+import numpy as np
+
+from gatekeeper_tpu.extdata.column import ProviderColumn
+
+MODES = ("batched", "perkey", "differential")
+
+# per-key error for keys that were requested but never landed (should
+# not happen: ProviderCache.fetch answers every key, value or error)
+_NOT_LANDED = "external data: key not resolved"
+
+
+class ExtDataDivergence(AssertionError):
+    """The batched join disagreed with the per-key reference."""
+
+
+class ExtDataLane:
+    def __init__(self, cache, mode: str = "batched",
+                 column_ttl_s: Optional[float] = None,
+                 max_keys_per_call: int = 256,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if mode not in MODES:
+            raise ValueError(f"extdata lane mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        self.cache = cache  # externaldata.providers.ProviderCache
+        self.mode = mode
+        self.column_ttl_s = (cache.response_ttl_s if column_ttl_s is None
+                             else column_ttl_s)
+        self.max_keys_per_call = max(1, int(max_keys_per_call))
+        self.metrics = metrics
+        self._clock = clock
+        self._columns: dict[str, ProviderColumn] = {}
+        # provider -> (column version, covered vocab len, tables dict):
+        # reusable while the column is unchanged and every requested key
+        # sid is under the covered length (sids interned after the build
+        # would clip out of range = a silent miss)
+        self._table_cache: dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        # provider reconcile -> column invalidation (controller/manager
+        # reconciles through ProviderCache.upsert/remove)
+        add = getattr(cache, "add_listener", None)
+        if add is not None:
+            add(self._on_provider_change)
+
+    # --- residency -------------------------------------------------------
+    def device_join(self) -> bool:
+        """True when external-data templates may ride the device grid
+        (batched/differential); perkey keeps them on the interpreter."""
+        return self.mode != "perkey"
+
+    def column(self, provider: str) -> ProviderColumn:
+        with self._lock:
+            col = self._columns.get(provider)
+            if col is None:
+                col = ProviderColumn(provider, ttl_s=self.column_ttl_s,
+                                     clock=self._clock)
+                self._columns[provider] = col
+            return col
+
+    def invalidate(self, provider: Optional[str] = None) -> None:
+        with self._lock:
+            cols = ([self._columns[provider]]
+                    if provider in self._columns else
+                    list(self._columns.values()) if provider is None else [])
+            if provider is None:
+                self._table_cache.clear()
+            else:
+                self._table_cache.pop(provider, None)
+        for col in cols:
+            col.invalidate()
+
+    def _on_provider_change(self, name: str) -> None:
+        self.invalidate(name)
+
+    def _count_keys(self, provider: str, outcome: str, n: int) -> None:
+        if n and self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(
+                M.EXTDATA_KEYS, {"provider": provider, "outcome": outcome},
+                value=float(n))
+
+    def ensure(self, provider: str, keys) -> int:
+        """Land every requested key into the provider's column (bulk
+        fetch of the deduped miss list, ``max_keys_per_call`` per
+        transport send).  Returns the number of keys fetched — 0 is the
+        warm-column steady state.  Transport-level failures never raise:
+        ProviderCache.fetch degrades per key (stale / error), and an
+        unknown provider lands a per-key error for every key."""
+        from gatekeeper_tpu.observability import tracing
+
+        col = self.column(provider)
+        missing = col.missing(keys)
+        n_req = len({k for k in keys})
+        self._count_keys(provider, "warm", n_req - len(missing))
+        if not missing:
+            return 0
+        with tracing.span("extdata.join", provider=provider,
+                          n_keys=n_req, n_miss=len(missing)):
+            landed: dict = {}
+            for i in range(0, len(missing), self.max_keys_per_call):
+                chunk = missing[i:i + self.max_keys_per_call]
+                try:
+                    res = self.cache.fetch(provider, chunk)
+                except Exception as e:  # unknown provider etc.
+                    res = {k: (None, str(e)) for k in chunk}
+                landed.update(res)
+                if self.metrics is not None:
+                    from gatekeeper_tpu.metrics import registry as M
+
+                    self.metrics.inc_counter(
+                        M.EXTDATA_BULK_CALLS, {"provider": provider})
+            col.land(landed)
+        self._count_keys(provider, "fetched", len(missing))
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.set_gauge(M.EXTDATA_COLUMN_KEYS, len(col),
+                                   {"provider": provider})
+        return len(missing)
+
+    # --- resolution ------------------------------------------------------
+    def _resolve_perkey(self, provider: str, keys) -> dict:
+        """The authoritative per-key reference: one ProviderCache.fetch
+        per key (PR 2 semantics, a transport round-trip per cold key)."""
+        out: dict = {}
+        for k in keys:
+            if k in out:
+                continue
+            try:
+                out[k] = self.cache.fetch(provider, [k])[k]
+            except Exception as e:
+                out[k] = (None, str(e))
+        self._count_keys(provider, "perkey", len(out))
+        return out
+
+    def _resolve_batched(self, provider: str, keys) -> dict:
+        self.ensure(provider, keys)
+        col = self.column(provider)
+        out: dict = {}
+        for k in keys:
+            if k in out:
+                continue
+            hit = col.get(k)
+            out[k] = hit if hit is not None else (None, _NOT_LANDED)
+        return out
+
+    def resolve_keys(self, provider: str, keys) -> dict:
+        """``key -> (value, error-or-None)`` for deduped ``keys`` under
+        the active lane mode.  ``differential`` resolves through BOTH
+        paths and raises :class:`ExtDataDivergence` on any value/error
+        mismatch."""
+        keys = [k for k in keys]
+        if self.mode == "perkey":
+            return self._resolve_perkey(provider, keys)
+        out = self._resolve_batched(provider, keys)
+        if self.mode == "differential":
+            ref = self._resolve_perkey(provider, keys)
+            for k, got in out.items():
+                want = ref.get(k)
+                if got != want:
+                    raise ExtDataDivergence(
+                        f"extdata differential: provider {provider!r} "
+                        f"key {k!r}: batched={got!r} perkey={want!r}")
+        return out
+
+    def resolve_placeholders(self, placeholders) -> dict:
+        """Batch-resolve mutation placeholders: ONE lane resolution per
+        provider over the deduped key set.  Returns
+        ``(provider, key) -> (value, error-or-None)``; failure-policy
+        interpretation stays with the caller (mutation/system.py), so
+        Fail/Ignore/UseDefault semantics are exactly the per-key
+        path's."""
+        by_provider: dict = {}
+        for ph in placeholders:
+            by_provider.setdefault(ph.provider, []).append(ph.original_value)
+        out: dict = {}
+        for provider, keys in sorted(by_provider.items()):
+            resolved = self.resolve_keys(provider, keys)
+            for k, ve in resolved.items():
+                out[(provider, k)] = ve
+        return out
+
+    # --- device join tables ---------------------------------------------
+    def tables_for(self, provider: str, keys, vocab) -> dict:
+        """Vocab-padded join arrays for one provider after ensuring all
+        ``keys`` (strings, already interned by the flatten) are landed:
+
+        - ``ext:<provider>:ok``  bool[Vpad]  — key resolved, no per-key
+          error (the ``responses`` membership test);
+        - ``ext:<provider>:val`` int32[Vpad] — sid of the resolved value
+          when it is a string, -2 for resolved non-string values, -3 for
+          unresolved keys.
+
+        Arrays are cached per (column version, covered vocab length), so
+        a warm column returns the identical numpy objects and the
+        device LRU skips the upload."""
+        from gatekeeper_tpu.ir.program import _vpad
+
+        self.ensure(provider, keys)
+        col = self.column(provider)
+        ver = col.version
+        with self._lock:
+            cached = self._table_cache.get(provider)
+        if cached is not None and cached[0] == ver:
+            covered = cached[1]
+            if all(0 <= vocab.lookup(k) < covered for k in keys):
+                return cached[2]
+        covered = len(vocab)
+        vp = _vpad(covered)
+        ok = np.zeros(vp, bool)
+        val = np.full(vp, -3, np.int32)
+        for key, (v, e) in col.snapshot().items():
+            sid = vocab.lookup(key)
+            if not (0 <= sid < covered):
+                continue  # resident key never interned: no column reads it
+            if e is None:
+                ok[sid] = True
+                val[sid] = vocab.intern(v) if isinstance(v, str) else -2
+        tables = {f"ext:{provider}:ok": ok, f"ext:{provider}:val": val}
+        with self._lock:
+            self._table_cache[provider] = (ver, covered, tables)
+        return tables
+
+    def snapshot(self) -> dict:
+        """Introspection (tests / debug): per-provider residency."""
+        with self._lock:
+            cols = dict(self._columns)
+        return {
+            "mode": self.mode,
+            "providers": {p: {"keys": len(c), "version": c.version}
+                          for p, c in sorted(cols.items())},
+        }
+
+
+# --- activation (mirrors resilience/faults.py) ----------------------------
+
+_ctx_lane: contextvars.ContextVar = contextvars.ContextVar(
+    "extdata_lane", default=None)
+_global_lane: list = [None]
+
+
+def install(lane: Optional[ExtDataLane]) -> None:
+    """Process-global activation (the ``--extdata-lane`` CLI path):
+    webhook handler threads, the audit thread and the batcher all see
+    one lane."""
+    _global_lane[0] = lane
+
+
+def uninstall() -> None:
+    _global_lane[0] = None
+
+
+@contextmanager
+def activate(lane: ExtDataLane, process: bool = True):
+    """Scoped activation for tests; restores both scopes on exit."""
+    token = _ctx_lane.set(lane)
+    prev = _global_lane[0]
+    if process:
+        _global_lane[0] = lane
+    try:
+        yield lane
+    finally:
+        _ctx_lane.reset(token)
+        if process:
+            _global_lane[0] = prev
+
+
+def active() -> Optional[ExtDataLane]:
+    lane = _ctx_lane.get()
+    if lane is None:
+        lane = _global_lane[0]
+    return lane
+
+
+# --- the Rego builtin's fetch (lang/rego/builtins.py delegates here) ------
+
+def builtin_fetch(req):
+    """``external_data({"provider": p, "keys": [...]})`` — the reference
+    response shape: ``{"responses": [[key, value], ...], "errors":
+    [[key, err], ...], "status_code": 200, "system_error": ""}``.
+
+    Transport-level failures surface as PER-KEY errors (the
+    ProviderCache stale/error fallback), never as ``system_error`` —
+    the lowered device join and this host reference agree on that
+    single encoding.  Keys dedupe on first occurrence; non-string keys
+    are per-key errors (the device join's non-string subjects read
+    not-resolved the same way).  With no lane active every key errors —
+    external-data policies fail closed toward their template's own
+    error handling."""
+    from gatekeeper_tpu.lang.rego.builtins import UNDEFINED
+
+    if not isinstance(req, dict):
+        return UNDEFINED
+    provider = req.get("provider")
+    keys = req.get("keys")
+    if not isinstance(provider, str) or not isinstance(keys, list):
+        return UNDEFINED
+    uniq: list = []
+    seen: set = set()
+    for k in keys:
+        marker = k if isinstance(k, (str, int, float, bool)) else repr(k)
+        if (type(marker), marker) in seen:
+            continue
+        seen.add((type(marker), marker))
+        uniq.append(k)
+    str_keys = [k for k in uniq if isinstance(k, str)]
+    lane = active()
+    if lane is None:
+        resolved = {k: (None, "external data: no lane configured")
+                    for k in str_keys}
+    else:
+        resolved = lane.resolve_keys(provider, str_keys)
+    responses: list = []
+    errors: list = []
+    for k in uniq:
+        if not isinstance(k, str):
+            errors.append([k, "external data: key is not a string"])
+            continue
+        v, e = resolved.get(k, (None, _NOT_LANDED))
+        if e:
+            errors.append([k, e])
+        else:
+            responses.append([k, v])
+    return {"responses": responses, "errors": errors,
+            "status_code": 200, "system_error": ""}

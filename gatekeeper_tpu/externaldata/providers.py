@@ -122,6 +122,23 @@ class ProviderCache:
         self.retry = retry
         self._breakers: dict[str, Any] = {}
         self._lock = threading.Lock()
+        # provider-change listeners (name) — the extdata lane registers
+        # its column invalidation here so a Provider reconcile from
+        # controller/manager.py drops the resident join columns
+        self._listeners: list = []
+
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _notify(self, name: str) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(name)
+            except Exception:
+                pass  # invalidation must never break reconcile
 
     def _breaker(self, provider_name: str):
         from gatekeeper_tpu.resilience.policy import CircuitBreaker
@@ -142,11 +159,22 @@ class ProviderCache:
              else Provider.from_unstructured(obj_or_provider))
         with self._lock:
             self._providers[p.name] = p
+            self._drop_responses(p.name)
+        self._notify(p.name)
         return p
 
     def remove(self, name: str) -> None:
         with self._lock:
             self._providers.pop(name, None)
+            self._drop_responses(name)
+        self._notify(name)
+
+    def _drop_responses(self, name: str) -> None:
+        """Reconcile invalidation (call under the lock): a Provider spec
+        change (URL, CA, timeout) voids its cached answers — stale-serve
+        fallbacks must not resurrect responses from the OLD endpoint."""
+        for key in [k for k in self._responses if k[0] == name]:
+            del self._responses[key]
 
     def get(self, name: str) -> Optional[Provider]:
         return self._providers.get(name)

@@ -141,6 +141,7 @@ cmd_bench = _delegate("bench")
 cmd_sync = _delegate("sync_cmd")
 cmd_policy = _delegate("policy_cmd")
 cmd_decisions = _delegate("decisions_cmd")
+cmd_generate_vap = _delegate("generate_vap_cmd")
 
 
 COMMANDS = {
@@ -151,6 +152,7 @@ COMMANDS = {
     "sync": cmd_sync,
     "policy": cmd_policy,
     "decisions": cmd_decisions,
+    "generate-vap": cmd_generate_vap,
 }
 
 
@@ -159,7 +161,8 @@ def main(argv=None) -> int:
     # JAX_PLATFORMS honored at package import (gatekeeper_tpu/__init__.py)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: gator [--chaos spec.json] "
-              "{test|verify|expand|bench|sync|policy|decisions} [options]")
+              "{test|verify|expand|bench|sync|policy|decisions|"
+              "generate-vap} [options]")
         return 0
     # global --chaos spec.json: install the deterministic fault-injection
     # plan before any subcommand runs (README 'Failure semantics')
@@ -181,7 +184,8 @@ def main(argv=None) -> int:
         print(f"chaos harness active: {chaos}", file=sys.stderr)
     if not argv:
         print("usage: gator [--chaos spec.json] "
-              "{test|verify|expand|bench|sync|policy|decisions} [options]")
+              "{test|verify|expand|bench|sync|policy|decisions|"
+              "generate-vap} [options]")
         return 0
     cmd = argv[0]
     fn = COMMANDS.get(cmd)

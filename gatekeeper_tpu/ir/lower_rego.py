@@ -174,6 +174,59 @@ class StrFnVal:
 
 
 @dataclass(frozen=True)
+class FeatListVal:
+    """``[x | x = <feature>[_]...]`` — a feature-list comprehension (the
+    key-batching idiom of external-data templates).  ``inner`` is the
+    per-element feature (ItemVal for axis iterations, PathVal when the
+    comprehension is degenerate)."""
+
+    inner: Any  # PathVal | ItemVal
+
+
+@dataclass(frozen=True)
+class ExtDataRespVal:
+    """``external_data({"provider": <const>, "keys": <keys>})`` — the
+    response document.  ``key`` is the per-key subject feature (PathVal |
+    ItemVal); ``from_list`` marks comprehension-batched keys (each use
+    re-instances the axis existential) vs a literal one-key array whose
+    bound instance the response inherits (per-binding semantics)."""
+
+    provider: str
+    key: Any
+    from_list: bool = False
+
+
+@dataclass(frozen=True)
+class ExtDataListVal:
+    """``resp.responses`` / ``resp.errors`` — only emptiness tests and
+    iteration (responses) lower; exact counts diverge under the lane's
+    key dedupe and stay on the interpreter."""
+
+    resp: ExtDataRespVal
+    field: str  # "responses" | "errors"
+
+
+@dataclass(frozen=True)
+class ExtDataItemVal:
+    """One ``[key, value]`` pair iterated from ``resp.responses[_]``;
+    ``key`` carries the (possibly re-instanced) subject feature whose
+    existential group the pair's predicates share."""
+
+    provider: str
+    key: Any  # PathVal | ItemVal
+
+
+@dataclass(frozen=True)
+class ExtDataValueVal:
+    """``item[1]`` of a responses pair: the provider's resolved value,
+    sid-valued on device (ir/nodes.ExtDataValueSid) — definedness is the
+    key's resolution, so predicates self-gate on the join."""
+
+    provider: str
+    key: Any  # PathVal | ItemVal
+
+
+@dataclass(frozen=True)
 class BoolComprVal:
     """[b | e := params.X[_]; b = pred(..., e)] — a per-param-element
     boolean vector; any()/all() reduce it."""
@@ -654,6 +707,20 @@ class _Lowerer:
             raise LowerError("definedness of dynamic field access")
         if isinstance(val, DefinedOpaqueVal):
             return []  # charged at its assignment
+        if isinstance(val, (ExtDataRespVal, ExtDataListVal, FeatListVal)):
+            return []  # total: the builtin answers (errors included) and
+            # comprehensions are empty-on-no-solutions
+        if isinstance(val, ExtDataItemVal):
+            # a responses pair exists iff its key resolved ok
+            _key, group = (val.key, None) if isinstance(val.key, PathVal) \
+                else (val.key, ("axis", val.key.axis, val.key.instance))
+            return [(N.ExtDataOk(val.provider,
+                                 self._extdata_subject(val.key)), group)]
+        if isinstance(val, ExtDataValueVal):
+            group = None if isinstance(val.key, PathVal) else (
+                "axis", val.key.axis, val.key.instance)
+            return [(N.ExtDataOk(val.provider,
+                                 self._extdata_subject(val.key)), group)]
         if isinstance(val, OpaqueVal):
             raise LowerError(f"definedness of opaque value: {val.why}")
         return []
@@ -743,6 +810,8 @@ class _Lowerer:
                                             strip_prefix=affix.value)
                     return XformElemVal(inner, strip_suffix=affix.value)
                 return OpaqueVal(f"call {term.op}")
+            if term.op == "external_data" and len(term.args) == 1:
+                return self._abstract_external_data(term.args[0], env)
             fn_rule = self.entry_mod.rules.get(term.op)
             if fn_rule is not None:
                 out = self._abstract_value_fn(fn_rule, term, env)
@@ -753,8 +822,102 @@ class _Lowerer:
             sel = self._abstract_selector_compr(term, env)
             if sel is not None:
                 return sel
+            feat = self._abstract_feat_compr(term, env)
+            if feat is not None:
+                return feat
             return self._abstract_bool_compr(term, env)
         return OpaqueVal(type(term).__name__)
+
+    def _abstract_feat_compr(self, term: ast.ArrayCompr, env: dict):
+        """``[x | x = <feature>]`` — the key-batching comprehension of
+        external-data templates (one stmt, head var == target, value a
+        lowerable feature).  Returns FeatListVal or None."""
+        if not (isinstance(term.term, ast.Var) and len(term.body) == 1):
+            return None
+        stmt = term.body[0]
+        if isinstance(stmt, ast.AssignStmt) and isinstance(
+                stmt.target, ast.Var):
+            tgt, val_t = stmt.target.name, stmt.term
+        elif isinstance(stmt, ast.UnifyStmt) and isinstance(
+                stmt.lhs, ast.Var):
+            tgt, val_t = stmt.lhs.name, stmt.rhs
+        else:
+            return None
+        if tgt != term.term.name:
+            return None
+        inner = self._abstract(val_t, dict(env))
+        if isinstance(inner, (PathVal, ItemVal)):
+            return FeatListVal(inner)
+        return None
+
+    def _abstract_external_data(self, arg, env: dict):
+        """``external_data({"provider": <const str>, "keys": ...})`` —
+        keys: a feature-list comprehension (or a var bound to one) or a
+        literal one-element array of a feature.  Anything else is
+        opaque: the template keeps the interpreter (which resolves
+        through the same lane, per-key)."""
+        if not isinstance(arg, ast.ObjectTerm):
+            return OpaqueVal("external_data arg not an object literal")
+        provider = keys_t = None
+        for k, v in arg.pairs:
+            if isinstance(k, ast.Scalar) and k.value == "provider":
+                provider = self._abstract(v, env)
+            elif isinstance(k, ast.Scalar) and k.value == "keys":
+                keys_t = v
+            else:
+                return OpaqueVal("external_data arg shape")
+        if not (isinstance(provider, ConstVal)
+                and isinstance(provider.value, str)) or keys_t is None:
+            return OpaqueVal("external_data provider/keys shape")
+        keys = self._abstract(keys_t, env)
+        if isinstance(keys, FeatListVal):
+            return ExtDataRespVal(provider.value, keys.inner,
+                                  from_list=True)
+        if isinstance(keys_t, ast.ArrayTerm) and len(keys_t.items) == 1:
+            inner = self._abstract(keys_t.items[0], env)
+            if isinstance(inner, (PathVal, ItemVal)):
+                return ExtDataRespVal(provider.value, inner,
+                                      from_list=False)
+        return OpaqueVal("external_data keys shape")
+
+    # --- external-data join pieces (used by steps/counts below) ----------
+    def _extdata_subject(self, key) -> "N.Expr":
+        """The sid-valued subject feature of a join key (registers the
+        column in the program schema)."""
+        if isinstance(key, PathVal):
+            return N.FeatSid(self._scalar_col(key))
+        return N.FeatSid(self._ragged_col(key))
+
+    def _extdata_reinstance(self, resp: ExtDataRespVal):
+        """(key, group) for one USE of the response: comprehension-
+        batched keys re-instance the axis existential per use (each
+        ``responses[_]``/count is its own ∃ over the key axis); a
+        literal one-key array inherits the key's bound instance
+        (per-binding response semantics); scalar keys have no group."""
+        key = resp.key
+        if isinstance(key, PathVal):
+            return key, None
+        if not resp.from_list:
+            return key, ("axis", key.axis, key.instance)
+        inst = self._fresh_instance()
+        pa = self._axis_parent.get((key.axis, key.instance))
+        if pa is not None:
+            self._axis_parent[(key.axis, inst)] = pa
+        newk = ItemVal(key.axis, key.subpath, inst)
+        return newk, ("axis", key.axis, inst)
+
+    def _extdata_item_pred(self, provider: str, key, want_ok: bool):
+        """Per-key membership predicate: ``responses`` = the key resolved
+        (ok implies present-and-string), ``errors`` = the key is present
+        but did NOT resolve ok (non-string present keys are per-key
+        errors host-side too)."""
+        subj = self._extdata_subject(key)
+        ok = N.ExtDataOk(provider, subj)
+        if want_ok:
+            return ok
+        col = (self._scalar_col(key) if isinstance(key, PathVal)
+               else self._ragged_col(key))
+        return N.And((N.Present(col), N.Not(ok)))
 
     def _abstract_selector_compr(self, term: ast.ArrayCompr, env: dict):
         """Recognize ``[s | v := M[key]; s := concat(":", [key, v])]`` —
@@ -961,6 +1124,17 @@ class _Lowerer:
         for arg in term.args:
             if isinstance(arg, ast.Scalar) and isinstance(arg.value, str):
                 base = self._step(base, arg.value)
+            elif (isinstance(arg, ast.Scalar)
+                  and isinstance(arg.value, int)
+                  and not isinstance(arg.value, bool)
+                  and isinstance(base, ExtDataItemVal)):
+                # a responses pair: [0] = the key (only message-renderable
+                # — predicates on it would need an ok-gated key sid),
+                # [1] = the resolved value (sid-valued, self-gating)
+                if arg.value == 1:
+                    base = ExtDataValueVal(base.provider, base.key)
+                else:
+                    base = OpaqueVal("external_data response key slot")
             elif isinstance(arg, ast.Var) and arg.name.startswith("$w"):
                 base = self._iterate(base)  # wildcard: fresh existential
             elif isinstance(arg, ast.Var) and isinstance(
@@ -1093,6 +1267,17 @@ class _Lowerer:
             # lower to dotted ParamSpec names; p_get/p_has resolve the
             # path at table-build time (PSP users/fsgroup shapes)
             return ParamVal(f"{base.name}.{key}")
+        if isinstance(base, ExtDataRespVal):
+            if key in ("responses", "errors"):
+                return ExtDataListVal(base, key)
+            if key == "system_error":
+                # transport failures fold into PER-KEY errors (the
+                # ProviderCache stale/error semantics the host builtin
+                # mirrors), so system_error is the constant ""
+                return ConstVal("")
+            if key == "status_code":
+                return ConstVal(200)
+            return OpaqueVal(f"external_data response field {key}")
         if isinstance(base, OpaqueVal):
             return base
         return OpaqueVal(f"step on {type(base).__name__}")
@@ -1117,6 +1302,13 @@ class _Lowerer:
             # iteration within an inventory entry: the host-side table
             # build flattens it ('*' path step)
             return InventoryFeatVal(base.inv, base.path + ("*",))
+        if isinstance(base, ExtDataListVal):
+            if base.field != "responses":
+                # per-error pairs carry host-rendered error strings; only
+                # emptiness (count) lowers for the errors list
+                return OpaqueVal("iterate external_data errors")
+            key, _group = self._extdata_reinstance(base.resp)
+            return ExtDataItemVal(base.resp.provider, key)
         if isinstance(base, OpaqueVal):
             return base
         return OpaqueVal(f"iterate {type(base).__name__}")
@@ -1336,6 +1528,12 @@ class _Lowerer:
             # (endswith(forbidden, "*")): elem sids index the pred matrix
             subj = self._sid_operand(subject)
             group = ("param", subject.name, subject.instance)
+        elif isinstance(subject, ExtDataValueVal):
+            # startswith(item[1], "sha256:") — the resolved value as a
+            # pred-matrix subject, self-gated on resolution
+            subj = self._sid_operand(subject)
+            group = None if isinstance(subject.key, PathVal) else (
+                "axis", subject.key.axis, subject.key.instance)
         else:
             raise LowerError(
                 f"string-pred subject {type(subject).__name__}"
@@ -1418,6 +1616,9 @@ class _Lowerer:
             g = None
             if isinstance(v, (ItemVal, MapKeyVal)):
                 g = ("axis", v.axis, v.instance)
+            elif isinstance(v, ExtDataValueVal):
+                if isinstance(v.key, ItemVal):
+                    g = ("axis", v.key.axis, v.key.instance)
             elif isinstance(v, (ParamElemVal, ParamElemFieldVal)):
                 g = ("param", v.name, v.instance)
             if g is not None:
@@ -1551,6 +1752,50 @@ class _Lowerer:
 
     def _lower_count_cmp(self, op: str, set_term, n, env: dict):
         val = self._abstract(set_term, env)
+        if isinstance(val, ConstVal):
+            # count of a compile-time constant (the canonical external-
+            # data template's count(response.system_error) > 0 clause):
+            # fold statically — strings count length, composites size
+            v = val.value
+            if isinstance(v, str):
+                cnt = len(v)
+            elif isinstance(v, (list, tuple, dict)):
+                cnt = len(v)
+            else:
+                raise LowerError("count() of non-countable constant")
+            import operator as _op
+
+            fn = {"lt": _op.lt, "lte": _op.le, "gt": _op.gt,
+                  "gte": _op.ge, "equal": _op.eq, "neq": _op.ne}[op]
+            return N.ConstBool(bool(fn(cnt, n))), None
+        if isinstance(val, ExtDataListVal):
+            # emptiness tests only: the lane dedupes keys, so EXACT pair
+            # counts can diverge from the per-object key list — ∃/∄ is
+            # dedupe-insensitive
+            key, group = self._extdata_reinstance(val.resp)
+            pred = self._extdata_item_pred(val.resp.provider, key,
+                                           want_ok=(val.field
+                                                    == "responses"))
+            nonzero = (op == "gt" and n == 0) or (op == "gte" and n == 1) \
+                or (op == "neq" and n == 0)
+            zero = (op in ("equal", "lte") and n == 0) or (
+                op == "lt" and n == 1)
+            if nonzero:
+                return pred, group
+            if zero:
+                if group is None:
+                    return N.Not(pred), None
+                _ax, axis, inst = group
+                if not val.resp.from_list:
+                    # bound single-key response: per-binding negation
+                    # under the already-open existential
+                    return N.Not(pred), group
+                pa = self._axis_parent.get((axis, inst))
+                if pa is not None:
+                    return N.Not(self._nested_any(axis, pa[0], [pred])), \
+                        ("axis",) + pa
+                return N.Not(N.AnyAxis(axis, pred)), None
+            raise LowerError(f"external_data count comparison {op} {n}")
         if isinstance(val, PathVal):
             # count(obj.spec.tls) OP n: composite item count / string length
             if val.path[:2] != OBJECT_ROOT:
@@ -1745,7 +1990,7 @@ class _Lowerer:
         return node.get("type")
 
     def _is_stringy(self, val) -> bool:
-        if isinstance(val, MapKeyVal):
+        if isinstance(val, (MapKeyVal, ExtDataValueVal)):
             return True
         if isinstance(val, ConstVal):
             return isinstance(val.value, str)
@@ -1816,6 +2061,9 @@ class _Lowerer:
             if col not in self.schema.map_keys:
                 self.schema.map_keys.append(col)
             return N.MapKeySid(col)
+        if isinstance(val, ExtDataValueVal):
+            return N.ExtDataValueSid(val.provider,
+                                     self._extdata_subject(val.key))
         if isinstance(val, (InventoryFeatVal, InventoryObjVal,
                             InventoryMetaVal)):
             raise LowerError("inventory value outside a join")

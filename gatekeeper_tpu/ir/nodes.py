@@ -176,6 +176,31 @@ class InventoryUniqueJoin(Expr):
 
 
 @dataclass(frozen=True)
+class ExtDataOk(Expr):
+    """subject's key resolved by the external-data provider without a
+    per-key error — the ``responses`` membership half of the batched
+    join (extdata/lane.py tables ``ext:<provider>:ok``).  False for
+    non-string subjects (the host builtin marks them per-key errors)
+    and for keys outside the table (never fetched = not resolved)."""
+
+    provider: str
+    subject: Expr  # sid-valued
+
+
+@dataclass(frozen=True)
+class ExtDataValueSid(Expr):
+    """sid of the provider's resolved value for subject's key
+    (``ext:<provider>:val``): sid-valued where the value is a string,
+    present-non-string for resolved non-string values, absent when the
+    key did not resolve — so (in)equality against it follows the same
+    defined/undefined rules the host interpreter applies to
+    ``response.responses[_][1]``."""
+
+    provider: str
+    subject: Expr  # sid-valued
+
+
+@dataclass(frozen=True)
 class NumBin(Expr):
     """Arithmetic over two numeric operands.  Rego arithmetic is PARTIAL:
     defined only when both operands are numbers (and the divisor nonzero)

@@ -769,6 +769,25 @@ def build_inventory_tables(program: N.Program, data_tree: dict,
     return out, exact
 
 
+def extdata_key_cols(program: N.Program) -> tuple:
+    """(provider -> set of subject column specs, extractable) for the
+    program's external-data joins.  The driver dedupes each batch's key
+    strings from these columns' sid arrays before asking the lane for
+    join tables.  ``extractable`` is False when any subject is not a
+    plain column read (the lane could not guarantee table coverage, so
+    the kind must take the interpreter) — the lowering only emits
+    FeatSid subjects, this is the defensive check."""
+    out: dict = {}
+    extractable = True
+    for node in expr_nodes(program):
+        if isinstance(node, (N.ExtDataOk, N.ExtDataValueSid)):
+            if isinstance(node.subject, N.FeatSid):
+                out.setdefault(node.provider, set()).add(node.subject.col)
+            else:
+                extractable = False
+    return out, extractable
+
+
 def vocab_tables(program: N.Program, vocab: Vocab) -> dict:
     """Shared (non-vmapped) vocab-derived arrays for the cols dict."""
     out = {}
@@ -945,7 +964,32 @@ def _eval_sidlike(ctx: _Ctx, e: N.Expr):
         # Padding rows are masked by the enclosing AnyAxis count.
         is_str = sid >= 0
         return sid, is_str, jnp.ones_like(is_str)
+    if isinstance(e, N.ExtDataValueSid):
+        resolved = _eval_extdata_ok(ctx, e.provider, e.subject)
+        sid, _sok, _sp = _eval_sidlike(ctx, e.subject)
+        val = ctx.cols[f"ext:{e.provider}:val"]
+        safe = jnp.clip(sid, 0, val.shape[0] - 1)
+        v = val[safe]
+        # present = the response item exists (key resolved); string only
+        # when the landed value is one (resolved non-strings compare
+        # defined-unequal against strings, like the interpreter)
+        return jnp.where(resolved, v, -3), resolved & (v >= 0), resolved
     raise LowerError(f"not a string operand: {e}")
+
+
+def _eval_extdata_ok(ctx: _Ctx, provider: str, subject: N.Expr):
+    """Shared ok-join: subject is a string whose key sid is inside the
+    provider table and landed without a per-key error.  Sids interned
+    after the table build (the lane rebuilds per batch when any
+    requested key is uncovered) read not-resolved — the safe default
+    for keys nothing fetched."""
+    sid, sok, _sp = _eval_sidlike(ctx, subject)
+    ok = ctx.cols.get(f"ext:{provider}:ok")
+    if ok is None:
+        raise LowerError(f"extdata table for provider {provider!r} "
+                         "not in batch")
+    safe = jnp.clip(sid, 0, ok.shape[0] - 1)
+    return sok & (sid >= 0) & (sid < ok.shape[0]) & ok[safe]
 
 
 _CMP = {
@@ -984,6 +1028,8 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
         ok = ctx.cols[f"fn:{e.fn}:ok"]
         safe = jnp.clip(sid, 0, ok.shape[0] - 1)
         return sok & (sid >= 0) & ok[safe]
+    if isinstance(e, N.ExtDataOk):
+        return _eval_extdata_ok(ctx, e.provider, e.subject)
     if isinstance(e, N.CmpNum):
         lv, lrank, lnum, lpres = _eval_cmp_operand(ctx, e.lhs)
         rv, rrank, rnum, rpres = _eval_cmp_operand(ctx, e.rhs)

@@ -896,3 +896,17 @@ def _print(*args):
     # normally intercepts first): deliver and succeed
     print_message(args)
     return True
+
+
+@builtin("external_data")
+def _external_data(req):
+    # reference: the frameworks' external_data builtin (validation-side
+    # external data).  Resolution rides the active extdata lane
+    # (extdata/lane.py): batched = resident-column bulk join, perkey =
+    # the authoritative single-key reference, differential = both with
+    # the resolved values asserted identical.  The host response here is
+    # the exact oracle the device join (ir/nodes.ExtDataOk /
+    # ExtDataValueSid) must agree with.
+    from gatekeeper_tpu.extdata.lane import builtin_fetch
+
+    return builtin_fetch(req)

@@ -359,6 +359,15 @@ TRACE_SAMPLED_OUT = "trace_traces_sampled_out_count"
 # the ROADMAP's "flatten is the sweep ceiling" number, scrapeable
 FLATTEN_LANE = "flatten_lane_count"
 FLATTEN_OBJECTS_PER_SECOND = "flatten_objects_per_second"
+# batched external-data join lane (extdata/lane.py): bulk transport
+# calls per provider (one fetch per max_keys_per_call chunk of the
+# deduped miss list), per-key outcomes (warm = resident column hit with
+# zero transport, fetched = landed through a bulk call, perkey = the
+# reference lane's single-key fetches), and the resident column size —
+# together the "round-trips collapsed" story EXTDATA_BENCH measures
+EXTDATA_BULK_CALLS = "extdata_bulk_calls_count"  # {provider}
+EXTDATA_KEYS = "extdata_keys_count"  # {provider, outcome}
+EXTDATA_COLUMN_KEYS = "extdata_column_keys"  # gauge {provider}
 # webhook serving-lane contention (VERDICT r4 weak #5 instrumentation):
 # in-flight admission handlers per worker, time a review spent queued in
 # the batcher lane before its batch ran, and the coalesced batch sizes —

@@ -61,7 +61,7 @@ def col_stats_update(stats: dict, cols: dict) -> None:
     (layout is part of the jit key — a data-dependent per-chunk layout
     would retrace the fused sweep mid-run)."""
     for key in cols:
-        if key.startswith(("fn:", "st:", "inv:")):
+        if key.startswith(("fn:", "st:", "inv:", "ext:")):
             continue
         val = cols[key]
         items = sorted(val.items()) if isinstance(val, dict) \
@@ -197,7 +197,7 @@ def pack_transfer_cols(cols: dict, pad_n: int,
     layout: list = []
     seen: dict = {}  # id(array) -> (key, sub): identity alias dedup
     for key in sorted(k for k in cols
-                      if not k.startswith(("fn:", "st:", "inv:"))):
+                      if not k.startswith(("fn:", "st:", "inv:", "ext:"))):
         val = cols[key]
         items = sorted(val.items()) if isinstance(val, dict) \
             else [(None, val)]
@@ -374,7 +374,7 @@ def shard_batch_arrays(cols: dict, mesh: Mesh,
     """
     out = {}
     for key, val in cols.items():
-        if key.startswith(("fn:", "st:", "inv:")):
+        if key.startswith(("fn:", "st:", "inv:", "ext:")):
             # vocab-derived tables are shared lookup state: replicate.
             # Cache hit on content (the builders may return a fresh but
             # identical array per chunk; identity would re-upload every
@@ -901,7 +901,8 @@ class ShardedEvaluator:
                 by_kind.setdefault(con.kind, []).append(con)
             lowered = [k for k in by_kind
                        if k in self.driver._programs
-                       and self.driver.inventory_exact(k)]
+                       and self.driver.inventory_exact(k)
+                       and self.driver.extdata_ready(k)]
             if not lowered:
                 state[g] = None
                 return None
@@ -1052,7 +1053,8 @@ class ShardedEvaluator:
             by_kind.setdefault(con.kind, []).append(con)
         lowered = [k for k in by_kind
                    if k in self.driver._programs
-                   and self.driver.inventory_exact(k)]
+                   and self.driver.inventory_exact(k)
+                   and self.driver.extdata_ready(k)]
         schema = Schema()
         for kind in lowered:
             schema.merge(self.driver._programs[kind].program.schema)
@@ -1257,6 +1259,15 @@ class ShardedEvaluator:
                 [np.asarray(m).sum(axis=1, dtype=np.int64)
                  for m in mask_rows]).astype(np.int32)
         table_cols: dict = {}
+        # external-data join tables FIRST: the lane's bulk fetch lands
+        # this chunk's deduped keys and the table build interns value
+        # strings — the vocab tables built below must cover those sids
+        t0 = time.perf_counter()
+        for kind in kinds:
+            ext_cols, _ok = self.driver.extdata_cols(kind, batch)
+            table_cols.update(ext_cols)
+        if table_cols:
+            self._perf_add("extdata", time.perf_counter() - t0)
         for kind in kinds:
             for tk, tv in vocab_tables(
                 self.driver._programs[kind].program, self.driver.vocab

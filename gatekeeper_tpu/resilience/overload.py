@@ -277,7 +277,25 @@ class OverloadController:
 
             self._ledger_qos = TenantCostLedger()
             self._queue_qos = QoSQueue(self.config.qos,
-                                       heaviness=self._heaviness)
+                                       heaviness=self._heaviness,
+                                       cap_fn=self._tenant_cap)
+
+    def _tenant_cap(self) -> int:
+        """The per-tenant inflight cap in force: the configured
+        ``tenantInflightCap`` scaled by the AIMD limiter's CURRENT limit
+        over ``max_inflight`` (floor 1).  A cap chosen as a fraction of
+        healthy capacity keeps that fraction when the limiter collapses
+        — a static 8 over a collapsed limit of 4 would hand one tenant
+        every slot and void the isolation guarantee.  0 (no configured
+        cap) stays unbounded."""
+        cap = self.config.qos.tenant_inflight_cap
+        if cap <= 0:
+            return 0
+        base = max(1, self.config.max_inflight)
+        lim = self.limiter.limit
+        if lim >= base:
+            return cap
+        return max(1, (cap * lim + base - 1) // base)
 
     # --- admission -------------------------------------------------------
     @contextmanager
@@ -373,7 +391,7 @@ class OverloadController:
         from gatekeeper_tpu.resilience.qos import Ticket
 
         c = self.config
-        cap = c.qos.tenant_inflight_cap
+        cap = self._tenant_cap()
         with self._cv:
             t = Ticket(self._seq, tenant, level, cost)
             self._seq += 1

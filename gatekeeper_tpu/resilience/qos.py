@@ -180,13 +180,51 @@ def parse_qos_config(doc: dict) -> QoSConfig:
     return cfg
 
 
+_SA_PREFIX = "system:serviceaccount:"
+
+
+def normalize_serviceaccount(username: str) -> Optional[str]:
+    """The canonical ``system:serviceaccount:<ns>:<name>`` triple, or
+    None when ``username`` is not a well-formed serviceaccount identity.
+
+    ``userInfo.username`` is attacker-influenced on impersonation /
+    proxy paths, so the serviceaccount tenant key must not trust it
+    verbatim: only an EXACT case-sensitive prefix with exactly two
+    non-empty, whitespace-free segments (k8s namespace/SA names — ':'
+    is not legal in either) normalizes; anything else (extra segments,
+    empty parts, case games like ``System:ServiceAccount:...``) is not
+    a serviceaccount and must not be billed as one."""
+    if not username.startswith(_SA_PREFIX):
+        return None
+    rest = username[len(_SA_PREFIX):]
+    parts = rest.split(":")
+    if len(parts) != 2:
+        return None
+    ns, name = parts
+    if not ns or not name:
+        return None
+    if ns != ns.strip() or name != name.strip() or " " in ns or \
+            " " in name:
+        return None
+    return _SA_PREFIX + ns + ":" + name
+
+
 def tenant_of_request(req: dict, tenant_key: str = TENANT_NAMESPACE) -> str:
     """Tenant identity of an AdmissionReview ``request`` dict — the
     attribution key shared by QoS, the flight recorder and the cost
-    grid's ``{tenant}`` axis."""
+    grid's ``{tenant}`` axis.  Under the serviceaccount key, SA-shaped
+    usernames normalize through :func:`normalize_serviceaccount`;
+    malformed SA triples fold into the cluster tenant (a spoofed-looking
+    identity must not mint itself a fresh fair-share queue), and non-SA
+    users keep their username."""
     if tenant_key == TENANT_SERVICEACCOUNT:
         user = ((req.get("userInfo") or {}).get("username", "")) or ""
-        return user or CLUSTER_TENANT
+        if not user:
+            return CLUSTER_TENANT
+        if user.lower().startswith(_SA_PREFIX) or \
+                user.startswith(_SA_PREFIX):
+            return normalize_serviceaccount(user) or CLUSTER_TENANT
+        return user
     ns = req.get("namespace", "") or ""
     return ns or CLUSTER_TENANT
 
@@ -256,15 +294,28 @@ class QoSQueue:
     queue itself is pure state + deterministic decisions."""
 
     def __init__(self, config: QoSConfig,
-                 heaviness: Optional[Callable[[str], float]] = None):
+                 heaviness: Optional[Callable[[str], float]] = None,
+                 cap_fn: Optional[Callable[[], int]] = None):
         self.config = config
         self._heaviness = heaviness or (lambda tenant: 0.0)
+        # live per-tenant inflight cap (PR 10 NEXT): the owning
+        # controller derives it from the AIMD limiter's CURRENT limit so
+        # isolation survives limit collapse — a static cap of 8 over a
+        # collapsed limit of 4 would let one tenant own every slot.
+        # None keeps the static config cap.
+        self._cap_fn = cap_fn
         self.lanes = [_Lane(lv) for lv in config.levels]
         self._by_level = {lv.name: lane
                           for lv, lane in zip(config.levels, self.lanes)}
         self.depth = 0
         self.cost_total = 0.0
         self.tenant_cost: dict = {}  # queued cost per tenant, all lanes
+
+    def effective_cap(self) -> int:
+        """The per-tenant inflight cap in force NOW (0 = unbounded)."""
+        if self._cap_fn is not None:
+            return self._cap_fn()
+        return self.config.tenant_inflight_cap
 
     # --- enqueue / shed ordering ---------------------------------------
     def enqueue(self, t: Ticket, queue_depth: int, queue_cost: float
@@ -394,7 +445,7 @@ class QoSQueue:
                      inflight_of: Callable[[str], int]) -> bool:
         if not lane.queues.get(tenant):
             return False
-        cap = self.config.tenant_inflight_cap
+        cap = self.effective_cap()
         return not (cap > 0 and inflight_of(tenant) >= cap)
 
     def _pick_lane(self, lane: _Lane,
@@ -449,6 +500,9 @@ class QoSQueue:
             "tenant_key": self.config.tenant_key,
             "queued": self.depth,
             "queued_cost": round(self.cost_total, 1),
+            # the cap in force NOW (AIMD-derived when the limiter has
+            # collapsed below max_inflight; 0 = unbounded)
+            "tenant_inflight_cap": self.effective_cap(),
             "lanes": lanes,
         }
 

@@ -1,0 +1,545 @@
+"""Batched external-data join lane (PR 11, gatekeeper_tpu/extdata/).
+
+THE pins: the device join is bit-identical to the exact interpreter on
+every (object, constraint) pair; the batched lane resolves the same
+values the per-key reference resolves; warm columns make ZERO transport
+calls; Provider reconcile invalidates residency."""
+
+import threading
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import Constraint
+from gatekeeper_tpu.apis.templates import ConstraintTemplate
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.extdata import ExtDataDivergence, ExtDataLane, activate
+from gatekeeper_tpu.extdata.column import ProviderColumn
+from gatekeeper_tpu.externaldata.providers import Provider, ProviderCache
+from gatekeeper_tpu.target.review import AugmentedUnstructured
+from gatekeeper_tpu.target.target import K8sValidationTarget
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+# the canonical validation-side external-data template (key batching +
+# response_with_error, the reference docs' shape)
+RULES_ERRORS = """
+package k8sextdata
+
+violation[{"msg": msg}] {
+  images := [img | img = input.review.object.spec.containers[_].image]
+  response := external_data({"provider": "trusted", "keys": images})
+  response_with_error(response)
+  msg := sprintf("invalid images: %v", [response.errors])
+}
+
+response_with_error(response) {
+  count(response.errors) > 0
+}
+
+response_with_error(response) {
+  count(response.system_error) > 0
+}
+"""
+
+# value-comparison shape: per-container single-key request, responses
+# pair iteration, resolved value vs the original feature
+RULES_DIGEST = """
+package k8sdigest
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  resp := external_data({"provider": "digest", "keys": [container.image]})
+  item := resp.responses[_]
+  item[1] != container.image
+  msg := sprintf("image %v is not pinned to its digest", [container.image])
+}
+"""
+
+
+def tmpl(kind, rego):
+    return ConstraintTemplate.from_unstructured({
+        "apiVersion": "templates.gatekeeper.sh/v1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                 "targets": [{"target": TARGET, "rego": rego}]},
+    })
+
+
+class CountingTransport:
+    """send_fn double: answers deterministically, counts round-trips."""
+
+    def __init__(self):
+        self.calls = 0
+        self.keys_sent = 0
+        self.lock = threading.Lock()
+
+    def __call__(self, provider, keys):
+        with self.lock:
+            self.calls += 1
+            self.keys_sent += len(keys)
+        items = []
+        for k in keys:
+            if provider.name == "trusted":
+                if "bad" in k:
+                    items.append({"key": k, "error": f"untrusted: {k}"})
+                else:
+                    items.append({"key": k, "value": k})
+            else:  # digest provider pins unpinned images
+                if "@sha256:" in k:
+                    items.append({"key": k, "value": k})
+                else:
+                    items.append({"key": k, "value": k + "@sha256:abc"})
+        return {"response": {"items": items, "systemError": ""}}
+
+
+def make_lane(mode="batched", **kw):
+    transport = CountingTransport()
+    cache = ProviderCache(send_fn=transport)
+    cache.upsert(Provider(name="trusted", url="https://t", ca_bundle="x"))
+    cache.upsert(Provider(name="digest", url="https://d", ca_bundle="x"))
+    lane = ExtDataLane(cache, mode=mode, **kw)
+    return lane, cache, transport
+
+
+def make_driver(lane):
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.extdata_lane = lane
+    tpu.add_template(tmpl("K8sExtData", RULES_ERRORS))
+    tpu.add_template(tmpl("K8sDigest", RULES_DIGEST))
+    cons = [
+        Constraint(kind="K8sExtData", name="trusted-images", match={},
+                   parameters={}, enforcement_action="deny"),
+        Constraint(kind="K8sDigest", name="pinned", match={},
+                   parameters={}, enforcement_action="deny"),
+    ]
+    for c in cons:
+        tpu.add_constraint(c)
+    return tpu, cons
+
+
+def corpus():
+    """Pods covering every join outcome: ok keys, error keys, pinned and
+    unpinned digests, duplicate keys, empty container lists, absent and
+    non-string image fields."""
+    pods = [
+        {"kind": "Pod", "metadata": {"name": "ok"},
+         "spec": {"containers": [{"name": "c", "image": "nginx"}]}},
+        {"kind": "Pod", "metadata": {"name": "mixed"},
+         "spec": {"containers": [{"name": "c", "image": "bad/x"},
+                                 {"name": "d", "image": "repo/y"}]}},
+        {"kind": "Pod", "metadata": {"name": "dup"},
+         "spec": {"containers": [{"name": "c", "image": "bad/x"},
+                                 {"name": "d", "image": "bad/x"}]}},
+        {"kind": "Pod", "metadata": {"name": "pinned"},
+         "spec": {"containers": [
+             {"name": "c", "image": "repo/y@sha256:abc"}]}},
+        {"kind": "Pod", "metadata": {"name": "empty"},
+         "spec": {"containers": []}},
+        {"kind": "Pod", "metadata": {"name": "noimage"},
+         "spec": {"containers": [{"name": "c"}]}},
+        {"kind": "Pod", "metadata": {"name": "numimage"},
+         "spec": {"containers": [{"name": "c", "image": 42}]}},
+    ]
+    for i in range(40):
+        img = f"bad/i{i % 5}" if i % 3 == 0 else f"ok/i{i % 7}"
+        pods.append({"kind": "Pod", "metadata": {"name": f"p{i}"},
+                     "spec": {"containers": [{"name": "c", "image": img}]}})
+    return pods
+
+
+def reviews_of(pods):
+    target = K8sValidationTarget()
+    return target, [target.handle_review(AugmentedUnstructured(object=p))
+                    for p in pods]
+
+
+def result_key(r):
+    return ((r.constraint or {}).get("kind"), r.msg)
+
+
+# --- ProviderColumn unit --------------------------------------------------
+
+def test_provider_column_ttl_land_invalidate():
+    clock = [0.0]
+    col = ProviderColumn("p", ttl_s=10.0, clock=lambda: clock[0])
+    assert col.missing(["a", "b", "a"]) == ["a", "b"]
+    col.land({"a": ("v", None), "b": (None, "boom")})
+    v0 = col.version
+    assert col.missing(["a", "b"]) == []
+    assert col.get("a") == ("v", None)
+    assert col.get("b") == (None, "boom")
+    clock[0] = 11.0  # TTL expiry: keys refetch, last values stay readable
+    assert col.missing(["a", "b"]) == ["a", "b"]
+    assert col.get("a") == ("v", None)
+    col.invalidate()
+    assert col.version > v0
+    assert col.get("a") is None
+    assert len(col) == 0
+
+
+def test_lane_dedupes_and_chunks_bulk_calls():
+    lane, _cache, transport = make_lane(max_keys_per_call=3)
+    keys = [f"k{i}" for i in range(8)] * 4  # heavy duplication
+    lane.ensure("trusted", keys)
+    # 8 unique keys at <=3 per call = 3 transport sends, 8 keys total
+    assert transport.calls == 3
+    assert transport.keys_sent == 8
+    lane.ensure("trusted", keys)  # warm: zero new transport
+    assert transport.calls == 3
+    res = lane.resolve_keys("trusted", ["k1", "bad/z"])
+    assert res["k1"] == ("k1", None)
+    assert res["bad/z"][1].startswith("untrusted")
+    assert transport.calls == 4  # only the one missing key went out
+
+
+def test_provider_reconcile_invalidates_column():
+    lane, cache, transport = make_lane()
+    lane.ensure("trusted", ["a", "b"])
+    assert len(lane.column("trusted")) == 2
+    # reconcile (spec change) through the cache -> listener invalidates
+    cache.upsert(Provider(name="trusted", url="https://t2", ca_bundle="x"))
+    assert len(lane.column("trusted")) == 0
+    lane.ensure("trusted", ["a"])
+    assert transport.calls == 2  # refetched after invalidation
+
+
+def test_unknown_provider_errors_per_key():
+    lane, _cache, _t = make_lane()
+    res = lane.resolve_keys("nosuch", ["a"])
+    assert res["a"][0] is None and "nosuch" in res["a"][1]
+
+
+def test_builtin_without_lane_errors_every_key():
+    from gatekeeper_tpu.extdata.lane import builtin_fetch
+
+    resp = builtin_fetch({"provider": "p", "keys": ["a", 7]})
+    assert resp["responses"] == []
+    assert len(resp["errors"]) == 2
+    assert resp["system_error"] == ""
+
+
+# --- lowering coverage ----------------------------------------------------
+
+def test_extdata_templates_lower():
+    lane, _c, _t = make_lane()
+    tpu, _cons = make_driver(lane)
+    assert {"K8sExtData", "K8sDigest"} <= set(tpu.lowered_kinds()), \
+        tpu.fallback_kinds()
+
+
+def test_extdata_without_lane_falls_back_to_interp():
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.add_template(tmpl("K8sExtData", RULES_ERRORS))
+    assert "K8sExtData" in tpu.lowered_kinds()
+    # the program exists, but with no lane the kind is not device-ready
+    assert not tpu.extdata_ready("K8sExtData")
+    lane, _c, _t = make_lane(mode="perkey")
+    tpu.extdata_lane = lane
+    assert not tpu.extdata_ready("K8sExtData")  # perkey: interp lane
+    lane.mode = "batched"
+    assert tpu.extdata_ready("K8sExtData")
+
+
+# --- THE verdict differential --------------------------------------------
+
+@pytest.mark.parametrize("mode", ["batched", "differential"])
+def test_query_batch_matches_interpreter(mode):
+    lane, _cache, transport = make_lane(mode=mode)
+    tpu, cons = make_driver(lane)
+    target, reviews = reviews_of(corpus())
+    with activate(lane):
+        got = tpu.query_batch(TARGET, cons, reviews)
+        for oi, review in enumerate(reviews):
+            expected = []
+            for con in cons:
+                if not target.to_matcher(con.match).match(review):
+                    continue
+                expected.extend(
+                    tpu._interp.query(TARGET, [con], review).results)
+            assert sorted(map(result_key, got[oi].results)) == \
+                sorted(map(result_key, expected)), f"pod {oi}"
+    assert transport.calls > 0
+
+
+def test_warm_columns_make_zero_transport_calls():
+    lane, _cache, transport = make_lane()
+    tpu, cons = make_driver(lane)
+    _target, reviews = reviews_of(corpus())
+    with activate(lane):
+        tpu.query_batch(TARGET, cons, reviews)
+        cold = transport.calls
+        tpu.query_batch(TARGET, cons, reviews)
+        tpu.query_batch(TARGET, cons, reviews)
+    assert transport.calls == cold
+
+
+def test_batched_and_perkey_lanes_bit_identical():
+    """The acceptance pin: identical verdicts AND resolved values across
+    lanes, with a validation-side and a mutation-side consumer."""
+    pods = corpus()
+    out = {}
+    for mode in ("batched", "perkey"):
+        lane, _cache, _t = make_lane(mode=mode)
+        tpu, cons = make_driver(lane)
+        _target, reviews = reviews_of(pods)
+        with activate(lane):
+            got = tpu.query_batch(TARGET, cons, reviews)
+        out[mode] = [sorted(map(result_key, r.results)) for r in got]
+        # resolved values: every key the corpus references
+        keys = sorted({c.get("image") for p in pods
+                       for c in p["spec"]["containers"]
+                       if isinstance(c.get("image"), str)})
+        with activate(lane):
+            out[mode + ":vals"] = lane.resolve_keys("digest", keys)
+    assert out["batched"] == out["perkey"]
+    assert out["batched:vals"] == out["perkey:vals"]
+
+
+def test_differential_mode_catches_tampered_column():
+    lane, _cache, _t = make_lane(mode="differential")
+    tpu, cons = make_driver(lane)
+    _target, reviews = reviews_of(corpus()[:4])
+    with activate(lane):
+        tpu.query_batch(TARGET, cons, reviews)  # clean pass
+        # tamper a resolved value behind the per-key reference's back
+        col = lane.column("digest")
+        key = next(iter(col.snapshot()))
+        col.land({key: ("tampered", None)})
+        with pytest.raises(ExtDataDivergence):
+            tpu.query_batch(TARGET, cons, reviews)
+
+
+# --- audit sweep ----------------------------------------------------------
+
+def test_sweep_exact_totals_and_lane_parity():
+    from gatekeeper_tpu.parallel.sharded import (ShardedEvaluator,
+                                                 make_mesh,
+                                                 violation_rows)
+
+    pods = []
+    want_bad = set()
+    for i in range(120):
+        bad = i % 3 == 0
+        if bad:
+            want_bad.add(i)
+        img = f"bad/i{i % 7}" if bad else f"ok/i{i % 11}"
+        pods.append({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"p{i}", "uid": f"u{i}"},
+                     "spec": {"containers": [{"name": "c", "image": img}]}})
+    lane, _cache, transport = make_lane()
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.extdata_lane = lane
+    tpu.add_template(tmpl("K8sExtData", RULES_ERRORS))
+    con = Constraint(kind="K8sExtData", name="x", match={}, parameters={},
+                     enforcement_action="deny")
+    tpu.add_constraint(con)
+    ev = ShardedEvaluator(tpu, make_mesh())
+    with activate(lane):
+        out = ev.sweep([con], pods, return_bits=True)
+        _cons, _idx, _valid, counts, bits = out["K8sExtData"]
+        assert counts[0] == len(want_bad)
+        rows = set(violation_rows(bits, 0, len(pods)).tolist())
+        assert rows == want_bad
+        # the whole chunk cost ONE bulk transport call (18 unique keys)
+        assert transport.calls == 1
+        # perkey lane: the kind leaves the device set; the caller's
+        # interpreter fallback is the reference (sweep returns {})
+        lane.mode = "perkey"
+        assert ev.sweep([con], pods) == {}
+        lane.mode = "batched"
+
+
+# --- mutation-side consumer ----------------------------------------------
+
+MUTATOR = {
+    "apiVersion": "mutations.gatekeeper.sh/v1",
+    "kind": "Assign",
+    "metadata": {"name": "pin-image"},
+    "spec": {
+        "applyTo": [{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": "spec.containers[name:*].image",
+        "parameters": {"assign": {
+            "externalData": {"provider": "digest",
+                             "dataSource": "ValueAtLocation",
+                             "failurePolicy": "Fail"}}},
+    },
+}
+
+
+def mutate_pod():
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "m"},
+            "spec": {"containers": [{"name": "a", "image": "repo/a"},
+                                    {"name": "b", "image": "repo/b"},
+                                    {"name": "c", "image": "repo/a"}]}}
+
+
+def test_mutation_placeholders_batch_resolve_identical():
+    from gatekeeper_tpu.mutation.system import MutationSystem
+
+    results = {}
+    calls = {}
+    for mode in ("batched", "perkey"):
+        lane, cache, transport = make_lane(mode=mode)
+        sys_ = MutationSystem(provider_cache=cache)
+        sys_.upsert_unstructured(MUTATOR)
+        obj = mutate_pod()
+        with activate(lane):
+            changed = sys_.mutate(obj)
+        assert changed
+        results[mode] = obj
+        calls[mode] = transport.calls
+    assert results["batched"] == results["perkey"]
+    imgs = [c["image"] for c in results["batched"]["spec"]["containers"]]
+    assert imgs == ["repo/a@sha256:abc", "repo/b@sha256:abc",
+                    "repo/a@sha256:abc"]
+    # batched: ONE bulk call for the deduped {repo/a, repo/b}.  (The
+    # perkey reference ALSO coalesces here — PR 2's prefetch already
+    # batched the mutation convergence pass — so the contrast this pin
+    # guards is resolve identity, not mutation-path call counts.)
+    assert calls["batched"] == 1
+    assert calls["perkey"] >= 1
+
+
+# --- gator generate-vap (satellite) --------------------------------------
+
+def test_gator_generate_vap_library_cel_template(capsys):
+    from gatekeeper_tpu.gator.generate_vap_cmd import run_cli
+
+    rc = run_cli(["-f", "library/general/containerlimitscel"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import yaml as _yaml
+
+    docs = list(_yaml.safe_load_all(out))
+    kinds = [d["kind"] for d in docs]
+    assert "ValidatingAdmissionPolicy" in kinds
+    assert "ValidatingAdmissionPolicyBinding" in kinds
+    vap = docs[kinds.index("ValidatingAdmissionPolicy")]
+    assert vap["spec"]["paramKind"]["kind"] == "K8sContainerLimitsCEL"
+    assert vap["spec"]["validations"]
+    names = [v["name"] for v in vap["spec"]["variables"]]
+    assert "params" in names and "anyObject" in names
+    vapb = docs[kinds.index("ValidatingAdmissionPolicyBinding")]
+    assert vapb["spec"]["policyName"] == vap["metadata"]["name"]
+
+
+def test_gator_generate_vap_skips_rego_templates(capsys):
+    from gatekeeper_tpu.gator import reader  # noqa: F401
+    from gatekeeper_tpu.gator.generate_vap_cmd import generate
+
+    docs, skipped = generate([
+        {"apiVersion": "templates.gatekeeper.sh/v1",
+         "kind": "ConstraintTemplate",
+         "metadata": {"name": "regoonly"},
+         "spec": {"crd": {"spec": {"names": {"kind": "RegoOnly"}}},
+                  "targets": [{"target": TARGET,
+                               "rego": RULES_ERRORS}]}}])
+    assert docs == []
+    assert skipped and skipped[0][0] == "RegoOnly"
+
+
+# --- idiom boundary: variants lower or fall back, never diverge ----------
+
+VARIANTS = {
+    # exact counts are dedupe-sensitive: interpreter lane
+    "K8sExact": ("fallback", """
+package a
+violation[{"msg": "x"}] {
+  images := [img | img = input.review.object.spec.containers[_].image]
+  resp := external_data({"provider": "trusted", "keys": images})
+  count(resp.errors) == 2
+}
+"""),
+    # responses pair key slot: only the value slot lowers
+    "K8sKeySlot": ("fallback", """
+package b
+violation[{"msg": "x"}] {
+  c := input.review.object.spec.containers[_]
+  resp := external_data({"provider": "trusted", "keys": [c.image]})
+  item := resp.responses[_]
+  item[0] == "nginx"
+}
+"""),
+    # non-constant provider name: interpreter lane
+    "K8sDynProv": ("fallback", """
+package c
+violation[{"msg": "x"}] {
+  p := input.parameters.provider
+  resp := external_data({"provider": p, "keys": ["k"]})
+  count(resp.errors) > 0
+}
+"""),
+    # error strings are host-rendered: iterating them stays exact-engine
+    "K8sErrIter": ("fallback", """
+package f
+violation[{"msg": msg}] {
+  images := [img | img = input.review.object.spec.containers[_].image]
+  resp := external_data({"provider": "trusted", "keys": images})
+  e := resp.errors[_]
+  msg := sprintf("%v", [e])
+}
+"""),
+    # negated helper over the errors count: ¬∃ closes on device
+    "K8sNegated": ("lowered", """
+package d
+violation[{"msg": "x"}] {
+  images := [img | img = input.review.object.spec.containers[_].image]
+  resp := external_data({"provider": "trusted", "keys": images})
+  not clean(resp)
+}
+clean(resp) { count(resp.errors) == 0 }
+"""),
+    # responses emptiness
+    "K8sNoResp": ("lowered", """
+package e
+violation[{"msg": "x"}] {
+  images := [img | img = input.review.object.spec.containers[_].image]
+  resp := external_data({"provider": "trusted", "keys": images})
+  count(resp.responses) == 0
+}
+"""),
+    # resolved-value string predicate
+    "K8sPrefix": ("lowered", """
+package g
+violation[{"msg": "x"}] {
+  c := input.review.object.spec.containers[_]
+  resp := external_data({"provider": "digest", "keys": [c.image]})
+  item := resp.responses[_]
+  not startswith(item[1], "repo/")
+}
+"""),
+}
+
+
+def test_idiom_variants_route_and_agree():
+    """Each variant either lowers or cleanly falls back (LowerError is
+    the ONLY acceptable compile failure), and EVERY variant's verdicts
+    match the interpreter over the full corpus either way."""
+    lane, _cache, _t = make_lane()
+    tpu = TpuDriver(batch_bucket=8)
+    tpu.extdata_lane = lane
+    cons = []
+    for kind, (_want, rules) in VARIANTS.items():
+        tpu.add_template(tmpl(kind, rules))
+        con = Constraint(kind=kind, name=kind.lower(), match={},
+                         parameters={}, enforcement_action="deny")
+        tpu.add_constraint(con)
+        cons.append(con)
+    lowered = set(tpu.lowered_kinds())
+    for kind, (want, _rules) in VARIANTS.items():
+        assert (kind in lowered) == (want == "lowered"), \
+            (kind, want, tpu.fallback_kinds().get(kind))
+    target, reviews = reviews_of(corpus())
+    with activate(lane):
+        got = tpu.query_batch(TARGET, cons, reviews)
+        for oi, review in enumerate(reviews):
+            expected = []
+            for con in cons:
+                if not target.to_matcher(con.match).match(review):
+                    continue
+                expected.extend(
+                    tpu._interp.query(TARGET, [con], review).results)
+            assert sorted(map(result_key, got[oi].results)) == \
+                sorted(map(result_key, expected)), f"pod {oi}"

@@ -1,0 +1,28 @@
+"""tools/bench_extdata.py smoke (slow lane) — the script embeds a
+batched-vs-perkey verdict cross-check, so a diverging lane fails here,
+and the acceptance shape (bulk dedupe >= 10x at chunk >= 64, warm
+steady state zero transport) is pinned at smoke scale."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_extdata_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "bench_extdata.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout)
+    assert rec["chunk_size"] >= 64
+    assert rec["dedupe_ratio"] >= 10.0
+    assert rec["warm_round_trips"] == 0
+    assert rec["batched_round_trips"] >= 1
+    assert rec["violations"] > 0

@@ -637,3 +637,84 @@ def test_bench_tenant_mix_smoke_toy_scale():
         assert mix["tenant-b"]["accepted"] > 0  # B survived the mix
     finally:
         srv.stop(drain_timeout=3)
+
+
+# --- PR 11 QoS hardening: SA-triple normalization + AIMD-derived cap ------
+
+def test_serviceaccount_tenant_normalization():
+    """The serviceaccount tenant key must not trust userInfo.username
+    verbatim: only a well-formed system:serviceaccount:<ns>:<name>
+    triple normalizes; malformed/spoof-shaped identities fold into the
+    cluster tenant instead of minting themselves a fair-share queue."""
+    def t(username):
+        return qos.tenant_of_request(
+            {"namespace": "x", "userInfo": {"username": username}},
+            qos.TENANT_SERVICEACCOUNT)
+
+    assert t("system:serviceaccount:team-a:bot") == \
+        "system:serviceaccount:team-a:bot"
+    # extra segments, empty parts, whitespace, case games: NOT an SA
+    assert t("system:serviceaccount:team-a:bot:extra") == \
+        qos.CLUSTER_TENANT
+    assert t("system:serviceaccount::bot") == qos.CLUSTER_TENANT
+    assert t("system:serviceaccount:team-a:") == qos.CLUSTER_TENANT
+    assert t("system:serviceaccount: team-a :bot") == qos.CLUSTER_TENANT
+    assert t("System:ServiceAccount:team-a:bot") == qos.CLUSTER_TENANT
+    # non-SA identities keep their username; empty folds to cluster
+    assert t("alice") == "alice"
+    assert t("") == qos.CLUSTER_TENANT
+    # the unit normalizer agrees
+    assert qos.normalize_serviceaccount(
+        "system:serviceaccount:a:b") == "system:serviceaccount:a:b"
+    assert qos.normalize_serviceaccount("system:serviceaccount:a") is None
+
+
+def test_tenant_cap_derives_from_live_aimd_limit():
+    """tenantInflightCap scales with the limiter's LIVE limit: a cap
+    chosen as a fraction of healthy capacity keeps that fraction when
+    AIMD collapses, so one tenant can never own every remaining slot
+    (the PR 10 isolation guarantee surviving limit collapse)."""
+    cfg = qos.QoSConfig(tenant_inflight_cap=4)
+    ctl = ovl.OverloadController(ovl.OverloadConfig(
+        min_inflight=1, max_inflight=8, initial_inflight=8,
+        queue_depth=16, queue_timeout_s=2.0, qos=cfg))
+    assert ctl._tenant_cap() == 4  # healthy: the configured cap
+    with ctl.limiter._lock:
+        ctl.limiter._limit = 2.0  # AIMD collapse
+    assert ctl._tenant_cap() == 1  # ceil(4 * 2/8) = 1: a slot stays free
+    with ctl.limiter._lock:
+        ctl.limiter._limit = 4.0
+    assert ctl._tenant_cap() == 2
+    # snapshot surfaces the cap in force
+    assert ctl._queue_qos.snapshot()["tenant_inflight_cap"] == 2
+    # cap 0 stays unbounded at any limit
+    cfg0 = qos.QoSConfig()
+    ctl0 = ovl.OverloadController(ovl.OverloadConfig(
+        min_inflight=1, max_inflight=8, initial_inflight=2, qos=cfg0))
+    assert ctl0._tenant_cap() == 0
+
+
+def test_collapsed_limit_tenant_cannot_hoard_slots():
+    """Behavioral pin: static cap 4, limit collapsed to 2 — tenant A's
+    burst must never hold more than the DERIVED cap (1) in review, so
+    a victim tenant still gets the other slot."""
+    cfg = qos.QoSConfig(tenant_inflight_cap=4)
+    ctl = ovl.OverloadController(ovl.OverloadConfig(
+        min_inflight=1, max_inflight=8, initial_inflight=8,
+        queue_depth=32, queue_timeout_s=2.0, qos=cfg))
+    with ctl.limiter._lock:
+        ctl.limiter._limit = 2.0
+    client = _TenantTrackingClient(service_s=0.05)
+    h = ValidationHandler(client, failure_policy="fail", overload=ctl)
+    threads = [threading.Thread(
+        target=lambda i=i, ns=ns: h.handle(
+            _body(uid=f"{ns}-{i}", namespace=ns)))
+        for ns in ("team-a", "team-b") for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert client.max_conc.get("team-a", 0) <= 1
+    assert client.max_conc.get("team-b", 0) <= 1
+    assert client.reviews == 8
+    assert ctl.shed_count == 0

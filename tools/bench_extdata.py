@@ -1,0 +1,265 @@
+"""External-data join-lane bench: deduped bulk calls vs per-key RTTs.
+
+The registry-lookup workload the ROADMAP names (image-digest-style
+verification): a synthetic pod corpus whose container images draw from a
+bounded registry namespace, one validation-side external-data template
+(errors lane) and one mutation-side Assign placeholder, evaluated in
+audit chunks.  Measured per chunk size:
+
+- ``perkey_round_trips``  — transport sends the PR 2 per-key reference
+  makes over a cold sweep (one ``ProviderCache.fetch`` per unique cold
+  key, the per-object interpreter loop in disguise);
+- ``batched_round_trips`` — transport sends the batched lane makes for
+  the same sweep (the deduped miss list, ``max_keys_per_call`` per
+  send);
+- ``dedupe_ratio``        — per-key / batched round-trips (the headline:
+  >= 10x at chunk >= 64 per the PR 11 acceptance bar);
+- ``warm_round_trips``    — transport sends of a SECOND identical sweep
+  over the resident columns (the steady-state number: 0);
+- ``batched_sweep_s`` / ``perkey_sweep_s`` — wall time of the device
+  sweep vs the interpreter reference at a simulated per-send transport
+  latency (``--rtt-ms``, default 0 so CI smoke stays fast).
+
+Appends the previous latest record to the ``history`` list in
+``EXTDATA_BENCH.json`` (the FLATTEN_BENCH convention); ``host_cpus``
+recorded because the flatten half scales with cores.  Run:
+
+    python tools/bench_extdata.py [n_objects] [chunk_size]
+
+``--smoke`` (tiny corpus, no file write unless --write) runs in the
+slow lane via tests/test_extdata_bench.py so the script cannot rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+RULES = """
+package k8sextdata
+
+violation[{"msg": msg}] {
+  images := [img | img = input.review.object.spec.containers[_].image]
+  response := external_data({"provider": "registry", "keys": images})
+  count(response.errors) > 0
+  msg := sprintf("invalid images: %v", [response.errors])
+}
+"""
+
+MUTATOR = {
+    "apiVersion": "mutations.gatekeeper.sh/v1",
+    "kind": "Assign",
+    "metadata": {"name": "pin-image"},
+    "spec": {
+        "applyTo": [{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": "spec.containers[name:*].image",
+        "parameters": {"assign": {"externalData": {
+            "provider": "registry", "dataSource": "ValueAtLocation",
+            "failurePolicy": "Ignore"}}},
+    },
+}
+
+
+class RegistryTransport:
+    """Deterministic digest-registry double with a simulated RTT."""
+
+    def __init__(self, rtt_s: float = 0.0):
+        self.calls = 0
+        self.keys = 0
+        self.rtt_s = rtt_s
+
+    def __call__(self, provider, keys):
+        self.calls += 1
+        self.keys += len(keys)
+        if self.rtt_s:
+            time.sleep(self.rtt_s)
+        items = []
+        for k in keys:
+            if "forbidden" in k:
+                items.append({"key": k, "error": "untrusted registry"})
+            elif "@sha256:" in k:
+                items.append({"key": k, "value": k})
+            else:
+                items.append({"key": k, "value": f"{k}@sha256:{hash(k) & 0xFFFF:04x}"})
+        return {"response": {"items": items, "systemError": ""}}
+
+
+def make_corpus(n: int, registry_size: int, seed: int = 7) -> list:
+    import random
+
+    rng = random.Random(seed)
+    pods = []
+    for i in range(n):
+        containers = []
+        for j in range(rng.randint(1, 3)):
+            r = rng.randrange(registry_size)
+            base = ("forbidden" if r % 11 == 0 else f"registry.example/app{r}")
+            containers.append({"name": f"c{j}", "image": base})
+        pods.append({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"p{i}", "uid": f"u{i}",
+                                  "namespace": f"ns{i % 17}"},
+                     "spec": {"containers": containers}})
+    return pods
+
+
+def run_bench(n_objects: int = 20_000, chunk_size: int = 2048,
+              registry_size: int = 4096, rtt_ms: float = 0.0,
+              max_keys_per_call: int = 256,
+              out_path: str = None, write: bool = True) -> dict:
+    from gatekeeper_tpu.apis.constraints import Constraint
+    from gatekeeper_tpu.apis.templates import ConstraintTemplate
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.extdata import ExtDataLane, activate
+    from gatekeeper_tpu.externaldata.providers import (Provider,
+                                                       ProviderCache)
+    from gatekeeper_tpu.mutation.system import MutationSystem
+    from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+
+    pods = make_corpus(n_objects, registry_size)
+    unique_keys = sorted({c["image"] for p in pods
+                          for c in p["spec"]["containers"]})
+
+    def setup(mode):
+        transport = RegistryTransport(rtt_s=rtt_ms / 1000.0)
+        cache = ProviderCache(send_fn=transport)
+        cache.upsert(Provider(name="registry", url="https://r",
+                              ca_bundle="x"))
+        lane = ExtDataLane(cache, mode=mode,
+                           max_keys_per_call=max_keys_per_call)
+        tpu = TpuDriver()
+        tpu.extdata_lane = lane
+        tpu.add_template(ConstraintTemplate.from_unstructured({
+            "apiVersion": "templates.gatekeeper.sh/v1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8sextdata"},
+            "spec": {"crd": {"spec": {"names": {"kind": "K8sExtData"}}},
+                     "targets": [{"target": TARGET, "rego": RULES}]}}))
+        con = Constraint(kind="K8sExtData", name="registry-check",
+                         match={}, parameters={},
+                         enforcement_action="deny")
+        tpu.add_constraint(con)
+        return lane, transport, tpu, con
+
+    def chunks():
+        for i in range(0, len(pods), chunk_size):
+            yield pods[i:i + chunk_size]
+
+    # --- batched lane: device sweep, bulk calls ------------------------
+    lane_b, tr_b, tpu_b, con_b = setup("batched")
+    ev = ShardedEvaluator(tpu_b, make_mesh(), violations_limit=20)
+    with activate(lane_b):
+        t0 = time.perf_counter()
+        total_b = 0
+        for ch in chunks():
+            out = ev.sweep([con_b], ch)
+            if out:
+                _cons, _idx, _valid, counts, _bits = out["K8sExtData"]
+                total_b += int(counts.sum())
+        batched_sweep_s = time.perf_counter() - t0
+        batched_round_trips = tr_b.calls
+        # warm steady state: the same sweep again over resident columns
+        warm0 = tr_b.calls
+        for ch in chunks():
+            ev.sweep([con_b], ch)
+        warm_round_trips = tr_b.calls - warm0
+
+    # --- per-key reference: interpreter loop, one fetch per cold key ---
+    lane_p, tr_p, tpu_p, con_p = setup("perkey")
+    from gatekeeper_tpu.target.review import AugmentedUnstructured
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+
+    target = K8sValidationTarget()
+    with activate(lane_p):
+        t0 = time.perf_counter()
+        total_p = 0
+        for p in pods:
+            review = target.handle_review(AugmentedUnstructured(object=p))
+            total_p += len(
+                tpu_p._interp.query(TARGET, [con_p], review).results)
+        perkey_sweep_s = time.perf_counter() - t0
+        perkey_round_trips = tr_p.calls
+    if total_b != total_p:
+        raise AssertionError(
+            f"lane verdict mismatch: batched {total_b} vs perkey {total_p}")
+
+    # --- mutation-side consumer: one placeholder pass ------------------
+    lane_m, tr_m, _tpu_m, _con = setup("batched")
+    cache_m = lane_m.cache
+    system = MutationSystem(provider_cache=cache_m)
+    system.upsert_unstructured(MUTATOR)
+    sample = [json.loads(json.dumps(p)) for p in pods[:chunk_size]]
+    with activate(lane_m):
+        t0 = time.perf_counter()
+        for obj in sample:
+            system.mutate(obj)
+        mutate_s = time.perf_counter() - t0
+        mutate_round_trips = tr_m.calls
+
+    record = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_cpus": os.cpu_count(),
+        "n_objects": n_objects,
+        "chunk_size": chunk_size,
+        "unique_keys": len(unique_keys),
+        "rtt_ms": rtt_ms,
+        "max_keys_per_call": max_keys_per_call,
+        "violations": total_b,
+        "perkey_round_trips": perkey_round_trips,
+        "batched_round_trips": batched_round_trips,
+        "dedupe_ratio": round(perkey_round_trips
+                              / max(1, batched_round_trips), 1),
+        "warm_round_trips": warm_round_trips,
+        "mutate_round_trips": mutate_round_trips,
+        "batched_sweep_s": round(batched_sweep_s, 3),
+        "perkey_sweep_s": round(perkey_sweep_s, 3),
+    }
+    if write:
+        path = out_path or os.path.join(os.path.dirname(__file__), "..",
+                                        "EXTDATA_BENCH.json")
+        doc = {"history": []}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {"history": []}
+            latest = {k: v for k, v in doc.items() if k != "history"}
+            if latest:
+                doc.setdefault("history", []).append(latest)
+        history = doc.get("history", [])
+        doc = dict(record)
+        doc["history"] = history
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path}")
+    return record
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--smoke" in argv:
+        rec = run_bench(n_objects=300, chunk_size=64, registry_size=128,
+                        write="--write" in argv)
+        print(json.dumps(rec, indent=2))
+        return 0
+    pos = [a for a in argv if not a.startswith("--")]
+    n = int(pos[0]) if pos else 20_000
+    chunk = int(pos[1]) if len(pos) > 1 else 2048
+    rtt = 0.0
+    for a in argv:
+        if a.startswith("--rtt-ms="):
+            rtt = float(a.split("=", 1)[1])
+    rec = run_bench(n_objects=n, chunk_size=chunk, rtt_ms=rtt)
+    print(json.dumps(rec, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
