@@ -128,6 +128,29 @@ def main(argv=None) -> int:
                    help="max keys per bulk provider call (the batched "
                         "lane chunks larger deduped miss lists into "
                         "multiple transport sends)")
+    p.add_argument("--extdata-fanout", type=int, default=4,
+                   help="per-provider concurrency of the batched lane's "
+                        "bulk fetches: a chunk referencing N providers "
+                        "lands their miss lists across this many threads "
+                        "(1 = strictly serial, the pre-fanout behavior)")
+    p.add_argument("--generation-swap", default="on",
+                   choices=["on", "off"],
+                   help="template-churn compile lane: 'on' stages "
+                        "post-boot template/constraint mutations, "
+                        "compiles the next generation on a background "
+                        "thread and atomically swaps executables in "
+                        "(the serving path never pays lowering); 'off' "
+                        "compiles inline on the reconcile path, "
+                        "bit-identical to the pre-generation behavior. "
+                        "Boot (manifests + warm) is always synchronous")
+    p.add_argument("--compile-cache", default="",
+                   help="directory for the on-disk compile cache: "
+                        "lowered template programs keyed by (template "
+                        "digest, engine, jax/jaxlib version, "
+                        "flatten-schema version) with a vocab snapshot "
+                        "replay, plus JAX's persistent XLA compilation "
+                        "cache under <dir>/xla — a warm restart or "
+                        "--once run skips lowering entirely")
     p.add_argument("--collect", default="reduced",
                    choices=["reduced", "masks", "differential"],
                    help="sweep collect lane: 'reduced' folds verdicts ON "
@@ -543,10 +566,36 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
     else:
-        tpu = TpuDriver(cel_driver=cel, metrics=metrics)
+        compile_cache = None
+        if args.compile_cache:
+            from gatekeeper_tpu.drivers.generation import CompileCache
+
+            compile_cache = CompileCache(args.compile_cache,
+                                         metrics=metrics)
+            try:
+                # XLA executables persist beside the lowering entries;
+                # min thresholds dropped so small admission kernels cache
+                import jax as _jax
+
+                _jax.config.update("jax_compilation_cache_dir",
+                                   compile_cache.xla_cache_dir())
+                _jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+                _jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0)
+            except Exception as e:
+                print(f"xla compile cache unavailable: {e}",
+                      file=sys.stderr)
+            print(f"compile cache: {args.compile_cache}", file=sys.stderr)
+        tpu = TpuDriver(cel_driver=cel, metrics=metrics,
+                        generation_swap=args.generation_swap == "on",
+                        compile_cache=compile_cache)
     client = Client(target=K8sValidationTarget(),
                     drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, "audit.gatekeeper.sh"])
+    if getattr(tpu, "gen_coord", None) is not None:
+        # pre-swap warm traces changed kernels at the real serving shape
+        tpu.gen_coord.constraints_fn = client.constraints
     kube_cluster = None
     if args.kubeconfig:
         from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
@@ -582,7 +631,8 @@ def main(argv=None) -> int:
     provider_cache = ProviderCache(metrics=metrics)
     extdata_lane = _extlane.ExtDataLane(
         provider_cache, mode=args.extdata_lane,
-        max_keys_per_call=args.extdata_max_keys, metrics=metrics)
+        max_keys_per_call=args.extdata_max_keys, metrics=metrics,
+        fanout=args.extdata_fanout)
     _extlane.install(extdata_lane)
     if args.extdata_lane != "batched":
         print(f"extdata lane: {args.extdata_lane}", file=sys.stderr)
@@ -790,7 +840,10 @@ def main(argv=None) -> int:
 
             mut_lane = MutationLane(
                 mgr.mutation_system, metrics=metrics,
-                differential=args.mutate_lane == "differential")
+                differential=args.mutate_lane == "differential",
+                # mutator churn recompiles on the generation thread too
+                # (bursts keep the previous revision until the install)
+                coordinator=getattr(tpu, "gen_coord", None))
             mutation_batcher = MutationBatcher(
                 mut_lane, metrics=metrics).start()
             mutation_handler = BatchedMutationHandler(
@@ -923,6 +976,15 @@ def main(argv=None) -> int:
                 daemon=True,
             ).start()
 
+    # boot reconcile + warm are done: flip template churn to the
+    # background generation lane (README "Generations & compile cache")
+    # — from here on a ConstraintTemplate add/edit stages + enqueues,
+    # the compile thread builds/warms the next generation, and the swap
+    # lands off the serving path
+    if mgr.begin_background_compile():
+        print("generation swap active: post-boot template churn "
+              "compiles in the background", file=sys.stderr)
+
     # graceful shutdown (the drain state machine, README "Overload &
     # drain semantics"): on SIGTERM readiness flips 503 {draining:true}
     # immediately (the LB deregisters during --shutdown-delay while the
@@ -977,6 +1039,9 @@ def main(argv=None) -> int:
             mutation_batcher.stop()
         if snap_ingester is not None:
             snap_ingester.stop()
+        _gc = getattr(tpu, "gen_coord", None)
+        if _gc is not None:
+            _gc.stop()
         if slo_engine is not None:
             slo_engine.stop()
         if flight_rec is not None:

@@ -420,6 +420,13 @@ class AuditManager:
             run = self._audit_snapshot_impl(full=False)
             sp.set_attribute("objects", run.total_objects)
             sp.set_attribute("duration_s", round(run.duration_s, 3))
+            gc = getattr(getattr(self.evaluator, "driver", None),
+                         "gen_coord", None)
+            if gc is not None:
+                # which template generation this tick evaluated under —
+                # a tick spanning a swap shows the post-swap id and its
+                # rows re-chunked (snapshot.rechunk), never a relist
+                sp.set_attribute("generation", gc.gen_id)
             if run.incomplete:
                 sp.set_attribute("incomplete", True)
             return run
@@ -429,6 +436,7 @@ class AuditManager:
         events.  Returns True when a rebuild happened."""
         snap = self.snapshot
         rebuilt = False
+        rechunks = getattr(snap, "rechunk_count", 0)
         if snap.set_constraints(constraints):
             from gatekeeper_tpu.utils.logging import log_event
 
@@ -440,6 +448,18 @@ class AuditManager:
             self._gen_verdicts.clear()
             log_event("info", "snapshot rebuilt",
                       event_type="snapshot_rebuilt", rows=n,
+                      generation=snap.generation)
+        elif getattr(snap, "rechunk_count", 0) != rechunks:
+            from gatekeeper_tpu.utils.logging import log_event
+
+            # a template/constraint (generation) change was absorbed by
+            # re-chunking resident rows — zero relist; the verdict store
+            # reset with the plan, so generated verdicts reset too and
+            # the all-dirty tick re-derives everything
+            self._gen_verdicts.clear()
+            log_event("info", "snapshot rechunked (no relist)",
+                      event_type="snapshot_rechunked",
+                      rows=snap.live_count(),
                       generation=snap.generation)
         snap.pump()
         return rebuilt

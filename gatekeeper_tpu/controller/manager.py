@@ -116,6 +116,30 @@ class Manager:
         """Reference: operations.IsAssigned (operations.go:92)."""
         return op in self.operations or "*" in self.operations
 
+    # --- generation swap (drivers/generation.py) ------------------------
+    def generation_coordinator(self):
+        """The TPU driver's GenerationCoordinator, or None (no TPU
+        driver / --generation-swap off)."""
+        for d in self.client.drivers:
+            gc = getattr(d, "gen_coord", None)
+            if gc is not None:
+                return gc
+        return None
+
+    def begin_background_compile(self) -> bool:
+        """Flip template reconciles from inline compile to the
+        enqueue-and-swap lane.  Called once boot reconcile has settled
+        (manifests loaded, warm pass done): boot stays synchronous —
+        readiness and the warm loop see compiled templates — while every
+        LATER reconcile only stages + notifies; the background thread
+        compiles the next generation and swaps it in off the serving
+        path.  Returns True when a coordinator exists and is running."""
+        gc = self.generation_coordinator()
+        if gc is None:
+            return False
+        gc.start()
+        return True
+
     # --- boot (reference: readiness tracker seeding, ready_tracker.go:326)
     def start(self) -> "Manager":
         # stored-version migration first (reference: pkg/upgrade runs

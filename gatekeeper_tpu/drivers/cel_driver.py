@@ -105,7 +105,10 @@ class CELDriver:
         return parse_source(template) is not None
 
     # --- template lifecycle -------------------------------------------
-    def add_template(self, template: ConstraintTemplate) -> None:
+    def compile_template(self, template: ConstraintTemplate) \
+            -> "_CompiledCELTemplate":
+        """Pure compile (no install) — the generation coordinator's
+        staged-validation seam; ``add_template`` = compile + install."""
         source = parse_source(template)
         if source is None:
             raise CELCompileError(
@@ -153,10 +156,13 @@ class CELDriver:
             raise CELCompileError(
                 f"template {template.name}: {e}"
             ) from e
-        self._templates[template.kind] = _CompiledCELTemplate(
+        return _CompiledCELTemplate(
             template.kind, validations, variables, match_conditions,
             failure_policy, bool(source.get("generateVAP", False)), source,
         )
+
+    def add_template(self, template: ConstraintTemplate) -> None:
+        self._templates[template.kind] = self.compile_template(template)
 
     def remove_template(self, template_kind: str) -> None:
         self._templates.pop(template_kind, None)

@@ -50,7 +50,11 @@ class RegoDriver:
     def has_source_for(self, template: ConstraintTemplate) -> bool:
         return template.targets[0].source_for(ENGINE_REGO) is not None
 
-    def add_template(self, template: ConstraintTemplate) -> None:
+    def compile_template(self, template: ConstraintTemplate) \
+            -> _CompiledTemplate:
+        """Pure compile (no install): the artifact ``add_template`` would
+        store.  The generation coordinator uses this to validate a staged
+        template synchronously while deferring the install to the swap."""
         src = template.targets[0].source_for(ENGINE_REGO)
         if src is None:
             raise TemplateCompileError(
@@ -74,9 +78,10 @@ class RegoDriver:
                 f"template {template.name}: no violation rule in package "
                 f"{'.'.join(entry_pkg)}"
             )
-        self._templates[template.kind] = _CompiledTemplate(
-            template.kind, modules, entry_pkg
-        )
+        return _CompiledTemplate(template.kind, modules, entry_pkg)
+
+    def add_template(self, template: ConstraintTemplate) -> None:
+        self._templates[template.kind] = self.compile_template(template)
 
     def remove_template(self, template_kind: str) -> None:
         self._templates.pop(template_kind, None)

@@ -84,13 +84,41 @@ class TpuDriver:
     same compile-or-fallback split the Rego path uses."""
 
     def __init__(self, batch_bucket: int = 256, cel_driver=None,
-                 metrics=None):
+                 metrics=None, generation_swap: bool = False,
+                 compile_cache=None):
+        import threading
+
         self._interp = RegoDriver()
         self._cel = cel_driver  # optional CELDriver
         self._cel_kinds: set = set()  # kinds owned by the CEL engine
         self.vocab = Vocab()
         self._programs: dict[str, CompiledProgram] = {}  # kind -> compiled
         self._lower_errors: dict[str, str] = {}  # kind -> why fallback
+        # on-disk lowering cache (drivers/generation.py CompileCache):
+        # consulted by BOTH the inline path and generation builds, so
+        # --once / gator restarts skip lowering with or without swap mode
+        self._compile_cache = compile_cache
+        # monotone epoch of the compiled plane: bumped on every template
+        # install (inline or swap) — the evaluator's per-generation
+        # schema/executable caches key on it
+        self.plan_epoch = 0
+        self._swap_lock = threading.Lock()
+        # --generation-swap on: template mutations stage + compile on a
+        # background thread and swap atomically; None = inline compile
+        # (today's path, byte-for-byte)
+        self.gen_coord = None
+        # (objects, review_docs, pad_n) of the latest query_batch — the
+        # generation warm's shape reference (generation mode only)
+        self._warm_ref = None
+        # (plan_epoch, union Schema) — the generation-pinned admission
+        # union, merged once per swap (see _query_batch_impl)
+        self._qb_schema = None
+        if generation_swap:
+            from gatekeeper_tpu.drivers.generation import \
+                GenerationCoordinator
+
+            self.gen_coord = GenerationCoordinator(
+                self, cache=compile_cache, metrics=metrics)
         self._data_version = 0
         self._data_kind_versions: dict = {}  # inventory kind -> version
         self._inv_cache: dict = {}  # kind -> (versions, cols, exact)
@@ -143,31 +171,97 @@ class TpuDriver:
         return self._cel is not None and self._cel.has_source_for(template)
 
     def add_template(self, template: ConstraintTemplate) -> None:
+        if self.gen_coord is not None:
+            # generation mode: synchronous validation + staged compile;
+            # the serving executable is untouched until the swap
+            self.gen_coord.submit_add(template)
+            return
         if not self._interp.has_source_for(template) and \
                 self._cel is not None and self._cel.has_source_for(template):
             self._add_cel_template(template)
             return
         self._interp.add_template(template)
-        self._cel_kinds.discard(template.kind)
+        self._cel_kinds = self._cel_kinds - {template.kind}
         compiled = self._interp._templates[template.kind]
-        try:
-            program = lower_template(
+        program, err, _hit = self._lower_or_cached(
+            template.kind, "rego", template,
+            lambda: lower_template(
                 compiled.modules,
                 compiled.package,
                 template.kind,
                 self.vocab,
                 schema_hint=template.parameters_schema,
-            )
-            self._trial_param_table(program, template.kind)
-            self._programs[template.kind] = CompiledProgram(program)
-            self._lower_errors.pop(template.kind, None)
-            self._count_lowering(template.kind, "rego", True)
+            ))
+        self._install_inline(template.kind, program, err, "rego")
+
+    def _lower_or_cached(self, kind: str, engine: str, template,
+                         lower_fn) -> tuple:
+        """(CompiledProgram | None, lower-error | None, from_cache):
+        answer from the on-disk compile cache when the entry's vocab
+        snapshot replays here (zero lowering, zero trial), else lower +
+        trial-build and persist the result (program or error)."""
+        cache = self._compile_cache
+        digest = ""
+        if cache is not None:
+            from gatekeeper_tpu.drivers.generation import template_digest
+
+            digest = template_digest(template)
+            hit = cache.get(digest, engine, self.vocab)
+            if hit is not None:
+                tag, val = hit
+                if tag == "program":
+                    return CompiledProgram(val), None, True
+                return None, val, True
+        try:
+            program = lower_fn()
+            self._trial_param_table(program, kind)
         except LowerError as e:
-            self._programs.pop(template.kind, None)
-            self._lower_errors[template.kind] = str(e)
-            self._count_lowering(template.kind, "rego", False)
-        self._inv_cache.pop(template.kind, None)
-        self._render_specs.pop(template.kind, None)
+            if cache is not None:
+                cache.put(digest, engine, None, str(e), self.vocab)
+            return None, str(e), False
+        if cache is not None:
+            cache.put(digest, engine, program, None, self.vocab)
+        return CompiledProgram(program), None, False
+
+    # --- generation machinery (drivers/generation.py) -------------------
+    def _lower_staged(self, staged) -> tuple:
+        """Lower one staged template for a background generation build
+        (serving state untouched).  Returns (program, err, from_cache)."""
+        kind = staged.template.kind
+        hint = staged.template.parameters_schema
+        if staged.engine == "cel":
+            from gatekeeper_tpu.ir.lower_cel import lower_cel_template
+
+            def lower_fn():
+                return lower_cel_template(staged.artifact, kind,
+                                          self.vocab, schema_hint=hint)
+        else:
+            def lower_fn():
+                return lower_template(staged.artifact.modules,
+                                      staged.artifact.package, kind,
+                                      self.vocab, schema_hint=hint)
+        program, err, from_cache = self._lower_or_cached(
+            kind, staged.engine, staged.template, lower_fn)
+        self._count_lowering(kind, staged.engine, program is not None)
+        return program, err, from_cache
+
+    def _install_generation(self, gen) -> None:
+        """The swap point: every serving structure is REPLACED with a
+        fresh object (single attribute assignments under the swap lock),
+        never mutated in place — in-flight batches that captured the old
+        dicts finish on the generation they started on, and readers see
+        either the old or the new generation, never a mix of one dict."""
+        with self._swap_lock:
+            self._interp._templates = dict(gen.interp_templates)
+            if self._cel is not None:
+                self._cel._templates = dict(gen.cel_templates)
+            self._cel_kinds = set(gen.cel_kinds)
+            self._programs = dict(gen.programs)
+            self._lower_errors = dict(gen.lower_errors)
+            self._inv_cache = {}
+            self._render_specs = {}
+            self._render_idx = {}
+            self.plan_epoch += 1
 
     def _trial_param_table(self, program, kind: str) -> None:
         """Compile-time dry run of build_param_table with a synthetic
@@ -183,32 +277,52 @@ class TpuDriver:
         from gatekeeper_tpu.ir.lower_cel import lower_cel_template
 
         self._cel.add_template(template)
-        self._cel_kinds.add(template.kind)
+        self._cel_kinds = self._cel_kinds | {template.kind}
         compiled = self._cel._templates[template.kind]
-        try:
-            program = lower_cel_template(
+        program, err, _hit = self._lower_or_cached(
+            template.kind, "cel", template,
+            lambda: lower_cel_template(
                 compiled, template.kind, self.vocab,
                 schema_hint=template.parameters_schema,
-            )
-            self._trial_param_table(program, template.kind)
-            self._programs[template.kind] = CompiledProgram(program)
-            self._lower_errors.pop(template.kind, None)
-            self._count_lowering(template.kind, "cel", True)
-        except LowerError as e:
-            self._programs.pop(template.kind, None)
-            self._lower_errors[template.kind] = str(e)
-            self._count_lowering(template.kind, "cel", False)
-        self._inv_cache.pop(template.kind, None)
-        self._render_specs.pop(template.kind, None)
+            ))
+        self._install_inline(template.kind, program, err, "cel")
+
+    def _install_inline(self, kind: str, program, err, engine: str) -> None:
+        """Install one inline compile result copy-on-write: the serving
+        dicts are REPLACED, not mutated, so a batch that captured them
+        mid-flight never sees a half-applied template change (the same
+        contract the generation swap gives, at single-template grain)."""
+        programs = dict(self._programs)
+        errors = dict(self._lower_errors)
+        if program is not None:
+            programs[kind] = program
+            errors.pop(kind, None)
+        else:
+            programs.pop(kind, None)
+            errors[kind] = err
+        self._programs = programs
+        self._lower_errors = errors
+        self._count_lowering(kind, engine, program is not None)
+        self.plan_epoch += 1
+        self._inv_cache.pop(kind, None)
+        self._render_specs.pop(kind, None)
 
     def remove_template(self, template_kind: str) -> None:
+        if self.gen_coord is not None:
+            self.gen_coord.submit_remove(template_kind)
+            return
         if template_kind in self._cel_kinds:
             self._cel.remove_template(template_kind)
-            self._cel_kinds.discard(template_kind)
+            self._cel_kinds = self._cel_kinds - {template_kind}
         else:
             self._interp.remove_template(template_kind)
-        self._programs.pop(template_kind, None)
-        self._lower_errors.pop(template_kind, None)
+        programs = dict(self._programs)
+        programs.pop(template_kind, None)
+        errors = dict(self._lower_errors)
+        errors.pop(template_kind, None)
+        self._programs = programs  # copy-on-write (see _install_inline)
+        self._lower_errors = errors
+        self.plan_epoch += 1
         self._inv_cache.pop(template_kind, None)
         self._render_specs.pop(template_kind, None)
 
@@ -216,6 +330,12 @@ class TpuDriver:
         if constraint.kind in self._cel_kinds:
             self._cel.add_constraint(constraint)
         else:
+            if self.gen_coord is not None and \
+                    constraint.kind not in self._interp._templates and \
+                    self.gen_coord.is_staged(constraint.kind):
+                # template staged but not yet swapped in: the constraint
+                # is accepted now and starts matching at the swap
+                return
             self._interp.add_constraint(constraint)
 
     def remove_constraint(self, constraint: Constraint) -> None:
@@ -247,17 +367,20 @@ class TpuDriver:
         self._data_kind_versions.clear()
 
     # --- referential (data.inventory) join tables ----------------------
-    def inventory_cols(self, kind: str):
+    def inventory_cols(self, kind: str, programs=None):
         """(cols, exact) for a lowered referential template; ({}, True)
         when the program has no inventory joins.  Cached per data version;
         out-of-vocab sids are definite misses so vocab growth alone never
-        invalidates (see InventoryUniqueJoin eval)."""
+        invalidates (see InventoryUniqueJoin eval).  ``programs`` pins a
+        captured generation (a batch mid-swap must read ITS programs,
+        not the freshly-swapped dict)."""
         from gatekeeper_tpu.ir.program import build_inventory_tables
 
         from gatekeeper_tpu.ir import nodes as _N
         from gatekeeper_tpu.ir.program import expr_nodes
 
-        prog = self._programs.get(kind)
+        prog = (programs if programs is not None
+                else self._programs).get(kind)
         if prog is None:
             return {}, True
         inv_kinds = tuple(sorted({
@@ -279,11 +402,11 @@ class TpuDriver:
         self._inv_cache[kind] = (versions, cols, exact)
         return cols, exact
 
-    def inventory_exact(self, kind: str) -> bool:
+    def inventory_exact(self, kind: str, programs=None) -> bool:
         """False when the kind's referential tables can't represent the
         current inventory exactly (non-string join values): callers must
         route the kind through the interpreter for this data version."""
-        return self.inventory_cols(kind)[1]
+        return self.inventory_cols(kind, programs=programs)[1]
 
     # --- external-data join tables (extdata/lane.py) --------------------
     def _active_extdata(self):
@@ -296,14 +419,15 @@ class TpuDriver:
 
         return lane_mod.active()
 
-    def extdata_ready(self, kind: str) -> bool:
+    def extdata_ready(self, kind: str, programs=None) -> bool:
         """True when the kind may ride the device grid w.r.t. external
         data: no external-data joins at all, or an active lane in a
         device-join mode (batched/differential) with extractable key
         columns.  perkey mode (the authoritative reference) and lane-less
         processes route external-data kinds through the interpreter —
         whose ``external_data`` builtin resolves per key."""
-        prog = self._programs.get(kind)
+        prog = (programs if programs is not None
+                else self._programs).get(kind)
         if prog is None:
             return True
         keymap, extractable = extdata_key_cols(prog.program)
@@ -312,7 +436,7 @@ class TpuDriver:
         lane = self._active_extdata()
         return (extractable and lane is not None and lane.device_join())
 
-    def extdata_cols(self, kind: str, batch) -> tuple:
+    def extdata_cols(self, kind: str, batch, programs=None) -> tuple:
         """(cols, ready) — vocab-padded ``ext:`` join tables covering
         every key THIS batch's subject columns reference: per provider,
         the key strings dedupe across the whole batch off the flattened
@@ -321,7 +445,8 @@ class TpuDriver:
         resident column serves the arrays.  Value strings intern here —
         callers must build vocab-derived tables (pred matrices) AFTER
         this call."""
-        prog = self._programs.get(kind)
+        prog = (programs if programs is not None
+                else self._programs).get(kind)
         if prog is None:
             return {}, True
         keymap, extractable = extdata_key_cols(prog.program)
@@ -332,7 +457,7 @@ class TpuDriver:
             return {}, False
         import numpy as _np
 
-        cols: dict = {}
+        requests: dict = {}
         for provider in sorted(keymap):
             sids: set = set()
             for spec in keymap[provider]:
@@ -344,7 +469,14 @@ class TpuDriver:
                 s = col.sid[col.kind == K_STR]
                 if s.size:
                     sids.update(int(x) for x in _np.unique(s) if x >= 0)
-            keys = sorted(self.vocab.string(s) for s in sids)
+            requests[provider] = sorted(self.vocab.string(s) for s in sids)
+        if len(requests) > 1:
+            # per-provider concurrency: land every provider's misses in
+            # one fan-out, then build tables from the warm columns (the
+            # table build interns value strings and stays on this thread)
+            lane.ensure_many(requests)
+        cols: dict = {}
+        for provider, keys in requests.items():
             cols.update(lane.tables_for(provider, keys, self.vocab))
         return cols, True
 
@@ -565,9 +697,16 @@ class TpuDriver:
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
 
+        # capture the generation ONCE: a swap replaces these objects (it
+        # never mutates them), so this batch finishes on the generation
+        # it started on even when templates churn mid-flight
+        programs = self._programs
+        cel_kinds = self._cel_kinds
+
         lowered_kinds = [k for k in by_kind
-                         if k in self._programs and self.inventory_exact(k)
-                         and self.extdata_ready(k)]
+                         if k in programs
+                         and self.inventory_exact(k, programs=programs)
+                         and self.extdata_ready(k, programs=programs)]
         fallback_kinds = [k for k in by_kind if k not in lowered_kinds]
 
         t0 = time.perf_counter_ns()
@@ -578,13 +717,32 @@ class TpuDriver:
         cel_delete_idx = [
             oi for oi, r in enumerate(reviews)
             if r.request.operation == "DELETE"
-        ] if self._cel_kinds else []
+        ] if cel_kinds else []
         verdicts: dict[str, np.ndarray] = {}
         # flatten once with the union schema (identity columns always needed
         # for match masks, even when every kind falls back)
-        schema = Schema()
-        for kind in lowered_kinds:
-            schema.merge(self._programs[kind].program.schema)
+        if self.gen_coord is not None:
+            # generation mode: the union is pinned to the GENERATION's
+            # full program set (sorted — the same merge the pre-swap
+            # warm performs), not to which kinds happen to have active
+            # constraints this batch.  Constraint churn therefore never
+            # reshapes the flatten (a removed constraint would otherwise
+            # shrink the union and retrace every remaining kernel on the
+            # serving thread); the union only moves at a swap, whose
+            # shapes the background warm already traced.  Cached per
+            # generation epoch — one merge per swap, not per batch.
+            cached = self._qb_schema
+            if cached is not None and cached[0] == self.plan_epoch:
+                schema = cached[1]
+            else:
+                schema = Schema()
+                for kind in sorted(programs):
+                    schema.merge(programs[kind].program.schema)
+                self._qb_schema = (self.plan_epoch, schema)
+        else:
+            schema = Schema()
+            for kind in lowered_kinds:
+                schema.merge(programs[kind].program.schema)
         # power-of-two padding above the base bucket caps the number of
         # distinct jit shapes at log2(max N): first-compile cost is bounded
         pad_n = self.batch_bucket
@@ -609,17 +767,25 @@ class TpuDriver:
         ]
         batch = flattener.flatten(objects, pad_n=pad_n, reviews=review_docs)
         flatten_ns = time.perf_counter_ns() - tf
+        if self.gen_coord is not None:
+            # retain the latest real batch (references, not copies): the
+            # pre-swap warm replays it through the next generation so
+            # the warm traces land at the EXACT serving shapes (ragged
+            # widths are data-dependent; a synthetic object can't
+            # reproduce them)
+            self._warm_ref = (objects, review_docs, pad_n)
         eval_ns = 0
         te = time.perf_counter_ns()
         batch_memo: dict = {}  # this batch's uploads, shared across kinds
         for kind in lowered_kinds:
-            prog = self._programs[kind]
+            prog = programs[kind]
             cons = by_kind[kind]
             table = build_param_table(prog.program, cons, self.vocab)
             # extdata tables BEFORE run: the build interns value strings
             # the vocab tables inside run must cover
-            ext_cols, _ext_ok = self.extdata_cols(kind, batch)
-            extra = self.inventory_cols(kind)[0]
+            ext_cols, _ext_ok = self.extdata_cols(kind, batch,
+                                                  programs=programs)
+            extra = self.inventory_cols(kind, programs=programs)[0]
             if ext_cols:
                 extra = {**extra, **ext_cols}
             grid = prog.run(batch, table, vocab=self.vocab,
@@ -642,7 +808,7 @@ class TpuDriver:
                 if lane is not None and lane.mode == "differential":
                     self.extdata_differential(target, kind, cons, reviews,
                                               grid, mask, cfg)
-            if kind in self._cel_kinds and cel_delete_idx:
+            if kind in cel_kinds and cel_delete_idx:
                 for ci, con in enumerate(cons):
                     for oi in cel_delete_idx:
                         if mask[ci, oi]:
@@ -678,7 +844,7 @@ class TpuDriver:
         # fallback kinds: exact engine on match-filtered pairs
         for kind in fallback_kinds:
             cons = by_kind[kind]
-            engine = (self._cel.query if kind in self._cel_kinds
+            engine = (self._cel.query if kind in cel_kinds
                       else self._interp.query)
             mask = masks_mod.constraint_masks(
                 cons, batch, self.vocab, objects, namespaces, sources

@@ -71,7 +71,8 @@ class ExtDataLane:
                  column_ttl_s: Optional[float] = None,
                  max_keys_per_call: int = 256,
                  metrics=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 fanout: int = 4):
         if mode not in MODES:
             raise ValueError(f"extdata lane mode must be one of {MODES}, "
                              f"got {mode!r}")
@@ -82,6 +83,11 @@ class ExtDataLane:
         self.max_keys_per_call = max(1, int(max_keys_per_call))
         self.metrics = metrics
         self._clock = clock
+        # per-provider bulk-fetch concurrency (ensure_many): a chunk
+        # referencing N providers lands their miss lists across a small
+        # thread pool instead of serially; 1 = serial (bit-identical)
+        self.fanout = max(1, int(fanout))
+        self._pool = None  # lazy ThreadPoolExecutor, daemon threads
         self._columns: dict[str, ProviderColumn] = {}
         # provider -> (column version, covered vocab len, tables dict):
         # reusable while the column is unchanged and every requested key
@@ -172,6 +178,38 @@ class ExtDataLane:
                                    {"provider": provider})
         return len(missing)
 
+    def ensure_many(self, requests: dict) -> int:
+        """Land several providers' key sets concurrently: one
+        :meth:`ensure` per provider, fanned across a small thread pool
+        (``fanout``).  Per-key failure semantics are exactly the serial
+        path's — each worker runs the unchanged ``ensure`` (bulk
+        ``ProviderCache.fetch`` with per-key retry/breaker/stale
+        degradation), they just overlap in wall time.  Returns total
+        keys fetched (0 = every column warm)."""
+        items = [(p, ks) for p, ks in sorted(requests.items()) if ks]
+        if not items:
+            return 0
+        if len(items) == 1 or self.fanout <= 1:
+            return sum(self.ensure(p, ks) for p, ks in items)
+        # only cold providers pay a worker; warm ones answer inline
+        cold = [(p, ks) for p, ks in items
+                if self.column(p).missing(ks)]
+        total = sum(self.ensure(p, ks) for p, ks in items
+                    if (p, ks) not in cold)
+        if not cold:
+            return total
+        if len(cold) == 1:
+            return total + self.ensure(*cold[0])
+        pool = self._pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._pool = ThreadPoolExecutor(
+                max_workers=self.fanout,
+                thread_name_prefix="extdata-fanout")
+        futures = [pool.submit(self.ensure, p, ks) for p, ks in cold]
+        return total + sum(f.result() for f in futures)
+
     # --- resolution ------------------------------------------------------
     def _resolve_perkey(self, provider: str, keys) -> dict:
         """The authoritative per-key reference: one ProviderCache.fetch
@@ -227,6 +265,11 @@ class ExtDataLane:
         by_provider: dict = {}
         for ph in placeholders:
             by_provider.setdefault(ph.provider, []).append(ph.original_value)
+        if self.mode != "perkey" and len(by_provider) > 1:
+            # multi-provider burst: land every provider's misses in one
+            # fan-out, then resolve from the warm columns (the perkey
+            # reference keeps its strictly serial per-key transport)
+            self.ensure_many(by_provider)
         out: dict = {}
         for provider, keys in sorted(by_provider.items()):
             resolved = self.resolve_keys(provider, keys)

@@ -56,20 +56,26 @@ class BenchResult:
                 for k, v in self.__dict__.items() if v is not None}
 
 
-def _drivers_for(engine: str):
+def _drivers_for(engine: str, compile_cache: str = ""):
     if engine == "rego":
         return [RegoDriver()]
     if engine == "cel":
         return [CELDriver()]
     if engine in ("tpu", "sweep"):
-        return [TpuDriver(cel_driver=CELDriver())]
+        cache = None
+        if compile_cache:
+            from gatekeeper_tpu.drivers.generation import CompileCache
+
+            cache = CompileCache(compile_cache)
+        return [TpuDriver(cel_driver=CELDriver(), compile_cache=cache)]
     return [RegoDriver(), CELDriver()]  # all
 
 
 def run_bench(objs, engine: str, iterations: int,
               pipeline: str = "auto",
               flatten_lane: str = "auto",
-              collect: str = "reduced") -> BenchResult:
+              collect: str = "reduced",
+              compile_cache: str = "") -> BenchResult:
     templates = [o for o in objs if reader.is_template(o)]
     constraints = [o for o in objs if reader.is_constraint(o)]
     data = [o for o in objs
@@ -81,7 +87,7 @@ def run_bench(objs, engine: str, iterations: int,
 
     t0 = time.perf_counter()
     client = Client(target=K8sValidationTarget(),
-                    drivers=_drivers_for(engine),
+                    drivers=_drivers_for(engine, compile_cache),
                     enforcement_points=[GATOR_EP])
     r.setup_client_s = time.perf_counter() - t0
 
@@ -402,6 +408,11 @@ def run_cli(argv: list[str]) -> int:
     p.add_argument("--trace", default="",
                    help="export a Chrome trace-event JSON of the bench "
                         "run's spans to this path (Perfetto-loadable)")
+    p.add_argument("--compile-cache", default="",
+                   help="on-disk compile cache directory (see python -m "
+                        "gatekeeper_tpu --compile-cache): a warm cache "
+                        "makes repeat device-engine bench runs skip "
+                        "template lowering entirely")
     p.add_argument("--attribution", action="store_true",
                    help="per-template cost attribution table after the "
                         "run: each engine's shared passes apportioned "
@@ -448,10 +459,12 @@ def run_cli(argv: list[str]) -> int:
         for engine in engines:
             seen = len(tracer.traces())
             try:
-                results.append(run_bench(objs, engine, args.iterations,
-                                         pipeline=args.pipeline,
-                                         flatten_lane=args.flatten_lane,
-                                         collect=args.collect))
+                results.append(run_bench(
+                    objs, engine, args.iterations,
+                    pipeline=args.pipeline,
+                    flatten_lane=args.flatten_lane,
+                    collect=args.collect,
+                    compile_cache=args.compile_cache))
             except Exception as e:
                 print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
                 return 1

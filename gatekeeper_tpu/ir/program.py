@@ -1300,11 +1300,19 @@ def eval_expr(ctx: _Ctx, e: N.Expr):
 # --------------------------------------------------------------------------
 
 
+_PROG_UID = __import__("itertools").count(1)
+
+
 class CompiledProgram:
     """One template's verdict kernel: (batch arrays, param table) -> [C, N]."""
 
     def __init__(self, program: N.Program):
         self.program = program
+        # process-monotone identity: fused sweep executables are cached
+        # per program SET (parallel/sharded.py), so a template edit that
+        # replaces a kind's program must miss the old executable — dict
+        # keys carry uids, never id() (GC reuse) or kind names (stale)
+        self.uid = next(_PROG_UID)
         self._fn = jax.jit(self._build())  # retraces per shape bucket
 
     def _build(self):

@@ -13,10 +13,16 @@ summary computed quantiles over a ``deque(maxlen=4096)`` window while
 outlived the window).  Buckets are lifetime-cumulative like the sums, so
 ``_bucket``/``_sum``/``_count`` always describe the same population;
 the old ``name{quantile="..."}`` series stay as a compat shim estimated
-from the buckets.  Each bucket carries an optional **exemplar** (the
-trace id of the most recent observation that landed in it) so a slow
-P99 bucket links straight to a ``/debug/traces`` span; exemplars render
-in the OpenMetrics format (negotiated by Accept on ``/metrics``).
+from the buckets.  Each bucket carries **exemplars** (trace ids of
+observations that landed in it) so a slow P99 bucket links straight to a
+``/debug/traces`` span; exemplars render in the OpenMetrics format
+(negotiated by Accept on ``/metrics``).  Exemplar retention is a
+per-bucket **reservoir sample** (size ``EXEMPLAR_RESERVOIR``, seeded
+RNG): each traced observation enters the reservoir with probability
+``K/seen``, so a burst of boring observations cannot evict the whole
+history the way last-write-wins did — the retained set stays a uniform
+sample over the bucket's lifetime, and the RENDERED exemplar pins the
+bucket's max-value observation (the most latency-interesting trace).
 
 Label sets are **bounded per metric name** (``max_label_sets``): at
 production churn an unbounded ``{template}``/``{tenant}`` label set is a
@@ -48,6 +54,10 @@ COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
 OPENMETRICS_CONTENT_TYPE = \
     "application/openmetrics-text; version=1.0.0; charset=utf-8"
 TEXT_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+# per-bucket exemplar reservoir size (uniform sample over the bucket's
+# traced observations; see the module docstring)
+EXEMPLAR_RESERVOIR = 4
 
 
 def _labels_key(labels: dict) -> tuple:
@@ -84,6 +94,10 @@ class MetricsRegistry:
         self._series_labels: dict = {}
         self._bucket_overrides: dict = {}
         self._lock = threading.Lock()
+        # seeded: reservoir eviction replays identically run-to-run
+        import random
+
+        self._ex_rng = random.Random(0)
 
     # --- cardinality guard ---------------------------------------------
     def _bounded_labels(self, name: str, labels: Optional[dict]) -> tuple:
@@ -149,9 +163,13 @@ class MetricsRegistry:
                     # per-bucket (NOT cumulative) counts; index len(bounds)
                     # is the +Inf bucket.  Cumulation happens at render.
                     "buckets": [0] * (len(bounds) + 1),
-                    # exemplar per bucket: (trace_id, value, unix_ts) of
-                    # the most recent traced observation that landed there
+                    # rendered exemplar per bucket: (trace_id, value,
+                    # unix_ts) — the reservoir's max-value entry
                     "exemplars": [None] * (len(bounds) + 1),
+                    # reservoir state per bucket: retained entries +
+                    # traced-observation count (the sampling denominator)
+                    "ex_res": [[] for _ in range(len(bounds) + 1)],
+                    "ex_seen": [0] * (len(bounds) + 1),
                 }
             h["count"] += 1
             h["sum"] += value
@@ -162,7 +180,25 @@ class MetricsRegistry:
             i = bisect.bisect_left(h["bounds"], value)
             h["buckets"][i] += 1
             if tid:
-                h["exemplars"][i] = (tid, float(value), time.time())
+                # reservoir sampling: entry j of n survives with
+                # probability K/n — a burst can no longer evict the
+                # bucket's whole exemplar history (last-write-wins did)
+                entry = (tid, float(value), time.time())
+                h["ex_seen"][i] += 1
+                res = h["ex_res"][i]
+                if len(res) < EXEMPLAR_RESERVOIR:
+                    res.append(entry)
+                else:
+                    j = self._ex_rng.randrange(h["ex_seen"][i])
+                    if j < EXEMPLAR_RESERVOIR:
+                        res[j] = entry
+                # the RENDERED exemplar pins the bucket's max-value
+                # observation (the most latency-interesting trace,
+                # deterministic: first writer wins ties) — a burst of
+                # faster observations can never displace it
+                cur = h["exemplars"][i]
+                if cur is None or entry[1] > cur[1]:
+                    h["exemplars"][i] = entry
 
     def timed(self, name: str, labels: Optional[dict] = None):
         registry = self
@@ -244,7 +280,9 @@ class MetricsRegistry:
                       labels: Optional[dict] = None) -> Optional[dict]:
         """Histogram state snapshot for one series (test/introspection):
         {count, sum, min, max, bounds, buckets (non-cumulative),
-        exemplars}; None when the series does not exist."""
+        exemplars (rendered, one per bucket), exemplar_reservoir (the
+        per-bucket retained sample)}; None when the series does not
+        exist."""
         with self._lock:
             h = self._hist.get((name, _labels_key(labels)))
             if h is None:
@@ -252,6 +290,9 @@ class MetricsRegistry:
             out = dict(h)
             out["buckets"] = list(h["buckets"])
             out["exemplars"] = list(h["exemplars"])
+            out["exemplar_reservoir"] = [list(r) for r in h["ex_res"]]
+            out.pop("ex_res", None)
+            out.pop("ex_seen", None)
             return out
 
 
@@ -436,3 +477,12 @@ SLO_BREACHES = "slo_breach_count"  # {objective}
 # admission flight recorder (observability/flightrec.py): decisions
 # captured into the bounded ring (served at /debug/decisions)
 FLIGHTREC_DECISIONS = "flightrec_decisions_recorded_count"  # {decision}
+# generations (drivers/generation.py, --generation-swap on): the serving
+# generation id, wall seconds of the last background build, completed
+# swaps, and the on-disk compile cache's outcomes — a warm restart shows
+# hit_count == template count and zero fresh lowering
+GENERATION_ID = "generation_id"  # gauge
+GENERATION_COMPILE_SECONDS = "generation_compile_seconds"  # gauge
+GENERATION_SWAP_COUNT = "generation_swap_count"
+GENERATION_CACHE_HIT = "generation_cache_hit_count"
+GENERATION_CACHE_MISS = "generation_cache_miss_count"  # {reason}

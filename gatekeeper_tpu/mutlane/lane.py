@@ -147,30 +147,63 @@ class MutationLane:
     """Batched front of a :class:`MutationSystem` (which stays the
     authoritative reference).  Thread-safe for concurrent
     ``mutate_objects`` calls; the compile cache re-keys on the system
-    revision so mutator churn invalidates the batched program."""
+    revision so mutator churn invalidates the batched program.
 
-    def __init__(self, system, metrics=None, differential: bool = False):
+    With a ``coordinator`` (the driver's
+    :class:`~gatekeeper_tpu.drivers.generation.GenerationCoordinator`),
+    the revision-keyed mutator programs join the generation machinery:
+    a mutator reconcile no longer recompiles on the serving burst —
+    bursts keep the previous revision's compiled programs until the
+    background thread installs the new ones (the first-ever compile is
+    still inline: there is no stale program to serve)."""
+
+    def __init__(self, system, metrics=None, differential: bool = False,
+                 coordinator=None):
         self.system = system
         self.metrics = metrics
         self.differential = differential
         self._compiled: Optional[_Compiled] = None
         self._lock = threading.Lock()
+        self._coordinator = coordinator
+        if coordinator is not None:
+            coordinator.register_aux(
+                "mutlane", self.system.revision,
+                self._compile_now, self._install_compiled)
 
     # --- compile cache ----------------------------------------------------
+    def _compile_now(self) -> _Compiled:
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("mutlane.compile",
+                          revision=self.system.revision()) as sp:
+            c = _Compiled(self.system)
+            sp.set_attribute("lowered", len(c.lowered))
+            sp.set_attribute("host_only", len(c.host_only))
+        return c
+
+    def _install_compiled(self, c: _Compiled) -> None:
+        with self._lock:
+            self._compiled = c
+
     def compiled(self) -> _Compiled:
         rev = self.system.revision()
         with self._lock:
             c = self._compiled
             if c is not None and c.revision == rev:
                 return c
-        from gatekeeper_tpu.observability import tracing
-
-        with tracing.span("mutlane.compile", revision=rev) as sp:
-            c = _Compiled(self.system)
-            sp.set_attribute("lowered", len(c.lowered))
-            sp.set_attribute("host_only", len(c.host_only))
-        with self._lock:
-            self._compiled = c
+        coord = self._coordinator
+        if c is not None and coord is not None and coord.running \
+                and not self.differential:
+            # (differential mode always compiles inline: its per-object
+            # reference runs against the LIVE registry, and asserting an
+            # old generation against it would be a false divergence)
+            # serve the previous revision's programs until the background
+            # build swaps the new ones in (zero-stall mutator churn; the
+            # host walk stays the bit-identity authority either way)
+            coord.note_aux_dirty("mutlane")
+            return c
+        c = self._compile_now()
+        self._install_compiled(c)
         return c
 
     # --- the batched pass -------------------------------------------------

@@ -35,6 +35,13 @@ import numpy as np
 K_ABSENT, K_FALSE, K_TRUE, K_NUM, K_STR, K_OTHER, K_NULL = 0, 1, 2, 3, 4, 5, 6
 K_MAP = 7
 
+# Version of the object->column derivation (schema shapes, kind tags, pad
+# rules).  Part of the on-disk compile-cache key (drivers/generation.py):
+# bump it whenever flattening changes in a way that alters what a lowered
+# program reads, so stale cached lowerings can never be served against
+# incompatible columns.
+FLATTEN_SCHEMA_VERSION = 1
+
 
 class Vocab:
     """Host-side string interner.  id 0 is reserved for ""; -1 means absent."""
@@ -42,8 +49,21 @@ class Vocab:
     def __init__(self):
         self._to_id: dict[str, int] = {"": 0}
         self._to_str: list[str] = [""]
+        # optional mutual exclusion for the Python intern path: the
+        # generation coordinator (drivers/generation.py) compiles on a
+        # background thread against the live vocab, so its interns must
+        # not interleave with a serving thread's.  None (the default)
+        # keeps the hot flatten loops branch-cheap and bit-identical.
+        self._lock = None
 
     def intern(self, s: str) -> int:
+        lk = self._lock
+        if lk is not None:
+            with lk:
+                return self._intern(s)
+        return self._intern(s)
+
+    def _intern(self, s: str) -> int:
         i = self._to_id.get(s)
         if i is None:
             i = len(self._to_str)

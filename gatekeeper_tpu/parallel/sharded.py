@@ -596,10 +596,12 @@ class _FlatChunk:
     and the dispatch stage (masks + wire pack + device_put + jit call)."""
 
     __slots__ = ("by_kind", "kinds", "cols", "batch", "objects", "any_gen",
-                 "n", "pad_n", "return_bits", "source", "budget")
+                 "n", "pad_n", "return_bits", "source", "budget",
+                 "programs")
 
     def __init__(self, by_kind, kinds, cols, batch, objects, any_gen, n,
-                 pad_n, return_bits, source="", budget=None):
+                 pad_n, return_bits, source="", budget=None,
+                 programs=None):
         self.by_kind = by_kind
         self.kinds = kinds
         self.cols = cols
@@ -619,6 +621,11 @@ class _FlatChunk:
         # so the device selection is a superset of what the fold keeps);
         # None = full render cap for every constraint
         self.budget = budget
+        # the generation this chunk was flattened under ({kind ->
+        # CompiledProgram}, captured once at flatten): dispatch MUST use
+        # these programs — a generation swap between flatten and dispatch
+        # would otherwise evaluate old columns with new kernels
+        self.programs = programs
 
 
 class ShardedEvaluator:
@@ -655,6 +662,9 @@ class ShardedEvaluator:
             raise ValueError(f"unknown collect lane {collect!r}")
         self.collect = collect
         self._sweep_fns: dict = {}
+        # per-generation merged-schema cache: (plan epoch, lowered set)
+        # -> union Schema (see sweep_schema)
+        self._schema_cache: dict = {}
         # reduced lane adaptive state per (kinds, pad_n): hit-buffer size
         # for complete-hits chunks, masks-lane pinning, low-water streak
         self._hit_state: dict = {}
@@ -688,7 +698,8 @@ class ShardedEvaluator:
                          width_targets=self._width_targets or None,
                          lane=self.flatten_lane)
 
-    def _needs_union(self, kinds, alias: Optional[dict] = None) -> dict:
+    def _needs_union(self, kinds, alias: Optional[dict] = None,
+                     programs=None) -> dict:
         """Union of array fields any lowered program reads — the
         transfer-slimming key shared by warm_pass (col stats) and
         sweep_submit (packing); one definition so the stats keys always
@@ -696,10 +707,12 @@ class ShardedEvaluator:
         the Flattener's prefix-axis dedup) extends each aliased key's
         needs onto its exec column so slimming keeps exactly the fields
         some consumer reads through either name."""
+        progs = programs if programs is not None \
+            else self.driver._programs
         needs: dict = {}
         for kind in sorted(kinds):
             for ck, fields in needed_fields(
-                    self.driver._programs[kind].program).items():
+                    progs[kind].program).items():
                 needs.setdefault(ck, set()).update(fields)
         if alias:
             for orig, new in alias.items():
@@ -711,7 +724,8 @@ class ShardedEvaluator:
         return needs
 
     def _sweep_fn(self, kinds: tuple, k: int, return_bits: bool,
-                  cols_layout: tuple, tables_layout: tuple, pad_n: int):
+                  cols_layout: tuple, tables_layout: tuple, pad_n: int,
+                  progs=None):
         """One fused jitted program for the whole sweep: every template's
         verdict grid + mask + top-k + totals, returning ONE packed int32
         array [C_total, 2k+1] = [idx(k) | valid(k) | count].
@@ -721,12 +735,19 @@ class ShardedEvaluator:
         tables arrive byte-packed (unpacked here under jit, where the
         slices/bitcasts fuse to nothing), and the chunk result leaves in
         one packed transfer.
+
+        Executables cache per program SET (the uid tuple): a generation
+        swap that replaces one kind's program misses cleanly, while
+        groups whose programs carried over keep their compiled fns.
         """
-        key = (kinds, k, return_bits, cols_layout, tables_layout, pad_n)
+        progs = progs if progs is not None else self.driver._programs
+        uids = tuple(progs[kind].uid for kind in kinds)
+        key = (kinds, uids, k, return_bits, cols_layout, tables_layout,
+               pad_n)
         fn = self._sweep_fns.get(key)
         if fn is not None:
             return fn
-        builders = [self.driver._programs[kind]._build() for kind in kinds]
+        builders = [progs[kind]._build() for kind in kinds]
 
         # epilogue: the Pallas fused first-k/count kernel measures 2.1x
         # the XLA top_k twin on-chip (PALLAS_BENCH.json) but a pallas
@@ -772,7 +793,7 @@ class ShardedEvaluator:
 
     def _sweep_fn_reduced(self, kinds: tuple, k: int, complete: bool,
                           hit_cap: int, cols_layout: tuple,
-                          tables_layout: tuple, pad_n: int):
+                          tables_layout: tuple, pad_n: int, progs=None):
         """The device-side verdict REDUCTION twin of :meth:`_sweep_fn`:
         the fused grid never leaves the chip — per-constraint violation
         totals (segmented sum over the masked grid), the kept selection
@@ -789,12 +810,14 @@ class ShardedEvaluator:
         selected count; a value above ``hit_cap`` means the buffer
         truncated and the collect side must fall back to the masks lane
         for this chunk."""
-        key = ("reduced", kinds, k, complete, hit_cap, cols_layout,
+        progs = progs if progs is not None else self.driver._programs
+        uids = tuple(progs[kind].uid for kind in kinds)
+        key = ("reduced", kinds, uids, k, complete, hit_cap, cols_layout,
                tables_layout, pad_n)
         fn = self._sweep_fns.get(key)
         if fn is not None:
             return fn
-        builders = [self.driver._programs[kind]._build() for kind in kinds]
+        builders = [progs[kind]._build() for kind in kinds]
 
         if self.mesh.size == 1 and not complete:
             from gatekeeper_tpu.ops.pallas_topk import (
@@ -1041,23 +1064,37 @@ class ShardedEvaluator:
             self.sweep_flatten(constraints, objects, return_bits,
                                budget=budget))
 
-    def sweep_schema(self, constraints: Sequence) -> tuple:
+    def sweep_schema(self, constraints: Sequence, programs=None) -> tuple:
         """(by_kind, lowered_kinds, merged_schema) — the columnize plan
         :meth:`sweep_flatten` runs; exposed so the resident-snapshot
         store (gatekeeper_tpu/snapshot/) flattens patches with EXACTLY
         the schema a fresh sweep of the same constraint group would use
         (the bit-identity precondition of the resync differential).
-        ``lowered_kinds`` is empty when nothing is device-eligible."""
+        ``lowered_kinds`` is empty when nothing is device-eligible.
+
+        The merged union schema is cached per (generation epoch, lowered
+        set): 46-template groups re-merge ~150 column specs per chunk
+        otherwise, and the epoch key makes a generation swap a clean
+        miss while chunks of one generation share one schema object."""
+        progs = programs if programs is not None \
+            else self.driver._programs
         by_kind: dict[str, list] = {}
         for con in constraints:
             by_kind.setdefault(con.kind, []).append(con)
         lowered = [k for k in by_kind
-                   if k in self.driver._programs
-                   and self.driver.inventory_exact(k)
-                   and self.driver.extdata_ready(k)]
-        schema = Schema()
-        for kind in lowered:
-            schema.merge(self.driver._programs[kind].program.schema)
+                   if k in progs
+                   and self.driver.inventory_exact(k, programs=progs)
+                   and self.driver.extdata_ready(k, programs=progs)]
+        key = (getattr(self.driver, "plan_epoch", 0),
+               tuple(sorted(lowered)))
+        schema = self._schema_cache.get(key)
+        if schema is None:
+            schema = Schema()
+            for kind in lowered:
+                schema.merge(progs[kind].program.schema)
+            if len(self._schema_cache) > 64:
+                self._schema_cache.clear()
+            self._schema_cache[key] = schema
         return by_kind, lowered, schema
 
     def sweep_flatten_from_batch(self, constraints: Sequence, batch,
@@ -1072,11 +1109,14 @@ class ShardedEvaluator:
         producing Flattener's prefix-axis alias map (slimming must keep
         fields read through either name).  Returns the same
         :class:`_FlatChunk` the columnizing lane produces."""
-        by_kind, lowered, _schema = self.sweep_schema(constraints)
+        programs = self.driver._programs  # capture the generation once
+        by_kind, lowered, _schema = self.sweep_schema(constraints,
+                                                      programs=programs)
         if not lowered:
             return {}
         cols = slim_cols(pack_batch_cols(batch),
-                         self._needs_union(lowered, alias or {}))
+                         self._needs_union(lowered, alias or {},
+                                           programs=programs))
         n = len(objects)
         if batch.has_generate_name is not None:
             any_gen = bool(batch.has_generate_name[:n].any())
@@ -1086,7 +1126,7 @@ class ShardedEvaluator:
                 for o in objects)
         return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
                           objects, any_gen, n, batch.n, return_bits,
-                          source=source, budget=budget)
+                          source=source, budget=budget, programs=programs)
 
     def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
                       return_bits: bool = False, source: str = "",
@@ -1095,7 +1135,9 @@ class ShardedEvaluator:
         union + flatten + column pack/slim.  Returns a :class:`_FlatChunk`
         for :meth:`sweep_dispatch`, or {} when no kind is lowered (the
         caller's fallback lane handles everything)."""
-        by_kind, lowered, schema = self.sweep_schema(constraints)
+        programs = self.driver._programs  # capture the generation once
+        by_kind, lowered, schema = self.sweep_schema(constraints,
+                                                     programs=programs)
         if not lowered:
             return {}
         n = len(objects)
@@ -1133,7 +1175,8 @@ class ShardedEvaluator:
 
         cols = pack_batch_cols(batch)
         # transfer slimming: ship only the array fields some program reads
-        cols = slim_cols(cols, self._needs_union(lowered, fl.alias))
+        cols = slim_cols(cols, self._needs_union(lowered, fl.alias,
+                                                 programs=programs))
 
         if batch.has_generate_name is not None:
             # native JSON lane: presence came back as a column — avoids
@@ -1145,7 +1188,7 @@ class ShardedEvaluator:
                 for o in objects)
         return _FlatChunk(by_kind, tuple(sorted(lowered)), cols, batch,
                           objects, any_gen, n, pad_n, return_bits,
-                          source=source, budget=budget)
+                          source=source, budget=budget, programs=programs)
 
     def sweep_dispatch(self, flat):
         """Pipeline stage 2 (host->device): match masks + param tables +
@@ -1211,6 +1254,11 @@ class ShardedEvaluator:
         cols = flat.cols
         any_gen = flat.any_gen
         n, pad_n, return_bits = flat.n, flat.pad_n, flat.return_bits
+        # the generation this chunk flattened under (its columns match
+        # THESE programs' schemas; a swap between flatten and dispatch
+        # must not retarget the chunk)
+        progs = flat.programs if flat.programs is not None \
+            else self.driver._programs
         k = self.violations_limit
         tables = []
         mask_rows = []
@@ -1218,7 +1266,7 @@ class ShardedEvaluator:
         c_off = 0
         t0 = time.perf_counter()
         for kind in kinds:
-            prog = self.driver._programs[kind]
+            prog = progs[kind]
             cons = by_kind[kind]
             # param tables FIRST: they register StrPred needle rows that the
             # vocab tables below must include
@@ -1264,16 +1312,18 @@ class ShardedEvaluator:
         # strings — the vocab tables built below must cover those sids
         t0 = time.perf_counter()
         for kind in kinds:
-            ext_cols, _ok = self.driver.extdata_cols(kind, batch)
+            ext_cols, _ok = self.driver.extdata_cols(kind, batch,
+                                                     programs=progs)
             table_cols.update(ext_cols)
         if table_cols:
             self._perf_add("extdata", time.perf_counter() - t0)
         for kind in kinds:
             for tk, tv in vocab_tables(
-                self.driver._programs[kind].program, self.driver.vocab
+                progs[kind].program, self.driver.vocab
             ).items():
                 table_cols[tk] = tv
-            for tk, tv in self.driver.inventory_cols(kind)[0].items():
+            for tk, tv in self.driver.inventory_cols(
+                    kind, programs=progs)[0].items():
                 table_cols[tk] = tv
         # ONE transfer per input: packed batch columns (data-sharded),
         # packed param tables (replicated, device-cached on content — the
@@ -1345,7 +1395,7 @@ class ShardedEvaluator:
                 budget_np, NamedSharding(self.mesh, P(None)))
             result = self._sweep_fn_reduced(
                 kinds, k, complete, hit_cap, cols_layout, tables_layout,
-                pad_n)(
+                pad_n, progs=progs)(
                 tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev,
                 budget_dev
             )
@@ -1358,7 +1408,7 @@ class ShardedEvaluator:
             pending.budget_np = None if complete else budget_np
             return pending
         result = self._sweep_fn(kinds, k, return_bits, cols_layout,
-                                tables_layout, pad_n)(
+                                tables_layout, pad_n, progs=progs)(
             tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev
         )
         self._perf_add("dispatch", time.perf_counter() - t0)

@@ -259,6 +259,10 @@ class OverloadController:
         # priority-lane DRR queue; None keeps the PR 5 single FIFO
         self._queue_qos = None
         self._tenant_inflight: dict = {}
+        # per-priority-lane inflight (QoS mode): the demand-aware
+        # assuredConcurrencyShares input — a lane at its assured
+        # concurrency yields freed slots to lower lanes with demand
+        self._lane_inflight: dict = {}
         self._exported_tenants: set = set()
         self._seq = 0
         self._tenant_cost_input = None
@@ -339,6 +343,11 @@ class OverloadController:
                         self._tenant_inflight.pop(tenant, None)
                     else:
                         self._tenant_inflight[tenant] = n
+                    ln = self._lane_inflight.get(priority.name, 0) - 1
+                    if ln <= 0:
+                        self._lane_inflight.pop(priority.name, None)
+                    else:
+                        self._lane_inflight[priority.name] = ln
                     self._dispatch_locked()
                     self._pressure_locked()
                     self._cv.notify_all()
@@ -449,6 +458,8 @@ class OverloadController:
         t.granted = True
         self._tenant_inflight[t.tenant] = \
             self._tenant_inflight.get(t.tenant, 0) + 1
+        self._lane_inflight[t.level.name] = \
+            self._lane_inflight.get(t.level.name, 0) + 1
         if self._ledger_qos is not None:
             self._ledger_qos.charge(t.tenant, t.cost)
         self.trajectory.append(
@@ -464,7 +475,9 @@ class OverloadController:
             if not self.limiter.try_acquire():
                 break
             t = q.pick_next(
-                lambda tn: self._tenant_inflight.get(tn, 0))
+                lambda tn: self._tenant_inflight.get(tn, 0),
+                lane_inflight_of=lambda nm: self._lane_inflight.get(nm, 0),
+                limit=int(self.limiter.limit))
             if t is None:
                 # every queued tenant is at its inflight cap: the slot
                 # goes back without an AIMD sample
@@ -656,6 +669,7 @@ class OverloadController:
                 cfg = self.config.qos
                 out["qos"] = self._queue_qos.snapshot()
                 out["qos"]["tenant_inflight"] = dict(self._tenant_inflight)
+                out["qos"]["lane_inflight"] = dict(self._lane_inflight)
                 out["qos"]["tenant_inflight_cap"] = cfg.tenant_inflight_cap
                 out["qos"]["tenant_queue_cost"] = cfg.tenant_queue_cost
                 if self._ledger_qos is not None:

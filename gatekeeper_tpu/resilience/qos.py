@@ -53,7 +53,15 @@ CLUSTER_TENANT = "_cluster"
 class PriorityLevel:
     """One APF-shaped priority lane.  ``order`` is the dequeue rank
     (lower dequeues first, sheds last); a level with no selectors is a
-    catch-all."""
+    catch-all.
+
+    ``shares`` (APF's ``assuredConcurrencyShares``) makes dequeue
+    demand-aware: when ANY level declares shares > 0, a lane already
+    holding its assured fraction of the limiter (``ceil(limit x shares /
+    sum shares)``) yields freed slots to lower-priority lanes with
+    queued demand — so a pathological system-lane flood is bounded too,
+    instead of starving user traffic forever under strict priority.
+    All-zero shares (the default) keeps strict priority bit-identical."""
 
     name: str
     order: int
@@ -61,6 +69,7 @@ class PriorityLevel:
     namespace_prefixes: tuple = ()
     users: tuple = ()
     user_prefixes: tuple = ()
+    shares: int = 0
 
     def matches(self, namespace: str, username: str) -> bool:
         if not (self.namespaces or self.namespace_prefixes
@@ -168,6 +177,7 @@ def parse_qos_config(doc: dict) -> QoSConfig:
                     lv.get("matchNamespacePrefixes") or ()),
                 users=tuple(lv.get("matchUsers") or ()),
                 user_prefixes=tuple(lv.get("matchUserPrefixes") or ()),
+                shares=int(lv.get("assuredConcurrencyShares", 0)),
             ))
         levels.sort(key=lambda l: (l.order, l.name))
         cfg.levels = levels
@@ -310,6 +320,20 @@ class QoSQueue:
         self.depth = 0
         self.cost_total = 0.0
         self.tenant_cost: dict = {}  # queued cost per tenant, all lanes
+        # demand-aware shares engage only when some level declares them
+        # (all-zero keeps the strict-priority dequeue bit-identical,
+        # including the seeded trajectory pins)
+        self._shares_total = sum(max(0, lv.shares)
+                                 for lv in config.levels)
+
+    def assured_cap(self, level: PriorityLevel, limit: int) -> int:
+        """APF assured-concurrency value of one lane under the CURRENT
+        limiter limit: ``ceil(limit x shares / sum shares)``, floor 1.
+        0 = the lane declared no shares (unbounded under strict
+        priority)."""
+        if self._shares_total <= 0 or level.shares <= 0 or limit <= 0:
+            return 0
+        return max(1, -(-limit * level.shares // self._shares_total))
 
     def effective_cap(self) -> int:
         """The per-tenant inflight cap in force NOW (0 = unbounded)."""
@@ -426,15 +450,34 @@ class QoSQueue:
                 lane.rr = pos % len(lane.ring) if lane.ring else 0
 
     # --- weighted-fair dequeue -----------------------------------------
-    def pick_next(self, inflight_of: Callable[[str], int]) -> \
-            Optional[Ticket]:
+    def pick_next(self, inflight_of: Callable[[str], int],
+                  lane_inflight_of: Optional[Callable[[str], int]] = None,
+                  limit: int = 0) -> Optional[Ticket]:
         """The next ticket to grant a freed limiter slot: strict
         priority across lanes; deficit round robin across tenants within
         a lane (credit ``quantum x weight`` per unaffordable visit,
         serve when the deficit covers the head's cost); tenants at the
         per-tenant inflight cap are skipped without losing their turn.
         Returns None when nothing is serviceable (empty, or every queued
-        tenant is at its cap)."""
+        tenant is at its cap).
+
+        With ``assuredConcurrencyShares`` configured (and the caller
+        supplying per-lane inflight + the live limit), a lane already at
+        its assured concurrency yields the slot to a lower-priority lane
+        with queued demand — then a work-conserving second pass hands it
+        back if nothing below could actually take it."""
+        if self._shares_total > 0 and lane_inflight_of is not None \
+                and limit > 0:
+            for li, lane in enumerate(self.lanes):
+                if lane.depth() == 0:
+                    continue
+                cap = self.assured_cap(lane.level, limit)
+                if cap and lane_inflight_of(lane.level.name) >= cap and \
+                        any(l2.depth() for l2 in self.lanes[li + 1:]):
+                    continue  # bounded: lower-priority demand goes first
+                t = self._pick_lane(lane, inflight_of)
+                if t is not None:
+                    return t
         for lane in self.lanes:
             t = self._pick_lane(lane, inflight_of)
             if t is not None:
@@ -493,6 +536,7 @@ class QoSQueue:
             lanes.append({
                 "priority": lane.level.name,
                 "order": lane.level.order,
+                "shares": lane.level.shares,
                 "queued": lane.depth(),
                 "tenants": tenants,
             })

@@ -67,6 +67,10 @@ class SnapshotConfig:
     # own from the RowInternCache — no per-occurrence probe of the
     # cluster-sized vocab dict, bit-identical ids
     phase2_intern: bool = True
+    # a constraint-set / template (generation) change RE-CHUNKS resident
+    # rows against the new plan instead of invalidating the whole
+    # snapshot (zero relist; row ids survive); False = wholesale reset
+    rechunk: bool = True
 
 
 def obj_key(obj) -> tuple:
@@ -524,6 +528,7 @@ class ClusterSnapshot:
         self.stale = True  # needs a rebuild before serving sweeps
         self.generation = 0
         self.patch_count = 0
+        self.rechunk_count = 0  # plan changes absorbed without a relist
 
     # --- constraint set currency ---------------------------------------
     def _cons_digest(self, constraints) -> tuple:
@@ -540,9 +545,17 @@ class ClusterSnapshot:
 
     def set_constraints(self, constraints: Sequence) -> bool:
         """Adopt the active constraint set; a changed set (or a lowering/
-        inventory-exactness flip) invalidates the snapshot — groups,
-        schemas and verdicts all derive from it.  Returns True when a
-        rebuild is now required."""
+        inventory-exactness flip) invalidates the derived state — groups,
+        schemas and verdicts.  Returns True when a full rebuild (relist)
+        is now required.
+
+        When the snapshot already holds resident rows, a plan change
+        (template edit / generation swap / constraint churn) RE-CHUNKS
+        instead: the resident raw objects re-columnize against the new
+        plan's schemas with their row ids intact and every row marked
+        dirty — O(cluster) flatten+eval once, but zero relist traffic
+        and no identity loss.  ``SnapshotConfig.rechunk=False`` keeps
+        the wholesale reset."""
         from gatekeeper_tpu.parallel.sharded import make_kind_router
 
         digest = self._cons_digest(constraints)
@@ -550,11 +563,56 @@ class ClusterSnapshot:
             if digest == self._digest and not self.stale:
                 return False
             if digest != self._digest:
+                can_rechunk = (getattr(self.config, "rechunk", True)
+                               and not self.stale and self._pos)
                 self._digest = digest
                 self._constraints = list(constraints)
                 self._router = make_kind_router(constraints)
+                if can_rechunk and self._rechunk():
+                    return False
                 self._reset_rows()
             return self.stale
+
+    def _rechunk(self) -> bool:
+        """Re-columnize every resident row against the NEW plan (new
+        router, new group schemas from the freshly-swapped generation).
+        Row ids survive (``_apply_upserts`` re-appends a known id whose
+        position was cleared); verdicts reset and every routed row lands
+        dirty, so the next tick re-evaluates the cluster against the new
+        template set without a relist.  Returns False (fall back to the
+        wholesale reset) when any resident object is unavailable."""
+        from gatekeeper_tpu.observability import tracing
+
+        objs: list = []
+        for store in self._groups.values():
+            for pos in store.live_positions():
+                obj = store.row_obj(pos)
+                if obj is None:
+                    return False
+                objs.append((store.gids[pos], obj))
+        with tracing.span("snapshot.rechunk", rows=len(objs)):
+            # gid order: deterministic write order regardless of the old
+            # grouping (ids are monotone arrival order)
+            objs.sort(key=lambda t: t[0])
+            self._groups = {}
+            self._pos = {}
+            self._dirty = set()
+            self.verdicts.clear()
+            if self.intern_cache is not None:
+                self.intern_cache.clear()
+            mb = max(1, self.config.micro_batch)
+            pending = [(obj_key(o), o) for _gid, o in objs]
+            for i in range(0, len(pending), mb):
+                self._apply_upserts(pending[i: i + mb])
+            self.rechunk_count += 1
+            self.generation += 1
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as M
+
+                self.metrics.inc_counter(M.SNAPSHOT_PATCHES,
+                                         {"type": "rechunk"},
+                                         value=float(len(pending)))
+        return True
 
     def invalidate(self) -> None:
         """Force a rebuild before the next sweep (resync divergence)."""

@@ -543,3 +543,68 @@ def test_idiom_variants_route_and_agree():
                     tpu._interp.query(TARGET, [con], review).results)
             assert sorted(map(result_key, got[oi].results)) == \
                 sorted(map(result_key, expected)), f"pod {oi}"
+
+
+# --- per-provider fan-out (PR 12) ------------------------------------------
+
+def test_ensure_many_parity_with_serial():
+    """ensure_many (thread-pool fan-out) lands exactly what serial
+    ensures land: same values, same per-key errors, same bulk-call
+    count."""
+    keys_t = ["nginx", "bad/x", "repo/y"]
+    keys_d = ["img@sha256:abc", "plain"]
+    lane_s, _c1, tr_s = make_lane(fanout=1)
+    n_s = lane_s.ensure_many({"trusted": keys_t, "digest": keys_d})
+    lane_f, _c2, tr_f = make_lane(fanout=4)
+    n_f = lane_f.ensure_many({"trusted": keys_t, "digest": keys_d})
+    assert n_s == n_f == len(keys_t) + len(keys_d)
+    assert tr_s.calls == tr_f.calls == 2  # one bulk call per provider
+    for prov, keys in (("trusted", keys_t), ("digest", keys_d)):
+        assert lane_s.resolve_keys(prov, keys) == \
+            lane_f.resolve_keys(prov, keys)
+    # warm re-ensure: zero transport either way
+    assert lane_f.ensure_many({"trusted": keys_t, "digest": keys_d}) == 0
+    assert tr_f.calls == 2
+
+
+def test_ensure_many_actually_overlaps_providers():
+    """Two cold providers' bulk fetches overlap in wall time: each
+    fetch blocks on a barrier only released when BOTH are in flight —
+    completing at all proves the fan-out is concurrent."""
+    barrier = threading.Barrier(2, timeout=10.0)
+
+    def blocking_transport(provider, keys):
+        barrier.wait()  # serial execution would deadlock here
+        return {"response": {
+            "items": [{"key": k, "value": k} for k in keys],
+            "systemError": ""}}
+
+    cache = ProviderCache(send_fn=blocking_transport)
+    cache.upsert(Provider(name="p1", url="https://1", ca_bundle="x"))
+    cache.upsert(Provider(name="p2", url="https://2", ca_bundle="x"))
+    lane = ExtDataLane(cache, fanout=4)
+    n = lane.ensure_many({"p1": ["a", "b"], "p2": ["c"]})
+    assert n == 3
+    assert lane.resolve_keys("p1", ["a"]) == {"a": ("a", None)}
+    assert lane.resolve_keys("p2", ["c"]) == {"c": ("c", None)}
+
+
+def test_ensure_many_failure_semantics_unchanged():
+    """A provider whose transport raises degrades per key exactly as
+    the serial path: the OTHER provider's keys land clean."""
+    def flaky_transport(provider, keys):
+        if provider.name == "p1":
+            raise RuntimeError("transport down")
+        return {"response": {
+            "items": [{"key": k, "value": k} for k in keys],
+            "systemError": ""}}
+
+    for fanout in (1, 4):
+        cache = ProviderCache(send_fn=flaky_transport)
+        cache.upsert(Provider(name="p1", url="https://1", ca_bundle="x"))
+        cache.upsert(Provider(name="p2", url="https://2", ca_bundle="x"))
+        lane = ExtDataLane(cache, fanout=fanout)
+        lane.ensure_many({"p1": ["a"], "p2": ["b"]})
+        ra = lane.resolve_keys("p1", ["a"])["a"]
+        assert ra[0] is None and ra[1]  # per-key error, not an exception
+        assert lane.resolve_keys("p2", ["b"]) == {"b": ("b", None)}

@@ -193,3 +193,74 @@ def test_render_parses_as_name_labels_value():
         name_part, value = ln.rsplit(" ", 1)
         assert value != ""
         float(value)  # parses
+
+
+# --- reservoir-sampled exemplars (PR 12) -----------------------------------
+
+def test_exemplar_reservoir_survives_a_burst():
+    """A burst of boring observations into one bucket no longer evicts
+    the interesting (slow) trace: retention is a uniform reservoir and
+    the RENDERED exemplar is the reservoir's max-value entry."""
+    reg = MetricsRegistry()
+    tracer = tracing.Tracer(seed=7)
+    with tracing.activate(tracer):
+        with tracing.span("interesting") as sp:
+            reg.observe("lat_seconds", 0.024)  # lands in the 0.025 bucket
+            slow_tid = sp.trace_id
+        # 500-observation burst into the SAME bucket, all faster: under
+        # last-write-wins the final one would own the exemplar slot
+        for i in range(500):
+            with tracing.span(f"boring-{i}"):
+                reg.observe("lat_seconds", 0.011)
+    h = reg.get_histogram("lat_seconds")
+    i = [j for j, e in enumerate(h["exemplars"]) if e is not None]
+    assert len(i) == 1
+    bucket = i[0]
+    # rendered exemplar = the bucket's max-value observation = the
+    # slow trace (pinned; the burst cannot displace it)
+    assert h["exemplars"][bucket][0] == slow_tid
+    assert h["exemplars"][bucket][1] == 0.024
+    res = h["exemplar_reservoir"][bucket]
+    assert 1 <= len(res) <= 4
+    # the reservoir is NOT just the last K observations (anti-recency):
+    # with the seeded RNG at least one retained entry predates the
+    # burst's tail window
+    om = reg.render(openmetrics=True)
+    assert f'trace_id="{slow_tid}"' in om
+
+
+def test_exemplar_reservoir_uniform_not_recency():
+    """Deterministic (seeded) check that retention spans the sequence
+    instead of the tail: observe 200 traced values into one bucket and
+    assert some retained exemplar comes from the first half."""
+    reg = MetricsRegistry()
+    tracer = tracing.Tracer(seed=3)
+    tids = []
+    with tracing.activate(tracer):
+        for i in range(200):
+            with tracing.span(f"s{i}") as sp:
+                reg.observe("lat_seconds", 0.011)
+                tids.append(sp.trace_id)
+    h = reg.get_histogram("lat_seconds")
+    bucket = [j for j, e in enumerate(h["exemplars"])
+              if e is not None][0]
+    res = h["exemplar_reservoir"][bucket]
+    assert len(res) == 4
+    order = {tid: i for i, tid in enumerate(tids)}
+    retained = sorted(order[e[0]] for e in res)
+    # last-write-wins / pure recency would retain only 196..199
+    assert retained[0] < 100, retained
+
+
+def test_exemplar_reservoir_single_observation_compat():
+    """One traced observation: exemplars behave exactly as before
+    (reservoir of one, rendered as-is)."""
+    reg = MetricsRegistry()
+    tracer = tracing.Tracer(seed=9)
+    with tracing.activate(tracer):
+        with tracing.span("only") as sp:
+            reg.observe("lat_seconds", 0.2)
+            tid = sp.trace_id
+    h = reg.get_histogram("lat_seconds")
+    ex = [e for e in h["exemplars"] if e is not None]
+    assert ex == [(tid, 0.2, ex[0][2])]

@@ -718,3 +718,95 @@ def test_collapsed_limit_tenant_cannot_hoard_slots():
     assert client.max_conc.get("team-b", 0) <= 1
     assert client.reviews == 8
     assert ctl.shed_count == 0
+
+
+# --- demand-aware assuredConcurrencyShares (PR 12) -------------------------
+
+def _shares_cfg():
+    return qos.parse_qos_config({
+        "priorityLevels": [
+            {"name": "system", "matchNamespaces": ["kube-system"],
+             "assuredConcurrencyShares": 1},
+            {"name": "user", "assuredConcurrencyShares": 3},
+        ]})
+
+
+def test_shares_parse_and_snapshot():
+    cfg = _shares_cfg()
+    assert _lv(cfg, "system").shares == 1
+    assert _lv(cfg, "user").shares == 3
+    q = qos.QoSQueue(cfg)
+    assert q.assured_cap(_lv(cfg, "system"), 8) == 2   # ceil(8*1/4)
+    assert q.assured_cap(_lv(cfg, "user"), 8) == 6
+    snap = q.snapshot()
+    assert {l["priority"]: l["shares"] for l in snap["lanes"]} == \
+        {"system": 1, "user": 3}
+
+
+def test_shares_bound_a_system_lane_flood():
+    """A pathological system-lane flood is bounded: with user demand
+    queued, the system lane cannot take slots past its assured
+    concurrency — user traffic keeps its share instead of starving
+    under strict priority."""
+    cfg = _shares_cfg()
+    q = qos.QoSQueue(cfg)
+    system, user = _lv(cfg, "system"), _lv(cfg, "user")
+    seq = 0
+    for i in range(32):  # the flood
+        q.enqueue(qos.Ticket(seq, "kube-system", system, 10.0), 1000, 1e18)
+        seq += 1
+    for i in range(8):
+        q.enqueue(qos.Ticket(seq, "team-a", user, 10.0), 1000, 1e18)
+        seq += 1
+    limit = 8
+    lane_inflight = {"system": 0, "user": 0}
+    granted = []
+    for _ in range(limit):  # fill every limiter slot
+        t = q.pick_next(lambda tn: 0,
+                        lane_inflight_of=lambda nm: lane_inflight[nm],
+                        limit=limit)
+        assert t is not None
+        lane_inflight[t.level.name] += 1
+        granted.append(t.level.name)
+    # system bounded at ceil(8 * 1/4) = 2; user holds its 6
+    assert lane_inflight == {"system": 2, "user": 6}, granted
+
+
+def test_shares_work_conserving_without_lower_demand():
+    """With NO lower-priority demand the cap does not idle slots: the
+    system lane takes everything (the second work-conserving pass)."""
+    cfg = _shares_cfg()
+    q = qos.QoSQueue(cfg)
+    system = _lv(cfg, "system")
+    for i in range(8):
+        q.enqueue(qos.Ticket(i, "kube-system", system, 10.0), 1000, 1e18)
+    lane_inflight = {"system": 0, "user": 0}
+    for _ in range(8):
+        t = q.pick_next(lambda tn: 0,
+                        lane_inflight_of=lambda nm: lane_inflight[nm],
+                        limit=8)
+        assert t is not None
+        lane_inflight[t.level.name] += 1
+    assert lane_inflight["system"] == 8  # nothing below wanted the slots
+
+
+def test_shares_unset_keeps_strict_priority_bit_identical():
+    """All-zero shares (the default): pick_next with the new arguments
+    decides exactly what the legacy call decides."""
+    def fill(q, cfg):
+        user, system = _lv(cfg, "user"), _lv(cfg, "system")
+        seq = 0
+        for tn, lv in (("team-a", user), ("kube-system", system),
+                       ("team-b", user), ("kube-system", system)):
+            q.enqueue(qos.Ticket(seq, tn, lv, 10.0), 1000, 1e18)
+            seq += 1
+
+    cfg = qos.QoSConfig()
+    q1, q2 = qos.QoSQueue(cfg), qos.QoSQueue(cfg)
+    fill(q1, cfg)
+    fill(q2, cfg)
+    legacy = [q1.pick_next(lambda tn: 0).tenant for _ in range(4)]
+    shares = [q2.pick_next(lambda tn: 0,
+                           lane_inflight_of=lambda nm: 0,
+                           limit=8).tenant for _ in range(4)]
+    assert legacy == shares

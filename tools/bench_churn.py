@@ -1,0 +1,291 @@
+"""Template-churn storm bench: zero-stall generation swap vs inline compile.
+
+The headline number for the generation-swap refactor (ROADMAP
+"zero-stall template churn"): with admission bursts running nonstop, a
+churn thread adds/removes ``n_churn`` templates mid-burst.  With
+``--generation-swap off`` every add lowers + reshapes the union schema
+inline and the first post-change batch retraces on the serving thread;
+with ``on`` the churn stages + compiles on the background thread (warmed
+at the real serving shapes) and swaps atomically — storm P99 must hold
+within 2x the steady-state P99.
+
+Also measures the on-disk compile cache's cold-start story: a fresh
+driver against a warm ``CompileCache`` must perform ZERO lowering (every
+template answered from disk with the vocab snapshot replayed).
+
+Appends the previous latest record to the ``history`` list in
+``CHURN_BENCH.json`` (the FLATTEN_BENCH convention).  Run:
+
+    python tools/bench_churn.py [--smoke] [--out PATH]
+
+``--smoke`` (small corpus, fewer bursts) runs in the slow lane via
+tests/test_generation.py so the bench script itself cannot rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _build_client(generation_swap: bool, cache=None, skip_kinds=()):
+    from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.utils.synthetic import load_library
+
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel, generation_swap=generation_swap,
+                    compile_cache=cache)
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP])
+    load_library(client, skip_kinds=skip_kinds)
+    if tpu.gen_coord is not None:
+        tpu.gen_coord.constraints_fn = client.constraints
+    return client, tpu
+
+
+def _churn_docs(n_churn: int):
+    """The last n_churn library templates (template yaml + constraint
+    yamls) — the storm removes and re-adds them."""
+    import glob
+
+    from gatekeeper_tpu.utils.synthetic import library_dir
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    out = []
+    tpaths = sorted(
+        glob.glob(os.path.join(library_dir(), "general", "*",
+                               "template.yaml")) +
+        glob.glob(os.path.join(library_dir(), "pod-security-policy", "*",
+                               "template.yaml")))
+    for tpath in tpaths[-n_churn:]:
+        tdoc = load_yaml_file(tpath)[0]
+        kind = (tdoc.get("spec", {}).get("crd", {}).get("spec", {})
+                .get("names", {}).get("kind", ""))
+        cons = []
+        cpath = os.path.join(os.path.dirname(tpath), "samples",
+                             "constraint.yaml")
+        if os.path.exists(cpath):
+            cons = load_yaml_file(cpath)
+        out.append((kind, tdoc, cons))
+    return out
+
+
+def _percentiles(samples):
+    if not samples:
+        return {"p50_ms": None, "p99_ms": None, "n": 0}
+    s = sorted(samples)
+    return {
+        "p50_ms": round(1e3 * s[len(s) // 2], 3),
+        "p99_ms": round(1e3 * s[min(len(s) - 1,
+                                    int(len(s) * 0.99))], 3),
+        "mean_ms": round(1e3 * statistics.fmean(s), 3),
+        "max_ms": round(1e3 * s[-1], 3),
+        "n": len(s),
+    }
+
+
+def _run_mode(generation_swap: bool, objects, n_churn: int,
+              steady_bursts: int, burst: int, churn_gap_s: float) -> dict:
+    """One mode's storm measurement: burst loop on this thread, churn
+    on another; latencies bucketed into steady vs storm windows."""
+    from gatekeeper_tpu.match.match import SOURCE_ORIGINAL
+    from gatekeeper_tpu.target.review import AugmentedUnstructured
+
+    client, tpu = _build_client(generation_swap)
+    docs = _churn_docs(n_churn)
+    reviews = [AugmentedUnstructured(object=o, source=SOURCE_ORIGINAL)
+               for o in objects[:burst]]
+    coord = tpu.gen_coord
+    if coord is not None:
+        coord.start()
+
+    # warm every serving shape, then measure the steady state
+    for _ in range(3):
+        client.review_batch(reviews)
+    steady: list = []
+    for _ in range(steady_bursts):
+        t0 = time.perf_counter()
+        client.review_batch(reviews)
+        steady.append(time.perf_counter() - t0)
+
+    storm: list = []
+    errors = [0]
+    done = threading.Event()
+
+    def churn():
+        # remove + re-add each doc: every edit reshapes the union schema
+        try:
+            for kind, tdoc, cons in docs:
+                client.remove_template(kind)
+                time.sleep(churn_gap_s)
+                client.add_template(tdoc)
+                for cdoc in cons:
+                    client.add_constraint(cdoc)
+                time.sleep(churn_gap_s)
+        except Exception:
+            errors[0] += 1
+        finally:
+            done.set()
+
+    def storm_active():
+        if not done.is_set():
+            return True
+        # swap mode: keep measuring while the background compile drains
+        return coord is not None and coord.snapshot()["pending"]
+
+    th = threading.Thread(target=churn, daemon=True)
+    th.start()
+    while storm_active():
+        t0 = time.perf_counter()
+        try:
+            client.review_batch(reviews)
+        except Exception:
+            errors[0] += 1
+        storm.append(time.perf_counter() - t0)
+    th.join(30.0)
+    if coord is not None:
+        coord.wait_idle(30.0)
+    # a couple of post-storm bursts: the first post-swap shapes
+    post: list = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        client.review_batch(reviews)
+        post.append(time.perf_counter() - t0)
+    if coord is not None:
+        coord.stop()
+    st = _percentiles(steady)
+    sm = _percentiles(storm + post)
+    ratio = (sm["p99_ms"] / st["p99_ms"]
+             if st["p99_ms"] and sm["p99_ms"] else None)
+    return {
+        "mode": "on" if generation_swap else "off",
+        "steady": st,
+        "storm": sm,
+        "p99_ratio": round(ratio, 2) if ratio else None,
+        "burst_errors": errors[0],
+        "swaps": coord.swap_count if coord is not None else 0,
+    }
+
+
+def _run_cache(smoke: bool) -> dict:
+    """Cold start vs warm-cache start: lowering counts + wall."""
+    from gatekeeper_tpu.drivers.generation import CompileCache
+
+    import gatekeeper_tpu.drivers.tpu_driver as TD
+    import gatekeeper_tpu.ir.lower_rego as LR
+
+    with tempfile.TemporaryDirectory(prefix="gtpu-cc-") as d:
+        cc1 = CompileCache(d)
+        t0 = time.perf_counter()
+        _build_client(False, cache=cc1)
+        cold_s = time.perf_counter() - t0
+
+        calls = [0]
+        orig = LR.lower_template
+
+        def counting(*a, **k):
+            calls[0] += 1
+            return orig(*a, **k)
+
+        TD.lower_template = counting
+        try:
+            cc2 = CompileCache(d)
+            t0 = time.perf_counter()
+            _build_client(False, cache=cc2)
+            warm_s = time.perf_counter() - t0
+        finally:
+            TD.lower_template = orig
+        return {
+            "cold_start_s": round(cold_s, 3),
+            "warm_start_s": round(warm_s, 3),
+            "cold": cc1.stats(),
+            "warm": cc2.stats(),
+            "warm_fresh_lowerings": calls[0],
+        }
+
+
+def run_bench(n_objects: int = 64, burst: int = 16, n_churn: int = 10,
+              steady_bursts: int = 60, churn_gap_s: float = 0.01,
+              out_path: str = None, seed: int = 31,
+              write: bool = True) -> dict:
+    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
+
+    objects = make_cluster_objects(n_objects, seed=seed)
+    record = {
+        "kind": "churn_bench",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_cpus": os.cpu_count() or 1,
+        "n_objects": n_objects,
+        "burst": burst,
+        "templates_churned": n_churn,
+        "steady_bursts": steady_bursts,
+        "modes": {},
+    }
+    for swap in (False, True):
+        m = _run_mode(swap, objects, n_churn, steady_bursts, burst,
+                      churn_gap_s)
+        record["modes"][m["mode"]] = m
+    record["cache"] = _run_cache(smoke=steady_bursts < 30)
+    on = record["modes"]["on"]
+    record["headline"] = {
+        "storm_p99_within_2x_steady": (
+            on["p99_ratio"] is not None and on["p99_ratio"] <= 2.0),
+        "p99_ratio_on": on["p99_ratio"],
+        "p99_ratio_off": record["modes"]["off"]["p99_ratio"],
+        "warm_start_zero_lowering":
+            record["cache"]["warm_fresh_lowerings"] == 0,
+    }
+    if write:
+        out = out_path or os.path.join(os.path.dirname(__file__), "..",
+                                       "CHURN_BENCH.json")
+        history = []
+        if os.path.exists(out):
+            try:
+                with open(out) as fh:
+                    prev = json.load(fh)
+                history = prev.pop("history", [])
+                history.append(prev)  # previous latest becomes history
+            except Exception:
+                history = []
+        record_out = dict(record)
+        record_out["history"] = history
+        with open(out, "w") as fh:
+            json.dump(record_out, fh, indent=1)
+    return record
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+    argv = [a for a in argv if a != "--smoke"]
+    out = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        out = argv[i + 1]
+        del argv[i: i + 2]
+    if smoke:
+        rec = run_bench(n_objects=24, burst=8, n_churn=3,
+                        steady_bursts=12, out_path=out,
+                        write=out is not None)
+    else:
+        rec = run_bench(out_path=out)
+    print(json.dumps({"headline": rec["headline"],
+                      "on": rec["modes"]["on"],
+                      "off": rec["modes"]["off"],
+                      "cache": rec["cache"]}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
