@@ -71,6 +71,17 @@ def main(argv=None) -> int:
                         "one generation; 0/1 = off (one-shot full "
                         "differential incl. the cluster-global verdict "
                         "check)")
+    p.add_argument("--snapshot-spill", default="",
+                   help="snapshot mode: directory for the on-disk spill "
+                        "of the resident audit state (tall columns + "
+                        "vocab + row ids + verdicts + per-GVK rv marks). "
+                        "On boot a valid spill warm-starts the auditor — "
+                        "watches resubscribe FROM the recorded rv and "
+                        "the first tick pays zero relist and zero "
+                        "flatten; a corrupt or drifted spill is deleted "
+                        "and the boot relists (README 'Cold start & "
+                        "persistence').  Spills write off the audit "
+                        "thread after each clean resync and at drain")
     p.add_argument("--audit-expand", action="store_true",
                    help="expansion generator stage in the audit sweep: "
                         "generator objects (per ExpansionTemplate "
@@ -246,6 +257,16 @@ def main(argv=None) -> int:
                         "(kube-system + gatekeeper-system + system: "
                         "users ahead of break-glass ahead of everyone, "
                         "namespace as the tenant key)")
+    p.add_argument("--qos-ledger-decay", default="events",
+                   choices=["events", "slo-window"],
+                   help="decay driver for the QoS displacement ledger "
+                        "(who is 'heaviest'): 'events' (the default) "
+                        "halves totals per fixed charge count — "
+                        "deterministic replay; 'slo-window' halves them "
+                        "per elapsed SLO short-window on the SLO "
+                        "engine's clock, so tenant heaviness ages on "
+                        "the same timebase the burn-rate windows use "
+                        "(an idle gap forgets a past burst)")
     p.add_argument("--enable-profile", action="store_true",
                    help="serve /debug/profile?seconds=N (pprof equivalent)")
     p.add_argument("--fail-open-on-error", action="store_true",
@@ -548,6 +569,16 @@ def main(argv=None) -> int:
         print(f"SLO engine active: "
               f"{len(slo_engine.objectives)} objectives, tick every "
               f"{args.slo_interval:.0f}s (/debug/slo)", file=sys.stderr)
+    if args.qos == "on" and args.qos_ledger_decay == "slo-window" \
+            and overload_ctl is not None:
+        # displacement-ledger decay on the SLO window clock (default
+        # 'events' keeps the deterministic event-count decay untouched)
+        if slo_engine is not None:
+            overload_ctl.set_qos_ledger_clock(
+                slo_engine.window_clock, slo_engine.shortest_window_s())
+        else:
+            overload_ctl.set_qos_ledger_clock(time.monotonic, 300.0)
+        print("qos ledger decay: slo-window", file=sys.stderr)
     cel = CELDriver()
     if args.evaluate_sidecar:
         from gatekeeper_tpu.drivers.remote import RemoteDriver
@@ -654,6 +685,10 @@ def main(argv=None) -> int:
     audit_mgr = None
     snapshot = None
     snap_ingester = None
+    snap_spiller = None
+    spill_load = None
+    warm_cache = None
+    evaluator = None
     if mgr.is_assigned("audit") or args.once:
         if args.evaluate_sidecar:
             from gatekeeper_tpu.drivers.remote import RemoteEvaluator
@@ -717,11 +752,37 @@ def main(argv=None) -> int:
             else:
                 from gatekeeper_tpu.snapshot import (ClusterSnapshot,
                                                      SnapshotConfig,
+                                                     SnapshotSpill,
+                                                     SnapshotSpiller,
                                                      WatchIngester,
-                                                     gvks_of)
+                                                     gvks_of,
+                                                     templates_digest)
 
                 snapshot = ClusterSnapshot(evaluator, SnapshotConfig(),
                                            metrics=metrics)
+                spill_load = None
+                if args.snapshot_spill:
+                    snap_spill = SnapshotSpill(args.snapshot_spill,
+                                               metrics=metrics)
+                    from gatekeeper_tpu.apis.constraints import AUDIT_EP \
+                        as _AEP
+
+                    audit_cons = [c for c in client.constraints()
+                                  if c.actions_for(_AEP)]
+                    spill_load = snap_spill.load(
+                        snapshot, audit_cons,
+                        extdata_lane=extdata_lane,
+                        templates=templates_digest(client))
+                    if spill_load is not None:
+                        print(f"snapshot spill loaded: "
+                              f"{spill_load['rows']} rows warm, zero "
+                              f"relist (resubscribing from recorded rv)",
+                              file=sys.stderr)
+                    else:
+                        print("snapshot spill miss "
+                              f"({snap_spill.stats()['miss_reasons']}); "
+                              "booting with a clean relist",
+                              file=sys.stderr)
                 watch_src = kube_cluster if kube_cluster is not None \
                     else cluster
                 if kube_cluster is not None:
@@ -735,9 +796,16 @@ def main(argv=None) -> int:
                     watch_gvks = gvks_of(cluster.list())
                 snap_ingester = WatchIngester(
                     snapshot, watch_src, watch_gvks,
+                    from_rvs=(spill_load or {}).get("rvs"),
                     on_error=lambda e: print(
                         f"snapshot watch subscribe failed: {e}",
                         file=sys.stderr)).start()
+                if args.snapshot_spill:
+                    snap_spiller = SnapshotSpiller(
+                        snap_spill, snapshot,
+                        rvs_fn=lambda: dict(snap_ingester.rvs),
+                        extdata_lane=extdata_lane,
+                        templates_fn=lambda: templates_digest(client))
                 print(f"resident snapshot active: watching "
                       f"{len(watch_gvks)} GVKs, resync every "
                       f"{args.snapshot_resync_every} intervals",
@@ -763,7 +831,27 @@ def main(argv=None) -> int:
             metrics=metrics,
             snapshot=snapshot,
             expansion_system=mgr.expansion_system,
+            spiller=snap_spiller,
         )
+        if snapshot is not None and snapshot.warm_loaded \
+                and spill_load is not None:
+            audit_mgr.restore_spill_aux(spill_load.get("aux") or {})
+        if args.compile_cache and not args.evaluate_sidecar \
+                and not args.once:
+            # warm-state replay (drivers/generation.WarmStateCache):
+            # re-land the fused sweep traces + the admission warm-ref
+            # kernels recorded by the previous process, so the first
+            # tick/burst after this restart retraces nothing — the
+            # persistent XLA cache under the same dir answers the
+            # compiles
+            from gatekeeper_tpu.drivers.generation import WarmStateCache
+
+            warm_cache = WarmStateCache(args.compile_cache,
+                                        metrics=metrics)
+            rep = warm_cache.replay(tpu, evaluator)
+            if rep["hit"]:
+                print(f"warm state replayed: {rep['sweep_traces']} "
+                      f"sweep traces landed", file=sys.stderr)
 
     def export_trace():
         if tracer is None or not args.trace:
@@ -778,6 +866,11 @@ def main(argv=None) -> int:
 
     if args.once:
         run = audit_mgr.audit()
+        if snap_spiller is not None:
+            # a --once sweep is a natural spill point: the NEXT --once
+            # (or server boot) warm-starts off it, mirroring how the
+            # compile cache serves one-shot runs
+            snap_spiller.spill_now()
         total = sum(run.total_violations.values())
         print(f"audit: {run.total_objects} objects, {total} violations "
               f"in {run.duration_s:.2f}s"
@@ -1037,8 +1130,16 @@ def main(argv=None) -> int:
         batcher.stop()  # idempotent (server.stop drained it already)
         if mutation_batcher is not None:
             mutation_batcher.stop()
+        if snap_spiller is not None:
+            # final spill (idempotent with run_forever's exit flush): a
+            # clean drain never loses the resident state it paid for
+            snap_spiller.stop(flush=True)
         if snap_ingester is not None:
             snap_ingester.stop()
+        if warm_cache is not None:
+            # persist the warm execution state beside the compile cache
+            # so the NEXT process replays traces instead of retracing
+            warm_cache.save(tpu, evaluator)
         _gc = getattr(tpu, "gen_coord", None)
         if _gc is not None:
             _gc.stop()

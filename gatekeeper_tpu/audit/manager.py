@@ -197,6 +197,7 @@ class AuditManager:
         metrics=None,  # metrics.registry.MetricsRegistry (optional)
         snapshot=None,  # snapshot.ClusterSnapshot (audit_source=snapshot)
         expansion_system=None,  # expansion.ExpansionSystem (expand stage)
+        spiller=None,  # snapshot.SnapshotSpiller (--snapshot-spill)
     ):
         self.client = client
         self.lister = lister
@@ -218,6 +219,12 @@ class AuditManager:
         self._gen_ns: dict = {}
         self._gen_kinds: set = set()
         self._gen_verdicts: dict = {}
+        # snapshot spill writer (snapshot/persist.py): a clean resync
+        # requests a background spill, run_forever's exit flushes a
+        # final one (the drain guarantee); None = persistence off
+        self.spiller = spiller
+        if spiller is not None:
+            self.attach_spiller(spiller)
         # human-readable first difference of the last resync differential
         # (None = bit-identical), for tests/ops introspection
         self.last_resync_diff: Optional[str] = None
@@ -232,14 +239,37 @@ class AuditManager:
         # None when the last sweep ran the serial schedule
         self.pipe_stats: Optional[dict] = None
 
+    # --- spill persistence (snapshot/persist.py) -------------------------
+    def attach_spiller(self, spiller) -> None:
+        """Wire a SnapshotSpiller: the manager feeds it the expansion
+        stage's generated verdicts (they ride the spill's aux section so
+        a warm boot's totals include them without re-expanding clean
+        parents) and flushes it at drain."""
+        self.spiller = spiller
+        spiller.aux_fn = lambda: {
+            "gen_verdicts": dict(self._gen_verdicts)}
+
+    def restore_spill_aux(self, aux: dict) -> None:
+        """Adopt a loaded spill's aux section (persist.load's 'aux')."""
+        gen = aux.get("gen_verdicts")
+        if gen:
+            self._gen_verdicts = dict(gen)
+
     # --- loop (reference: auditManagerLoop, manager.go:831) -------------
     def run_forever(self):
         if self._snapshot_mode():
             # initial full pass builds the snapshot and evaluates every
             # row; steady state is incremental ticks over the dirty set,
             # with the full-resync differential every resync_every-th
-            # interval proving the snapshot still equals a fresh relist
-            self.audit()
+            # interval proving the snapshot still equals a fresh relist.
+            # A spill-loaded snapshot (persist.load) boots WARM: rows
+            # are clean with persisted verdicts, so the first pass is an
+            # incremental tick — zero relist, zero flatten, zero
+            # re-evaluation of clean rows
+            if getattr(self.snapshot, "warm_loaded", False):
+                self.audit_tick()
+            else:
+                self.audit()
             n = 0
             every = max(0, getattr(self.config, "resync_every", 0))
             while not self._stop.wait(self.config.interval_s):
@@ -248,6 +278,12 @@ class AuditManager:
                     self.audit_resync()
                 else:
                     self.audit_tick()
+            if self.spiller is not None:
+                # drain flush: a clean SIGTERM never loses the resident
+                # state it just paid to build (synchronous — the process
+                # is leaving anyway and the DrainCoordinator budget
+                # covers it)
+                self.spiller.spill_now()
             return
         while not self._stop.wait(self.config.interval_s):
             self.audit()
@@ -977,6 +1013,11 @@ class AuditManager:
             # scope so operators can tell a 1/K proof from the full one
             self.perf["resync_scope"] = (1.0 / rotor[1]) if rotor \
                 else 1.0
+            if diff is None and self.spiller is not None:
+                # a just-proven-consistent snapshot is the best state to
+                # persist: capture now (under-lock memcpy), write on the
+                # spiller's worker — the next tick is untouched
+                self.spiller.request()
             return run
 
     @staticmethod

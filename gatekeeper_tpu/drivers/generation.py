@@ -274,6 +274,143 @@ class CompileCache:
                 "stores": self.stores}
 
 
+def programs_digest(driver) -> str:
+    """Digest of the installed compiled plan (kind -> program schema) —
+    the warm-state cache key: recorded executable layouts only replay
+    against the exact program schemas they were traced with."""
+    parts = sorted((k, schema_digest(p.program.schema))
+                   for k, p in driver._programs.items())
+    return hashlib.sha256(repr(parts).encode()).hexdigest()
+
+
+# bump when the warm-state payload layout changes
+WARM_FORMAT = 1
+
+
+class WarmStateCache:
+    """Persisted warm execution state under the compile-cache dir.
+
+    The compile cache (above) removes restart LOWERING; this removes the
+    restart RETRACE: the fused sweep executables' trace descriptors +
+    input avals (``ShardedEvaluator.warm_state``: recorded keys, corpus
+    column stats, width targets, hit-buffer state) and the admission
+    path's warm reference batch (``TpuDriver._warm_ref`` — the latest
+    real admission batch, the only thing that traces kernels at the true
+    serving shapes).  On boot, :meth:`replay` re-lands every trace off
+    the serving path — with the persistent XLA cache answering the
+    compiles — so a restarted process retraces nothing on its first
+    tick or admission burst.
+
+    Integrity mirrors :class:`CompileCache`: payload sha256 + format /
+    jax / flatten-schema fields + the installed-programs digest in the
+    meta; corrupt or drifted state is deleted and simply not replayed
+    (the process falls back to lazy tracing — never wrong, just cold).
+    """
+
+    def __init__(self, root: str, metrics=None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.metrics = metrics
+        self.saves = 0
+        self.loads = 0
+        self.misses = 0
+
+    def _paths(self) -> tuple:
+        return (os.path.join(self.root, "warm_state.json"),
+                os.path.join(self.root, "warm_state.pkl"))
+
+    def save(self, driver, evaluator=None) -> bool:
+        """Best-effort: a failed save never fails the caller (drain)."""
+        meta_p, payload_p = self._paths()
+        try:
+            payload = {
+                "sweeps": (evaluator.warm_state()
+                           if evaluator is not None else None),
+                "warm_ref": getattr(driver, "_warm_ref", None),
+            }
+            raw = pickle.dumps(payload)
+            jv, jlv = CompileCache._versions()
+            meta = {"format": WARM_FORMAT,
+                    "flatten_schema_version": FLATTEN_SCHEMA_VERSION,
+                    "jax": jv, "jaxlib": jlv,
+                    "programs": programs_digest(driver),
+                    "payload_sha256": hashlib.sha256(raw).hexdigest(),
+                    "saved_at": time.time()}
+            tmp = payload_p + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, payload_p)
+            tmp = meta_p + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, meta_p)
+            self.saves += 1
+            return True
+        except Exception:
+            return False
+
+    def _reject(self) -> None:
+        self.misses += 1
+        for p in self._paths():
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def load(self, driver):
+        """The validated payload, or None (corrupt/drifted state is
+        deleted, never replayed)."""
+        meta_p, payload_p = self._paths()
+        if not (os.path.exists(meta_p) and os.path.exists(payload_p)):
+            self.misses += 1
+            return None
+        try:
+            with open(meta_p) as f:
+                meta = json.load(f)
+            with open(payload_p, "rb") as f:
+                raw = f.read()
+        except Exception:
+            self._reject()
+            return None
+        jv, jlv = CompileCache._versions()
+        want = {"format": WARM_FORMAT,
+                "flatten_schema_version": FLATTEN_SCHEMA_VERSION,
+                "jax": jv, "jaxlib": jlv,
+                "programs": programs_digest(driver)}
+        if any(meta.get(k) != v for k, v in want.items()):
+            self._reject()
+            return None
+        if hashlib.sha256(raw).hexdigest() != meta.get("payload_sha256"):
+            self._reject()
+            return None
+        try:
+            payload = pickle.loads(raw)
+        except Exception:
+            self._reject()
+            return None
+        self.loads += 1
+        return payload
+
+    def replay(self, driver, evaluator=None) -> dict:
+        """Load + re-land: sweep traces through
+        ``ShardedEvaluator.replay_warm`` and — when a generation
+        coordinator exists — the admission kernels through a
+        ``warm_serving`` pass over the restored ``_warm_ref``."""
+        payload = self.load(driver)
+        if payload is None:
+            return {"hit": False, "sweep_traces": 0}
+        landed = 0
+        if payload.get("sweeps") is not None and evaluator is not None:
+            evaluator.restore_warm_state(payload["sweeps"])
+            landed = evaluator.replay_warm()
+        ref = payload.get("warm_ref")
+        if ref is not None:
+            driver._warm_ref = tuple(ref)
+            if driver.gen_coord is not None:
+                driver.gen_coord.warm_serving()
+        return {"hit": True, "sweep_traces": landed}
+
+
 class _Staged:
     """One staged template: synchronously-validated artifacts waiting for
     the next generation build."""
@@ -630,6 +767,17 @@ class GenerationCoordinator:
         except Exception as e:
             with self._lock:
                 self.last_error = f"warm: {e}"
+
+    def warm_serving(self) -> None:
+        """Warm the CURRENT serving generation at the persisted
+        ``_warm_ref`` shapes — the WarmStateCache boot replay's
+        admission-side half.  Runs :meth:`_warm` over a pseudo
+        generation holding the serving programs; traces land on the
+        caller (boot) thread before any traffic, so the first real
+        admission burst retraces nothing."""
+        gen = Generation(self.gen_id)
+        gen.programs = dict(self.driver._programs)
+        self._warm(gen)
 
     def _swap(self, gen: Generation, desired: dict) -> None:
         self.driver._install_generation(gen)

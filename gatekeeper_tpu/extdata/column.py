@@ -90,3 +90,33 @@ class ProviderColumn:
         with self._lock:
             self._entries.clear()
             self._version += 1
+
+    # --- spill persistence (snapshot/persist.py envelope) --------------
+    def export_entries(self) -> dict:
+        """``key -> (remaining_ttl_s, value, error)`` — absolute clock
+        stamps do not survive a restart (the default clock is
+        monotonic), so the spill records each key's REMAINING ttl and
+        the import re-stamps against the new process's clock."""
+        now = self._clock()
+        with self._lock:
+            return {k: (self.ttl_s - (now - t), v, e)
+                    for k, (t, v, e) in self._entries.items()}
+
+    def import_entries(self, entries: dict, elapsed_s: float = 0.0
+                       ) -> int:
+        """Re-land spilled entries; ``elapsed_s`` is the wall time the
+        process spent down (spill ``saved_at`` to load) — keys whose
+        remaining TTL it consumed are DROPPED, so a warm restart
+        re-fetches only what actually expired.  Returns keys landed."""
+        now = self._clock()
+        landed = 0
+        with self._lock:
+            for k, (remaining, v, e) in entries.items():
+                remaining -= max(0.0, elapsed_s)
+                if remaining <= 0:
+                    continue
+                self._entries[k] = (now - (self.ttl_s - remaining), v, e)
+                landed += 1
+            if landed:
+                self._version += 1
+        return landed

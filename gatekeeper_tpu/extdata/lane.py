@@ -116,6 +116,28 @@ class ExtDataLane:
                 self._columns[provider] = col
             return col
 
+    def export_columns(self) -> dict:
+        """Spill payload: every resident ProviderColumn's entries with
+        per-key remaining TTL (the snapshot spill's extdata section)."""
+        with self._lock:
+            cols = dict(self._columns)
+        return {p: {"ttl_s": col.ttl_s,
+                    "entries": col.export_entries()}
+                for p, col in cols.items()}
+
+    def import_columns(self, payload: dict, elapsed_s: float = 0.0
+                       ) -> int:
+        """Re-land spilled columns; ``elapsed_s`` (the wall time since
+        the spill was written) consumes each key's remaining TTL, and
+        expired keys drop on load — a warm restart re-fetches only what
+        actually expired.  Returns total keys landed."""
+        landed = 0
+        for provider, rec in (payload or {}).items():
+            col = self.column(provider)
+            landed += col.import_entries(rec.get("entries") or {},
+                                         elapsed_s=elapsed_s)
+        return landed
+
     def invalidate(self, provider: Optional[str] = None) -> None:
         with self._lock:
             cols = ([self._columns[provider]]

@@ -449,6 +449,13 @@ SNAPSHOT_RESYNC_SECONDS = "snapshot_resync_seconds"  # gauge
 # that had to probe/intern into the cluster-sized global vocab
 SNAPSHOT_INTERN_HITS = "snapshot_intern_cache_hits"  # gauge
 SNAPSHOT_INTERN_PROBES = "snapshot_intern_global_probes"  # gauge
+# snapshot spill (snapshot/persist.py): wall seconds + bytes of the last
+# on-disk spill write, boot loads served warm, and boot loads that fell
+# back to a relist {reason=cold|corrupt|version|plan|vocab|schema}
+SNAPSHOT_SPILL_SECONDS = "snapshot_spill_seconds"  # gauge
+SNAPSHOT_SPILL_BYTES = "snapshot_spill_bytes"  # gauge
+SNAPSHOT_SPILL_LOAD_HITS = "snapshot_spill_load_hits"
+SNAPSHOT_SPILL_LOAD_MISS = "snapshot_spill_load_miss_count"  # {reason}
 # batched mutation + expansion lane (gatekeeper_tpu/mutlane/): batched
 # lane passes, objects routed to the authoritative host walk {reason},
 # emitted RFC-6902 patch ops, and convergence iterations per applied

@@ -172,6 +172,20 @@ class SLOEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
+    @property
+    def window_clock(self) -> Callable[[], float]:
+        """The monotonic clock the burn windows run on — consumers that
+        want to age state on the SLO timebase (e.g. the QoS displacement
+        ledger under ``--qos-ledger-decay slo-window``) read it here so
+        an injected test clock drives them too."""
+        return self._clock
+
+    def shortest_window_s(self) -> float:
+        """The tightest burn-tier short window — the natural half-life
+        for window-driven decay consumers."""
+        return min((float(t["short_s"]) for t in self.tiers),
+                   default=300.0)
+
     # --- loop ------------------------------------------------------------
     def start(self, interval_s: float = 10.0) -> "SLOEngine":
         def loop():

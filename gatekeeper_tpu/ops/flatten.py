@@ -130,6 +130,23 @@ class RowIdMap:
         """Total ids ever issued (monotone, ≥ len(self))."""
         return self._next
 
+    def export_state(self) -> tuple:
+        """(next_id, [(uid, id)]) — the snapshot spill's identity
+        section.  The high-water mark travels too: a restored map must
+        keep issuing ids ABOVE every id ever issued (including retired
+        ones), or a post-restart create could reuse a retired id and
+        collide with a spilled verdict entry."""
+        return (self._next, list(self._ids.items()))
+
+    def restore(self, state: tuple) -> None:
+        """Adopt an exported state (spill load).  Replaces the current
+        assignment wholesale — only valid on a map that has issued
+        nothing this process, or whose rows are being replaced with the
+        spill's."""
+        nxt, items = state
+        self._ids = dict(items)
+        self._next = max(int(nxt), self._next)
+
 
 class RowInternCache:
     """Phase-2 intern state for the snapshot patch lane, keyed by the

@@ -662,6 +662,15 @@ class ShardedEvaluator:
             raise ValueError(f"unknown collect lane {collect!r}")
         self.collect = collect
         self._sweep_fns: dict = {}
+        # fused-sweep trace counter: each jit TRACE of a sweep fn body
+        # (first call per input-shape signature) bumps it — the
+        # "zero retraces after a warm restart" pin reads the delta
+        self.trace_count = 0
+        # warm-state record (drivers/generation.WarmStateCache): every
+        # NEW fused executable's serializable descriptor + the input
+        # avals its first dispatch traced at, so a restarted process can
+        # replay the traces with zero-filled buffers before serving
+        self.warm_record: dict = {}
         # per-generation merged-schema cache: (plan epoch, lowered set)
         # -> union Schema (see sweep_schema)
         self._schema_cache: dict = {}
@@ -692,6 +701,110 @@ class ShardedEvaluator:
 
     def perf_reset(self) -> None:
         self.perf = {}
+
+    # --- warm-state persistence (drivers/generation.WarmStateCache) ------
+    def _record_warm(self, desc: tuple, cols_bufs: dict,
+                     tables_bufs: dict, table_cols: dict, mask,
+                     budget) -> None:
+        """Record a NEW fused executable's trace signature: the
+        serializable key descriptor (lane, kinds, k, flags, layouts,
+        pad_n) plus the host-side input avals its first dispatch carried
+        — everything :meth:`replay_warm` needs to re-land the trace with
+        zero-filled buffers after a restart.  Called only when the
+        executable cache missed, so steady-state dispatches never pay
+        this."""
+        if len(self.warm_record) >= 64:
+            return
+        self.warm_record[desc] = {
+            "cols": {dt: (b.shape, b.dtype.str)
+                     for dt, b in cols_bufs.items()},
+            "tables": {dt: (b.shape, b.dtype.str)
+                       for dt, b in tables_bufs.items()},
+            "table_cols": {name: (np.asarray(a).shape,
+                                  np.asarray(a).dtype.str)
+                           for name, a in table_cols.items()},
+            "mask": tuple(mask.shape),
+            "budget": None if budget is None else tuple(budget.shape),
+        }
+
+    def warm_state(self) -> dict:
+        """The persistable warm execution state: recorded executable
+        descriptors + the adaptive inputs that make post-restart
+        dispatches compute IDENTICAL jit keys — corpus column stats and
+        ragged width targets (they decide the wire layout, which is part
+        of the key) and the reduced lane's hit-buffer state (cap sizing
+        is part of the key too)."""
+        return {
+            "record": dict(self.warm_record),
+            "col_stats": dict(self._col_stats),
+            "width_targets": dict(self._width_targets),
+            "hit_state": {k: dict(v)
+                          for k, v in self._hit_state.items()},
+        }
+
+    def restore_warm_state(self, state: dict) -> None:
+        self._col_stats = dict(state.get("col_stats") or {})
+        self._width_targets = dict(state.get("width_targets") or {})
+        self._hit_state = {k: dict(v) for k, v in
+                           (state.get("hit_state") or {}).items()}
+        self.warm_record = dict(state.get("record") or {})
+
+    def replay_warm(self) -> int:
+        """Re-land every recorded fused-sweep trace: zero-filled buffers
+        at the recorded avals drive one trace per entry off the serving
+        path (the persistent XLA cache answers the compile), so the
+        first real tick after a restart reuses the traces instead of
+        retracing once per layout.  Best-effort per entry: a descriptor
+        the current program set cannot satisfy is skipped and simply
+        retraces lazily later.  Returns the number of traces landed."""
+        progs = self.driver._programs
+        landed = 0
+        for desc, avals in list(self.warm_record.items()):
+            kinds = desc[1]
+            if any(kd not in progs for kd in kinds):
+                continue
+            try:
+                tables_dev = {
+                    dt: jax.device_put(
+                        np.zeros(shape, np.dtype(ds)),
+                        NamedSharding(self.mesh, P(None)))
+                    for dt, (shape, ds) in avals["tables"].items()}
+                cols_dev = {
+                    dt: jax.device_put(
+                        np.zeros(shape, np.dtype(ds)),
+                        NamedSharding(self.mesh, P("data", None)))
+                    for dt, (shape, ds) in avals["cols"].items()}
+                tcols = {name: np.zeros(shape, np.dtype(ds))
+                         for name, (shape, ds)
+                         in avals["table_cols"].items()}
+                tcols_dev = shard_batch_arrays(tcols, self.mesh, {})
+                mask_dev = jax.device_put(
+                    np.zeros(avals["mask"], np.uint8),
+                    NamedSharding(self.mesh, P(None, "data")))
+                if desc[0] == "reduced":
+                    (_lane, kinds, k, complete, hit_cap, cols_layout,
+                     tables_layout, pad_n) = desc
+                    budget_dev = jax.device_put(
+                        np.zeros(avals["budget"] or (0,), np.int32),
+                        NamedSharding(self.mesh, P(None)))
+                    fn = self._sweep_fn_reduced(
+                        kinds, k, complete, hit_cap, cols_layout,
+                        tables_layout, pad_n)
+                    jax.block_until_ready(fn(tables_dev, cols_dev,
+                                             tcols_dev, mask_dev,
+                                             budget_dev))
+                else:
+                    (_lane, kinds, k, return_bits, cols_layout,
+                     tables_layout, pad_n) = desc
+                    fn = self._sweep_fn(kinds, k, return_bits,
+                                        cols_layout, tables_layout,
+                                        pad_n)
+                    jax.block_until_ready(fn(tables_dev, cols_dev,
+                                             tcols_dev, mask_dev))
+                landed += 1
+            except Exception:  # noqa: PERF203
+                continue
+        return landed
 
     def _flattener(self, schema: Schema) -> Flattener:
         return Flattener(schema, self.driver.vocab, bucket=self._bucket,
@@ -763,6 +876,7 @@ class ShardedEvaluator:
             use_pallas = False
 
         def fused(tables_buf, cols_buf, table_cols: dict, mask_bits):
+            self.trace_count += 1  # runs at TRACE time only
             cols = unpack_transfer_cols(cols_buf, cols_layout, pad_n)
             cols.update(table_cols)
             tables = unpack_flat_tables(tables_buf, tables_layout,
@@ -829,6 +943,7 @@ class ShardedEvaluator:
 
         def fused(tables_buf, cols_buf, table_cols: dict, mask_bits,
                   budget):
+            self.trace_count += 1  # runs at TRACE time only
             cols = unpack_transfer_cols(cols_buf, cols_layout, pad_n)
             cols.update(table_cols)
             tables = unpack_flat_tables(tables_buf, tables_layout,
@@ -1393,9 +1508,16 @@ class ShardedEvaluator:
                 hit_cap = hit_bucket(guess, c_off * k_eff)
             budget_dev = jax.device_put(
                 budget_np, NamedSharding(self.mesh, P(None)))
-            result = self._sweep_fn_reduced(
+            nfns0 = len(self._sweep_fns)
+            fn = self._sweep_fn_reduced(
                 kinds, k, complete, hit_cap, cols_layout, tables_layout,
-                pad_n, progs=progs)(
+                pad_n, progs=progs)
+            if len(self._sweep_fns) != nfns0:
+                self._record_warm(
+                    ("reduced", kinds, k, complete, hit_cap, cols_layout,
+                     tables_layout, pad_n),
+                    cols_bufs, tables_bufs, table_cols, mask, budget_np)
+            result = fn(
                 tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev,
                 budget_dev
             )
@@ -1407,8 +1529,15 @@ class ShardedEvaluator:
             pending.host_occ = host_occ_np
             pending.budget_np = None if complete else budget_np
             return pending
-        result = self._sweep_fn(kinds, k, return_bits, cols_layout,
-                                tables_layout, pad_n, progs=progs)(
+        nfns0 = len(self._sweep_fns)
+        fn = self._sweep_fn(kinds, k, return_bits, cols_layout,
+                            tables_layout, pad_n, progs=progs)
+        if len(self._sweep_fns) != nfns0:
+            self._record_warm(
+                ("masks", kinds, k, return_bits, cols_layout,
+                 tables_layout, pad_n),
+                cols_bufs, tables_bufs, table_cols, mask, None)
+        result = fn(
             tables_bufs_dev, cols_bufs_dev, table_cols_dev, mask_dev
         )
         self._perf_add("dispatch", time.perf_counter() - t0)

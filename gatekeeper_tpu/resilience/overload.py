@@ -599,6 +599,17 @@ class OverloadController:
             self._slo_input = fn
             self._pressure_locked()
 
+    def set_qos_ledger_clock(self, clock, half_life_s: float) -> None:
+        """``--qos-ledger-decay slo-window``: drive the displacement
+        ledger's decay from the SLO engine's window clock (totals halve
+        per elapsed ``half_life_s``) instead of the event counter —
+        "heaviest tenant" then ages on the same timebase the burn-rate
+        windows use.  No-op with QoS off; ``clock=None`` restores the
+        bit-identical event-count default."""
+        with self._cv:
+            if self._ledger_qos is not None:
+                self._ledger_qos.set_clock(clock, half_life_s)
+
     def refresh_pressure(self) -> int:
         """Recompute the brownout level outside a queue event (the SLO
         engine calls this each tick so burn changes move the ladder even

@@ -244,19 +244,60 @@ class TenantCostLedger:
     input for displacement.  Decay is by EVENT COUNT (every
     ``half_every`` charges all totals halve), not wall time, so a
     replayed admission sequence reproduces the exact heaviness
-    trajectory."""
+    trajectory.
+
+    ``set_clock`` (``--qos-ledger-decay slo-window``) arms the optional
+    WALL-WINDOW decay driver instead: totals halve once per elapsed
+    ``half_life_s`` of the supplied clock — the SLO engine's window
+    clock, so "heaviest" ages on the same timebase the burn-rate
+    windows use, and an idle gap forgets a past burst the way a burn
+    window does (event-count decay can hold a dead tenant heavy
+    forever when traffic stops).  Unarmed (the default) the ledger is
+    bit-identical to the event-count behavior."""
 
     def __init__(self, half_every: int = 512):
         self.half_every = max(1, int(half_every))
         self._cost: dict = {}
         self._n = 0
+        # wall-window decay driver (None = event-count decay)
+        self._clock = None
+        self._half_life_s = 0.0
+        self._last_half = 0.0
+
+    def set_clock(self, clock, half_life_s: float) -> None:
+        """Arm (or, with ``clock=None``, disarm) wall-window decay."""
+        if clock is None or half_life_s <= 0:
+            self._clock = None
+            self._half_life_s = 0.0
+            return
+        self._clock = clock
+        self._half_life_s = float(half_life_s)
+        self._last_half = clock()
+
+    def _halve(self) -> None:
+        self._cost = {t: c / 2.0 for t, c in self._cost.items()
+                      if c / 2.0 > 1.0}
 
     def charge(self, tenant: str, cost: float) -> None:
+        if self._clock is not None:
+            # elapsed windows predate this charge: decay FIRST, then
+            # land the new cost at full weight
+            now = self._clock()
+            while now - self._last_half >= self._half_life_s:
+                self._halve()
+                self._last_half += self._half_life_s
+                if not self._cost:
+                    # nothing left to decay: snap the window forward so
+                    # a long idle gap costs O(1), not O(gap/half_life)
+                    self._last_half = now
+                    break
+            self._cost[tenant] = self._cost.get(tenant, 0.0) \
+                + max(0.0, cost)
+            return
         self._cost[tenant] = self._cost.get(tenant, 0.0) + max(0.0, cost)
         self._n += 1
         if self._n % self.half_every == 0:
-            self._cost = {t: c / 2.0 for t, c in self._cost.items()
-                          if c / 2.0 > 1.0}
+            self._halve()
 
     def totals(self) -> dict:
         return dict(self._cost)

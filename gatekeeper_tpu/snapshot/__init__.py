@@ -2,6 +2,11 @@
 snapshot (see :mod:`gatekeeper_tpu.snapshot.store` for the design)."""
 
 from gatekeeper_tpu.snapshot.ingest import WatchIngester, gvks_of  # noqa: F401
+from gatekeeper_tpu.snapshot.persist import (  # noqa: F401
+    SnapshotSpill,
+    SnapshotSpiller,
+    templates_digest,
+)
 from gatekeeper_tpu.snapshot.store import (  # noqa: F401
     ClusterSnapshot,
     GroupStore,

@@ -19,31 +19,67 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, Optional, Sequence
 
+from gatekeeper_tpu.utils.unstructured import gvk_of
+
 
 class WatchIngester:
-    """Fan-in of per-GVK watch subscriptions into a ClusterSnapshot."""
+    """Fan-in of per-GVK watch subscriptions into a ClusterSnapshot.
+
+    ``from_rvs`` (``{gvk: resourceVersion}``, the snapshot spill's rv
+    high-water marks) makes a restart cold-start-free on the watch side
+    too: sources that support it (``KubeCluster``) resubscribe straight
+    FROM the recorded rv — no initial list, missed events replay off the
+    server's watch cache — seeding the vanished-object diff with the
+    spilled keys so a 410-forced relist still synthesizes DELETEDs.
+    Sources without rv resume fall back to a full replay, which the
+    snapshot's no-op-patch detection absorbs.
+
+    ``rvs`` tracks the newest resourceVersion seen per GVK (event
+    objects advance it) — the value the next spill records."""
 
     def __init__(self, snapshot, source, gvks: Sequence[tuple],
-                 on_error: Optional[Callable[[Exception], None]] = None):
+                 on_error: Optional[Callable[[Exception], None]] = None,
+                 from_rvs: Optional[dict] = None):
         self.snapshot = snapshot
         self.source = source
         self.gvks = list(gvks)
         self.on_error = on_error
+        self.from_rvs = dict(from_rvs or {})
+        # gvk -> newest seen resourceVersion; starts at the resume marks
+        # so a quiet restart's next spill keeps the spilled rvs
+        self.rvs: dict = dict(self.from_rvs)
         self._cancels: list = []
         self._lock = threading.Lock()
         self.events_seen = 0
 
     def _on_event(self, ev) -> None:
         self.events_seen += 1
+        rv = ((ev.obj.get("metadata") or {})
+              .get("resourceVersion", "")) or ""
+        if rv:
+            self.rvs[gvk_of(ev.obj)] = rv
         self.snapshot.enqueue(ev.type, ev.obj)
+
+    def _subscribe(self, gvk: tuple):
+        rv = self.from_rvs.get(gvk, "")
+        # a warm-loaded snapshot always seeds the vanished-object diff
+        # (spilled keys the source no longer holds must synthesize
+        # DELETED) even when the source records no rv marks — only the
+        # list-skip needs a real rv to resume from
+        if rv or getattr(self.snapshot, "warm_loaded", False):
+            try:
+                return self.source.subscribe(
+                    gvk, self._on_event, replay=True, from_rv=rv,
+                    seed_known=self.snapshot.keys_for_gvk(gvk))
+            except TypeError:
+                pass  # source without warm resume: full replay below
+        return self.source.subscribe(gvk, self._on_event, replay=True)
 
     def start(self) -> "WatchIngester":
         with self._lock:
             for gvk in self.gvks:
                 try:
-                    self._cancels.append(
-                        self.source.subscribe(gvk, self._on_event,
-                                              replay=True))
+                    self._cancels.append(self._subscribe(gvk))
                 except Exception as e:  # noqa: PERF203
                     if self.on_error is not None:
                         self.on_error(e)

@@ -1,0 +1,465 @@
+"""Snapshot spill: cold-start-free restarts for the audit data plane.
+
+PR 6 cut the steady-state sweep to O(churn) and PR 12's compile cache
+cut the restart COMPILE cost to zero — but a restarted auditor still
+relists + reflattens the world before its first sweep (SNAPSHOT_BENCH:
+3.42s for 20k objects, and that is the cheap part of a real cluster).
+This module spills the complete resident audit state to disk and loads
+it back on boot:
+
+- per-group tall ColumnBatches, trimmed to real extents and re-padded to
+  capacity on load (``GroupStore.export_rows``/``import_rows``);
+- the interned vocab string table (sid arrays point into it — the
+  current vocab must be a PREFIX of the spilled one, exactly the
+  CompileCache replay rule, so template-boot interning composes);
+- the RowIdMap with its high-water mark (monotone ids survive restart,
+  so gid-keyed verdicts and phase-2 interning stay valid and a
+  post-restart create can never collide with a retired id);
+- tombstone/dirty sets and the per-(constraint, row) VerdictStore
+  (loaded rows are CLEAN with their persisted verdicts — the first tick
+  re-evaluates nothing);
+- the per-GVK resourceVersion high-water mark, so the watch ingester
+  resubscribes FROM the spill's rv instead of list+replaying; a server
+  that compacted past it answers 410 and the PR 6 ``watch_iter`` seam's
+  relist + synthetic-DELETE fallback doubles as stale-spill recovery;
+- (optional) the external-data ProviderColumns with per-key remaining
+  TTL, so a warm restart re-fetches only what actually expired.
+
+Integrity mirrors :class:`~gatekeeper_tpu.drivers.generation.
+CompileCache`: content sha256 per section, format / flatten-schema /
+jax-version fields plus the constraint-set and template-set digests in
+the header, per-group schema digests validated against the freshly
+derived plan.  A corrupt or drifted spill is DELETED and the boot falls
+back to a clean relist — it is never served.  Writes are atomic
+(tmp + rename, header last) so a crashed writer leaves no torn spill.
+
+:class:`SnapshotSpiller` runs the pickling + write on a daemon worker:
+the audit thread only pays the under-lock array capture (memcpy), so
+steady-state ticks are untouched.  Spills happen after each clean
+resync and at drain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Optional, Sequence
+
+from gatekeeper_tpu.ops.flatten import FLATTEN_SCHEMA_VERSION
+
+# bump when the on-disk spill layout changes
+SPILL_FORMAT = 1
+
+HEADER = "snapshot.json"
+
+# miss reasons for gatekeeper_snapshot_spill_load_miss_count{reason}
+MISS_COLD = "cold"          # no spill on disk
+MISS_CORRUPT = "corrupt"    # unreadable header / section sha / pickle fail
+MISS_VERSION = "version"    # format / flatten-schema / jax drift
+MISS_PLAN = "plan"          # constraint- or template-set digest drift
+MISS_VOCAB = "vocab"        # spilled vocab not replayable here
+MISS_SCHEMA = "schema"      # a group's schema digest drifted
+
+
+def templates_digest(client) -> str:
+    """Template-set digest of a client's loaded templates — the header
+    guard against template drift that leaves the constraint spec AND the
+    lowered schemas unchanged (e.g. a message-text edit) but would make
+    persisted verdicts stale."""
+    from gatekeeper_tpu.drivers.generation import (template_digest,
+                                                   template_set_digest)
+
+    try:
+        return template_set_digest(
+            template_digest(t) for t in client.templates())
+    except Exception:
+        return ""
+
+
+def _gvk_key(gvk: tuple) -> str:
+    return "|".join(gvk)
+
+
+def _gvk_unkey(s: str) -> tuple:
+    return tuple(s.split("|", 2))
+
+
+class SnapshotSpill:
+    """One spill directory: versioned header + sha256-guarded sections.
+
+    Layout::
+
+        DIR/snapshot.json       header (format/version fields, digests,
+                                per-section sha256+bytes, rv marks)
+        DIR/snapshot.rows.pkl   groups + RowIdMap + verdicts + dirty set
+        DIR/snapshot.vocab.pkl  the interned string table
+        DIR/snapshot.aux.pkl    optional: extdata columns, generated
+                                verdicts
+
+    The header is written LAST (tmp + rename), so its presence commits
+    the spill; a load that finds any section torn, truncated or
+    tampered deletes the whole spill and reports a miss.
+    """
+
+    def __init__(self, root: str, metrics=None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.metrics = metrics
+        self.load_hits = 0
+        self.load_misses = 0
+        self.miss_reasons: dict = {}
+        self.spill_count = 0
+        self.last_spill_s = 0.0
+        self.last_spill_bytes = 0
+
+    # --- paths / accounting -------------------------------------------
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def _sections(self) -> tuple:
+        return ("snapshot.rows.pkl", "snapshot.vocab.pkl",
+                "snapshot.aux.pkl")
+
+    def _count(self, hit: bool, reason: str = "") -> None:
+        if hit:
+            self.load_hits += 1
+        else:
+            self.load_misses += 1
+            self.miss_reasons[reason] = \
+                self.miss_reasons.get(reason, 0) + 1
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            if hit:
+                self.metrics.inc_counter(M.SNAPSHOT_SPILL_LOAD_HITS)
+            else:
+                self.metrics.inc_counter(M.SNAPSHOT_SPILL_LOAD_MISS,
+                                         {"reason": reason})
+
+    def _reject(self, reason: str) -> None:
+        """A corrupt/drifted spill is deleted so the next clean spill
+        replaces it — it must never be half-served."""
+        self._count(False, reason)
+        self.delete()
+
+    def delete(self) -> None:
+        for name in (HEADER,) + self._sections():
+            try:
+                os.remove(self._path(name))
+            except OSError:
+                pass
+
+    @staticmethod
+    def _versions() -> tuple:
+        import jax
+
+        try:
+            import jaxlib
+
+            jl = getattr(jaxlib, "__version__", "?")
+        except Exception:
+            jl = "?"
+        return jax.__version__, jl
+
+    # --- capture (audit thread, under the snapshot lock) ---------------
+    def capture(self, snapshot, rvs: Optional[dict] = None,
+                extdata_lane=None, aux: Optional[dict] = None,
+                templates: str = "") -> dict:
+        """Assemble the spill state.  Array copies happen inside
+        ``snapshot.export_state`` under its lock; everything here is
+        cheap bookkeeping — pickling is :meth:`write`'s job."""
+        state = snapshot.export_state()
+        vocab = snapshot.evaluator.driver.vocab
+        ext = None
+        if extdata_lane is not None:
+            try:
+                ext = extdata_lane.export_columns()
+            except Exception:
+                ext = None
+        return {
+            "state": state,
+            "vocab": list(vocab._to_str),
+            "rvs": dict(rvs or {}),
+            "aux": dict(aux or {}),
+            "extdata": ext,
+            "templates": templates,
+        }
+
+    # --- write (off-thread safe: no snapshot state touched) -------------
+    def write(self, captured: dict) -> dict:
+        """Pickle + sha + atomic write.  Returns spill stats; failures
+        are swallowed into the stats (a failed spill must never take the
+        audit plane down — the previous spill, if any, stays intact
+        because every replace is atomic and the header goes last)."""
+        from gatekeeper_tpu.observability import tracing
+
+        t0 = time.perf_counter()
+        state = captured["state"]
+        with tracing.span("snapshot.spill", rows=state.get("rows", 0)):
+            try:
+                jv, jlv = self._versions()
+                payloads = {
+                    "snapshot.rows.pkl": pickle.dumps(state),
+                    "snapshot.vocab.pkl": pickle.dumps(captured["vocab"]),
+                    "snapshot.aux.pkl": pickle.dumps(
+                        {"aux": captured.get("aux") or {},
+                         "extdata": captured.get("extdata")}),
+                }
+                header = {
+                    "format": SPILL_FORMAT,
+                    "flatten_schema_version": FLATTEN_SCHEMA_VERSION,
+                    "jax": jv, "jaxlib": jlv,
+                    "templates": captured.get("templates", ""),
+                    "rows": state.get("rows", 0),
+                    "rv": {_gvk_key(g): rv
+                           for g, rv in captured["rvs"].items()},
+                    "sections": {
+                        name: {"sha256": hashlib.sha256(raw).hexdigest(),
+                               "bytes": len(raw)}
+                        for name, raw in payloads.items()},
+                    "saved_at": time.time(),
+                }
+                for name, raw in payloads.items():
+                    tmp = self._path(name) + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(raw)
+                    os.replace(tmp, self._path(name))
+                tmp = self._path(HEADER) + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(header, f)
+                os.replace(tmp, self._path(HEADER))
+            except Exception as e:
+                return {"ok": False, "error": str(e)}
+        dt = time.perf_counter() - t0
+        nbytes = sum(len(raw) for raw in payloads.values())
+        self.spill_count += 1
+        self.last_spill_s = dt
+        self.last_spill_bytes = nbytes
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.set_gauge(M.SNAPSHOT_SPILL_SECONDS, dt)
+            self.metrics.set_gauge(M.SNAPSHOT_SPILL_BYTES, nbytes)
+        return {"ok": True, "seconds": dt, "bytes": nbytes,
+                "rows": state.get("rows", 0)}
+
+    def save(self, snapshot, rvs: Optional[dict] = None,
+             extdata_lane=None, aux: Optional[dict] = None,
+             templates: str = "") -> dict:
+        """Synchronous capture + write (benches, tests, drain flush)."""
+        return self.write(self.capture(snapshot, rvs=rvs,
+                                       extdata_lane=extdata_lane,
+                                       aux=aux, templates=templates))
+
+    # --- load -----------------------------------------------------------
+    def load(self, snapshot, constraints: Sequence,
+             extdata_lane=None, templates: str = "") -> Optional[dict]:
+        """Validate + adopt a spill into ``snapshot``.
+
+        Returns ``{"rows", "rvs", "aux"}`` on a hit (the snapshot is now
+        warm: ``stale`` False, rows clean, verdicts resident), or None
+        on any miss — reason counted in
+        ``gatekeeper_snapshot_spill_load_miss_count{reason}`` and, for
+        corrupt/drifted spills, the files deleted.  The caller falls
+        back to the normal relist boot; nothing about the snapshot
+        changed on a miss."""
+        from gatekeeper_tpu.observability import tracing
+
+        with tracing.span("snapshot.load") as sp:
+            out = self._load_impl(snapshot, constraints, extdata_lane,
+                                  templates)
+            sp.set_attribute("hit", out is not None)
+            if out is not None:
+                sp.set_attribute("rows", out["rows"])
+            return out
+
+    def _load_impl(self, snapshot, constraints, extdata_lane,
+                   templates) -> Optional[dict]:
+        header_p = self._path(HEADER)
+        if not os.path.exists(header_p):
+            self._count(False, MISS_COLD)
+            return None
+        try:
+            with open(header_p) as f:
+                header = json.load(f)
+        except Exception:
+            self._reject(MISS_CORRUPT)
+            return None
+        jv, jlv = self._versions()
+        if (header.get("format") != SPILL_FORMAT
+                or header.get("flatten_schema_version")
+                != FLATTEN_SCHEMA_VERSION
+                or header.get("jax") != jv
+                or header.get("jaxlib") != jlv):
+            self._reject(MISS_VERSION)
+            return None
+        if header.get("templates", "") != templates:
+            self._reject(MISS_PLAN)
+            return None
+        sections: dict = {}
+        for name, meta in (header.get("sections") or {}).items():
+            try:
+                with open(self._path(name), "rb") as f:
+                    raw = f.read()
+            except OSError:
+                self._reject(MISS_CORRUPT)
+                return None
+            if hashlib.sha256(raw).hexdigest() != meta.get("sha256"):
+                self._reject(MISS_CORRUPT)
+                return None
+            try:
+                sections[name] = pickle.loads(raw)
+            except Exception:
+                self._reject(MISS_CORRUPT)
+                return None
+        state = sections.get("snapshot.rows.pkl")
+        vocab_snap = sections.get("snapshot.vocab.pkl")
+        auxpack = sections.get("snapshot.aux.pkl") or {}
+        if state is None or vocab_snap is None:
+            self._reject(MISS_CORRUPT)
+            return None
+        # constraint-set currency: the spilled digest must equal the
+        # digest of the LIVE constraint set (spec + lowered kinds) — a
+        # changed set means the verdicts/grouping no longer apply
+        if state.get("digest") != snapshot._cons_digest(constraints):
+            self._reject(MISS_PLAN)
+            return None
+        # vocab replay (the CompileCache rule): current interned strings
+        # must be the spill's prefix, then the tail interns in recorded
+        # order so every resident sid points at the same string here
+        vocab = snapshot.evaluator.driver.vocab
+        cur = vocab._to_str
+        if len(cur) > len(vocab_snap) or vocab_snap[: len(cur)] != cur:
+            self._count(False, MISS_VOCAB)  # spill itself is fine
+            return None
+        for s in vocab_snap[len(cur):]:
+            vocab.intern(s)
+        try:
+            rows = snapshot.adopt_spill(constraints, state)
+        except ValueError:
+            self._reject(MISS_SCHEMA)
+            return None
+        if extdata_lane is not None and auxpack.get("extdata"):
+            try:
+                # downtime consumes the spilled keys' remaining TTL:
+                # what expired while the process was down drops here
+                elapsed = max(0.0, time.time()
+                              - float(header.get("saved_at", 0.0)))
+                extdata_lane.import_columns(auxpack["extdata"],
+                                            elapsed_s=elapsed)
+            except Exception:
+                pass  # extdata re-fetches through the bulk path
+        self._count(True)
+        return {
+            "rows": rows,
+            "rvs": {_gvk_unkey(k): rv
+                    for k, rv in (header.get("rv") or {}).items()},
+            "aux": auxpack.get("aux") or {},
+        }
+
+    def stats(self) -> dict:
+        return {"load_hits": self.load_hits,
+                "load_misses": self.load_misses,
+                "miss_reasons": dict(self.miss_reasons),
+                "spills": self.spill_count,
+                "last_spill_s": self.last_spill_s,
+                "last_spill_bytes": self.last_spill_bytes}
+
+
+class SnapshotSpiller:
+    """Off-audit-thread spill writer.
+
+    ``spill()`` captures the state under the snapshot lock (array
+    copies only) and enqueues it; a daemon worker pickles + writes.
+    Coalescing: a request arriving while one is queued replaces it (the
+    newest capture wins — spills are full-state, not deltas).  ``wait``
+    blocks for the write (drain flush, benches)."""
+
+    def __init__(self, spill: SnapshotSpill, snapshot,
+                 rvs_fn=None, extdata_lane=None, aux_fn=None,
+                 templates_fn=None):
+        self.spill = spill
+        self.snapshot = snapshot
+        self.rvs_fn = rvs_fn
+        self.extdata_lane = extdata_lane
+        self.aux_fn = aux_fn
+        self.templates_fn = templates_fn
+        self._cv = threading.Condition()
+        self._pending: Optional[dict] = None
+        self._busy = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self.last_result: Optional[dict] = None
+
+    def _capture(self) -> dict:
+        rvs = self.rvs_fn() if self.rvs_fn is not None else None
+        aux = self.aux_fn() if self.aux_fn is not None else None
+        templates = self.templates_fn() if self.templates_fn is not None \
+            else ""
+        return self.spill.capture(self.snapshot, rvs=rvs,
+                                  extdata_lane=self.extdata_lane,
+                                  aux=aux, templates=templates)
+
+    def spill_now(self) -> dict:
+        """Synchronous capture + write on the calling thread (drain)."""
+        result = self.spill.write(self._capture())
+        with self._cv:
+            self.last_result = result
+        return result
+
+    def request(self, wait: bool = False) -> None:
+        """Capture now (cheap, on the caller), write in the background.
+        The first call lazily starts the worker."""
+        captured = self._capture()
+        with self._cv:
+            if self._stopped:
+                return
+            self._pending = captured
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="snapshot-spill", daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+            if wait:
+                while self._pending is not None or self._busy:
+                    self._cv.wait(0.05)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._stopped:
+                    self._cv.wait(0.5)
+                if self._pending is None and self._stopped:
+                    return
+                captured, self._pending = self._pending, None
+                self._busy = True
+            try:
+                result = self.spill.write(captured)
+            except Exception as e:  # never take the process down
+                result = {"ok": False, "error": str(e)}
+            with self._cv:
+                self.last_result = result
+                self._busy = False
+                self._cv.notify_all()
+                if self._pending is None and self._stopped:
+                    return
+
+    def stop(self, flush: bool = True) -> None:
+        """Stop the worker; with ``flush`` (the drain path) a final
+        spill writes synchronously first, so a clean SIGTERM never loses
+        the resident state it just paid to build."""
+        if flush:
+            try:
+                self.spill_now()
+            except Exception:
+                pass
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)

@@ -149,6 +149,29 @@ _PLACEHOLDERS = {
     "parent_idx": lambda: ParentIdxColumn(None),
 }
 
+# pad fill per (family, field) — the static twin of the fills
+# _iter_arrays yields off a live batch, used when a spilled (trimmed)
+# array is re-padded back to capacity on load (snapshot/persist.py)
+_FAM_FILLS = {
+    ("scalars", "kind"): 0, ("scalars", "num"): 0.0,
+    ("scalars", "sid"): -1,
+    ("raggeds", "kind"): 0, ("raggeds", "num"): 0.0,
+    ("raggeds", "sid"): -1,
+    ("axis_counts", None): 0,
+    ("keysets", "sid"): -1, ("keysets", "count"): 0,
+    ("ragged_keysets", "sid"): -1, ("ragged_keysets", "count"): 0,
+    ("map_keys", "sid"): -1,
+    ("parent_idx", "idx"): -1,
+    ("canons", None): -2,
+}
+
+
+def _fill_for(path):
+    fam, spec, field = path
+    if fam == "ident":
+        return 0 if spec == "has_generate_name" else -1
+    return _FAM_FILLS[(fam, field)]
+
 
 def _set_arr(batch: ColumnBatch, path, arr) -> None:
     fam, spec, field = path
@@ -459,6 +482,75 @@ class GroupStore:
             self.flattener._apply_alias(out)
         return out
 
+    # --- spill (snapshot/persist.py) ----------------------------------
+    def schema_digest(self) -> str:
+        """Digest of this group's columnize plan — the load-time guard
+        that a spilled group's arrays still mean what the CURRENT
+        template set's schemas say they mean (template drift with an
+        unchanged constraint spec would otherwise misread columns)."""
+        from gatekeeper_tpu.drivers.generation import schema_digest
+
+        return schema_digest(self.schema)
+
+    def export_rows(self) -> dict:
+        """Spill payload of one group: every stored array trimmed to the
+        used slots (capacity padding is layout, not data — it re-pads on
+        load), plus the slot bookkeeping and raw object refs.  Array
+        copies happen here, under the snapshot lock; pickling happens
+        off-thread."""
+        n = self.n_rows
+        arrays: dict = {}
+        if self.batch is not None:
+            for path, arr, _fill in _iter_arrays(self.batch):
+                arrays[path] = np.ascontiguousarray(arr[:n])
+        refs: list = []
+        for ref in self.objrefs:
+            if ref is None:
+                refs.append(None)
+            elif isinstance(ref, (bytes, bytearray, memoryview)):
+                refs.append(bytes(ref))
+            elif isinstance(ref, RawJSON):
+                refs.append(bytes(ref.raw))
+            else:
+                refs.append(ref)
+        return {
+            "kinds": sorted(self.group),
+            "lowered": list(self.lowered),
+            "schema": self.schema_digest(),
+            "n_rows": n,
+            "gids": list(self.gids),
+            "live": list(self.live),
+            "objrefs": refs,
+            "arrays": arrays,
+        }
+
+    def import_rows(self, payload: dict) -> None:
+        """Adopt a spilled group's rows into this (freshly constructed)
+        store: re-pad the trimmed arrays to a pow2 capacity with the
+        family fills.  The caller validated ``schema``/``lowered``
+        against this store's freshly derived plan first — arrays written
+        under a different plan must never be adopted."""
+        n = int(payload["n_rows"])
+        arrays = payload["arrays"]
+        if arrays:
+            cap = 64
+            while cap < n:
+                cap *= 2
+            base = ColumnBatch(n=cap, scalars={}, raggeds={},
+                               axis_counts={}, keysets={})
+            for path, arr in arrays.items():
+                full = np.full((cap,) + arr.shape[1:], _fill_for(path),
+                               arr.dtype)
+                full[:n] = arr
+                _set_arr(base, path, full)
+            self.batch = base
+            self.cap = cap
+        self.n_rows = n
+        self.gids = list(payload["gids"])
+        self.live = list(payload["live"])
+        self.objrefs = list(payload["objrefs"])
+        self.tombstones = sum(1 for alive in self.live if not alive)
+
 
 class VerdictStore:
     """Per-(constraint, row) audit results, keyed by stable row id.
@@ -497,6 +589,30 @@ class VerdictStore:
         self._rows.clear()
         self._by_gid.clear()
 
+    def export_state(self) -> list:
+        """[(con_key, [(gid, count, msgs)])] — the spill's verdict
+        section (rendered msgs ride along so a warm boot's first kept
+        derivation pays zero renders for already-rendered rows)."""
+        return [(ck, [(gid, v[0], v[1]) for gid, v in rows.items()])
+                for ck, rows in self._rows.items()]
+
+    def restore(self, state: list) -> None:
+        """Bulk-build the maps (a 20k-row spill carries ~100k verdict
+        entries; per-entry ``set()`` calls measured 0.5s of the 1s
+        load — dict comprehensions do the same work in ~0.1s)."""
+        self._rows = {ck: {gid: [count, msgs]
+                           for gid, count, msgs in rows}
+                      for ck, rows in state}
+        by_gid: dict = {}
+        for ck, rows in self._rows.items():
+            for gid in rows:
+                hit = by_gid.get(gid)
+                if hit is None:
+                    by_gid[gid] = {ck}
+                else:
+                    hit.add(ck)
+        self._by_gid = by_gid
+
 
 class ClusterSnapshot:
     """The process-wide resident snapshot: groups + identity + dirty set.
@@ -529,6 +645,11 @@ class ClusterSnapshot:
         self.generation = 0
         self.patch_count = 0
         self.rechunk_count = 0  # plan changes absorbed without a relist
+        # True after adopt_spill: the resident state came off a disk
+        # spill (snapshot/persist.py) — the audit loop's FIRST pass can
+        # be an incremental tick (rows are clean, verdicts persisted)
+        # instead of the O(cluster) full build+evaluate
+        self.warm_loaded = False
 
     # --- constraint set currency ---------------------------------------
     def _cons_digest(self, constraints) -> tuple:
@@ -790,6 +911,79 @@ class ClusterSnapshot:
             self.stale = False
             self.generation += 1
             return self.live_count()
+
+    # --- spill export / adopt (snapshot/persist.py) ----------------------
+    def export_state(self) -> dict:
+        """Capture the complete resident state for a disk spill, under
+        the lock: group arrays (trimmed copies), identity map, verdicts,
+        dirty set, constraint digest.  The capture copies every array
+        (memcpy-fast) so the caller can pickle + write OFF the audit
+        thread without holding the lock."""
+        with self.lock:
+            return {
+                "digest": self._digest,
+                "ids": self.ids.export_state(),
+                "dirty": sorted(self._dirty),
+                "verdicts": self.verdicts.export_state(),
+                "groups": [store.export_rows()
+                           for store in self._groups.values()],
+                "rows": self.live_count(),
+            }
+
+    def adopt_spill(self, constraints: Sequence, state: dict) -> int:
+        """Install a validated spill: fresh GroupStores re-derive their
+        schemas from the LIVE constraint set, adopt the spilled arrays,
+        and every loaded row is clean with its persisted verdicts — the
+        next tick serves resident rows with zero relist and zero
+        flatten.  Raises ``ValueError`` (nothing committed) when any
+        group's freshly derived plan disagrees with the plan its arrays
+        were written under; the caller treats that as a spill miss."""
+        from gatekeeper_tpu.parallel.sharded import make_kind_router
+
+        router = make_kind_router(constraints)
+        cons = list(constraints)
+        stores: dict = {}
+        pos: dict = {}
+        for payload in state["groups"]:
+            g = frozenset(payload["kinds"])
+            store = GroupStore(g, cons, self.evaluator,
+                               intern_cache=self.intern_cache)
+            if list(store.lowered) != list(payload["lowered"]):
+                raise ValueError(
+                    f"group {sorted(g)!r}: lowered set drifted")
+            if store.lowered and \
+                    store.schema_digest() != payload["schema"]:
+                raise ValueError(
+                    f"group {sorted(g)!r}: schema digest drifted")
+            store.import_rows(payload)
+            stores[g] = store
+            for p, (gid, alive) in enumerate(zip(store.gids, store.live)):
+                if alive:
+                    pos[gid] = (store, p)
+        with self.lock:
+            self._digest = state["digest"]
+            self._constraints = cons
+            self._router = router
+            self._groups = stores
+            self._pos = pos
+            self.ids.restore(state["ids"])
+            self.verdicts.restore(state["verdicts"])
+            if self.intern_cache is not None:
+                self.intern_cache.clear()
+            self._dirty = set(state["dirty"])
+            self.stale = False
+            self.warm_loaded = True
+            self.generation += 1
+            return self.live_count()
+
+    def keys_for_gvk(self, gvk: tuple) -> list:
+        """(namespace, name) keys of every known object of one GVK — the
+        seed for a warm watch resubscription's vanished-object diff (a
+        410 relist must synthesize DELETED for spilled rows the fresh
+        list no longer carries)."""
+        with self.lock:
+            return [(ns, name) for (g, ns, name) in self.ids.uids()
+                    if g == gvk]
 
     # --- sweep-facing reads ----------------------------------------------
     def routed_stores(self) -> list:

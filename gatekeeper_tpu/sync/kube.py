@@ -487,16 +487,26 @@ class KubeCluster:
                 raise
 
     def subscribe(self, gvk: tuple, callback: Callable[[Event], None],
-                  replay: bool = True) -> Callable[[], None]:
+                  replay: bool = True, from_rv: str = "",
+                  seed_known: Optional[Iterable[tuple]] = None
+                  ) -> Callable[[], None]:
         """List + replay, then stream WATCH events on a daemon thread.
         Returns a cancel function (stops the thread AND closes its live
-        stream so the socket doesn't linger until the server timeout)."""
+        stream so the socket doesn't linger until the server timeout).
+
+        ``from_rv`` (snapshot-spill warm resume): skip the initial list
+        and watch straight from that resourceVersion — missed events
+        replay off the server's watch cache; a server that compacted
+        past it answers 410 and the standard relist + synthetic-DELETE
+        recovery runs, diffing against ``seed_known`` (the (ns, name)
+        keys the caller already holds)."""
         stop = threading.Event()
         stream_ref: list = [None]  # the live response, closable by cancel
         entry = (stop, stream_ref)
         thread = threading.Thread(
             target=self._watch_thread,
             args=(gvk, callback, replay, stop, stream_ref, entry),
+            kwargs={"from_rv": from_rv, "seed_known": seed_known},
             daemon=True, name=f"kube-watch-{gvk[2]}",
         )
         with self._lock:
@@ -529,22 +539,28 @@ class KubeCluster:
 
     # --- watch internals ---------------------------------------------
     def _watch_thread(self, gvk, callback, replay, stop, stream_ref,
-                      entry):
+                      entry, from_rv="", seed_known=None):
         try:
-            self._watch_loop(gvk, callback, replay, stop, stream_ref)
+            self._watch_loop(gvk, callback, replay, stop, stream_ref,
+                             from_rv=from_rv, seed_known=seed_known)
         finally:
             with self._lock:
                 if entry in self._watchers:
                     self._watchers.remove(entry)
 
-    def _watch_loop(self, gvk, callback, replay, stop, stream_ref):
+    def _watch_loop(self, gvk, callback, replay, stop, stream_ref,
+                    from_rv="", seed_known=None):
         for ev in self.watch_iter(gvk, replay=replay, stop=stop,
-                                  stream_ref=stream_ref):
+                                  stream_ref=stream_ref, from_rv=from_rv,
+                                  seed_known=seed_known):
             callback(ev)
 
     def watch_iter(self, gvk, replay: bool = True,
                    stop: Optional[threading.Event] = None,
-                   stream_ref: Optional[list] = None) -> Iterable[Event]:
+                   stream_ref: Optional[list] = None,
+                   from_rv: str = "",
+                   seed_known: Optional[Iterable[tuple]] = None
+                   ) -> Iterable[Event]:
         """THE watch seam: a generator of :class:`Event` for one GVK.
 
         List + replay (ADDED), then a streaming WATCH whose resume
@@ -560,41 +576,56 @@ class KubeCluster:
         ``fault_point("kube.watch")`` fires once per stream cycle (an
         injected error with status 410 forces the relist-recovery path);
         repeated stream failures trip the watch circuit breaker, whose
-        open window paces reconnect attempts."""
+        open window paces reconnect attempts.
+
+        ``from_rv`` (spill warm resume): the FIRST cycle watches
+        straight from that rv — zero list calls; ``seed_known`` seeds
+        the vanished-object diff so the 410 recovery path (which is also
+        the stale-spill recovery path) synthesizes DELETED for keys the
+        caller holds that the fresh list no longer carries."""
         from gatekeeper_tpu.resilience.faults import fault_point
 
         stop = stop if stop is not None else threading.Event()
         stream_ref = stream_ref if stream_ref is not None else [None]
-        known: dict = {}  # (ns, name) -> True
-        first = True
+        known: dict = {k: True for k in (seed_known or ())}
+        first = not (from_rv or seed_known)
+        resume_rv = from_rv
         while not stop.is_set() and not self._stopped.is_set():
-            try:
-                objects, rv = self._list_paged(gvk)
-            except Exception:
-                if stop.wait(self.watch_backoff_s):
-                    return
-                continue
-            seen = set()
-            for obj in objects:
-                key = (namespace_of(obj), name_of(obj))
-                seen.add(key)
-                if replay or not first:
-                    if first or key not in known:
-                        yield Event(ADDED, obj)
-                    else:
-                        yield Event(MODIFIED, obj)
-            # objects that vanished while the watch was down (410 window)
-            if not first:
-                for key in set(known) - seen:
-                    ns, name = key
-                    yield Event(DELETED, {
-                        "apiVersion": f"{gvk[0]}/{gvk[1]}" if gvk[0]
-                        else gvk[1],
-                        "kind": gvk[2],
-                        "metadata": {"name": name,
-                                     **({"namespace": ns} if ns else {})},
-                    })
-            known = {k: True for k in seen}
+            if resume_rv:
+                # warm resume: no list — the watch cache replays what we
+                # missed; a compaction past resume_rv 410s into the
+                # relist branch below on the next outer iteration
+                rv, resume_rv = resume_rv, ""
+            else:
+                try:
+                    objects, rv = self._list_paged(gvk)
+                except Exception:
+                    if stop.wait(self.watch_backoff_s):
+                        return
+                    continue
+                seen = set()
+                for obj in objects:
+                    key = (namespace_of(obj), name_of(obj))
+                    seen.add(key)
+                    if replay or not first:
+                        if first or key not in known:
+                            yield Event(ADDED, obj)
+                        else:
+                            yield Event(MODIFIED, obj)
+                # objects that vanished while the watch was down (410
+                # window, or since a stale spill was written)
+                if not first:
+                    for key in set(known) - seen:
+                        ns, name = key
+                        yield Event(DELETED, {
+                            "apiVersion": f"{gvk[0]}/{gvk[1]}" if gvk[0]
+                            else gvk[1],
+                            "kind": gvk[2],
+                            "metadata": {"name": name,
+                                         **({"namespace": ns}
+                                            if ns else {})},
+                        })
+                known = {k: True for k in seen}
             first = False
             # watch from the list's rv; on clean stream end reconnect from
             # the LAST seen rv (standard informer resume) — a full relist

@@ -85,15 +85,33 @@ class FakeCluster:
             return self._objects.get((gvk, namespace, name))
 
     def subscribe(self, gvk: tuple, callback: Callable[[Event], None],
-                  replay: bool = True) -> Callable[[], None]:
+                  replay: bool = True, from_rv: str = "",
+                  seed_known=None) -> Callable[[], None]:
         """Register a watcher; replays current state as ADDED events
-        (watch.replay semantics)."""
+        (watch.replay semantics).  ``from_rv``/``seed_known`` (the
+        KubeCluster warm-resume surface): an in-memory store has no
+        watch cache, so the resume degrades to the full replay — which
+        the snapshot's no-op-patch detection absorbs — plus a synthetic
+        DELETED for every ``seed_known`` key the store no longer holds
+        (the vanished-object diff a real relist recovery yields)."""
         with self._lock:
             self._subscribers.setdefault(gvk, []).append(callback)
             current = [o for (g, _ns, _n), o in self._objects.items()
                        if g == gvk] if replay else []
+            held = {(ns, n) for (g, ns, n) in self._objects
+                    if g == gvk}
         for obj in current:
             callback(Event(ADDED, obj))
+        for ns, name in (seed_known or ()):
+            if (ns, name) not in held:
+                group, version, kind = gvk
+                callback(Event(DELETED, {
+                    "apiVersion": f"{group}/{version}" if group
+                    else version,
+                    "kind": kind,
+                    "metadata": {"name": name,
+                                 **({"namespace": ns} if ns else {})},
+                }))
 
         def cancel():
             with self._lock:

@@ -1,0 +1,576 @@
+"""Snapshot spill persistence: cold-start-free restarts.
+
+1. THE restart differential: build → churn → tick → spill → "restart"
+   into a FRESH driver/vocab/evaluator (compile cache warm) → load →
+   tick, pinned bit-identical to a fresh relist with ZERO list calls,
+   ZERO flatten, ZERO lowerings and ZERO fused-sweep retraces.
+2. Torn/corrupt/stale spills: truncated section, flipped byte,
+   schema-version drift, constraint-set drift — each a counted miss,
+   deleted, and the boot falls back to a clean relist.
+3. Stale-spill recovery: the cluster changed while the process was
+   down — the warm resubscription's replay/diff (synthetic DELETEDs off
+   the spilled key set) reconciles, tick equals a fresh relist.
+4. The kube watch seam: ``from_rv`` resume makes zero list calls; an rv
+   compacted past the spill 410s into the standard relist recovery.
+5. Drain flush, extdata column TTL spill, and the QoS ledger's
+   slo-window decay satellite.
+
+Wall-budget note: one module-scoped corpus (8-template library slice,
+120 objects) and a shared on-disk compile cache keep the fresh-client
+restart test cheap (tier-1 runs ~35s under its timeout).
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.generation import CompileCache, WarmStateCache
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.ops.flatten import Flattener, RowIdMap
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.snapshot import (ClusterSnapshot, SnapshotConfig,
+                                     SnapshotSpill, SnapshotSpiller,
+                                     WatchIngester, gvks_of,
+                                     templates_digest)
+from gatekeeper_tpu.snapshot.persist import (HEADER, MISS_COLD,
+                                             MISS_CORRUPT, MISS_PLAN,
+                                             MISS_VERSION)
+from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
+from gatekeeper_tpu.sync.mock_apiserver import MockApiServer
+from gatekeeper_tpu.sync.source import ADDED, DELETED, FakeCluster
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.synthetic import (library_dir, load_library,
+                                            make_cluster_objects)
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+POD_GVK = ("", "v1", "Pod")
+
+
+def _all_kinds():
+    paths = sorted(
+        glob.glob(os.path.join(library_dir(), "general", "*",
+                               "template.yaml")) +
+        glob.glob(os.path.join(library_dir(), "pod-security-policy", "*",
+                               "template.yaml")))
+    return [load_yaml_file(p)[0]["spec"]["crd"]["spec"]["names"]["kind"]
+            for p in paths]
+
+
+_KEEP = 8  # template-subset client: bounded compile+trace wall (tier-1)
+
+
+def _make_client(cache_dir):
+    skip = tuple(_all_kinds()[_KEEP:])
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel,
+                    compile_cache=CompileCache(str(cache_dir)))
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[AUDIT_EP])
+    load_library(client, skip_kinds=skip)
+    return client, tpu
+
+
+def _snap_manager(client, evaluator, lister, snapshot, spiller=None):
+    return AuditManager(
+        client, lister=lister,
+        config=AuditConfig(audit_source="snapshot", chunk_size=64,
+                           exact_totals=False, pipeline="off"),
+        evaluator=evaluator, snapshot=snapshot, spiller=spiller)
+
+
+def _relist_reference(client, evaluator, lister):
+    return AuditManager(
+        client, lister=lister,
+        config=AuditConfig(chunk_size=64, exact_totals=False,
+                           pipeline="off"),
+        evaluator=evaluator).audit()
+
+
+def _assert_identical(run_a, run_b, limit=20):
+    diff = AuditManager._verdicts_differ_canonical(
+        run_a.kept, run_a.total_violations,
+        run_b.kept, run_b.total_violations, limit)
+    assert diff is None, diff
+
+
+def _churn_labels(cluster, objects, tag, n=10):
+    """Modify the SAME first n objects (layouts repeat across rounds —
+    the zero-retrace pin's precondition)."""
+    for j in range(n):
+        o = copy.deepcopy(objects[j])
+        o.setdefault("metadata", {}).setdefault("labels", {})["churn"] = \
+            tag
+        cluster.apply(o)
+
+
+def wait_for(pred, timeout=10.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """client1 + warm pre-restart state: full pass, one churn tick, the
+    spill and warm state saved to module-scoped dirs."""
+    cache_dir = tmp_path_factory.mktemp("compile-cache")
+    spill_dir = tmp_path_factory.mktemp("spill")
+    client, tpu = _make_client(cache_dir)
+    objects = make_cluster_objects(120, seed=13)
+    cluster = FakeCluster()
+    for o in objects:
+        cluster.apply(copy.deepcopy(o))
+
+    def lister():
+        return iter(cluster.list())
+
+    evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20)
+    snapshot = ClusterSnapshot(evaluator, SnapshotConfig())
+    mgr = _snap_manager(client, evaluator, lister, snapshot)
+    ingester = WatchIngester(snapshot, cluster,
+                             gvks_of(cluster.list())).start()
+    mgr.audit()
+    _churn_labels(cluster, objects, "r0")
+    ingester.pump()
+    tick_run = mgr.audit_tick()
+    spill = SnapshotSpill(str(spill_dir))
+    wrote = spill.save(snapshot, rvs=dict(ingester.rvs),
+                       templates=templates_digest(client))
+    assert wrote["ok"] and wrote["rows"] == 120
+    assert WarmStateCache(str(cache_dir)).save(tpu, evaluator)
+    ctx = {
+        "client": client, "tpu": tpu, "objects": objects,
+        "cluster": cluster, "lister": lister, "evaluator": evaluator,
+        "snapshot": snapshot, "mgr": mgr, "ingester": ingester,
+        "cache_dir": str(cache_dir), "spill_dir": str(spill_dir),
+        "cons": [c for c in client.constraints()
+                 if c.actions_for(AUDIT_EP)],
+        "tdig": templates_digest(client),
+        "tick_run": tick_run,
+    }
+    yield ctx
+    ingester.stop()
+
+
+# --- 0. unit: identity + cold miss -----------------------------------------
+
+def test_rowid_export_restore_keeps_high_water():
+    ids = RowIdMap()
+    a, _ = ids.assign(("k", "ns", "a"))
+    b, _ = ids.assign(("k", "ns", "b"))
+    ids.forget(("k", "ns", "a"))  # retired, never reissued
+    state = ids.export_state()
+    fresh = RowIdMap()
+    fresh.restore(state)
+    assert fresh.get(("k", "ns", "b")) == b
+    assert fresh.get(("k", "ns", "a")) is None
+    nid, created = fresh.assign(("k", "ns", "c"))
+    assert created and nid > max(a, b)  # above every id EVER issued
+
+
+def test_spill_cold_miss_counted(corpus, tmp_path):
+    spill = SnapshotSpill(str(tmp_path / "empty"))
+    snap = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    assert spill.load(snap, corpus["cons"],
+                      templates=corpus["tdig"]) is None
+    assert spill.miss_reasons == {MISS_COLD: 1}
+    assert snap.stale  # untouched on a miss
+
+
+# --- 1. THE restart differential ------------------------------------------
+
+def test_restart_roundtrip_cold_start_free(corpus):
+    """Fresh driver/vocab/evaluator (the real restart shape, compile
+    cache warm): spill load + warm-state replay serve the first tick
+    with zero list calls, zero flatten, zero lowerings, zero fused
+    retraces — verdicts and row ids bit-identical to the pre-restart
+    state and to a fresh relist of the same cluster."""
+    client2, tpu2 = _make_client(corpus["cache_dir"])
+    assert tpu2._compile_cache.misses == 0  # boot answered from disk
+    ev2 = ShardedEvaluator(tpu2, make_mesh(), violations_limit=20)
+    rep = WarmStateCache(corpus["cache_dir"]).replay(tpu2, ev2)
+    assert rep["hit"] and rep["sweep_traces"] > 0
+    snap2 = ClusterSnapshot(ev2, SnapshotConfig())
+    cons2 = [c for c in client2.constraints() if c.actions_for(AUDIT_EP)]
+    spill = SnapshotSpill(corpus["spill_dir"])
+    loaded = spill.load(snap2, cons2, templates=templates_digest(client2))
+    assert loaded is not None and loaded["rows"] == 120
+    assert not snap2.stale and snap2.warm_loaded
+    # row ids survived the restart exactly (gid-keyed verdicts depend
+    # on it)
+    assert dict(snap2.ids._ids) == dict(corpus["snapshot"].ids._ids)
+
+    cluster, objects = corpus["cluster"], corpus["objects"]
+    calls = [0]
+
+    def counting_lister():
+        calls[0] += 1
+        return iter(cluster.list())
+
+    mgr2 = _snap_manager(client2, ev2, counting_lister, snap2)
+    ing2 = WatchIngester(snap2, cluster, gvks_of(cluster.list()),
+                         from_rvs=loaded["rvs"]).start()
+    try:
+        # first tick: NOTHING changed since the spill — zero list, zero
+        # flatten, zero rows evaluated (replay churn absorbs as no-op)
+        flattens = [0]
+        orig_flatten = Flattener.flatten
+
+        def counting_flatten(self, *a, **k):
+            flattens[0] += 1
+            return orig_flatten(self, *a, **k)
+
+        Flattener.flatten = counting_flatten
+        try:
+            tick0 = mgr2.audit_tick()
+        finally:
+            Flattener.flatten = orig_flatten
+        assert calls[0] == 0, "warm boot paid a list call"
+        assert flattens[0] == 0, "warm boot paid a flatten"
+        assert mgr2.perf.get("snapshot_rows_evaluated", 0) == 0
+        _assert_identical(tick0, corpus["tick_run"])
+        # churn the SAME objects the pre-restart process churned: the
+        # tick's layouts repeat, so the replayed traces must absorb it
+        tc0, miss0 = ev2.trace_count, tpu2._compile_cache.misses
+        _churn_labels(cluster, objects, "r1")
+        ing2.pump()
+        tick1 = mgr2.audit_tick()
+        assert calls[0] == 0
+        assert ev2.trace_count == tc0, "post-restart tick retraced"
+        assert tpu2._compile_cache.misses == miss0
+        relist = _relist_reference(client2, ev2, corpus["lister"])
+        _assert_identical(tick1, relist)
+        # columns/vocab prove out row by row (the resync differential)
+        assert snap2.resync_differential(
+            lambda: iter(cluster.list())) is None
+    finally:
+        ing2.stop()
+
+
+# --- 2. stale spill: the cluster moved while the process was down ----------
+# (runs BEFORE the corrupt-spill rebuild below: the rebuild interns the
+# later churn's strings into client1's vocab, after which the pristine
+# spill's vocab is no longer a prefix and would legitimately miss)
+
+
+def test_stale_spill_reconciles_through_replay_diff(corpus):
+    """Load the spill against a cluster that changed since it was
+    written (delete + modify + add): the warm resubscription's replay
+    plus the synthetic-DELETE diff off the spilled key set reconcile
+    the resident rows, and the first tick equals a fresh relist — no
+    verdict divergence, no relist boot."""
+    objects = corpus["objects"]
+    c2 = FakeCluster()
+    for o in corpus["cluster"].list():
+        c2.apply(copy.deepcopy(o))
+    gone = copy.deepcopy(objects[20])
+    c2.delete(gone)
+    changed = copy.deepcopy(objects[21])
+    changed.setdefault("metadata", {}).setdefault(
+        "labels", {})["churn"] = "while-down"
+    c2.apply(changed)
+    newobj = copy.deepcopy(objects[22])
+    newobj["metadata"]["name"] = objects[22]["metadata"]["name"] + "-new"
+    c2.apply(newobj)
+
+    snapX = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    spill = SnapshotSpill(corpus["spill_dir"])
+    loaded = spill.load(snapX, corpus["cons"], templates=corpus["tdig"])
+    assert loaded is not None
+
+    def lister():
+        return iter(c2.list())
+
+    ing = WatchIngester(snapX, c2, gvks_of(c2.list()),
+                        from_rvs=loaded["rvs"]).start()
+    try:
+        mgrX = _snap_manager(corpus["client"], corpus["evaluator"],
+                             lister, snapX)
+        tick = mgrX.audit_tick()
+        relist = _relist_reference(corpus["client"], corpus["evaluator"],
+                                   lister)
+        _assert_identical(tick, relist)
+        # the vanished object's row is gone (synthetic DELETED landed)
+        from gatekeeper_tpu.snapshot import obj_key
+
+        assert snapX.ids.get(obj_key(gone)) is None
+        assert snapX.resync_differential(lambda: iter(c2.list())) is None
+    finally:
+        ing.stop()
+
+
+# --- 3. torn / corrupt / drifted spills ------------------------------------
+
+def _copy_spill(corpus, tmp_path):
+    dst = tmp_path / "spill-copy"
+    shutil.copytree(corpus["spill_dir"], dst)
+    return str(dst)
+
+
+def _load_into_fresh(corpus, spill_dir):
+    spill = SnapshotSpill(spill_dir)
+    snap = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    out = spill.load(snap, corpus["cons"], templates=corpus["tdig"])
+    return spill, snap, out
+
+
+def test_spill_truncated_section_falls_back_to_relist(corpus, tmp_path):
+    d = _copy_spill(corpus, tmp_path)
+    rows_p = os.path.join(d, "snapshot.rows.pkl")
+    with open(rows_p, "r+b") as f:
+        f.truncate(os.path.getsize(rows_p) // 2)
+    spill, snap, out = _load_into_fresh(corpus, d)
+    assert out is None
+    assert spill.miss_reasons == {MISS_CORRUPT: 1}
+    assert not os.path.exists(os.path.join(d, HEADER))  # deleted
+    # the fallback: a clean relist boot, verdicts identical to relist
+    mgr = _snap_manager(corpus["client"], corpus["evaluator"],
+                        corpus["lister"], snap)
+    run = mgr.audit()  # stale snapshot -> rebuild (the relist path)
+    relist = _relist_reference(corpus["client"], corpus["evaluator"],
+                               corpus["lister"])
+    _assert_identical(run, relist)
+
+
+def test_spill_flipped_byte_in_column_section_rejected(corpus, tmp_path):
+    d = _copy_spill(corpus, tmp_path)
+    rows_p = os.path.join(d, "snapshot.rows.pkl")
+    with open(rows_p, "r+b") as f:
+        f.seek(os.path.getsize(rows_p) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    spill, snap, out = _load_into_fresh(corpus, d)
+    assert out is None
+    assert spill.miss_reasons == {MISS_CORRUPT: 1}
+    assert snap.stale
+
+
+def test_spill_schema_version_drift_rejected(corpus, tmp_path):
+    import json
+
+    d = _copy_spill(corpus, tmp_path)
+    hp = os.path.join(d, HEADER)
+    with open(hp) as f:
+        header = json.load(f)
+    header["flatten_schema_version"] += 1
+    with open(hp, "w") as f:
+        json.dump(header, f)
+    spill, snap, out = _load_into_fresh(corpus, d)
+    assert out is None
+    assert spill.miss_reasons == {MISS_VERSION: 1}
+    assert not os.path.exists(hp)
+
+
+def test_spill_constraint_drift_rejected(corpus, tmp_path):
+    d = _copy_spill(corpus, tmp_path)
+    spill = SnapshotSpill(d)
+    snap = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    out = spill.load(snap, corpus["cons"][:-1],  # one constraint gone
+                     templates=corpus["tdig"])
+    assert out is None
+    assert spill.miss_reasons == {MISS_PLAN: 1}
+
+
+# --- 4. the kube watch seam: rv resume + 410 fallback ----------------------
+
+@pytest.fixture()
+def server():
+    srv = MockApiServer().start()
+    yield srv
+    srv.stop()
+
+
+def _pod(name):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img"}]}}
+
+
+def test_kube_warm_resume_makes_zero_list_calls(server):
+    kube = KubeCluster(KubeConfig(server=server.url), page_limit=50,
+                       watch_backoff_s=0.05, watch_timeout_s=20.0)
+    try:
+        server.put_object(_pod("a"))
+        server.put_object(_pod("b"))
+        _objs, rv = kube._list_paged(POD_GVK)  # the "spilled" rv
+        lists = [0]
+        orig = kube._list_paged
+
+        def counting(gvk):
+            lists[0] += 1
+            return orig(gvk)
+
+        kube._list_paged = counting
+        events = []
+        cancel = kube.subscribe(
+            POD_GVK, events.append, replay=True, from_rv=rv,
+            seed_known=[("default", "a"), ("default", "b")])
+        try:
+            server.put_object(_pod("new1"))
+            assert wait_for(lambda: any(
+                e.obj["metadata"]["name"] == "new1" for e in events))
+            assert lists[0] == 0, "warm resume paid a list call"
+            # nothing replayed the world: only the missed event arrived
+            assert all(e.obj["metadata"]["name"] == "new1"
+                       for e in events)
+        finally:
+            cancel()
+    finally:
+        kube.close()
+
+
+def test_kube_stale_rv_410_falls_back_to_relist_with_diff(server):
+    kube = KubeCluster(KubeConfig(server=server.url), page_limit=50,
+                       watch_backoff_s=0.05, watch_timeout_s=20.0)
+    try:
+        server.put_object(_pod("stay"))
+        server.put_object(_pod("goner"))
+        _objs, rv = kube._list_paged(POD_GVK)
+        # while "down": goner vanishes, history compacts past our rv
+        with server._lock:
+            server._objects.pop(("Pod", "default", "goner"))
+        server.put_object(_pod("later"))
+        server.compact()
+        events = []
+        cancel = kube.subscribe(
+            POD_GVK, events.append, replay=True, from_rv=rv,
+            seed_known=[("default", "stay"), ("default", "goner")])
+        try:
+            # 410 -> relist recovery: synthetic DELETED for the spilled
+            # key the fresh list no longer carries, MODIFIED/ADDED churn
+            # for the rest
+            assert wait_for(lambda: any(
+                e.type == DELETED
+                and e.obj["metadata"]["name"] == "goner"
+                for e in events))
+            assert wait_for(lambda: any(
+                e.type == ADDED
+                and e.obj["metadata"]["name"] == "later"
+                for e in events))
+        finally:
+            cancel()
+    finally:
+        kube.close()
+
+
+# --- 5. drain flush + spiller ----------------------------------------------
+
+def test_drain_flushes_final_spill(corpus, tmp_path):
+    spill = SnapshotSpill(str(tmp_path / "drain-spill"))
+    spiller = SnapshotSpiller(spill, corpus["snapshot"],
+                              templates_fn=lambda: corpus["tdig"])
+    mgr = _snap_manager(corpus["client"], corpus["evaluator"],
+                        corpus["lister"], corpus["snapshot"],
+                        spiller=spiller)
+    mgr.config.interval_s = 30.0
+    # the resident snapshot is already evaluated (rows clean, verdicts
+    # stored) — boot it warm so run_forever's first pass is a cheap
+    # tick, not a second full evaluation (tier-1 wall budget)
+    corpus["snapshot"].warm_loaded = True
+    t = threading.Thread(target=mgr.run_forever, daemon=True)
+    t.start()
+    try:
+        assert wait_for(lambda: not corpus["snapshot"].stale,
+                        timeout=30.0)
+    finally:
+        mgr.stop()
+        t.join(timeout=30.0)
+    assert not t.is_alive()
+    # run_forever's exit flushed the resident state to disk
+    assert os.path.exists(os.path.join(spill.root, HEADER))
+    assert spiller.last_result and spiller.last_result["ok"]
+    assert spiller.last_result["rows"] == \
+        corpus["snapshot"].live_count()
+    # a background request coalesces + lands too
+    spiller.request(wait=True)
+    assert spiller.last_result["ok"]
+    spiller.stop(flush=False)
+
+
+# --- 6. extdata column spill (per-key TTL) ----------------------------------
+
+def test_extdata_column_spill_drops_expired_keys():
+    from gatekeeper_tpu.extdata.lane import ExtDataLane
+    from gatekeeper_tpu.externaldata.providers import ProviderCache
+
+    clock = [1000.0]
+    lane = ExtDataLane(ProviderCache(), clock=lambda: clock[0])
+    col = lane.column("prov")
+    col.land({"k-fresh": ("v1", None), "k-err": (None, "boom")})
+    clock[0] += col.ttl_s * 0.6
+    col.land({"k-young": ("v2", None)})
+    payload = lane.export_columns()
+    # "restart" on a new clock epoch after half a TTL of downtime: the
+    # older keys (0.6 TTL consumed at spill + 0.5 down > 1.0) expired
+    clock2 = [5000.0]
+    lane2 = ExtDataLane(ProviderCache(), clock=lambda: clock2[0])
+    landed = lane2.import_columns(payload, elapsed_s=col.ttl_s * 0.5)
+    col2 = lane2.column("prov")
+    assert landed == 1
+    assert col2.get("k-young") == ("v2", None)
+    assert col2.missing(["k-fresh", "k-err", "k-young"]) == \
+        ["k-fresh", "k-err"]
+
+
+# --- 7. QoS ledger decay: slo-window satellite ------------------------------
+
+def test_qos_ledger_event_decay_bit_identical_when_unarmed():
+    from gatekeeper_tpu.resilience.qos import TenantCostLedger
+
+    a = TenantCostLedger(half_every=4)
+    b = TenantCostLedger(half_every=4)
+    b.set_clock(None, 0.0)  # explicit disarm == default
+    for i in range(13):
+        a.charge(f"t{i % 3}", 100.0 + i)
+        b.charge(f"t{i % 3}", 100.0 + i)
+    assert a.totals() == b.totals()
+
+
+def test_qos_ledger_slo_window_decay_halves_per_window():
+    from gatekeeper_tpu.resilience.qos import TenantCostLedger
+
+    clock = [0.0]
+    led = TenantCostLedger(half_every=4)
+    led.set_clock(lambda: clock[0], 300.0)
+    for _ in range(8):  # event count alone must NOT decay any more
+        led.charge("noisy", 100.0)
+    assert led.heaviness("noisy") == 800.0
+    clock[0] = 301.0
+    led.charge("noisy", 0.0)  # one window elapsed: halve once
+    assert led.heaviness("noisy") == 400.0
+    clock[0] = 1000.0  # two more windows
+    led.charge("quiet", 10.0)
+    assert led.heaviness("noisy") == 100.0
+    assert led.heaviness("quiet") == 10.0
+
+
+def test_overload_controller_wires_ledger_clock():
+    from gatekeeper_tpu.resilience.overload import (OverloadConfig,
+                                                    OverloadController)
+    from gatekeeper_tpu.resilience.qos import QoSConfig
+
+    ctl = OverloadController(OverloadConfig(qos=QoSConfig()))
+    clock = [0.0]
+    ctl.set_qos_ledger_clock(lambda: clock[0], 100.0)
+    ctl._ledger_qos.charge("t", 64.0)
+    clock[0] = 101.0
+    ctl._ledger_qos.charge("t", 0.0)
+    assert ctl._ledger_qos.heaviness("t") == 32.0
+    # disarm restores event-count behavior (the default path)
+    ctl.set_qos_ledger_clock(None, 0.0)
+    assert ctl._ledger_qos._clock is None

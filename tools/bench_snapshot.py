@@ -15,13 +15,22 @@ cares about:
   re-flatten + per-row signature compare + verdict differential), the
   periodic consistency proof's price tag.
 
+``--spill`` adds the cold-start lane (snapshot/persist.py): the
+resident state spills to disk, then a FRESH snapshot boots twice —
+once the relist way (rebuild: list + flatten + evaluate everything)
+and once the spill way (load columns + verdicts from disk, first tick
+evaluates nothing) — and the record carries
+``relist_boot_s`` / ``spill_boot_s`` / ``spill_boot_vs_relist``.
+
 Appends the previous latest record to the ``history`` list in
 ``SNAPSHOT_BENCH.json`` (the FLATTEN_BENCH convention).  Run:
 
-    python tools/bench_snapshot.py [n_objects] [churn_fraction]
+    python tools/bench_snapshot.py [n_objects] [churn_fraction] [--spill]
 
 A ``--smoke`` invocation (tiny corpus, one tick) runs in tier-1 via
-tests/test_snapshot.py so the bench script itself cannot rot.
+tests/test_snapshot.py so the bench script itself cannot rot; the
+spill lane's smoke runs in tests/test_snapshot_persist.py and pins
+spill-load boot < 0.5x relist boot.
 """
 
 from __future__ import annotations
@@ -39,7 +48,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def run_bench(n_objects: int = 20_000, churn_fraction: float = 0.01,
               ticks: int = 3, chunk_size: int = 2048,
               out_path: str = None, seed: int = 11,
-              write: bool = True) -> dict:
+              write: bool = True, spill: bool = False) -> dict:
     from gatekeeper_tpu.apis.constraints import AUDIT_EP
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
     from gatekeeper_tpu.client.client import Client
@@ -141,6 +150,57 @@ def run_bench(n_objects: int = 20_000, churn_fraction: float = 0.01,
     resync_s = time.perf_counter() - t0
     ingester.stop()
 
+    # --- cold-start lane: relist boot vs spill-load boot ----------------
+    spill_stats = None
+    if spill:
+        import tempfile
+
+        from gatekeeper_tpu.snapshot import (SnapshotSpill,
+                                             templates_digest)
+
+        tdig = templates_digest(client)
+        spill_dir = tempfile.mkdtemp(prefix="gtpu-spill-")
+        sp = SnapshotSpill(spill_dir)
+        wrote = sp.save(snapshot, templates=tdig)
+        cons = [c for c in client.constraints() if c.actions_for(AUDIT_EP)]
+
+        def boot(warm: bool) -> tuple:
+            """(wall seconds, totals) of the first completed audit pass
+            of a FRESH snapshot: the relist way (rebuild + evaluate
+            everything) or the spill way (load from disk, tick
+            evaluates nothing).  The evaluator is shared (already
+            compiled/traced) so the lane isolates the DATA-plane boot
+            cost — the compile side is PR 12's story."""
+            snap_b = ClusterSnapshot(evaluator, SnapshotConfig())
+            mgr_b = AuditManager(
+                client, lister=lister,
+                config=AuditConfig(chunk_size=chunk_size,
+                                   exact_totals=False, pipeline="off",
+                                   audit_source="snapshot"),
+                evaluator=evaluator, snapshot=snap_b)
+            t0 = time.perf_counter()
+            if warm:
+                loaded = SnapshotSpill(spill_dir).load(
+                    snap_b, cons, templates=tdig)
+                assert loaded is not None, "spill-load boot missed"
+                run_b = mgr_b.audit_tick()
+            else:
+                run_b = mgr_b.audit()
+            return time.perf_counter() - t0, run_b.total_violations
+
+        relist_boot_s, totals_relist = boot(warm=False)
+        spill_boot_s, totals_spill = boot(warm=True)
+        assert totals_spill == totals_relist, \
+            "spill-load boot verdicts diverged from relist boot"
+        spill_stats = {
+            "spill_write_s": round(wrote.get("seconds", 0.0), 4),
+            "spill_bytes": wrote.get("bytes", 0),
+            "relist_boot_s": round(relist_boot_s, 4),
+            "spill_boot_s": round(spill_boot_s, 4),
+            "spill_boot_vs_relist": round(
+                spill_boot_s / max(relist_boot_s, 1e-9), 4),
+        }
+
     tick_med = statistics.median(tick_times)
     record = {
         "n_objects": n_objects,
@@ -165,6 +225,8 @@ def run_bench(n_objects: int = 20_000, churn_fraction: float = 0.01,
         "full_vs_relist_speedup": round(relist_s / max(snap_full_s,
                                                        1e-9), 2),
     }
+    if spill_stats is not None:
+        record.update(spill_stats)
     if write:
         path = out_path or os.path.join(os.path.dirname(__file__), "..",
                                         "SNAPSHOT_BENCH.json")
@@ -193,18 +255,21 @@ def run_bench(n_objects: int = 20_000, churn_fraction: float = 0.01,
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     smoke = "--smoke" in argv
-    argv = [a for a in argv if a != "--smoke"]
+    spill = "--spill" in argv
+    argv = [a for a in argv if a not in ("--smoke", "--spill")]
     if smoke:
         rec = run_bench(n_objects=120, churn_fraction=0.05, ticks=1,
-                        chunk_size=64, write=False)
+                        chunk_size=64, write=False, spill=spill)
         assert rec["resync_ok"], "smoke resync diverged"
-        print(json.dumps({"smoke": True,
-                          "tick_s": rec["tick_s_median"],
-                          "rows": rec["snapshot_rows"]}))
+        out = {"smoke": True, "tick_s": rec["tick_s_median"],
+               "rows": rec["snapshot_rows"]}
+        if spill:
+            out["spill_boot_vs_relist"] = rec["spill_boot_vs_relist"]
+        print(json.dumps(out))
         return 0
     n = int(argv[0]) if argv else 20_000
     churn = float(argv[1]) if len(argv) > 1 else 0.01
-    run_bench(n_objects=n, churn_fraction=churn)
+    run_bench(n_objects=n, churn_fraction=churn, spill=spill)
     return 0
 
 
