@@ -47,6 +47,15 @@ FLATTEN_LANE = "auto"
 # is the host-fold reference, differential runs both and asserts
 # bit-identical)
 COLLECT_LANE = "reduced"
+# --flatten-workers=N (sweep ingest: fan each chunk's raw byte spans
+# across N flatten worker processes; 0 = in-process).  Requested counts
+# >1 on a 1-core host SKIP with a recorded reason (FLATTEN_BENCH
+# convention: the numbers would measure process contention, not
+# parallelism) and run workers=0 instead.
+FLATTEN_WORKERS = 0
+# --shard-chunks=K (audit scheduler: pack K consecutive same-group
+# chunks into one mesh-wide dispatch, object axis sharded over 'data')
+SHARD_CHUNKS = 0
 # --trace out.json: span-trace the timed sweeps and export a Chrome
 # trace-event file at exit (Perfetto-loadable device timeline)
 TRACE_PATH = ""
@@ -62,7 +71,8 @@ def _parse_pipeline_flag(argv: list) -> list:
     the JSON artifact); --trace installs the span tracer (seeded, full
     sampling) and writes the Chrome trace-event artifact — with --chaos
     the injected faults show up as instant events on the spans they hit."""
-    global PIPELINE_MODE, TRACE_PATH, FLATTEN_LANE, COLLECT_LANE
+    global PIPELINE_MODE, TRACE_PATH, FLATTEN_LANE, COLLECT_LANE, \
+        FLATTEN_WORKERS, SHARD_CHUNKS
     out = []
     chaos = ""
     it = iter(argv)
@@ -71,6 +81,14 @@ def _parse_pipeline_flag(argv: list) -> list:
             PIPELINE_MODE = next(it, "auto")
         elif a.startswith("--pipeline="):
             PIPELINE_MODE = a.split("=", 1)[1]
+        elif a == "--flatten-workers":
+            FLATTEN_WORKERS = int(next(it, "0") or 0)
+        elif a.startswith("--flatten-workers="):
+            FLATTEN_WORKERS = int(a.split("=", 1)[1] or 0)
+        elif a == "--shard-chunks":
+            SHARD_CHUNKS = int(next(it, "0") or 0)
+        elif a.startswith("--shard-chunks="):
+            SHARD_CHUNKS = int(a.split("=", 1)[1] or 0)
         elif a == "--flatten-lane":
             FLATTEN_LANE = next(it, "auto")
         elif a.startswith("--flatten-lane="):
@@ -149,6 +167,21 @@ def bench_history_append(entry: dict, path: str = None) -> None:
 
 def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
+
+
+def effective_flatten_workers() -> tuple:
+    """(workers, skip_reason): multi-worker flatten lanes SKIP with a
+    recorded reason on 1-core hosts (the FLATTEN_BENCH convention —
+    r05 showed 1T==8T at host_cpus=1, so the measurement would be
+    process contention, not parallelism) and run workers=0 instead;
+    the requested count still lands in the artifact so a multi-core
+    re-run knows what was asked for."""
+    n = os.cpu_count() or 1
+    if FLATTEN_WORKERS > 1 and n < 2:
+        return 0, (f"host_cpus={n}: {FLATTEN_WORKERS} flatten workers "
+                   "would measure process contention, not parallelism "
+                   "(FLATTEN_BENCH skip convention); ran workers=0")
+    return FLATTEN_WORKERS, None
 
 
 def _probe_accelerator_once(timeout_s: float) -> bool:
@@ -347,12 +380,16 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
+    workers, workers_skip = effective_flatten_workers()
+    if workers_skip:
+        log(f"flatten-workers lane skipped: {workers_skip}")
     evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
                                  flatten_lane=FLATTEN_LANE,
-                                 collect=COLLECT_LANE)
+                                 collect=COLLECT_LANE,
+                                 flatten_workers=workers)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
                       exact_totals=False, submit_window=submit_window,
-                      pipeline=PIPELINE_MODE)
+                      pipeline=PIPELINE_MODE, shard_chunks=SHARD_CHUNKS)
     mgr = AuditManager(client, lister=lister, config=cfg,
                        evaluator=evaluator)
     # fetch-free warmup: interns every name (vocab reaches its final
@@ -360,7 +397,11 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
     # fetch, so the timed run's uploads still ride full tunnel bandwidth
     log("warmup (streaming vocab pass + per-group jit compile)...")
     t_w = time.perf_counter()
-    evaluator.warm_pass(client.constraints(), lister(), chunk,
+    # warm at the PACKED chunk size: shard_chunks coalesces K chunks
+    # into one dispatch, so the timed sweep's pad buckets are K x chunk
+    # wide — warming at the unpacked size would retrace mid-sweep
+    evaluator.warm_pass(client.constraints(), lister(),
+                        chunk * max(1, SHARD_CHUNKS),
                         return_bits=cfg.exact_totals)
     log(f"warmup: {time.perf_counter() - t_w:.1f}s")
 
@@ -413,6 +454,19 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
                                     else "serial")}
     out["flatten_lane"] = FLATTEN_LANE
     out["collect"] = COLLECT_LANE
+    # self-describing ingest/dispatch geometry (run.flatten_workers etc.
+    # come from the AuditRun annotation — the effective values, not the
+    # requested flags)
+    out["flatten_workers"] = run.flatten_workers
+    out["shard_chunks"] = run.shard_chunks
+    out["n_devices"] = run.n_devices
+    if workers_skip:
+        out["flatten_workers_requested"] = FLATTEN_WORKERS
+        out["skipped_workers_reason"] = workers_skip
+    worker_busy = phases.get("fl_worker_busy", 0.0)
+    if worker_busy:
+        # aggregate objects per worker-second across the timed sweep
+        out["per_worker_objs_per_s"] = round(n / worker_busy, 1)
     if mgr.pipe_stats:
         out["pipeline"].update(mgr.pipe_stats)
     if cpu_fallback:
@@ -779,11 +833,16 @@ def main():
     from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
     from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
 
+    workers, workers_skip = effective_flatten_workers()
+    if workers_skip:
+        log(f"flatten-workers lane skipped: {workers_skip}")
     evaluator = ShardedEvaluator(tpu, make_mesh(), violations_limit=20,
                                  flatten_lane=FLATTEN_LANE,
-                                 collect=COLLECT_LANE)
+                                 collect=COLLECT_LANE,
+                                 flatten_workers=workers)
     cfg = AuditConfig(violations_limit=20, chunk_size=chunk,
-                      exact_totals=False, pipeline=PIPELINE_MODE)
+                      exact_totals=False, pipeline=PIPELINE_MODE,
+                      shard_chunks=SHARD_CHUNKS)
     mgr = AuditManager(client, lister=lambda: iter(objects), config=cfg,
                        evaluator=evaluator)
 
@@ -791,7 +850,8 @@ def main():
     # poisoning the tunnel's upload bandwidth before the timed run
     log("warmup (vocab pass + per-bucket jit compile, fetch-free)...")
     t0 = time.perf_counter()
-    evaluator.warm_pass(client.constraints(), objects, chunk,
+    evaluator.warm_pass(client.constraints(), objects,
+                        chunk * max(1, SHARD_CHUNKS),
                         return_bits=cfg.exact_totals)
     log(f"warmup: {time.perf_counter() - t0:.1f}s")
 
@@ -866,6 +926,12 @@ def main():
                                     else "serial")}
     out["flatten_lane"] = FLATTEN_LANE
     out["collect"] = COLLECT_LANE
+    out["flatten_workers"] = run.flatten_workers
+    out["shard_chunks"] = run.shard_chunks
+    out["n_devices"] = run.n_devices
+    if workers_skip:
+        out["flatten_workers_requested"] = FLATTEN_WORKERS
+        out["skipped_workers_reason"] = workers_skip
     if pipe_stats:
         out["pipeline"].update(pipe_stats)
     if cpu_fallback:

@@ -82,6 +82,16 @@ def main(argv=None) -> int:
                         "and the boot relists (README 'Cold start & "
                         "persistence').  Spills write off the audit "
                         "thread after each clean resync and at drain")
+    p.add_argument("--snapshot-spill-compress", default="none",
+                   choices=["none", "zlib"],
+                   help="spill section codec: 'none' (bit-identical to "
+                        "the uncompressed format — right for 1-core "
+                        "hosts, where zlib CPU costs more than the "
+                        "bytes) or 'zlib' (NVMe-rich hosts: ~3-5x "
+                        "smaller sections for one compress pass on the "
+                        "spill worker).  The header records the codec; "
+                        "the loader auto-detects either, so flipping "
+                        "the flag never strands an existing spill")
     p.add_argument("--audit-expand", action="store_true",
                    help="expansion generator stage in the audit sweep: "
                         "generator objects (per ExpansionTemplate "
@@ -115,6 +125,25 @@ def main(argv=None) -> int:
                         "The C columnizer already shards each chunk over "
                         "an internal pthread pool; extra workers overlap "
                         "the Python assembly slices across chunks")
+    p.add_argument("--flatten-workers", type=int, default=0,
+                   help="multiprocess flatten worker pool for sweep "
+                        "chunks: fan contiguous RawJSON byte spans of "
+                        "each chunk across N worker PROCESSES (each runs "
+                        "the C columnizer against a batch-local vocab; "
+                        "results merge into the shared vocab on the "
+                        "dispatch thread, bit-identical to in-process — "
+                        "see ops/flatten.FlattenWorkerPool). 0 = the "
+                        "exact in-process path (the 1-core default); "
+                        "with --flatten-lane differential the worker "
+                        "pool is additionally asserted column- and "
+                        "vocab-identical per chunk")
+    p.add_argument("--shard-chunks", type=int, default=0,
+                   help="pack K consecutive same-group audit chunks "
+                        "into one mesh-wide dispatch (object axis "
+                        "sharded over the mesh 'data' axis) — K ~= "
+                        "device count keeps each chip at "
+                        "audit-chunk-size objects while per-dispatch "
+                        "fixed costs amortize K-fold; 0/1 = off")
     p.add_argument("--flatten-lane", default="auto",
                    choices=["auto", "dict", "raw", "py", "differential"],
                    help="sweep columnizer lane: 'auto' feeds raw JSON "
@@ -708,7 +737,8 @@ def main(argv=None) -> int:
                 violations_limit=args.constraint_violations_limit,
                 flatten_lane=args.flatten_lane,
                 metrics=metrics,
-                collect=args.collect)
+                collect=args.collect,
+                flatten_workers=args.flatten_workers)
 
         if kube_cluster is not None:
             # discovery-driven audit listing (auditResources,
@@ -762,8 +792,9 @@ def main(argv=None) -> int:
                                            metrics=metrics)
                 spill_load = None
                 if args.snapshot_spill:
-                    snap_spill = SnapshotSpill(args.snapshot_spill,
-                                               metrics=metrics)
+                    snap_spill = SnapshotSpill(
+                        args.snapshot_spill, metrics=metrics,
+                        compress=args.snapshot_spill_compress)
                     from gatekeeper_tpu.apis.constraints import AUDIT_EP \
                         as _AEP
 
@@ -819,6 +850,7 @@ def main(argv=None) -> int:
                 chunk_size=args.audit_chunk_size,
                 pipeline=args.pipeline,
                 pipeline_flatten_workers=args.pipeline_flatten_workers,
+                shard_chunks=args.shard_chunks,
                 audit_source=audit_source,
                 resync_every=args.snapshot_resync_every,
                 resync_rotate=args.snapshot_resync_rotate,
@@ -873,7 +905,10 @@ def main(argv=None) -> int:
             snap_spiller.spill_now()
         total = sum(run.total_violations.values())
         print(f"audit: {run.total_objects} objects, {total} violations "
-              f"in {run.duration_s:.2f}s"
+              f"in {run.duration_s:.2f}s "
+              f"(flatten_workers={run.flatten_workers}, "
+              f"n_devices={run.n_devices}, "
+              f"shard_chunks={run.shard_chunks})"
               + (f" [INCOMPLETE: {run.failed_chunks} chunks dropped, "
                  f"{run.retried_chunks} retried]" if run.incomplete
                  else ""), file=sys.stderr)

@@ -274,6 +274,26 @@ class CompileCache:
                 "stores": self.stores}
 
 
+def warm_yield_s(cpu_count: Optional[int] = None) -> float:
+    """Per-kernel cooperative-yield gap for the pre-swap warm.
+
+    Tracing is GIL-held Python: on a 1-core host, back-to-back kernel
+    traces starve the serving thread for the whole warm, so each trace
+    leaves a bounded 5ms gap (the measured storm-P99 sweet spot —
+    CHURN_BENCH pins 1-core behavior unchanged).  Hosts with spare
+    cores need (almost) none: the serving thread runs on another core
+    while the warm traces, and every gap only stretches the warm —
+    which delays the swap the serving path is waiting on.  Few-core
+    (2-3) hosts keep a token 1ms: the GIL is still shared even when
+    the cores are not saturated."""
+    n = cpu_count if cpu_count is not None else (os.cpu_count() or 1)
+    if n <= 1:
+        return 0.005
+    if n < 4:
+        return 0.001
+    return 0.0
+
+
 def programs_digest(driver) -> str:
     """Digest of the installed compiled plan (kind -> program schema) —
     the warm-state cache key: recorded executable layouts only replay
@@ -753,6 +773,7 @@ class GenerationCoordinator:
                                parameters={}, enforcement_action="deny")]
                 tables[kind] = build_param_table(prog.program, cons,
                                                  driver.vocab)
+            gap = warm_yield_s()
             for kind in kinds:
                 prog = gen.programs[kind]
                 prog.run(batch, tables[kind], vocab=driver.vocab,
@@ -762,8 +783,12 @@ class GenerationCoordinator:
                 # GIL-held Python, and on few-core hosts back-to-back
                 # traces would otherwise starve the serving thread for
                 # the whole warm — one bounded gap per kernel keeps the
-                # storm P99 near one trace, not the sum of all of them
-                time.sleep(0.005)
+                # storm P99 near one trace, not the sum of all of them.
+                # Sized from the host's core count (warm_yield_s): on a
+                # many-core host the serving thread runs on its own
+                # core, so the gap only stretches the warm for nothing
+                if gap:
+                    time.sleep(gap)
         except Exception as e:
             with self._lock:
                 self.last_error = f"warm: {e}"

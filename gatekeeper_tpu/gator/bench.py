@@ -75,7 +75,9 @@ def run_bench(objs, engine: str, iterations: int,
               pipeline: str = "auto",
               flatten_lane: str = "auto",
               collect: str = "reduced",
-              compile_cache: str = "") -> BenchResult:
+              compile_cache: str = "",
+              flatten_workers: int = 0,
+              shard_chunks: int = 0) -> BenchResult:
     templates = [o for o in objs if reader.is_template(o)]
     constraints = [o for o in objs if reader.is_constraint(o)]
     data = [o for o in objs
@@ -121,7 +123,8 @@ def run_bench(objs, engine: str, iterations: int,
 
     if engine == "sweep":
         return _run_sweep_bench(r, client, data, iterations, pipeline,
-                                flatten_lane, collect)
+                                flatten_lane, collect, flatten_workers,
+                                shard_chunks)
 
     from gatekeeper_tpu.target.review import AugmentedReview
     from gatekeeper_tpu.webhook.policy import parse_admission_review
@@ -276,7 +279,9 @@ def _fill_latencies(r: BenchResult, latencies: list) -> None:
 def _run_sweep_bench(r: BenchResult, client: Client, data: list,
                      iterations: int, pipeline: str,
                      flatten_lane: str = "auto",
-                     collect: str = "reduced") -> BenchResult:
+                     collect: str = "reduced",
+                     flatten_workers: int = 0,
+                     shard_chunks: int = 0) -> BenchResult:
     """The ``sweep`` engine: the production audit lane (AuditManager +
     ShardedEvaluator) over the fixture's data objects, scheduled through
     the staged host pipeline per ``--pipeline``.  One latency sample per
@@ -291,10 +296,12 @@ def _run_sweep_bench(r: BenchResult, client: Client, data: list,
     r.objects = len(corpus)
     mgr = AuditManager(
         client, lister=lambda: iter(corpus),
-        config=AuditConfig(pipeline=pipeline),
+        config=AuditConfig(pipeline=pipeline,
+                           shard_chunks=shard_chunks),
         evaluator=ShardedEvaluator(tpu, make_mesh(),
                                    flatten_lane=flatten_lane,
-                                   collect=collect),
+                                   collect=collect,
+                                   flatten_workers=flatten_workers),
     )
     latencies = []
     violations = 0
@@ -398,6 +405,13 @@ def run_cli(argv: list[str]) -> int:
                         "vs the GIL-bound dict walker (dict) vs Python "
                         "(py); differential runs raw THEN dict and "
                         "asserts bit-identical columns")
+    p.add_argument("--flatten-workers", type=int, default=0,
+                   help="sweep-engine flatten worker processes (see "
+                        "the server's --flatten-workers); 0 = "
+                        "in-process")
+    p.add_argument("--shard-chunks", type=int, default=0,
+                   help="sweep-engine chunk packing: K consecutive "
+                        "chunks per mesh-wide dispatch; 0/1 = off")
     p.add_argument("--collect", default="reduced",
                    choices=["reduced", "masks", "differential"],
                    help="sweep-engine collect lane: device-side verdict "
@@ -464,7 +478,9 @@ def run_cli(argv: list[str]) -> int:
                     pipeline=args.pipeline,
                     flatten_lane=args.flatten_lane,
                     collect=args.collect,
-                    compile_cache=args.compile_cache))
+                    compile_cache=args.compile_cache,
+                    flatten_workers=args.flatten_workers,
+                    shard_chunks=args.shard_chunks))
             except Exception as e:
                 print(f"error: benchmarking {engine}: {e}", file=sys.stderr)
                 return 1

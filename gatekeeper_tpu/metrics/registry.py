@@ -400,6 +400,15 @@ TRACE_SAMPLED_OUT = "trace_traces_sampled_out_count"
 # the ROADMAP's "flatten is the sweep ceiling" number, scrapeable
 FLATTEN_LANE = "flatten_lane_count"
 FLATTEN_OBJECTS_PER_SECOND = "flatten_objects_per_second"
+# host-parallel flatten worker pool (--flatten-workers, ops/flatten.py
+# FlattenWorkerPool): effective worker processes of the last sweep
+# chunk, aggregate columnize throughput per worker-second, the parent-
+# side merge (intern + remap + concat) cost, and pool-unavailable
+# fallbacks to the in-process columnizer
+FLATTEN_WORKER_COUNT = "flatten_worker_count"
+FLATTEN_WORKER_OBJECTS_PER_SECOND = "flatten_worker_objects_per_second"
+FLATTEN_WORKER_MERGE_SECONDS = "flatten_worker_merge_seconds"
+FLATTEN_WORKER_FALLBACKS = "flatten_worker_fallback_count"
 # batched external-data join lane (extdata/lane.py): bulk transport
 # calls per provider (one fetch per max_keys_per_call chunk of the
 # deduped miss list), per-key outcomes (warm = resident column hit with

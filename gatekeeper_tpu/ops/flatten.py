@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Sequence
 
@@ -808,6 +809,302 @@ def round_up(n: int, bucket: int = 8) -> int:
     return ((n + bucket - 1) // bucket) * bucket
 
 
+# --- multiprocess flatten worker pool (--flatten-workers) ------------------
+#
+# The sweep's host ceiling is the columnize loop (SWEEP1M: flatten 13.9s
+# of a 42.9s 1M-object pass), and a single process cannot scale it past
+# one core's worth of GIL-held assembly no matter how many pthreads the
+# C columnizer runs.  The pool fans contiguous SPANS of a chunk's raw
+# JSON byte items (bytes pickle cheaply; no DOM ever crosses the process
+# boundary) across N worker processes, each running the C columnizer
+# against a batch-local vocab; the parent then interns each worker's
+# local string table into the shared vocab in span order and remaps +
+# concatenates the column arrays (merge_worker_columns).
+#
+# Bit-identity contract: spans use the C module's OWN partition scheme
+# (ceil-block contiguous ranges, thread count clamped to n/128+1), and
+# the merge replays its deterministic "(thread, first-seen)" vocab
+# order — so the worker lane is bit-identical (columns AND vocab string
+# table, order included) to the in-process lane run at nthreads=N, and
+# verdict-identical to ANY in-process thread count (intern order never
+# changes verdicts; ids stay self-consistent — the long-standing
+# pipeline_flatten_workers contract).  The workers differential lane
+# asserts both halves per batch.
+
+
+class FlattenPoolError(RuntimeError):
+    """The worker pool is unusable (worker died, pipe broke); callers
+    fall back to the in-process columnizer."""
+
+
+def _flatten_worker_main(conn):
+    """Worker process main loop: receives ``(items, specs, pad_n,
+    bucket)`` jobs, columnizes against a fresh batch-local vocab with
+    the C json columnizer (nthreads=1 — the pool IS the parallelism),
+    replies ``("ok", out, local_to_str, seconds)`` or
+    ``("err", exc_type_name, message)``."""
+    import time as _time
+
+    try:
+        from gatekeeper_tpu.ops import native
+
+        mod = native.load_json()
+    except Exception:
+        mod = None
+    while True:
+        try:
+            job = conn.recv()
+        except (EOFError, OSError):
+            return
+        if job is None:
+            return
+        items, specs, pad_n, bucket = job
+        try:
+            if mod is None:
+                raise RuntimeError("native json module unavailable in "
+                                   "flatten worker")
+            to_id: dict = {"": 0}
+            to_str: list = [""]
+            t0 = _time.perf_counter()
+            out = mod.flatten_json_batch(items, *specs, to_id, to_str,
+                                         int(pad_n), int(bucket), 1)
+            reply = ("ok", out, to_str, _time.perf_counter() - t0)
+        except Exception as e:
+            reply = ("err", type(e).__name__, str(e))
+        try:
+            conn.send(reply)
+        except (OSError, BrokenPipeError):
+            return
+
+
+class FlattenWorkerPool:
+    """N long-lived flatten worker processes behind pipes.
+
+    Forked (cheap; workers inherit the already-built native module and
+    never touch jax), created lazily on first use and reused across
+    chunks/sweeps.  ``run`` is serialized by a lock — concurrent
+    pipeline flatten-stage threads take turns rather than interleaving
+    pipe messages."""
+
+    def __init__(self, workers: int):
+        import multiprocessing as mp
+
+        # build + load the native module in the PARENT first so forked
+        # children inherit it loaded (two children racing the on-disk
+        # build would collide)
+        from gatekeeper_tpu.ops import native
+
+        native.load_json()
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:  # platforms without fork
+            ctx = mp.get_context("spawn")
+        self.workers = workers
+        self.dead = False
+        self._lock = threading.Lock()
+        self._procs: list = []
+        self._conns: list = []
+        import warnings
+
+        for _ in range(workers):
+            parent_c, child_c = ctx.Pipe()
+            p = ctx.Process(target=_flatten_worker_main, args=(child_c,),
+                            daemon=True, name="flatten-worker")
+            with warnings.catch_warnings():
+                # jax registers an at-fork RuntimeWarning (XLA threads +
+                # fork CAN deadlock in general); these children run only
+                # Python + the C columnizer and never touch jax, and the
+                # repo promotes RuntimeWarning to error
+                warnings.simplefilter("ignore", RuntimeWarning)
+                p.start()
+            child_c.close()
+            self._procs.append(p)
+            self._conns.append(parent_c)
+
+    # per-span reply deadline: a columnize is seconds at worst, so a
+    # worker silent this long is wedged (e.g. a bad fork interaction) —
+    # the pool dies and the batch falls back in-process rather than
+    # hanging the sweep
+    REPLY_TIMEOUT_S = 120.0
+
+    def run(self, jobs: list) -> list:
+        """Submit one job per worker (len(jobs) <= workers) and collect
+        replies in job order.  A broken or wedged worker marks the whole
+        pool dead (the registry builds a fresh one on next use)."""
+        with self._lock:
+            if self.dead:
+                raise FlattenPoolError("flatten worker pool is dead")
+            try:
+                for conn, job in zip(self._conns, jobs):
+                    conn.send(job)
+                out = []
+                for i in range(len(jobs)):
+                    if not self._conns[i].poll(self.REPLY_TIMEOUT_S):
+                        self.dead = True
+                        raise FlattenPoolError(
+                            f"flatten worker {i} timed out")
+                    out.append(self._conns[i].recv())
+                return out
+            except (OSError, EOFError, BrokenPipeError) as e:
+                self.dead = True
+                raise FlattenPoolError(str(e)) from e
+
+    def close(self) -> None:
+        with self._lock:
+            self.dead = True
+            for c in self._conns:
+                try:
+                    c.send(None)
+                except Exception:
+                    pass
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            for p in self._procs:
+                p.join(timeout=2.0)
+                if p.is_alive():
+                    p.terminate()
+            self._procs = []
+            self._conns = []
+
+
+_FLATTEN_POOLS: dict = {}
+_FLATTEN_POOLS_LOCK = threading.Lock()
+
+
+def get_flatten_pool(workers: int) -> FlattenWorkerPool:
+    """The process-wide pool for a worker count (lazily created; a dead
+    pool is replaced)."""
+    with _FLATTEN_POOLS_LOCK:
+        pool = _FLATTEN_POOLS.get(workers)
+        if pool is None or pool.dead:
+            pool = FlattenWorkerPool(workers)
+            _FLATTEN_POOLS[workers] = pool
+        return pool
+
+
+def shutdown_flatten_pools() -> None:
+    """Tear down every pool (tests, drain)."""
+    with _FLATTEN_POOLS_LOCK:
+        for pool in _FLATTEN_POOLS.values():
+            try:
+                pool.close()
+            except Exception:
+                pass
+        _FLATTEN_POOLS.clear()
+
+
+def _merge_rows(arrs: list, ns: list, pad_n: int, fill, remaps=None):
+    """Concatenate per-span arrays row-wise into one [pad_n, ...] array.
+
+    Ragged tails harmonize to the max span width (each span's width is
+    ``bucket_up`` of its local max, so the max across spans equals the
+    width a whole-batch columnize would have picked); rows/cells beyond
+    a span's extent keep ``fill`` — exactly the C columnizer's own
+    defaults for pad rows.  ``remaps`` (per-span local-sid -> global-sid
+    tables, index shifted by 2 for the -2/-1 sentinels) rewrites sid
+    arrays during the copy."""
+    tail = tuple(max(a.shape[d] for a in arrs)
+                 for d in range(1, arrs[0].ndim))
+    dst = np.full((pad_n,) + tail, fill, arrs[0].dtype)
+    off = 0
+    for i, a in enumerate(arrs):
+        sub = a[: ns[i]]
+        if remaps is not None:
+            sub = remaps[i][sub + 2]
+        dst[(slice(off, off + ns[i]),)
+            + tuple(slice(0, s) for s in sub.shape[1:])] = sub
+        off += ns[i]
+    return dst
+
+
+def flatten_worker_spans(n: int, workers: int) -> list:
+    """The C columnizer's own thread partition, applied to worker spans:
+    thread count clamped to ``n/128 + 1`` (tiny batches stay
+    single-context), then ceil-block contiguous ranges.  Matching the
+    native scheme exactly is what makes the worker merge reproduce the
+    in-process ``nthreads=N`` vocab order bit-for-bit.  Returns
+    ``[(lo, hi)]`` with empty tails dropped."""
+    if n <= 0 or workers <= 1:
+        return [(0, n)] if n > 0 else []
+    nw = min(workers, n // 128 + 1, n)
+    block = (n + nw - 1) // nw
+    spans = []
+    for t in range(nw):
+        lo = min(t * block, n)
+        hi = min(lo + block, n)
+        if hi > lo:
+            spans.append((lo, hi))
+    return spans
+
+
+def merge_worker_columns(vocab: Vocab, parts: list, pad_n: int) -> dict:
+    """Merge per-span worker outputs into one whole-batch columnizer
+    output dict (the exact shape ``flatten_json_batch`` returns).
+
+    ``parts``: ``[(out, local_to_str, n_items)]`` in span (document)
+    order.  Interning into ``vocab`` happens span by span; each span's
+    local table is the C columnizer's per-context first-seen order over
+    a contiguous ascending item range, so the merged assignment order
+    replays the native module's own "(thread, first-seen)" merge — the
+    vocab string table and every column are bit-identical to an
+    in-process columnize at ``nthreads=len(parts)`` over the same spans
+    (the workers differential lane asserts this, order included)."""
+    remaps = []
+    for _out, to_str, _n in parts:
+        rm = np.empty(len(to_str) + 2, np.int32)
+        rm[0] = -2
+        rm[1] = -1
+        for i, s in enumerate(to_str):
+            rm[i + 2] = vocab.intern(s)
+        remaps.append(rm)
+    outs = [p[0] for p in parts]
+    ns = [p[2] for p in parts]
+
+    def rows(pick, fill, remap=False):
+        return _merge_rows([pick(o) for o in outs], ns, pad_n, fill,
+                           remaps if remap else None)
+
+    merged: dict = {}
+    merged["identity"] = tuple(
+        rows(lambda o, j=j: o["identity"][j], fill, remap=(j < 4))
+        for j, fill in enumerate((-1, -1, -1, -1, 0)))
+    merged["scalars"] = [
+        (rows(lambda o: o["scalars"][c][0], 0),
+         rows(lambda o: o["scalars"][c][1], 0.0),
+         rows(lambda o: o["scalars"][c][2], -1, remap=True))
+        for c in range(len(outs[0]["scalars"]))]
+    merged["axes"] = [rows(lambda o: o["axes"][c], 0)
+                      for c in range(len(outs[0]["axes"]))]
+    merged["raggeds"] = [
+        (rows(lambda o: o["raggeds"][c][0], 0),
+         rows(lambda o: o["raggeds"][c][1], 0.0),
+         rows(lambda o: o["raggeds"][c][2], -1, remap=True))
+        for c in range(len(outs[0]["raggeds"]))]
+    merged["keysets"] = [
+        (rows(lambda o: o["keysets"][c][0], -1, remap=True),
+         rows(lambda o: o["keysets"][c][1], 0))
+        for c in range(len(outs[0]["keysets"]))]
+    merged["map_keys"] = [
+        rows(lambda o: o["map_keys"][c], -1, remap=True)
+        for c in range(len(outs[0]["map_keys"]))]
+    # parent ordinals are per-object indices into the parent axis
+    # enumeration — positional, not vocab ids: no remap
+    merged["parent_idx"] = [
+        rows(lambda o: o["parent_idx"][c], -1)
+        for c in range(len(outs[0]["parent_idx"]))]
+    merged["ragged_keysets"] = [
+        (rows(lambda o: o["ragged_keysets"][c][0], -1, remap=True),
+         rows(lambda o: o["ragged_keysets"][c][1], 0))
+        for c in range(len(outs[0]["ragged_keysets"]))]
+    if "canons" in outs[0]:
+        merged["canons"] = [
+            rows(lambda o: o["canons"][c], -2, remap=True)
+            for c in range(len(outs[0]["canons"]))]
+    return merged
+
+
 FLATTEN_LANES = ("auto", "dict", "raw", "py", "differential")
 
 
@@ -815,7 +1112,7 @@ class Flattener:
     def __init__(self, schema: Schema, vocab: Optional[Vocab] = None,
                  use_native: bool = True, bucket: int = 8,
                  width_targets: Optional[dict] = None,
-                 lane: str = "auto"):
+                 lane: str = "auto", workers: int = 0):
         # prefix-axis dedup: extraction runs over the exec schema; the
         # requested (orig) specs are aliased onto the exec columns after
         # flatten (same numpy arrays — identity the wire packer dedups on)
@@ -845,6 +1142,20 @@ class Flattener:
         if lane not in FLATTEN_LANES:
             raise ValueError(f"unknown flatten lane {lane!r}")
         self.lane = lane
+        # --flatten-workers: raw-lane batches with >= 2 items fan
+        # contiguous byte spans across this many worker processes
+        # (FlattenWorkerPool), merged bit-identically on the calling
+        # thread; 0 keeps the exact in-process path.  With
+        # lane='differential' the worker lane is additionally asserted
+        # column- AND vocab-order-identical to the in-process path.
+        self.workers = max(0, int(workers))
+        # effective worker processes of the last flatten (0 = the batch
+        # took the in-process path), for metrics/bench attribution
+        self.last_workers_used = 0
+        # in-process columnizer thread override (0 = env/cpu_count):
+        # the workers differential pins the reference at nthreads=N so
+        # the vocab-order comparison is exact
+        self.nthreads = 0
         # the lane the last flatten() actually took ('raw'/'dict'/'py'),
         # for metrics/span attribution; 'raw' batches that fell back to
         # the dict lane on a parse reject report the lane they landed on
@@ -947,6 +1258,9 @@ class Flattener:
         ``self.lane`` (see __init__)."""
         lane = self.lane
         if lane == "differential" and objects:
+            if self.workers:
+                return self._flatten_differential_workers(objects, pad_n,
+                                                          reviews)
             return self._flatten_differential(objects, pad_n, reviews)
         use_native = self.use_native and lane != "py"
         if objects:
@@ -1120,16 +1434,23 @@ class Flattener:
                 # plain dict, or a materialized RawJSON whose dict state
                 # may have diverged from .raw — serialize current state
                 items.append(json.dumps(o, separators=(",", ":")).encode())
-        nthreads = int(os.environ.get("GTPU_FLATTEN_THREADS", "0") or 0) \
+        nthreads = self.nthreads \
+            or int(os.environ.get("GTPU_FLATTEN_THREADS", "0") or 0) \
             or (os.cpu_count() or 1)
         from gatekeeper_tpu.resilience.faults import fault_point
 
         fault_point("ops.flatten_raw", n=len(items), nthreads=nthreads)
         import time as _time
         _t0 = _time.perf_counter()
+        self.last_workers_used = 0
         try:
-            out = self._call_columnizer(
-                mod, items, schema, axes, axis_index, pad_n, nthreads)
+            out = None
+            if self.workers and len(items) > 1:
+                out = self._columnize_workers(items, schema, axes,
+                                              axis_index, pad_n)
+            if out is None:
+                out = self._call_columnizer(
+                    mod, items, schema, axes, axis_index, pad_n, nthreads)
         except ValueError:
             # the C parser rejected an item: malformed/truncated bytes,
             # or input past its stricter limits (e.g. >256 nesting).
@@ -1145,7 +1466,7 @@ class Flattener:
                 return self.flatten(objects, pad_n=pad_n, reviews=reviews)
             finally:
                 self.lane = prev_lane
-        self.lane_used = "raw"
+        self.lane_used = "raw+workers" if self.last_workers_used else "raw"
         self.perf["c_columnize"] = (self.perf.get("c_columnize", 0.0)
                                     + _time.perf_counter() - _t0)
         _t0 = _time.perf_counter()
@@ -1194,11 +1515,12 @@ class Flattener:
                                   + _time.perf_counter() - _t0)
         return batch
 
-    def _call_columnizer(self, mod, items, schema, axes, axis_index,
-                         pad_n, nthreads):
-        """The raw native call, specs marshalled from the exec schema."""
-        return mod.flatten_json_batch(
-            items,
+    @staticmethod
+    def _columnizer_specs(schema, axes, axis_index) -> tuple:
+        """The plain-tuple spec bundle ``flatten_json_batch`` consumes —
+        shared by the in-process call and the worker-pool jobs (the
+        tuples pickle cheaply; workers never see Schema objects)."""
+        return (
             [tuple(s.path) for s in schema.scalars],
             [a.segments for a in axes],
             [(axis_index[r.axis], tuple(r.subpath))
@@ -1211,12 +1533,145 @@ class Flattener:
              for rk in schema.ragged_keysets],
             [(tuple(cc.path), 1 if cc.ns_scoped else 0)
              for cc in getattr(schema, "canons", [])],
+        )
+
+    def _call_columnizer(self, mod, items, schema, axes, axis_index,
+                         pad_n, nthreads):
+        """The raw native call, specs marshalled from the exec schema."""
+        return mod.flatten_json_batch(
+            items,
+            *self._columnizer_specs(schema, axes, axis_index),
             self.vocab._to_id,
             self.vocab._to_str,
             int(pad_n or len(items)),
             self.bucket,  # ragged bucket, matches round_up()
             nthreads,
         )
+
+    def _columnize_workers(self, items, schema, axes, axis_index, pad_n):
+        """Fan contiguous item spans across the worker pool and merge.
+
+        Returns the merged columnizer output dict, or None when the
+        pool is unavailable / a worker failed non-parse (the caller
+        then takes the in-process columnizer — never a lost batch).  A
+        worker-side parse reject raises ValueError exactly like the
+        in-process call, so the existing dict-lane fallback applies;
+        the shared vocab is untouched on every failure path (merging
+        is the only thing that interns, and it runs only on full
+        success)."""
+        import time as _time
+
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        bounds = flatten_worker_spans(len(items), self.workers)
+        if len(bounds) <= 1:
+            # the native clamp (n/128+1) says this batch is too small to
+            # fan out — the in-process call is both faster and the
+            # bit-identity reference
+            return None
+        nw = len(bounds)
+        fault_point("ops.flatten_workers", n=len(items), workers=nw)
+        t0 = _time.perf_counter()
+        try:
+            pool = get_flatten_pool(self.workers)
+        except Exception:
+            self.perf["worker_fallbacks"] = (
+                self.perf.get("worker_fallbacks", 0.0) + 1.0)
+            return None
+        specs = self._columnizer_specs(schema, axes, axis_index)
+        spans = [items[lo:hi] for lo, hi in bounds]
+        try:
+            replies = pool.run([(sp, specs, len(sp), self.bucket)
+                                for sp in spans])
+        except FlattenPoolError:
+            self.perf["worker_fallbacks"] = (
+                self.perf.get("worker_fallbacks", 0.0) + 1.0)
+            return None
+        parts = []
+        busy = 0.0
+        for sp, reply in zip(spans, replies):
+            if reply[0] != "ok":
+                _tag, ename, msg = reply
+                if ename == "ValueError":
+                    # malformed item: same contract as the in-process
+                    # call — the dict lane re-parses and is the oracle
+                    raise ValueError(msg)
+                self.perf["worker_fallbacks"] = (
+                    self.perf.get("worker_fallbacks", 0.0) + 1.0)
+                return None
+            _tag, out_w, to_str, dt = reply
+            busy += dt
+            parts.append((out_w, to_str, len(sp)))
+        self.perf["worker_columnize"] = (
+            self.perf.get("worker_columnize", 0.0)
+            + _time.perf_counter() - t0)
+        self.perf["worker_busy"] = (
+            self.perf.get("worker_busy", 0.0) + busy)
+        t1 = _time.perf_counter()
+        merged = merge_worker_columns(self.vocab, parts,
+                                      max(pad_n or 0, len(items)))
+        self.perf["worker_merge"] = (
+            self.perf.get("worker_merge", 0.0)
+            + _time.perf_counter() - t1)
+        self.last_workers_used = nw
+        return merged
+
+    def _flatten_differential_workers(self, objects, pad_n, reviews):
+        """``workers`` + ``lane='differential'``: prove the worker pool
+        bit-identical to the in-process path — columns AND the vocab
+        intern ORDER.  The in-process reference (itself the raw-vs-dict
+        differential) runs against a COPY of the vocab so both lanes
+        intern from the same starting state, pinned at
+        ``nthreads=len(spans)`` so its "(thread, first-seen)" merge is
+        the exact order the worker merge claims to replay; the worker
+        lane then runs against the real vocab and the two string tables
+        must match exactly, order included.  Identical columns +
+        identical vocab imply identical verdicts for any program
+        reading them.
+
+        Only raw-eligible batches (all RawJSON + native json built —
+        the gate ``flatten`` itself uses) take the worker comparison:
+        a dict-input batch never engages the pool, and its dict-lane
+        intern order legitimately differs from the raw reference's, so
+        it takes the plain raw-vs-dict differential instead."""
+        from gatekeeper_tpu.utils.rawjson import RawJSON
+
+        raw_ok = False
+        if self.use_native and objects and all(
+                isinstance(o, RawJSON) for o in objects):
+            from gatekeeper_tpu.ops import native
+
+            raw_ok = native.load_json() is not None
+        if not raw_ok:
+            return self._flatten_differential(objects, pad_n, reviews)
+        ref_vocab = Vocab()
+        ref_vocab._to_id = dict(self.vocab._to_id)
+        ref_vocab._to_str = list(self.vocab._to_str)
+        ref = Flattener(self.orig_schema, ref_vocab,
+                        use_native=self.use_native, bucket=self.bucket,
+                        width_targets=self.width_targets,
+                        lane="differential")
+        ref.nthreads = max(1, len(flatten_worker_spans(len(objects),
+                                                       self.workers)))
+        bref = ref.flatten(objects, pad_n=pad_n, reviews=reviews)
+        prev = self.lane
+        try:
+            self.lane = "auto"
+            bw = self.flatten(objects, pad_n=pad_n, reviews=reviews)
+            w_lane = self.lane_used
+        finally:
+            self.lane = prev
+        diff = diff_batches(self.orig_schema, bw, bref)
+        if diff:
+            raise RuntimeError(
+                f"flatten workers differential mismatch ({w_lane} vs "
+                f"{ref.lane_used}): {diff}")
+        if ref_vocab._to_str != self.vocab._to_str:
+            raise RuntimeError(
+                "flatten workers differential: vocab intern order "
+                "diverged from the in-process lane")
+        self.lane_used = f"differential:{w_lane}"
+        return bw
 
     def _flatten_differential(self, objects, pad_n, reviews) -> ColumnBatch:
         """``lane='differential'``: run the raw lane THEN the dict lane

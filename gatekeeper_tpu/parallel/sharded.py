@@ -637,7 +637,7 @@ class ShardedEvaluator:
 
     def __init__(self, driver, mesh: Mesh, violations_limit: int = 20,
                  flatten_lane: str = "auto", metrics=None,
-                 collect: str = "reduced"):
+                 collect: str = "reduced", flatten_workers: int = 0):
         self.driver = driver
         self.mesh = mesh
         self.violations_limit = violations_limit
@@ -645,6 +645,10 @@ class ShardedEvaluator:
         # FLATTEN_LANES) — auto takes the raw-bytes threaded C lane when
         # the lister hands over bytes and the native module built
         self.flatten_lane = flatten_lane
+        # --flatten-workers: raw-lane sweep chunks fan byte spans across
+        # N flatten worker processes (ops/flatten.FlattenWorkerPool),
+        # merged bit-identically on the dispatch thread; 0 = in-process
+        self.flatten_workers = max(0, int(flatten_workers))
         self.metrics = metrics
         # --collect: what a sweep chunk transfers device->host.
         # 'reduced' folds the verdict grid ON DEVICE (per-constraint
@@ -809,7 +813,8 @@ class ShardedEvaluator:
     def _flattener(self, schema: Schema) -> Flattener:
         return Flattener(schema, self.driver.vocab, bucket=self._bucket,
                          width_targets=self._width_targets or None,
-                         lane=self.flatten_lane)
+                         lane=self.flatten_lane,
+                         workers=self.flatten_workers)
 
     def _needs_union(self, kinds, alias: Optional[dict] = None,
                      programs=None) -> dict:
@@ -1058,7 +1063,8 @@ class ShardedEvaluator:
                 schema.merge(self.driver._programs[kind].program.schema)
             fl = Flattener(schema, self.driver.vocab,
                            bucket=self._bucket,
-                           lane=self.flatten_lane)
+                           lane=self.flatten_lane,
+                           workers=self.flatten_workers)
             st = (cons_g, fl, self._needs_union(lowered, fl.alias))
             state[g] = st
             return st
@@ -1287,6 +1293,21 @@ class ShardedEvaluator:
             if dt > 0:
                 self.metrics.set_gauge(M.FLATTEN_OBJECTS_PER_SECOND,
                                        n / dt)
+            wu = getattr(fl, "last_workers_used", 0)
+            if wu:
+                self.metrics.set_gauge(M.FLATTEN_WORKER_COUNT, wu)
+                busy = fl.perf.get("worker_busy", 0.0)
+                if busy > 0:
+                    # aggregate objects per worker-second: the number a
+                    # perfectly-parallel pool would serve per worker
+                    self.metrics.set_gauge(
+                        M.FLATTEN_WORKER_OBJECTS_PER_SECOND, n / busy)
+                self.metrics.set_gauge(M.FLATTEN_WORKER_MERGE_SECONDS,
+                                       fl.perf.get("worker_merge", 0.0))
+            fb = fl.perf.get("worker_fallbacks", 0.0)
+            if fb:
+                self.metrics.inc_counter(M.FLATTEN_WORKER_FALLBACKS,
+                                         value=float(fb))
 
         cols = pack_batch_cols(batch)
         # transfer slimming: ship only the array fields some program reads

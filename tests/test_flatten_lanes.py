@@ -301,3 +301,195 @@ def test_kube_raw_ingest_flattens_identically(corpus):
             kc.close()
     finally:
         srv.stop()
+
+
+# --- 4. host-parallel flatten workers (ISSUE 14) ----------------------
+
+@pytest.fixture(scope="module", autouse=True)
+def _flatten_pools_teardown():
+    yield
+    from gatekeeper_tpu.ops.flatten import shutdown_flatten_pools
+
+    shutdown_flatten_pools()
+
+
+def test_flatten_worker_spans_match_native_partition():
+    from gatekeeper_tpu.ops.flatten import flatten_worker_spans
+
+    # the native clamp: tiny batches stay single-context
+    assert flatten_worker_spans(100, 4) == [(0, 100)]
+    assert flatten_worker_spans(0, 4) == []
+    # ceil-block contiguous ranges, empty tails dropped
+    assert flatten_worker_spans(300, 2) == [(0, 150), (150, 300)]
+    assert flatten_worker_spans(260, 4) == [(0, 87), (87, 174), (174, 260)]
+    # spans cover every item exactly once, in order
+    for n, w in ((1000, 8), (513, 4), (129, 2)):
+        spans = flatten_worker_spans(n, w)
+        assert spans[0][0] == 0 and spans[-1][1] == n
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_flatten_workers_bit_identical_columns_and_vocab(corpus):
+    """The tentpole differential: the worker pool's columns AND vocab
+    string table (order included) equal the in-process lane's at the
+    matching thread partition; workers=0 stays literally the current
+    path."""
+    client, tpu, objects = corpus
+    schema = _union_schema(tpu)
+    n = len(objects)
+
+    v_ref = Vocab()
+    f_ref = Flattener(schema, v_ref, lane="raw")
+    f_ref.nthreads = 2  # the worker partition the pool will use
+    b_ref = f_ref.flatten([as_raw(o) for o in objects], pad_n=192)
+    assert f_ref.lane_used == "raw"
+    assert f_ref.last_workers_used == 0
+
+    v_w = Vocab()
+    f_w = Flattener(schema, v_w, lane="raw", workers=2)
+    b_w = f_w.flatten([as_raw(o) for o in objects], pad_n=192)
+    assert f_w.lane_used == "raw+workers"
+    assert f_w.last_workers_used == 2
+    assert f_w.perf.get("worker_busy", 0.0) > 0
+
+    assert diff_batches(schema, b_ref, b_w) is None
+    assert v_ref._to_str == v_w._to_str  # intern ORDER, not just content
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_flatten_workers_differential_lane(corpus):
+    """lane='differential' + workers asserts the worker pool against
+    the in-process raw-vs-dict differential per batch — columns and
+    vocab order — and reports the composed lane."""
+    client, tpu, objects = corpus
+    schema = _union_schema(tpu)
+    f = Flattener(schema, Vocab(), lane="differential", workers=2)
+    batch = f.flatten([as_raw(o) for o in objects], pad_n=192)
+    assert f.lane_used == "differential:raw+workers"
+    assert batch.n == 192
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_flatten_workers_parse_reject_falls_back_like_in_process(corpus):
+    """A worker-side C parse reject must take the same dict-lane
+    fallback as the in-process call — same columns, same vocab, and
+    the shared vocab untouched by the failed worker pass."""
+    client, tpu, objects = corpus
+    schema = _union_schema(tpu)
+    # deep nesting: the C parser rejects (>256 levels), json.loads accepts
+    deep = RawJSON(b'{"kind":"Pod","metadata":{"name":"deep"},"x":'
+                   + b'[' * 300 + b'1' + b']' * 300 + b'}')
+    mk = lambda: [as_raw(o) for o in objects] + [deep]
+
+    v_w = Vocab()
+    f_w = Flattener(schema, v_w, lane="raw", workers=2)
+    b_w = f_w.flatten(mk(), pad_n=192)
+    assert f_w.lane_used == "dict"
+
+    v_ref = Vocab()
+    f_ref = Flattener(schema, v_ref, lane="raw")
+    b_ref = f_ref.flatten(mk(), pad_n=192)
+    assert f_ref.lane_used == "dict"
+    assert diff_batches(schema, b_w, b_ref) is None
+    assert v_w._to_str == v_ref._to_str
+
+
+@pytest.fixture(scope="module")
+def big_corpus():
+    """A Pod-heavy corpus whose routed chunks exceed the native 128-row
+    fan-out clamp, so sweep chunks actually engage the pool."""
+    client, tpu = _library_client()
+    objects = make_cluster_objects(400, seed=7)
+    for o in objects:
+        if o.get("kind") == "Ingress":
+            client.add_data(o)
+    return client, tpu, objects
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_sweep_verdicts_identical_with_flatten_workers(big_corpus):
+    """The acceptance differential at sweep level: --flatten-workers N
+    produces bit-identical totals + kept violations to the in-process
+    lane over the library corpus, and the worker metrics surface."""
+    client, tpu, objects = big_corpus
+
+    def run_audit(workers, metrics=None):
+        mgr = AuditManager(
+            client, lister=lambda: iter([as_raw(o) for o in objects]),
+            config=AuditConfig(chunk_size=256, exact_totals=False,
+                               pipeline="off"),
+            evaluator=ShardedEvaluator(tpu, make_mesh(),
+                                       violations_limit=20,
+                                       flatten_lane="auto",
+                                       metrics=metrics,
+                                       flatten_workers=workers),
+            metrics=metrics,
+        )
+        return mgr.audit()
+
+    base = run_audit(0)
+    metrics = MetricsRegistry()
+    withw = run_audit(2, metrics=metrics)
+    assert _signature(base) == _signature(withw)
+    assert sum(base.total_violations.values()) > 0
+    # the run is self-describing
+    assert base.flatten_workers == 0 and withw.flatten_workers == 2
+    assert withw.n_devices == 8  # conftest's virtual mesh
+    # some chunk engaged the pool and the metrics surfaced it
+    assert metrics.get_counter(M.FLATTEN_LANE, {"lane": "raw+workers"}) > 0
+    assert metrics.get_gauge(M.FLATTEN_WORKER_COUNT) == 2
+    assert metrics.get_gauge(M.FLATTEN_WORKER_OBJECTS_PER_SECOND) > 0
+
+
+# --- 5. data-parallel chunk sharding (ISSUE 14) -----------------------
+
+def test_shard_chunks_verdicts_identical(corpus):
+    """Packing K consecutive chunks into one mesh-wide dispatch must
+    not change a single verdict — totals, kept order, messages — on
+    the multi-device virtual mesh AND on a 1-device mesh (the tier-1
+    scheduler-path pin; full 4-device parity runs in the slow lane)."""
+    client, tpu, objects = corpus
+
+    def run_audit(shard_chunks, n_devices=None):
+        mgr = AuditManager(
+            client, lister=lambda: iter(objects),
+            config=AuditConfig(chunk_size=24, exact_totals=False,
+                               pipeline="off", shard_chunks=shard_chunks),
+            evaluator=ShardedEvaluator(tpu, make_mesh(n_devices),
+                                       violations_limit=20),
+        )
+        return mgr.audit()
+
+    base = run_audit(0)
+    assert sum(base.total_violations.values()) > 0
+    sharded = run_audit(3)
+    assert _signature(base) == _signature(sharded)
+    assert sharded.shard_chunks == 3 and sharded.n_devices == 8
+    # 1-device scheduler path: coalescing alone, no mesh to shard over
+    one_dev = run_audit(3, n_devices=1)
+    assert _signature(base) == _signature(one_dev)
+    assert one_dev.n_devices == 1
+
+
+def test_shard_chunks_coalesces_same_group_only():
+    """The packer may only merge chunks of the SAME constraint group,
+    flushing partial tails at end of stream."""
+    from gatekeeper_tpu.apis.constraints import Constraint
+    from gatekeeper_tpu.audit.manager import AuditManager as AM
+
+    mgr = AM.__new__(AM)  # no client needed for the source wrapper
+    mgr.config = AuditConfig(shard_chunks=2)
+    ca = Constraint(kind="A", name="a", match={}, parameters={},
+                    enforcement_action="deny")
+    cb = Constraint(kind="B", name="b", match={}, parameters={},
+                    enforcement_action="deny")
+
+    def impl(constraints, kind_filter, use_router, counter):
+        yield [1, 2], [ca]
+        yield [3], [cb]
+        yield [4, 5], [ca]
+        yield [6], [ca]
+    mgr._chunk_source_impl = impl
+    out = list(mgr._chunk_source(None, None, False, [0]))
+    assert out == [([1, 2, 4, 5], [ca]), ([3], [cb]), ([6], [ca])]

@@ -492,3 +492,21 @@ def test_bench_churn_smoke(tmp_path):
     # artifact when the host can hold it — 1-core runs measure GIL
     # contention the background thread cannot remove)
     assert on["p99_ratio"] <= off["p99_ratio"]
+
+
+def test_warm_yield_sized_from_core_count():
+    """ISSUE 14 satellite: the per-kernel cooperative-yield gap comes
+    from the host's core count — 5ms on 1-core (pinned: the measured
+    CHURN_BENCH behavior must not move), a token 1ms on few-core, zero
+    on many-core (a gap there only delays the swap)."""
+    from gatekeeper_tpu.drivers.generation import warm_yield_s
+
+    assert warm_yield_s(1) == 0.005  # 1-core behavior pinned unchanged
+    assert warm_yield_s(2) == 0.001
+    assert warm_yield_s(3) == 0.001
+    assert warm_yield_s(4) == 0.0
+    assert warm_yield_s(64) == 0.0
+    # the default reads the real host
+    import os
+
+    assert warm_yield_s() == warm_yield_s(os.cpu_count() or 1)

@@ -1,0 +1,91 @@
+"""Simulated multi-chip sharded-chunk sweep parity (ISSUE 14).
+
+Promotes the MULTICHIP dryrun to a real ``audit`` pass: a subprocess
+pinned to a 4-device virtual CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=4``) runs the full
+library corpus through the sharded-chunk scheduler
+(``AuditConfig.shard_chunks``) on a 4-way data mesh AND on a 1-device
+mesh, and the verdicts — totals, kept violations, rendered messages —
+must be bit-identical.  Slow lane: the subprocess pays a full library
+compile; tier-1 keeps the in-process 1-device scheduler-path test in
+tests/test_flatten_lanes.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import json, sys
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.synthetic import load_library, make_cluster_objects
+
+import jax
+assert len(jax.devices()) == 4, jax.devices()
+
+cel = CELDriver()
+tpu = TpuDriver(cel_driver=cel)
+client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+load_library(client)
+objects = make_cluster_objects(600, seed=11)
+for o in objects:
+    if o.get("kind") == "Ingress":
+        client.add_data(o)
+
+
+def signature(run):
+    return (
+        sorted((list(k), v) for k, v in run.total_violations.items()),
+        sorted((list(k), [(v.message, v.kind, v.name, v.namespace,
+                           v.enforcement_action) for v in vs])
+               for k, vs in run.kept.items()),
+    )
+
+
+def audit(n_devices, shard_chunks):
+    mgr = AuditManager(
+        client, lister=lambda: iter(objects),
+        config=AuditConfig(chunk_size=64, exact_totals=False,
+                           pipeline="off", shard_chunks=shard_chunks),
+        evaluator=ShardedEvaluator(tpu, make_mesh(n_devices),
+                                   violations_limit=20),
+    )
+    return mgr.audit()
+
+single = audit(1, 0)
+sharded = audit(4, 4)
+print(json.dumps({
+    "violations": sum(single.total_violations.values()),
+    "identical": signature(single) == signature(sharded),
+    "n_devices": sharded.n_devices,
+    "shard_chunks": sharded.shard_chunks,
+}))
+"""
+
+
+@pytest.mark.slow
+def test_sharded_chunk_sweep_4dev_parity_subprocess():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["violations"] > 0
+    assert out["n_devices"] == 4 and out["shard_chunks"] == 4
+    assert out["identical"], out

@@ -1,0 +1,143 @@
+"""Snapshot spill compression (ISSUE 14 satellite): the zlib codec
+round-trips the exact state the 'none' codec does, the header records
+the codec (loader auto-detects either), default 'none' stays
+byte-identical to the pre-codec format, and miss-reason accounting is
+unchanged (a truncated compressed section is MISS_CORRUPT, an unknown
+codec MISS_VERSION).
+
+Fake-snapshot level on purpose: SnapshotSpill's contract with the
+snapshot is four calls (export_state / evaluator.driver.vocab /
+_cons_digest / adopt_spill); the full-stack spill round-trip including
+worker-flattened rows is tests/test_snapshot_persist.py's job.
+"""
+
+import json
+import os
+import zlib
+
+import pytest
+
+from gatekeeper_tpu.ops.flatten import Vocab
+from gatekeeper_tpu.snapshot.persist import (HEADER, MISS_CORRUPT,
+                                             MISS_VERSION, SnapshotSpill)
+
+_STATE = {"rows": 3, "digest": "d1",
+          "payload": list(range(200)) * 50}  # compressible
+
+
+class _FakeDriver:
+    def __init__(self):
+        self.vocab = Vocab()
+
+
+class _FakeEvaluator:
+    def __init__(self):
+        self.driver = _FakeDriver()
+
+
+class _FakeSnapshot:
+    def __init__(self):
+        self.evaluator = _FakeEvaluator()
+        self.adopted = None
+
+    def export_state(self):
+        return dict(_STATE)
+
+    def _cons_digest(self, constraints):
+        return "d1"
+
+    def adopt_spill(self, constraints, state):
+        self.adopted = state
+        return state["rows"]
+
+
+def _spill_dir(tmp_path, name):
+    return str(tmp_path / name)
+
+
+def test_unknown_codec_rejected_at_construction(tmp_path):
+    with pytest.raises(ValueError):
+        SnapshotSpill(_spill_dir(tmp_path, "x"), compress="lz4")
+
+
+def test_none_codec_header_is_pre_codec_format(tmp_path):
+    snap = _FakeSnapshot()
+    spill = SnapshotSpill(_spill_dir(tmp_path, "none"))
+    assert spill.save(snap)["ok"]
+    with open(os.path.join(spill.root, HEADER)) as f:
+        header = json.load(f)
+    assert "codec" not in header  # old loaders keep reading new spills
+    # sections are plain pickles (magic byte), not zlib streams
+    with open(os.path.join(spill.root, "snapshot.rows.pkl"), "rb") as f:
+        assert f.read(1) == b"\x80"
+
+
+def test_zlib_round_trip_identical_state_and_smaller(tmp_path):
+    snap_a, snap_b = _FakeSnapshot(), _FakeSnapshot()
+    plain = SnapshotSpill(_spill_dir(tmp_path, "plain"))
+    packed = SnapshotSpill(_spill_dir(tmp_path, "packed"), compress="zlib")
+    r_plain = plain.save(snap_a)
+    r_packed = packed.save(snap_b)
+    assert r_plain["ok"] and r_packed["ok"]
+    assert r_packed["bytes"] < r_plain["bytes"]  # it actually compressed
+    with open(os.path.join(packed.root, HEADER)) as f:
+        assert json.load(f)["codec"] == "zlib"
+
+    loaded = packed.load(_FakeSnapshot2 := _FakeSnapshot(), [])
+    assert loaded is not None and loaded["rows"] == 3
+    assert _FakeSnapshot2.adopted == _STATE
+    assert packed.load_hits == 1 and packed.load_misses == 0
+
+
+def test_loader_autodetects_either_codec_regardless_of_flag(tmp_path):
+    # written compressed, loaded by a 'none'-configured spill (the
+    # flag never strands an existing spill) — and vice versa
+    d = _spill_dir(tmp_path, "auto")
+    SnapshotSpill(d, compress="zlib").save(_FakeSnapshot())
+    rd = SnapshotSpill(d)  # compress='none'
+    assert rd.load(_FakeSnapshot(), []) is not None
+
+    d2 = _spill_dir(tmp_path, "auto2")
+    SnapshotSpill(d2).save(_FakeSnapshot())
+    rd2 = SnapshotSpill(d2, compress="zlib")
+    assert rd2.load(_FakeSnapshot(), []) is not None
+
+
+def test_corrupt_compressed_section_is_miss_corrupt(tmp_path):
+    d = _spill_dir(tmp_path, "corrupt")
+    spill = SnapshotSpill(d, compress="zlib")
+    assert spill.save(_FakeSnapshot())["ok"]
+    # valid zlib bytes that are NOT the recorded section: sha mismatch
+    # path is already covered; here the sha matches but inflate fails —
+    # rewrite section AND its recorded sha with a truncated stream
+    path = os.path.join(d, "snapshot.rows.pkl")
+    with open(path, "rb") as f:
+        raw = f.read()
+    bad = raw[: len(raw) // 2]
+    with open(path, "wb") as f:
+        f.write(bad)
+    import hashlib
+
+    with open(os.path.join(d, HEADER)) as f:
+        header = json.load(f)
+    header["sections"]["snapshot.rows.pkl"]["sha256"] = \
+        hashlib.sha256(bad).hexdigest()
+    with open(os.path.join(d, HEADER), "w") as f:
+        json.dump(header, f)
+    assert spill.load(_FakeSnapshot(), []) is None
+    assert spill.miss_reasons == {MISS_CORRUPT: 1}
+    # rejected spills are deleted, never half-served
+    assert not os.path.exists(os.path.join(d, HEADER))
+
+
+def test_unknown_codec_in_header_is_version_drift(tmp_path):
+    d = _spill_dir(tmp_path, "future")
+    spill = SnapshotSpill(d)
+    assert spill.save(_FakeSnapshot())["ok"]
+    with open(os.path.join(d, HEADER)) as f:
+        header = json.load(f)
+    header["codec"] = "zstd-9000"
+    with open(os.path.join(d, HEADER), "w") as f:
+        json.dump(header, f)
+    assert spill.load(_FakeSnapshot(), []) is None
+    assert spill.miss_reasons == {MISS_VERSION: 1}
