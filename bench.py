@@ -964,5 +964,17 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "sweep":
         sweep_main(int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000,
                    int(sys.argv[3]) if len(sys.argv) > 3 else 32_768)
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        # fleet packing bench (K small clusters packed vs sequential):
+        # one entry point beside sweep/burst; writes FLEET_BENCH.json
+        import importlib.util as _ilu
+
+        _spec = _ilu.spec_from_file_location(
+            "bench_fleet",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "bench_fleet.py"))
+        _bf = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_bf)
+        sys.exit(_bf.main(sys.argv[2:]))
     else:
         main()

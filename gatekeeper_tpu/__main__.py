@@ -101,6 +101,30 @@ def main(argv=None) -> int:
                         "at sweep scale with the template's "
                         "enforcementAction override (README 'Batched "
                         "mutation & expansion')")
+    p.add_argument("--fleet-config", default="",
+                   help="fleet mode: JSON roster of clusters "
+                        "({'clusters': [{'id': ..., 'manifests': "
+                        "[...]}]}) — one process multiplexes every "
+                        "cluster's audit plane behind SHARED per-library "
+                        "runtimes (clusters running the same template "
+                        "library share compiled executables; a second "
+                        "same-library cluster boots with zero lowering) "
+                        "and the fleet sweep packs small clusters' "
+                        "same-group chunks into device-sized dispatches. "
+                        "Honors --compile-cache (one shared cache), "
+                        "--snapshot-spill (per-cluster subdirs), "
+                        "--audit-interval/--audit-chunk-size/--once "
+                        "(README 'Fleet mode')")
+    p.add_argument("--mutate-ingest", default="dict",
+                   choices=["dict", "raw", "differential"],
+                   help="/v1/mutate burst columnizer: 'dict' keeps the "
+                        "dict-walk lane byte-for-byte; 'raw' serializes "
+                        "each burst once and feeds the PR 4 raw-bytes "
+                        "threaded C columnizer (GIL released) — match "
+                        "walks and patch emission still read the dict "
+                        "objects, so outcomes are lane-invariant; "
+                        "'differential' runs raw THEN dict per batch "
+                        "and asserts the columns bit-identical")
     p.add_argument("--mutate-lane", default="batched",
                    choices=["batched", "host", "differential"],
                    help="/v1/mutate serving lane: 'batched' coalesces "
@@ -415,6 +439,14 @@ def main(argv=None) -> int:
                    help="bind the webhook port with SO_REUSEPORT (set "
                         "automatically for --webhook-workers children)")
     args = p.parse_args(argv)
+
+    if args.fleet_config:
+        # fleet mode is its own process shape (N clusters' audit planes
+        # behind shared runtimes) — the single-cluster wiring below
+        # does not apply
+        from gatekeeper_tpu.fleet.run import run_fleet
+
+        return run_fleet(args)
 
     worker_procs: list = []
     if args.webhook_workers > 1 and args.once:
@@ -969,6 +1001,7 @@ def main(argv=None) -> int:
             mut_lane = MutationLane(
                 mgr.mutation_system, metrics=metrics,
                 differential=args.mutate_lane == "differential",
+                ingest=args.mutate_ingest,
                 # mutator churn recompiles on the generation thread too
                 # (bursts keep the previous revision until the install)
                 coordinator=getattr(tpu, "gen_coord", None))

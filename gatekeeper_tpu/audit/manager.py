@@ -677,6 +677,25 @@ class AuditManager:
             while window:
                 fold_oldest()
 
+    # --- fleet seam (gatekeeper_tpu/fleet/evaluator.py) ------------------
+    def fold_snapshot_segment(self, swept, cons_g, gids, objects) -> None:
+        """Fold ONE cluster's segment of a fleet-packed dispatch into
+        this manager's verdict store and mark its rows clean — the
+        packed twin of the per-chunk collect+fold in
+        :meth:`_snapshot_eval`.  ``swept`` carries segment-rebased hit
+        rows (``fleet.evaluator._SegmentHits`` duck-types the bits
+        slot), so the fold is bit-identical to an unpacked chunk of the
+        same rows: device hits replace verdict-store entries (exact
+        mode renders every hit now), non-lowered constraints run the
+        drivers' exact lane over the segment's objects."""
+        self._fold_snapshot_chunk(swept, cons_g, gids, objects)
+        self.snapshot.mark_clean(gids)
+
+    def snapshot_collect(self, constraints) -> tuple:
+        """(totals, kept) off the verdict store — the fleet scheduler's
+        per-cluster derivation (same path the snapshot tick uses)."""
+        return self._snapshot_collect(constraints)
+
     def _render_fn(self, source=SOURCE_ORIGINAL):
         """(render, review_cache): the exact-engine render for one
         (constraint, object) hit — the same path the relist fold uses,

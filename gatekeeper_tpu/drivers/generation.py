@@ -307,6 +307,17 @@ def programs_digest(driver) -> str:
 WARM_FORMAT = 1
 
 
+def library_warm_dir(root: str, library_digest: str) -> str:
+    """Per-library :class:`WarmStateCache` directory under one shared
+    compile-cache root (fleet mode).  The lowering entries are
+    template-digest-keyed, so N libraries SHARE the root — but warm
+    state is one file per directory, validated against the
+    installed-programs digest, so libraries sharing a root would
+    overwrite each other's.  One subdir per template-set digest keeps
+    every library's warm state resident beside the shared lowerings."""
+    return os.path.join(root, "warm", (library_digest or "default")[:16])
+
+
 class WarmStateCache:
     """Persisted warm execution state under the compile-cache dir.
 

@@ -5,7 +5,7 @@ sink (the operator's black box).
 the process is alive; this command answers it from the ``--flight-
 recorder-sink`` file after the pod is gone — same filter semantics
 (uid, half-open ``[--since, --until)`` time range, decision kinds,
-tenant), most-recent-first, bounded by ``--limit``.
+tenant, fleet cluster), most-recent-first, bounded by ``--limit``.
 
     gator decisions -f decisions.jsonl --decision shed --tenant team-a \
         --since 1700000000 --until 1700000060 -o json
@@ -44,6 +44,7 @@ def read_decisions(path: str, uid: str = "",
                    until: Optional[float] = None,
                    kinds: Optional[set] = None,
                    tenant: Optional[str] = None,
+                   cluster: Optional[str] = None,
                    limit: int = 100) -> dict:
     """Load + filter a flight-recorder JSONL sink.  Returns the same
     payload shape as ``FlightRecorder.snapshot`` (``decisions`` most
@@ -76,9 +77,12 @@ def read_decisions(path: str, uid: str = "",
                 continue
             if tenant is not None and e.get("tenant", "") != tenant:
                 continue
+            if cluster is not None and e.get("cluster", "") != cluster:
+                continue
             decisions.append(e)
     filtered = bool(uid or since is not None or until is not None
-                    or kinds or tenant is not None)
+                    or kinds or tenant is not None
+                    or cluster is not None)
     decisions.reverse()  # most recent first, like /debug/decisions
     out = {"recorded": total, "sink": path,
            "decisions": decisions[: max(0, limit)]}
@@ -94,7 +98,7 @@ def _table(doc: dict) -> str:
     if not rows:
         return "(no matching decisions)"
     cols = ("ts", "endpoint", "decision", "uid", "kind", "namespace",
-            "tenant", "priority", "reason", "cost")
+            "tenant", "cluster", "priority", "reason", "cost")
     rendered = [[("%.3f" % e["ts"]) if c == "ts" and "ts" in e
                  else str(e.get(c, "")) for c in cols] for e in rows]
     widths = [max(len(c), *(len(r[i]) for r in rendered))
@@ -126,6 +130,9 @@ def run_cli(argv: list) -> int:
     p.add_argument("--tenant", default=None,
                    help="one tenant's decisions (the QoS/attribution "
                         "tenant key: namespace or serviceaccount)")
+    p.add_argument("--cluster", default=None,
+                   help="one cluster's decisions (the fleet axis: the "
+                        "serving cluster id recorded per decision)")
     p.add_argument("--limit", type=int, default=100,
                    help="max decisions printed (most recent first)")
     p.add_argument("--output", "-o", default="",
@@ -142,7 +149,8 @@ def run_cli(argv: list) -> int:
     try:
         doc = read_decisions(args.filename, uid=args.uid, since=since,
                              until=until, kinds=kinds or None,
-                             tenant=args.tenant, limit=args.limit)
+                             tenant=args.tenant, cluster=args.cluster,
+                             limit=args.limit)
     except OSError as e:
         print(f"error: reading sink: {e}", file=sys.stderr)
         return 1

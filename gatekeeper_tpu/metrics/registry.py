@@ -493,6 +493,19 @@ SLO_BREACHES = "slo_breach_count"  # {objective}
 # admission flight recorder (observability/flightrec.py): decisions
 # captured into the bounded ring (served at /debug/decisions)
 FLIGHTREC_DECISIONS = "flightrec_decisions_recorded_count"  # {decision}
+# fleet mode (gatekeeper_tpu/fleet/): one evaluator multiplexing N
+# clusters behind shared compile/executable caches — cluster and
+# library-runtime counts, clusters that attached to an ALREADY-BUILT
+# runtime (the zero-lowering boot), packed device dispatches vs the
+# dispatches N independent sweeps would have paid, rows swept per
+# cluster, and the wall seconds of the last fleet pass
+FLEET_CLUSTERS = "fleet_clusters"  # gauge
+FLEET_RUNTIMES = "fleet_library_runtimes"  # gauge
+FLEET_SHARED_BOOTS = "fleet_runtime_shared_boot_count"
+FLEET_PACKED_DISPATCHES = "fleet_packed_dispatch_count"
+FLEET_UNPACKED_DISPATCHES = "fleet_unpacked_dispatch_count"
+FLEET_SWEPT_ROWS = "fleet_swept_rows_count"  # {cluster}
+FLEET_SWEEP_SECONDS = "fleet_sweep_seconds"  # gauge
 # generations (drivers/generation.py, --generation-swap on): the serving
 # generation id, wall seconds of the last background build, completed
 # swaps, and the on-disk compile cache's outcomes — a warm restart shows

@@ -182,12 +182,20 @@ class _PathLowerer:
 
 
 class MutationPrefilter:
-    """[M, N] would-change grids for a set of lowerable mutators."""
+    """[M, N] would-change grids for a set of lowerable mutators.
 
-    def __init__(self, vocab=None):
+    ``flatten_lane`` selects the columnizer the grids run over
+    (``ops.flatten.FLATTEN_LANES``): ``auto`` takes the raw-bytes
+    threaded C lane when the caller hands RawJSON objects over (the
+    ``--mutate-ingest raw`` burst path) and the dict walker otherwise;
+    ``differential`` runs raw THEN dict per batch and asserts the
+    columns bit-identical (the ingest-lane proof)."""
+
+    def __init__(self, vocab=None, flatten_lane: str = "auto"):
         from gatekeeper_tpu.ops.flatten import Vocab
 
         self.vocab = vocab if vocab is not None else Vocab()
+        self.flatten_lane = flatten_lane
         self._programs: dict = {}  # id -> (CompiledProgram, schema)
         self._unsupported: dict = {}  # id -> reason
 
@@ -262,7 +270,9 @@ class MutationPrefilter:
         for _mi, prog in todo:
             schema.merge(prog.program.schema)
         pad = pad_n or max(8, 1 << (n - 1).bit_length())
-        batch = Flattener(schema, self.vocab).flatten(objects, pad_n=pad)
+        batch = Flattener(schema, self.vocab,
+                          lane=self.flatten_lane).flatten(
+            objects, pad_n=pad)
         for mi, prog in todo:
             table = build_param_table(prog.program, [_NoParams()],
                                       self.vocab)
@@ -288,7 +298,9 @@ class MutationPrefilter:
             for prog in self._programs[m.id]:
                 schema.merge(prog.program.schema)
         pad = pad_n or max(8, 1 << (n - 1).bit_length())
-        batch = Flattener(schema, self.vocab).flatten(objects, pad_n=pad)
+        batch = Flattener(schema, self.vocab,
+                          lane=self.flatten_lane).flatten(
+            objects, pad_n=pad)
         for mi, m in todo:
             change[mi] = self._run_on_batch(m, 0, batch, n)
             err[mi] = self._run_on_batch(m, 1, batch, n)
@@ -333,7 +345,9 @@ class MutationPrefilter:
             for prog in self._programs[m.id]:
                 schema.merge(prog.program.schema)
         pad = pad_n or max(8, 1 << (n - 1).bit_length())
-        batch = Flattener(schema, self.vocab).flatten(objects, pad_n=pad)
+        batch = Flattener(schema, self.vocab,
+                          lane=self.flatten_lane).flatten(
+            objects, pad_n=pad)
         for mi, m in todo:
             rel[mi] = self._run_on_batch(m, 2, batch, n)
         return rel, batch

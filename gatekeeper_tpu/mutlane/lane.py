@@ -104,12 +104,12 @@ def _pointer(parts: Sequence[str]) -> str:
 class _Compiled:
     """Frozen compile artifact for one registry revision."""
 
-    def __init__(self, system):
+    def __init__(self, system, flatten_lane: str = "auto"):
         from gatekeeper_tpu.mutation.device import MutationPrefilter
 
         self.revision = system.revision()
         self.active = system.active()
-        self.prefilter = MutationPrefilter()
+        self.prefilter = MutationPrefilter(flatten_lane=flatten_lane)
         self.lowered = []
         self.host_only = []
         for m in self.active:
@@ -155,13 +155,30 @@ class MutationLane:
     a mutator reconcile no longer recompiles on the serving burst —
     bursts keep the previous revision's compiled programs until the
     background thread installs the new ones (the first-ever compile is
-    still inline: there is no stale program to serve)."""
+    still inline: there is no stale program to serve).
+
+    ``ingest`` selects how a burst columnizes into the relevance grids
+    (the PR 4 raw-bytes seam reaching ``/v1/mutate``): ``dict`` keeps
+    the dict-walk columnizer byte-for-byte; ``raw`` serializes each
+    burst once to canonical JSON bytes and feeds the threaded C
+    columnizer (GIL released — the dict walk is the burst's host
+    bottleneck at scale); ``differential`` runs raw THEN dict per
+    batch and asserts the columns bit-identical (the ingest proof).
+    Only the COLUMNIZE lane changes: match walks, patch emission and
+    the host fixed-point authority all keep reading the original dict
+    objects, so outcomes are lane-invariant by construction."""
+
+    INGEST_LANES = ("dict", "raw", "differential")
 
     def __init__(self, system, metrics=None, differential: bool = False,
-                 coordinator=None):
+                 coordinator=None, ingest: str = "dict"):
+        if ingest not in self.INGEST_LANES:
+            raise ValueError(f"unknown mutate ingest lane {ingest!r} "
+                             f"(want one of {self.INGEST_LANES})")
         self.system = system
         self.metrics = metrics
         self.differential = differential
+        self.ingest = ingest
         self._compiled: Optional[_Compiled] = None
         self._lock = threading.Lock()
         self._coordinator = coordinator
@@ -176,7 +193,10 @@ class MutationLane:
 
         with tracing.span("mutlane.compile",
                           revision=self.system.revision()) as sp:
-            c = _Compiled(self.system)
+            c = _Compiled(self.system,
+                          flatten_lane=("differential"
+                                        if self.ingest == "differential"
+                                        else "auto"))
             sp.set_attribute("lowered", len(c.lowered))
             sp.set_attribute("host_only", len(c.host_only))
         return c
@@ -271,7 +291,7 @@ class MutationLane:
                     for oi in range(n)]
 
         rel_grid, batch = c.prefilter.relevance_and_batch(
-            c.lowered, objects)
+            c.lowered, self._ingest_objects(objects))
 
         # host-side exact match matrices (M is small; the grid above is
         # the expensive part).  A matcher that RAISES (e.g. a
@@ -362,6 +382,23 @@ class MutationLane:
                 out.append(self._multi_apply(ms, obj, ns, source))
         self._observe(out)
         return out
+
+    def _ingest_objects(self, objects):
+        """The burst the prefilter's columnize sees.  ``raw``/
+        ``differential``: each object serializes ONCE to canonical JSON
+        bytes and rides a lazy :class:`RawJSON` proxy, so the flatten
+        takes the threaded C columnizer with the GIL released and only
+        slow-path consumers (matchers on matched objects) ever parse.
+        An unserializable burst falls back to the dict lane whole — an
+        ingest lane must never fail a mutation."""
+        if self.ingest == "dict":
+            return objects
+        from gatekeeper_tpu.utils.rawjson import as_raw
+
+        try:
+            return [as_raw(o) for o in objects]
+        except (TypeError, ValueError):
+            return objects
 
     def _probe_host_only(self, obj, matching, ns, source):
         """Iteration-1 probe of the matching host-only mutators: apply

@@ -51,10 +51,11 @@ class CostAttribution:
     registry as `gatekeeper_constraint_eval_seconds`."""
 
     def __init__(self, metrics=None, max_templates: int = 512,
-                 max_tenants: int = 512):
+                 max_tenants: int = 512, max_clusters: int = 512):
         self.metrics = metrics
         self.max_templates = max_templates
         self.max_tenants = max_tenants
+        self.max_clusters = max_clusters
         self._lock = threading.Lock()
         # (template, ep, phase) -> [seconds, passes, rows]
         self._cells: dict = {}
@@ -64,6 +65,13 @@ class CostAttribution:
         # sum to the parent pass's wall) is untouched — tenant seconds
         # are request wall, a different population.
         self._tenant_cells: dict = {}
+        # the {cluster} axis (fleet mode): (cluster, ep) -> [seconds,
+        # passes, rows].  Same additive-cardinality contract as tenants
+        # (templates + tenants + clusters, never their product): fleet
+        # packed dispatches apportion their wall across the clusters
+        # whose rows rode the batch, so "which cluster is expensive" is
+        # a query even when every dispatch is shared.
+        self._cluster_cells: dict = {}
 
     # --- recording -----------------------------------------------------
     def record(self, template: str, enforcement_point: str, phase: str,
@@ -119,6 +127,60 @@ class CostAttribution:
                 {"tenant": key[0], "enforcement_point": enforcement_point,
                  "phase": "admission"},
                 value=seconds)
+
+    def record_cluster(self, cluster: str, enforcement_point: str,
+                       seconds: float, rows: int = 0) -> None:
+        """One cluster's share of a (possibly fleet-packed) pass —
+        the ``{cluster}`` axis on ``gatekeeper_constraint_eval_seconds``
+        (series ``{cluster, enforcement_point, phase="sweep"}``, no
+        template label, additive cardinality).  Past ``max_clusters``
+        new clusters fold into ``other`` here, and the registry's
+        label-cardinality guard bounds the exposed series regardless."""
+        key = (cluster, enforcement_point)
+        with self._lock:
+            cell = self._cluster_cells.get(key)
+            if cell is None:
+                if len(self._cluster_cells) >= self.max_clusters:
+                    key = ("other", enforcement_point)
+                    cell = self._cluster_cells.get(key)
+                if cell is None:
+                    cell = self._cluster_cells[key] = [0.0, 0, 0]
+            cell[0] += seconds
+            cell[1] += 1
+            cell[2] += rows
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(
+                M.CONSTRAINT_EVAL,
+                {"cluster": key[0], "enforcement_point": enforcement_point,
+                 "phase": "sweep"},
+                value=seconds)
+
+    def attribute_clusters(self, wall_s: float, rows: dict,
+                           enforcement_point: str) -> None:
+        """Apportion one packed pass's wall across ``rows``
+        ({cluster: row count}) — shares sum to ``wall_s`` exactly, the
+        same closure contract :meth:`attribute` keeps for templates."""
+        if wall_s <= 0 or not rows:
+            return
+        total = float(sum(max(0, r) for r in rows.values()))
+        n = len(rows)
+        for cluster, r in rows.items():
+            share = (wall_s * max(0, int(r)) / total) if total > 0 \
+                else wall_s / n
+            self.record_cluster(cluster, enforcement_point, share,
+                                rows=int(r))
+
+    def cluster_totals(self, enforcement_point: Optional[str] = None
+                       ) -> dict:
+        """{cluster: attributed seconds} — per-cluster cost roll-up."""
+        out: dict = {}
+        with self._lock:
+            for (cluster, ep), (s, _n, _r) in self._cluster_cells.items():
+                if enforcement_point is None or ep == enforcement_point:
+                    out[cluster] = out.get(cluster, 0.0) + s
+        return out
 
     def tenant_totals(self, enforcement_point: Optional[str] = None
                       ) -> dict:
@@ -179,8 +241,13 @@ class CostAttribution:
                   "admission_cost": round(c, 1)}
                  for (t, ep), (s, n, c) in self._tenant_cells.items()),
                 key=lambda a: -a["seconds"])
-        return {"top": top, "tenants": tenants, "cells": sorted(
-            cells, key=lambda c: -c["seconds"])}
+            clusters = sorted(
+                ({"cluster": cl, "enforcement_point": ep,
+                  "seconds": round(s, 6), "passes": n, "rows": r}
+                 for (cl, ep), (s, n, r) in self._cluster_cells.items()),
+                key=lambda a: -a["seconds"])
+        return {"top": top, "tenants": tenants, "clusters": clusters,
+                "cells": sorted(cells, key=lambda c: -c["seconds"])}
 
     def total_seconds(self, enforcement_point: Optional[str] = None,
                       phase: Optional[str] = None) -> float:
@@ -212,6 +279,7 @@ class CostAttribution:
         with self._lock:
             self._cells.clear()
             self._tenant_cells.clear()
+            self._cluster_cells.clear()
 
 
 # --- activation (the faults.py pattern) -----------------------------------

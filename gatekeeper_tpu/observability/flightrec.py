@@ -52,13 +52,17 @@ class FlightRecorder:
                operation: str = "", message: str = "", lane: str = "",
                cost: float = 0.0, reason: str = "",
                warnings: int = 0, code: int = 0,
-               overload=None, tenant: str = "", **extra) -> dict:
+               overload=None, tenant: str = "", cluster: str = "",
+               **extra) -> dict:
         """One decision.  ``endpoint``: validate|mutate; ``decision``:
         allow|deny|shed|error|deadline.  ``overload`` is the
         OverloadController whose state gets snapshotted (or None).
         ``tenant`` is the QoS/attribution tenant key (namespace or
         serviceaccount) — the axis ``?tenant=`` and ``gator decisions
-        --tenant`` filter on."""
+        --tenant`` filter on.  ``cluster`` (fleet mode) names the
+        serving cluster the decision belongs to — the ``?cluster=`` /
+        ``gator decisions --cluster`` axis, so a fleet's interleaved
+        decision stream stays attributable per cluster."""
         from gatekeeper_tpu.observability import tracing
 
         span = tracing.current_span()
@@ -75,6 +79,8 @@ class FlightRecorder:
             entry["operation"] = operation
         if tenant:
             entry["tenant"] = tenant
+        if cluster:
+            entry["cluster"] = cluster
         if message:
             entry["message"] = message[: self.max_message]
         if lane:
@@ -134,26 +140,30 @@ class FlightRecorder:
                  since: Optional[float] = None,
                  until: Optional[float] = None,
                  kinds: Optional[set] = None,
-                 tenant: Optional[str] = None) -> dict:
+                 tenant: Optional[str] = None,
+                 cluster: Optional[str] = None) -> dict:
         """The ``/debug/decisions`` payload.
 
         ``since``/``until`` bound the decision timestamp (unix seconds,
         half-open ``[since, until)``); ``kinds`` keeps only the named
         decision kinds (allow|deny|shed|error|deadline); ``tenant``
-        keeps one tenant's decisions (the QoS/attribution axis).
+        keeps one tenant's decisions (the QoS/attribution axis);
+        ``cluster`` keeps one cluster's decisions (the fleet axis).
         Filters compose with each other and with ``uid``, so "every
         shed tenant-a took between 14:02 and 14:03" is one query
         instead of a ring dump."""
         with self._lock:
             ring = list(self._ring)
         filtered = since is not None or until is not None or kinds \
-            or tenant is not None
+            or tenant is not None or cluster is not None
         if filtered:
             ring = [e for e in ring
                     if (since is None or e.get("ts", 0.0) >= since)
                     and (until is None or e.get("ts", 0.0) < until)
                     and (not kinds or e.get("decision") in kinds)
-                    and (tenant is None or e.get("tenant", "") == tenant)]
+                    and (tenant is None or e.get("tenant", "") == tenant)
+                    and (cluster is None
+                         or e.get("cluster", "") == cluster)]
         if uid:
             matched = [e for e in ring if e.get("uid") == uid]
             return {"uid": uid, "recorded": self.recorded,

@@ -670,6 +670,11 @@ class ShardedEvaluator:
         # (first call per input-shape signature) bumps it — the
         # "zero retraces after a warm restart" pin reads the delta
         self.trace_count = 0
+        # device-dispatch counter: every real sweep dispatch (incl. the
+        # reduced lane's masks fallback re-dispatch) bumps it — the
+        # fleet packing win (K clusters' chunks collapsing into one
+        # dispatch) reads the delta, as does FLEET_BENCH
+        self.dispatch_count = 0
         # warm-state record (drivers/generation.WarmStateCache): every
         # NEW fused executable's serializable descriptor + the input
         # avals its first dispatch traced at, so a restarted process can
@@ -1381,6 +1386,7 @@ class ShardedEvaluator:
         from gatekeeper_tpu.resilience.faults import fault_point
 
         fault_point("device.dispatch", lane="sweep", n=flat.n)
+        self.dispatch_count += 1
         from gatekeeper_tpu.ir import masks as masks_mod
 
         by_kind = flat.by_kind

@@ -219,24 +219,37 @@ def normalize_serviceaccount(username: str) -> Optional[str]:
     return _SA_PREFIX + ns + ":" + name
 
 
-def tenant_of_request(req: dict, tenant_key: str = TENANT_NAMESPACE) -> str:
+def tenant_of_request(req: dict, tenant_key: str = TENANT_NAMESPACE,
+                      cluster: str = "") -> str:
     """Tenant identity of an AdmissionReview ``request`` dict — the
     attribution key shared by QoS, the flight recorder and the cost
     grid's ``{tenant}`` axis.  Under the serviceaccount key, SA-shaped
     usernames normalize through :func:`normalize_serviceaccount`;
     malformed SA triples fold into the cluster tenant (a spoofed-looking
     identity must not mint itself a fresh fair-share queue), and non-SA
-    users keep their username."""
+    users keep their username.
+
+    ``cluster`` (fleet mode) prefixes the tenant with the serving
+    cluster's id — the cluster → tenant → priority routing key: every
+    cluster's namespaces get their OWN fair-share queues (``team-a`` on
+    cluster-1 and ``team-a`` on cluster-2 are different tenants, with
+    independent DRR deficits, inflight caps and displacement ledgers),
+    while priority classification stays request-derived — one cluster's
+    user flood ranks below every cluster's system lane and can never
+    displace it."""
     if tenant_key == TENANT_SERVICEACCOUNT:
         user = ((req.get("userInfo") or {}).get("username", "")) or ""
         if not user:
-            return CLUSTER_TENANT
-        if user.lower().startswith(_SA_PREFIX) or \
+            tenant = CLUSTER_TENANT
+        elif user.lower().startswith(_SA_PREFIX) or \
                 user.startswith(_SA_PREFIX):
-            return normalize_serviceaccount(user) or CLUSTER_TENANT
-        return user
-    ns = req.get("namespace", "") or ""
-    return ns or CLUSTER_TENANT
+            tenant = normalize_serviceaccount(user) or CLUSTER_TENANT
+        else:
+            tenant = user
+    else:
+        ns = req.get("namespace", "") or ""
+        tenant = ns or CLUSTER_TENANT
+    return f"{cluster}:{tenant}" if cluster else tenant
 
 
 class TenantCostLedger:

@@ -12,6 +12,7 @@ from gatekeeper_tpu.snapshot.store import (  # noqa: F401
     GroupStore,
     SnapshotConfig,
     VerdictStore,
+    concat_group_rows,
     obj_key,
     row_signature,
 )

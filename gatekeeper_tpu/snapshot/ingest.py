@@ -39,12 +39,16 @@ class WatchIngester:
 
     def __init__(self, snapshot, source, gvks: Sequence[tuple],
                  on_error: Optional[Callable[[Exception], None]] = None,
-                 from_rvs: Optional[dict] = None):
+                 from_rvs: Optional[dict] = None, cluster: str = ""):
         self.snapshot = snapshot
         self.source = source
         self.gvks = list(gvks)
         self.on_error = on_error
         self.from_rvs = dict(from_rvs or {})
+        # fleet mode: which cluster this ingester feeds — the id the
+        # FleetEvaluator labels its metrics/log lines with, so N
+        # ingesters' errors and rv marks stay attributable
+        self.cluster = cluster
         # gvk -> newest seen resourceVersion; starts at the resume marks
         # so a quiet restart's next spill keeps the spilled rvs
         self.rvs: dict = dict(self.from_rvs)

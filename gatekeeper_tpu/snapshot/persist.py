@@ -75,6 +75,7 @@ MISS_VERSION = "version"    # format / flatten-schema / jax drift
 MISS_PLAN = "plan"          # constraint- or template-set digest drift
 MISS_VOCAB = "vocab"        # spilled vocab not replayable here
 MISS_SCHEMA = "schema"      # a group's schema digest drifted
+MISS_CLUSTER = "cluster"    # header's cluster id != this spill's owner
 
 
 def templates_digest(client) -> str:
@@ -115,9 +116,17 @@ class SnapshotSpill:
     The header is written LAST (tmp + rename), so its presence commits
     the spill; a load that finds any section torn, truncated or
     tampered deletes the whole spill and reports a miss.
+
+    ``cluster_id`` (fleet mode — one spill subdir per cluster under a
+    shared ``--snapshot-spill`` root): the id is written into the
+    header and checked on load.  A mismatch (a cluster pointed at a
+    sibling's spill dir) is a counted ``cluster`` miss and a clean
+    relist — the spill itself is NOT deleted, it still belongs to its
+    real owner.
     """
 
-    def __init__(self, root: str, metrics=None, compress: str = "none"):
+    def __init__(self, root: str, metrics=None, compress: str = "none",
+                 cluster_id: str = ""):
         if compress not in SPILL_CODECS:
             raise ValueError(
                 f"unknown spill codec {compress!r} (want one of "
@@ -126,6 +135,7 @@ class SnapshotSpill:
         os.makedirs(root, exist_ok=True)
         self.metrics = metrics
         self.compress = compress
+        self.cluster_id = cluster_id
         self.load_hits = 0
         self.load_misses = 0
         self.miss_reasons: dict = {}
@@ -237,6 +247,10 @@ class SnapshotSpill:
                     # stay byte-identical to the pre-codec format
                     **({"codec": self.compress}
                        if self.compress != "none" else {}),
+                    # cluster ownership (fleet mode); absent for the
+                    # single-cluster shape, keeping it byte-identical
+                    **({"cluster": self.cluster_id}
+                       if self.cluster_id else {}),
                     "templates": captured.get("templates", ""),
                     "rows": state.get("rows", 0),
                     "rv": {_gvk_key(g): rv
@@ -321,6 +335,13 @@ class SnapshotSpill:
                 or header.get("jaxlib") != jlv):
             self._reject(MISS_VERSION)
             return None
+        if self.cluster_id and \
+                header.get("cluster", "") != self.cluster_id:
+            # another cluster's spill (misrouted --snapshot-spill dir):
+            # counted miss + clean relist, but NEVER deleted — the data
+            # still belongs to its real owner
+            self._count(False, MISS_CLUSTER)
+            return None
         if header.get("templates", "") != templates:
             self._reject(MISS_PLAN)
             return None
@@ -364,16 +385,26 @@ class SnapshotSpill:
         if state.get("digest") != snapshot._cons_digest(constraints):
             self._reject(MISS_PLAN)
             return None
-        # vocab replay (the CompileCache rule): current interned strings
-        # must be the spill's prefix, then the tail interns in recorded
-        # order so every resident sid points at the same string here
+        # vocab replay (the CompileCache rule, extended one direction
+        # for fleet mode): the spill's snapshot and the current table
+        # must be prefix-compatible.  Current ⊆ snapshot replays the
+        # tail in recorded order (the restart shape); snapshot ⊆
+        # current is ALSO a hit with nothing to replay — a sibling
+        # cluster's earlier load (or its template boot) already grew
+        # the shared append-only vocab past this spill's snapshot, and
+        # every resident sid still points at the same string.  Loading
+        # a fleet is therefore N spills against one shared replay.
         vocab = snapshot.evaluator.driver.vocab
         cur = vocab._to_str
-        if len(cur) > len(vocab_snap) or vocab_snap[: len(cur)] != cur:
-            self._count(False, MISS_VOCAB)  # spill itself is fine
+        if len(cur) <= len(vocab_snap):
+            if vocab_snap[: len(cur)] != cur:
+                self._count(False, MISS_VOCAB)  # spill itself is fine
+                return None
+            for s in vocab_snap[len(cur):]:
+                vocab.intern(s)
+        elif cur[: len(vocab_snap)] != vocab_snap:
+            self._count(False, MISS_VOCAB)
             return None
-        for s in vocab_snap[len(cur):]:
-            vocab.intern(s)
         try:
             rows = snapshot.adopt_spill(constraints, state)
         except ValueError:

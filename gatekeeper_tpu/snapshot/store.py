@@ -552,6 +552,59 @@ class GroupStore:
         self.tombstones = sum(1 for alive in self.live if not alive)
 
 
+def concat_group_rows(parts: Sequence[tuple], pad_n: int) -> ColumnBatch:
+    """Gather rows of SEVERAL same-plan GroupStores into one packed
+    chunk-shaped :class:`ColumnBatch` — the fleet packer's batch
+    builder (``fleet/evaluator.py``): K small clusters' same-group rows
+    ride one device dispatch instead of K underfilled ones.
+
+    ``parts`` is ``[(store, positions)]``; segments land in order, so
+    every cluster's rows keep their canonical row order inside the
+    packed batch (the bit-identity precondition the per-cluster fold
+    relies on).  Per array path the widest tail wins — ragged pad
+    widths are data-dependent per store, and narrower segments pad
+    with the family fill, exactly the reconciliation
+    :meth:`GroupStore._write_rows` applies.  Pad rows beyond the real
+    rows carry the same fills a fresh flatten's pad region would.
+    Prefix-axis aliases re-attach off the first store's flattener.
+    The caller guarantees the stores share one columnize plan (same
+    library runtime, same constraint group — same schema digest)."""
+    paths: dict = {}  # path -> [tail, dtype, fill]
+    arrs: list = []   # per part: {path: array}
+    for store, _positions in parts:
+        per: dict = {}
+        for path, arr, fill in _iter_arrays(store.batch):
+            if arr is None:
+                continue
+            per[path] = arr
+            prev = paths.get(path)
+            if prev is None:
+                paths[path] = [arr.shape[1:], arr.dtype, fill]
+            else:
+                prev[0] = tuple(max(a, b) for a, b in
+                                zip(prev[0], arr.shape[1:]))
+        arrs.append(per)
+    out = ColumnBatch(n=pad_n, scalars={}, raggeds={}, axis_counts={},
+                      keysets={})
+    for path, (tail, dtype, fill) in paths.items():
+        full = np.full((pad_n,) + tuple(tail), fill, dtype)
+        off = 0
+        for (store, positions), per in zip(parts, arrs):
+            k = len(positions)
+            arr = per.get(path)
+            if k and arr is not None:
+                idx = np.asarray(positions, np.intp)
+                region = (slice(off, off + k),) + tuple(
+                    slice(0, s) for s in arr.shape[1:])
+                full[region] = arr[idx]
+            off += k
+        _set_arr(out, path, full)
+    fl = parts[0][0].flattener
+    if fl is not None:
+        fl._apply_alias(out)
+    return out
+
+
 class VerdictStore:
     """Per-(constraint, row) audit results, keyed by stable row id.
 

@@ -239,10 +239,11 @@ class WebhookServer:
                         kinds = {k for v in (q.get("decision") or [])
                                  for k in v.split(",") if k}
                         tenant = (q.get("tenant") or [None])[0]
+                        cluster = (q.get("cluster") or [None])[0]
                         self._reply(200, rec.snapshot(
                             uid=uid or None, limit=limit, since=since,
                             until=until, kinds=kinds or None,
-                            tenant=tenant))
+                            tenant=tenant, cluster=cluster))
                 elif self.path == METRICS_PATH and outer.metrics is not None:
                     # content negotiation: OpenMetrics (exemplars on the
                     # histogram buckets + # EOF) when the scraper asks

@@ -219,6 +219,10 @@ def test_audit_one_core_detection_takes_serial_path(monkeypatch):
     assert run3.total_violations == run.total_violations
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 15): the pipelined-vs-
+# serial differential above keeps the schedule's bit-identity in
+# tier-1; this backpressure stress (tiny queue bounds, 1-core) rides
+# the slow lane
 def test_audit_pipeline_backpressure_tiny_bounds():
     """Acceptance: queue bound of 1 + submit window of 1 over many small
     chunks — no deadlock, bounded in-flight depth, identical output."""

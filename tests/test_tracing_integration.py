@@ -250,6 +250,10 @@ def test_serial_sweep_emits_chunk_spans():
     assert all(s["parent_id"] == root["span_id"] for s in subs)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 15): observability
+# on-vs-off bit-identity stays pinned in tier-1 by
+# test_obs_integration.py::test_observability_on_vs_off_bit_identical;
+# this tracing-scoped twin rides the slow lane
 def test_tracing_differential_verdicts_bit_identical():
     """Acceptance: tracer-on vs tracer-off (and the empty sampler) are
     bit-identical on totals AND rendered kept messages over the library

@@ -1,0 +1,222 @@
+"""Fleet packing bench: K small clusters packed vs sequential.
+
+The headline number for fleet mode (ROADMAP "one evaluator, N
+clusters"): K clusters running the same template library, each too
+small to fill a device batch, swept (a) SEQUENTIALLY — each cluster's
+chunks dispatch alone, the N-independent-sweeps geometry — and (b)
+PACKED — the fleet scheduler coalesces same-group chunks across
+clusters into device-sized dispatches.  Verdicts are bit-identical by
+construction (asserted here per cluster); the wins are the device
+dispatch count (fixed per-dispatch costs: masks, wire pack,
+device_put commands, jit call) and padding waste, both collapsing
+~K-fold.  Also records the runtime-sharing story: every cluster past
+the first attaches with zero fresh lowerings and zero fused retraces.
+
+Appends the previous latest record to the ``history`` list in
+``FLEET_BENCH.json`` (the FLATTEN_BENCH convention).  Run:
+
+    python tools/bench_fleet.py [--smoke] [--out PATH]
+
+``--smoke`` (fewer clusters/objects) runs in tier-1 via
+tests/test_fleet.py so the bench script itself cannot rot; it pins the
+dispatch-count reduction >= 2x at K=4.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_KEEP = 5  # template-subset library: bounded compile wall (1-core host)
+
+
+def _all_kinds():
+    from gatekeeper_tpu.utils.synthetic import library_dir
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    paths = sorted(
+        glob.glob(os.path.join(library_dir(), "general", "*",
+                               "template.yaml")) +
+        glob.glob(os.path.join(library_dir(), "pod-security-policy", "*",
+                               "template.yaml")))
+    return [load_yaml_file(p)[0]["spec"]["crd"]["spec"]["names"]["kind"]
+            for p in paths]
+
+
+def _builder(cache_dir: str, skip, lower_counter=None):
+    def build():
+        from gatekeeper_tpu.apis.constraints import AUDIT_EP
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.drivers.cel_driver import CELDriver
+        from gatekeeper_tpu.drivers.generation import CompileCache
+        from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+        from gatekeeper_tpu.parallel.sharded import (ShardedEvaluator,
+                                                     make_mesh)
+        from gatekeeper_tpu.target.target import K8sValidationTarget
+        from gatekeeper_tpu.utils.synthetic import load_library
+
+        cel = CELDriver()
+        tpu = TpuDriver(cel_driver=cel,
+                        compile_cache=CompileCache(cache_dir))
+        client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                        enforcement_points=[AUDIT_EP])
+        load_library(client, skip_kinds=skip)
+        ev = ShardedEvaluator(tpu, make_mesh(), violations_limit=20)
+        return client, tpu, ev
+
+    return build
+
+
+def _make_fleet(k: int, n_objects: int, chunk: int, cache_dir: str,
+                seed0: int = 11):
+    """A K-cluster fleet over one shared library runtime."""
+    from gatekeeper_tpu.fleet import FleetEvaluator
+    from gatekeeper_tpu.sync.source import FakeCluster
+    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
+
+    skip = tuple(_all_kinds()[_KEEP:])
+    fleet = FleetEvaluator(chunk_size=chunk, exact_totals=False)
+    for i in range(k):
+        src = FakeCluster()
+        for o in make_cluster_objects(n_objects, seed=seed0 + i):
+            src.apply(copy.deepcopy(o))
+        fleet.add_cluster(f"c{i:02d}", src, "lib", _builder(cache_dir,
+                                                            skip))
+    return fleet
+
+
+def _sweep_lane(fleet, pack: bool) -> dict:
+    """One full fleet pass; every snapshot re-dirtied first so both
+    lanes evaluate identical row sets."""
+    rt = fleet.runtimes()[0]
+    ev = rt.evaluator
+    d0, t0c = ev.dispatch_count, ev.trace_count
+    t0 = time.perf_counter()
+    runs = fleet.sweep(full=True, pack=pack)
+    wall = time.perf_counter() - t0
+    return {
+        "wall_s": round(wall, 3),
+        "dispatches": ev.dispatch_count - d0,
+        "traces": ev.trace_count - t0c,
+        "runs": runs,
+    }
+
+
+def run_bench(k: int = 4, n_objects: int = 96, chunk: int = 500,
+              out_path: str = None, write: bool = True,
+              cache_dir: str = None) -> dict:
+    """``cache_dir``: reuse a warm on-disk compile cache (the tier-1
+    smoke shares the test module's, so the bench measures dispatch
+    geometry instead of template lowering)."""
+    import contextlib
+
+    from gatekeeper_tpu.audit.manager import AuditManager
+
+    record = {
+        "kind": "fleet_bench",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_cpus": os.cpu_count() or 1,
+        "clusters": k,
+        "objects_per_cluster": n_objects,
+        "chunk_size": chunk,
+    }
+    ctx = (contextlib.nullcontext(cache_dir) if cache_dir
+           else tempfile.TemporaryDirectory(prefix="gtpu-fleet-cc-"))
+    with ctx as d:
+        fleet = _make_fleet(k, n_objects, chunk, d)
+        rt = fleet.runtimes()[0]
+        record["library_runtimes"] = len(fleet.runtimes())
+        record["shared_boots"] = fleet.shared_boots
+        cc = rt.driver._compile_cache
+        record["compile_cache"] = cc.stats() if cc is not None else {}
+
+        # warm pass: land the packed and unpacked executables so the
+        # timed lanes measure dispatch geometry, not jit compiles
+        _sweep_lane(fleet, pack=True)
+        for fc in fleet.clusters.values():
+            for store, rows in fc.snapshot.all_rows().items():
+                fc.snapshot._dirty.update(g for g, _p in rows)
+        _sweep_lane(fleet, pack=False)
+
+        lanes = {}
+        ref_runs = None
+        for name, pack in (("sequential", False), ("packed", True)):
+            for fc in fleet.clusters.values():
+                for store, rows in fc.snapshot.all_rows().items():
+                    fc.snapshot._dirty.update(g for g, _p in rows)
+            lane = _sweep_lane(fleet, pack=pack)
+            runs = lane.pop("runs")
+            if ref_runs is None:
+                ref_runs = runs
+            else:
+                for cid, run in runs.items():
+                    ref = ref_runs[cid]
+                    diff = AuditManager._verdicts_differ_canonical(
+                        run.kept, run.total_violations,
+                        ref.kept, ref.total_violations, 20)
+                    if diff is not None:
+                        raise AssertionError(
+                            f"packed != sequential for {cid}: {diff}")
+            lane["violations"] = sum(
+                sum(r.total_violations.values()) for r in runs.values())
+            lanes[name] = lane
+        record["lanes"] = lanes
+        seq, packed = lanes["sequential"], lanes["packed"]
+        record["headline"] = {
+            "dispatch_reduction": round(
+                seq["dispatches"] / max(1, packed["dispatches"]), 2),
+            "wall_ratio": round(
+                packed["wall_s"] / seq["wall_s"], 3)
+            if seq["wall_s"] else None,
+            "verdicts_bit_identical": True,
+            "second_cluster_zero_lowering": fleet.shared_boots == k - 1,
+        }
+        fleet.stop()
+    if write:
+        out = out_path or os.path.join(os.path.dirname(__file__), "..",
+                                       "FLEET_BENCH.json")
+        history = []
+        if os.path.exists(out):
+            try:
+                with open(out) as fh:
+                    prev = json.load(fh)
+                history = prev.pop("history", [])
+                history.append(prev)  # previous latest becomes history
+            except Exception:
+                history = []
+        record_out = dict(record)
+        record_out["history"] = history
+        with open(out, "w") as fh:
+            json.dump(record_out, fh, indent=1)
+    return record
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+    argv = [a for a in argv if a != "--smoke"]
+    out = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        out = argv[i + 1]
+        del argv[i: i + 2]
+    if smoke:
+        rec = run_bench(k=4, n_objects=40, out_path=out,
+                        write=out is not None)
+    else:
+        rec = run_bench(out_path=out)
+    print(json.dumps({"headline": rec["headline"],
+                      "lanes": rec["lanes"],
+                      "shared_boots": rec["shared_boots"]}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
