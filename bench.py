@@ -964,6 +964,18 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "sweep":
         sweep_main(int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000,
                    int(sys.argv[3]) if len(sys.argv) > 3 else 32_768)
+    elif len(sys.argv) > 1 and sys.argv[1] == "replay":
+        # replay bench (record -> candidate replay: bit-identity +
+        # zero-fresh-lowering pins): writes REPLAY_BENCH.json
+        import importlib.util as _ilu
+
+        _spec = _ilu.spec_from_file_location(
+            "bench_replay",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "bench_replay.py"))
+        _br = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_br)
+        sys.exit(_br.main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
         # fleet packing bench (K small clusters packed vs sequential):
         # one entry point beside sweep/burst; writes FLEET_BENCH.json

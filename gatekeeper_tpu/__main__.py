@@ -416,7 +416,24 @@ def main(argv=None) -> int:
     p.add_argument("--flight-recorder-sink", default="",
                    help="append every flight-recorder decision to this "
                         "JSONL file (the operator's black box; decision "
-                        "metadata only, never object bodies)")
+                        "metadata only, never object bodies — unless "
+                        "--flight-recorder-capture)")
+    p.add_argument("--flight-recorder-capture", action="store_true",
+                   help="capture mode: sink lines additionally carry "
+                        "the raw admission request (the `gator replay` "
+                        "corpus). The in-memory ring stays metadata-"
+                        "only; the sink then holds Secrets-grade data")
+    p.add_argument("--shadow-candidate", action="append", default=[],
+                   help="shadow canary: candidate library file/dir "
+                        "(repeatable). Copies of live admissions "
+                        "evaluate against it off the response path — "
+                        "verdicts are never answered; /debug/shadow, "
+                        "gatekeeper_shadow_* metrics, and the shadow-"
+                        "divergence-rate SLO objective carry the "
+                        "promote/abort signal")
+    p.add_argument("--shadow-sink", default="",
+                   help="append shadow verdicts to this JSONL file "
+                        "(the shadow flight-recorder stream)")
     p.add_argument("--webhook-deadline", type=float, default=0.0,
                    help="per-admission wall-clock budget in seconds; on "
                         "expiry the request resolves per "
@@ -612,7 +629,8 @@ def main(argv=None) -> int:
         flight_rec = _flightrec.FlightRecorder(
             capacity=args.flight_recorder,
             sink_path=args.flight_recorder_sink or None,
-            metrics=metrics)
+            metrics=metrics,
+            capture=args.flight_recorder_capture)
         _flightrec.install(flight_rec)
     slo_engine = None
     if args.slo == "on" and not args.once:
@@ -622,6 +640,14 @@ def main(argv=None) -> int:
             slo_kw["objectives"] = cfg["objectives"]
             if cfg["tiers"]:
                 slo_kw["tiers"] = cfg["tiers"]
+        elif args.shadow_candidate:
+            # shadow canary on: the divergence-rate objective rides the
+            # default set (an explicit --slo-config replaces defaults
+            # wholesale, shadow objective included, like everything else)
+            from gatekeeper_tpu.replay.shadow import SHADOW_OBJECTIVE
+
+            slo_kw["objectives"] = (list(_slo.DEFAULT_OBJECTIVES)
+                                    + [SHADOW_OBJECTIVE])
         slo_engine = _slo.SLOEngine(metrics, brownout=overload_ctl,
                                     **slo_kw)
         if args.slo_brownout and overload_ctl is not None:
@@ -688,6 +714,34 @@ def main(argv=None) -> int:
     if getattr(tpu, "gen_coord", None) is not None:
         # pre-swap warm traces changed kernels at the real serving shape
         tpu.gen_coord.constraints_fn = client.constraints
+    shadow_lane = None
+    if args.shadow_candidate and not args.once:
+        # continuous shadow canary (replay/shadow.py): the candidate
+        # library loads through the same on-disk compile cache as
+        # serving, so a warmed candidate attaches with zero fresh
+        # lowerings; the webhook's per-decision hook feeds the lane
+        from gatekeeper_tpu.gator import reader as _reader
+        from gatekeeper_tpu.replay import core as _replay_core
+        from gatekeeper_tpu.replay import shadow as _shadow
+
+        try:
+            _cand_docs = _reader.read_sources(args.shadow_candidate)
+            _cand_rt = _replay_core.load_candidate(
+                _cand_docs, compile_cache_dir=args.compile_cache,
+                metrics=metrics)
+            _shadow_rec = None
+            if args.shadow_sink:
+                _shadow_rec = _flightrec.FlightRecorder(
+                    capacity=1024, sink_path=args.shadow_sink)
+            shadow_lane = _shadow.ShadowLane(
+                _cand_rt, serving_client=client,
+                candidate_docs=_cand_docs, recorder=_shadow_rec,
+                metrics=metrics).start()
+            _shadow.install(shadow_lane)
+            print(f"shadow canary active: {len(_cand_docs)} candidate "
+                  f"docs (/debug/shadow)", file=sys.stderr)
+        except Exception as e:
+            print(f"shadow canary disabled: {e}", file=sys.stderr)
     kube_cluster = None
     if args.kubeconfig:
         from gatekeeper_tpu.sync.kube import KubeCluster, KubeConfig
@@ -1211,6 +1265,13 @@ def main(argv=None) -> int:
         _gc = getattr(tpu, "gen_coord", None)
         if _gc is not None:
             _gc.stop()
+        if shadow_lane is not None:
+            from gatekeeper_tpu.replay import shadow as _shadow
+
+            _shadow.uninstall()
+            shadow_lane.stop()
+            if shadow_lane.recorder is not None:
+                shadow_lane.recorder.close()
         if slo_engine is not None:
             slo_engine.stop()
         if flight_rec is not None:

@@ -61,6 +61,37 @@ MODES = ("batched", "perkey", "differential")
 # not happen: ProviderCache.fetch answers every key, value or error)
 _NOT_LANDED = "external data: key not resolved"
 
+# the declared provider-response entry schema at the ProviderColumns
+# ingest boundary: key -> (json-typed value, error-string-or-None)
+_JSON_TYPES = (type(None), bool, int, float, str, list, dict)
+_MALFORMED = "malformed provider response"
+
+
+def validate_landed(landed: dict) -> tuple:
+    """Response-schema gate at the ProviderColumns ingest boundary.
+
+    Whatever the transport/cache layer handed back, only well-formed
+    ``key -> (json-value, error-or-None)`` entries may land in a
+    resident column.  A malformed entry becomes the already-pinned
+    per-key failure semantics — an error entry the placeholder failure
+    policy handles — never a crash, never a poisoned column; a non-str
+    key (nothing requested it, nothing could read it) drops.  Returns
+    ``(clean_entries, n_malformed)``."""
+    out: dict = {}
+    bad = 0
+    for key, entry in landed.items():
+        if not isinstance(key, str):
+            bad += 1
+            continue
+        if isinstance(entry, (tuple, list)) and len(entry) == 2 \
+                and isinstance(entry[0], _JSON_TYPES) \
+                and (entry[1] is None or isinstance(entry[1], str)):
+            out[key] = (entry[0], entry[1])
+        else:
+            bad += 1
+            out[key] = (None, _MALFORMED)
+    return out, bad
+
 
 class ExtDataDivergence(AssertionError):
     """The batched join disagreed with the per-key reference."""
@@ -191,6 +222,8 @@ class ExtDataLane:
 
                     self.metrics.inc_counter(
                         M.EXTDATA_BULK_CALLS, {"provider": provider})
+            landed, n_bad = validate_landed(landed)
+            self._count_keys(provider, "malformed", n_bad)
             col.land(landed)
         self._count_keys(provider, "fetched", len(missing))
         if self.metrics is not None:

@@ -274,10 +274,23 @@ class ProviderCache:
             return out
         breaker.record_success()
         items = deep_get(resp, ("response", "items"), []) or []
+        if not isinstance(items, list):
+            items = []  # schema drift: every key degrades below
         got = {}
         for item in items:
-            got[item.get("key")] = (item.get("value"),
-                                    item.get("error") or None)
+            # response-schema hardening: a misbehaving provider may
+            # return non-dict items or non-string keys/errors — skip or
+            # coerce so the affected keys degrade to the per-key
+            # "key not returned" error instead of crashing the batch
+            if not isinstance(item, dict):
+                continue
+            key = item.get("key")
+            if not isinstance(key, str):
+                continue
+            err = item.get("error")
+            if err is not None and not isinstance(err, str):
+                err = str(err)
+            got[key] = (item.get("value"), err or None)
         with self._lock:
             for key in missing:
                 value = got.get(key, (None, "key not returned"))

@@ -106,6 +106,10 @@ class LibraryRuntime:
         self.driver = driver
         self.evaluator = evaluator
         self.clusters: list = []  # FleetCluster, attach order
+        # persisted warm execution state (drivers/generation.py
+        # WarmStateCache), wired by FleetEvaluator when warm_root is set
+        self.warm_cache = None
+        self.warm_replayed: Optional[dict] = None
 
     @property
     def gen_coord(self):
@@ -170,8 +174,13 @@ class FleetEvaluator:
                  violations_limit: int = 20, exact_totals: bool = True,
                  pack_chunks: int = 0, spill_root: str = "",
                  spill_compress: str = "none", submit_window: int = 64,
-                 chunk_retries: int = 1):
+                 chunk_retries: int = 1, warm_root: str = ""):
         self.metrics = metrics
+        # warm execution state root (normally the compile-cache dir):
+        # each runtime replays its persisted sweep traces at build time
+        # and save_warm_all() persists them back — cold-start-free fleet
+        # restarts, including runtimes born AFTER boot
+        self.warm_root = warm_root
         self.chunk_size = max(1, chunk_size)
         self.violations_limit = violations_limit
         self.exact_totals = exact_totals
@@ -210,10 +219,47 @@ class FleetEvaluator:
             return rt
         client, driver, evaluator = build()
         rt = LibraryRuntime(key, client, driver, evaluator)
+        if self.warm_root:
+            self._attach_warm(rt)
         with self._lock:
             self._runtimes[key] = rt
         self._publish_sizes()
         return rt
+
+    def _attach_warm(self, rt: LibraryRuntime) -> None:
+        """Replay persisted warm execution state into a freshly built
+        runtime (WarmStateCache under ``warm_root``, keyed by the
+        runtime's installed-programs digest) — every runtime boots
+        cold-start-free, whether it was built at fleet boot or attached
+        later.  Failures degrade to a cold runtime, never an error."""
+        try:
+            from gatekeeper_tpu.drivers.generation import (
+                WarmStateCache, library_warm_dir, programs_digest)
+
+            rt.warm_cache = WarmStateCache(
+                library_warm_dir(self.warm_root,
+                                 programs_digest(rt.driver)),
+                metrics=self.metrics)
+            rt.warm_replayed = rt.warm_cache.replay(rt.driver,
+                                                    rt.evaluator)
+        except Exception:
+            rt.warm_cache = None
+            rt.warm_replayed = None
+
+    def save_warm_all(self) -> int:
+        """Persist every warm-wired runtime's execution state (the
+        drain/exit counterpart of :meth:`_attach_warm`).  Returns the
+        number of runtimes saved."""
+        saved = 0
+        for rt in self.runtimes():
+            if rt.warm_cache is None:
+                continue
+            try:
+                rt.warm_cache.save(rt.driver, rt.evaluator)
+                saved += 1
+            except Exception:
+                pass
+        return saved
 
     def runtimes(self) -> list:
         return list(self._runtimes.values())

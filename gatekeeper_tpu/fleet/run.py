@@ -93,7 +93,12 @@ def run_fleet(args) -> int:
         violations_limit=args.constraint_violations_limit,
         pack_chunks=cfg.pack_chunks,
         spill_root=args.snapshot_spill,
-        spill_compress=args.snapshot_spill_compress)
+        spill_compress=args.snapshot_spill_compress,
+        # per-library warm-state replay/save lives in the evaluator now
+        # (FleetEvaluator._attach_warm): every runtime — including ones
+        # born after boot — replays its persisted sweep traces from a
+        # WarmStateCache subdir under the shared compile-cache root
+        warm_root=args.compile_cache or "")
     for spec in cfg.clusters:
         key, library, state = load_cluster_spec(spec)
         source = FakeCluster()
@@ -112,31 +117,12 @@ def run_fleet(args) -> int:
           f"{len(fleet.runtimes())} library runtimes "
           f"({fleet.shared_boots} shared boots)", file=sys.stderr)
 
-    # per-library warm-state replay/save: one WarmStateCache subdir per
-    # template-set digest under the shared compile-cache root (the
-    # lowering entries are template-keyed and shared; warm state is one
-    # file per dir and keyed by the installed-programs digest, so
-    # libraries must not share one)
-    warm_caches: list = []
-    if args.compile_cache:
-        from gatekeeper_tpu.drivers.generation import (WarmStateCache,
-                                                       library_warm_dir)
-
-        for rt in fleet.runtimes():
-            wc = WarmStateCache(
-                library_warm_dir(args.compile_cache,
-                                 rt.library_digest()),
-                metrics=metrics)
-            warm_caches.append((wc, rt))
-            rep = wc.replay(rt.driver, rt.evaluator)
-            if rep["hit"]:
-                print(f"warm state replayed for library "
-                      f"{rt.key[:12]}: {rep['sweep_traces']} sweep "
-                      f"traces landed", file=sys.stderr)
-
-    def save_warm() -> None:
-        for wc, rt in warm_caches:
-            wc.save(rt.driver, rt.evaluator)
+    for rt in fleet.runtimes():
+        rep = rt.warm_replayed
+        if rep and rep.get("hit"):
+            print(f"warm state replayed for library "
+                  f"{rt.key[:12]}: {rep['sweep_traces']} sweep "
+                  f"traces landed", file=sys.stderr)
 
     def summarize(runs: dict) -> None:
         for cid in sorted(runs):
@@ -154,7 +140,7 @@ def run_fleet(args) -> int:
               f"{fleet.unpacked_dispatches} unpacked dispatches, "
               f"{fleet.last_sweep_s:.2f}s", file=sys.stderr)
         fleet.spill_all()
-        save_warm()
+        fleet.save_warm_all()
         fleet.stop()
         return 0
 
@@ -172,7 +158,7 @@ def run_fleet(args) -> int:
         pass
     finally:
         fleet.spill_all()
-        save_warm()
+        fleet.save_warm_all()
         fleet.stop()
         print("fleet drained (per-cluster spills + warm state flushed)",
               file=sys.stderr)

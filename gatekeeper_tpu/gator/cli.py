@@ -142,6 +142,7 @@ cmd_sync = _delegate("sync_cmd")
 cmd_policy = _delegate("policy_cmd")
 cmd_decisions = _delegate("decisions_cmd")
 cmd_generate_vap = _delegate("generate_vap_cmd")
+cmd_replay = _delegate("replay_cmd")
 
 
 COMMANDS = {
@@ -153,6 +154,7 @@ COMMANDS = {
     "policy": cmd_policy,
     "decisions": cmd_decisions,
     "generate-vap": cmd_generate_vap,
+    "replay": cmd_replay,
 }
 
 
@@ -162,7 +164,7 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: gator [--chaos spec.json] "
               "{test|verify|expand|bench|sync|policy|decisions|"
-              "generate-vap} [options]")
+              "generate-vap|replay} [options]")
         return 0
     # global --chaos spec.json: install the deterministic fault-injection
     # plan before any subcommand runs (README 'Failure semantics')
@@ -185,7 +187,7 @@ def main(argv=None) -> int:
     if not argv:
         print("usage: gator [--chaos spec.json] "
               "{test|verify|expand|bench|sync|policy|decisions|"
-              "generate-vap} [options]")
+              "generate-vap|replay} [options]")
         return 0
     cmd = argv[0]
     fn = COMMANDS.get(cmd)

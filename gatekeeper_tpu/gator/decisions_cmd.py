@@ -54,16 +54,28 @@ def read_decisions(path: str, uid: str = "",
     black box."""
     decisions: list = []
     malformed = 0
+    truncated = 0
     total = 0
     with open(path) as f:
-        for line in f:
-            line = line.strip()
+        for raw in f:
+            ends_nl = raw.endswith("\n")
+            line = raw.strip()
             if not line:
                 continue
             total += 1
             try:
                 e = json.loads(line)
             except ValueError:
+                # a final line with no newline is a crashed recorder's
+                # torn tail, not sink corruption — count it apart
+                if ends_nl:
+                    malformed += 1
+                else:
+                    truncated += 1
+                continue
+            if not isinstance(e, dict):
+                # valid JSON but not a record (e.g. a bare number from
+                # a corrupted merge) — same skip-and-count contract
                 malformed += 1
                 continue
             if uid and e.get("uid") != uid:
@@ -90,6 +102,8 @@ def read_decisions(path: str, uid: str = "",
         out["matched"] = len(decisions)
     if malformed:
         out["malformed"] = malformed
+    if truncated:
+        out["truncated"] = truncated
     return out
 
 
@@ -164,5 +178,7 @@ def run_cli(argv: list) -> int:
         extra += f" ({doc['recorded']} lines in sink"
         if doc.get("malformed"):
             extra += f", {doc['malformed']} malformed"
+        if doc.get("truncated"):
+            extra += f", {doc['truncated']} truncated"
         print(f"-- {extra})")
     return 0

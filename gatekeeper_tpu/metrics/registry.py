@@ -515,3 +515,15 @@ GENERATION_COMPILE_SECONDS = "generation_compile_seconds"  # gauge
 GENERATION_SWAP_COUNT = "generation_swap_count"
 GENERATION_CACHE_HIT = "generation_cache_hit_count"
 GENERATION_CACHE_MISS = "generation_cache_miss_count"  # {reason}
+# shadow canary + decision replay (gatekeeper_tpu/replay/): the shadow
+# lane evaluates copies of live admissions against a candidate library
+# off the response path; divergence{kind} vs decisions is the canary's
+# promote/abort signal (the shadow-divergence-rate SLO objective), and
+# replay_* covers the offline `gator replay` time machine
+SHADOW_DECISIONS = "shadow_decisions_count"  # {decision}
+SHADOW_DIVERGENCE = "shadow_divergence_count"  # {kind}
+SHADOW_DROPPED = "shadow_dropped_count"
+SHADOW_QUEUE_DEPTH = "shadow_queue_depth"  # gauge
+REPLAY_RECORDS = "replay_records_count"  # {outcome}
+REPLAY_DIVERGENCE = "replay_divergence_count"  # {kind}
+REPLAY_SECONDS = "replay_seconds"  # gauge

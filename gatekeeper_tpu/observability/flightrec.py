@@ -9,9 +9,13 @@ queue depth).  Served at ``/debug/decisions?uid=``; optionally mirrored
 to a JSONL file sink (the ``export/`` seam's disk shape — one line per
 decision, append-only, the operator's black box).
 
-Privacy: the recorder stores decision METADATA only — kind, name,
-namespace, uid, messages — never the object body (admission payloads
-carry Secrets).  Messages truncate at ``max_message``.
+Privacy: the in-memory ring stores decision METADATA only — kind,
+name, namespace, uid, messages — never the object body (admission
+payloads carry Secrets).  Messages truncate at ``max_message``.  With
+``capture=True`` the JSONL *sink* lines additionally carry the raw
+admission ``request`` (the replay corpus for ``gator replay``); the
+ring still never holds bodies, and capture is opt-in precisely because
+the sink then holds Secrets-grade data.
 
 Activation mirrors ``resilience/faults.py``: :func:`install` process-
 global, :func:`activate` scoped for tests, :func:`active` the hot-path
@@ -29,22 +33,50 @@ from contextlib import contextmanager
 from typing import Optional
 
 
+def _open_sink(path: str):
+    """Append-open the JSONL sink, repairing a torn tail first.
+
+    A recorder killed mid-write leaves a partial final line with no
+    newline; appending straight after it would fuse the next record
+    onto the fragment, corrupting BOTH lines for every reader.  Writing
+    one separating newline confines the damage to the already-lost
+    fragment (readers count it as a single truncated record)."""
+    torn = False
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            if f.tell() > 0:
+                f.seek(-1, 2)
+                torn = f.read(1) != b"\n"
+    except OSError:
+        pass  # absent/unreadable: plain append-create below
+    sink = open(path, "a", buffering=1)  # line-buffered
+    if torn:
+        try:
+            sink.write("\n")
+        except Exception:
+            pass
+    return sink
+
+
 class FlightRecorder:
     def __init__(self, capacity: int = 2048,
                  sink_path: Optional[str] = None,
                  metrics=None,
                  wall=time.time,
-                 max_message: int = 512):
+                 max_message: int = 512,
+                 capture: bool = False):
         self._ring: deque = deque(maxlen=max(1, capacity))
         self._lock = threading.Lock()
         self.metrics = metrics
         self._wall = wall
         self.max_message = max_message
+        self.capture = capture
         self.recorded = 0
         self._sink = None
         self.sink_path = sink_path
         if sink_path:
-            self._sink = open(sink_path, "a", buffering=1)  # line-buffered
+            self._sink = _open_sink(sink_path)
 
     # --- recording -----------------------------------------------------
     def record(self, endpoint: str, decision: str, uid: str = "",
@@ -53,7 +85,7 @@ class FlightRecorder:
                cost: float = 0.0, reason: str = "",
                warnings: int = 0, code: int = 0,
                overload=None, tenant: str = "", cluster: str = "",
-               **extra) -> dict:
+               request=None, **extra) -> dict:
         """One decision.  ``endpoint``: validate|mutate; ``decision``:
         allow|deny|shed|error|deadline.  ``overload`` is the
         OverloadController whose state gets snapshotted (or None).
@@ -62,7 +94,9 @@ class FlightRecorder:
         --tenant`` filter on.  ``cluster`` (fleet mode) names the
         serving cluster the decision belongs to — the ``?cluster=`` /
         ``gator decisions --cluster`` axis, so a fleet's interleaved
-        decision stream stays attributable per cluster."""
+        decision stream stays attributable per cluster.  ``request``
+        (capture mode only) is the raw admission request dict; it rides
+        the sink line — never the ring — as the replay corpus."""
         from gatekeeper_tpu.observability import tracing
 
         span = tracing.current_span()
@@ -112,8 +146,14 @@ class FlightRecorder:
             self.recorded += 1
             sink = self._sink
         if sink is not None:
+            line = entry
+            if self.capture and request is not None:
+                # bodies ride the sink only: the ring (served at
+                # /debug/decisions) stays metadata-only
+                line = dict(entry)
+                line["request"] = request
             try:
-                sink.write(json.dumps(entry, default=str) + "\n")
+                sink.write(json.dumps(line, default=str) + "\n")
             except Exception:
                 pass  # the recorder must never fail an admission
         if self.metrics is not None:

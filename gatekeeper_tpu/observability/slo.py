@@ -123,7 +123,14 @@ class SLOObjective:
                     within = cum
             return (float(h["count"] - within), float(h["count"]))
         if self.type == "ratio":
-            bad = metrics.get_counter(self.bad_metric, self.bad_labels)
+            # labels=None sums ACROSS labelsets (shadow divergence is
+            # labeled {kind} but the objective wants the sum); an exact
+            # labelset filters to one series
+            if self.bad_labels is None:
+                bad = metrics.counter_total(self.bad_metric)
+            else:
+                bad = metrics.get_counter(self.bad_metric,
+                                          self.bad_labels)
             if self.total_labels is None:
                 total = metrics.counter_total(self.total_metric)
             else:

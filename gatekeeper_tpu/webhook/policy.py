@@ -165,7 +165,23 @@ class ValidationHandler:
         self._record_decision(review_body, resp, cost,
                               tenant=tenant, lane=lane)
         self._attr_tenant(tenant, time.perf_counter() - t0, cost)
+        self._shadow_submit(review_body, resp)
         return resp
+
+    def _shadow_submit(self, review_body: dict, resp) -> None:
+        """Shadow-canary seam (replay/shadow.py): hand the admission to
+        the active shadow lane, enqueue-only.  The served response is
+        already final — the lane must never delay, alter, or answer for
+        it, so any failure here is swallowed."""
+        from gatekeeper_tpu.replay import shadow as _shadow
+
+        lane = _shadow.active()
+        if lane is None:
+            return
+        try:
+            lane.submit(review_body, resp)
+        except Exception:
+            pass
 
     def _route(self, review_body: dict) -> tuple:
         """(tenant, PriorityLevel-or-None) for this request: the QoS
@@ -236,6 +252,9 @@ class ValidationHandler:
             overload=self.overload,
             tenant=tenant,
             priority=getattr(lane, "name", "") or "",
+            # capture mode: the raw admission request rides the JSONL
+            # sink line (never the ring) as the `gator replay` corpus
+            request=(req if getattr(rec, "capture", False) else None),
         )
 
     def _counted(self, review_body: dict) -> ValidationResponse:

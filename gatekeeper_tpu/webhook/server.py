@@ -30,6 +30,7 @@ COST_PATH = "/debug/cost"
 SLO_PATH = "/debug/slo"
 DECISIONS_PATH = "/debug/decisions"
 OVERLOAD_PATH = "/debug/overload"
+SHADOW_PATH = "/debug/shadow"
 
 
 def admission_response(uid: str, allowed: bool, message: str = "",
@@ -208,6 +209,19 @@ class WebhookServer:
                                                    "on)"})
                     else:
                         self._reply(200, ctl.snapshot())
+                elif self.path == SHADOW_PATH:
+                    # the shadow canary lane: candidate-vs-serving
+                    # divergence counters, recent divergent rows,
+                    # promote/abort state (POST to act)
+                    from gatekeeper_tpu.replay import shadow as _shadow
+
+                    lane = _shadow.active()
+                    if lane is None:
+                        self._reply(404, {"error": "shadow lane not "
+                                                   "enabled (run with "
+                                                   "--shadow-candidate)"})
+                    else:
+                        self._reply(200, lane.snapshot())
                 elif self.path.startswith(DECISIONS_PATH):
                     # the admission flight recorder: every decision in
                     # the ring, or one uid's history (?uid=)
@@ -344,6 +358,8 @@ class WebhookServer:
                             self._mutate(body, uid, cost_hint=length)
                         elif self.path == ADMIT_LABEL_PATH:
                             self._admit_label(body, uid)
+                        elif self.path == SHADOW_PATH:
+                            self._shadow_action(body)
                         else:
                             self._reply(404, {"error": "not found"})
                 except Exception as e:
@@ -418,6 +434,28 @@ class WebhookServer:
                 self._reply(200, admission_response(
                     r.uid or uid, r.allowed, r.message, r.code
                 ))
+
+            def _shadow_action(self, body):
+                # POST /debug/shadow {"action": "promote"|"abort"}:
+                # promote applies the candidate docs to the serving
+                # client (generation-swap ride); abort stops shadowing
+                from gatekeeper_tpu.replay import shadow as _shadow
+
+                lane = _shadow.active()
+                if lane is None:
+                    self._reply(404, {"error": "shadow lane not enabled "
+                                               "(run with "
+                                               "--shadow-candidate)"})
+                    return
+                action = (body or {}).get("action", "")
+                if action == "promote":
+                    self._reply(200, lane.promote())
+                elif action == "abort":
+                    self._reply(200, lane.abort(
+                        reason=(body or {}).get("reason", "")))
+                else:
+                    self._reply(400, {"error": "action must be "
+                                               "promote|abort"})
 
             def _reply(self, status: int, payload: dict,
                        close: bool = False, headers: Optional[dict] = None):

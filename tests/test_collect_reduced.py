@@ -86,6 +86,8 @@ def _assert_runs_identical(a, b):
 
 # --- relist sweep: reduced == masks ---------------------------------------
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 24s; the exact-totals
+# variant below pins the same reduced==masks equivalence in tier 1.
 def test_reduced_matches_masks_nonexact(world):
     client, tpu, objects = world
     mgr_m, ev_m = _mgr(client, tpu, objects, "masks")
@@ -139,6 +141,8 @@ def test_reduced_capped_selection(world):
 
 # --- the differential lane -------------------------------------------------
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 37s; the exact-totals
+# differential-lane test below keeps the identity pin in tier 1.
 def test_differential_lane_proves_identity(world):
     client, tpu, objects = world
     mgr_m, _ = _mgr(client, tpu, objects, "masks")
@@ -166,6 +170,8 @@ def test_differential_lane_exact(world):
 
 # --- snapshot lane: tick + resync through reduced collect ------------------
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 27s; snapshot tick +
+# resync semantics are pinned extensively in tests/test_snapshot.py.
 def test_snapshot_reduced_tick_and_resync(world):
     client, tpu, objects = world
     cluster = FakeCluster()

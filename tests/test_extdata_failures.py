@@ -278,3 +278,74 @@ def test_brownout_serves_stale_without_transport():
             browned = lane.resolve_keys("trusted", ["a", "b"])
     assert transport.calls == calls  # zero transport under brownout
     assert browned == clean  # stale-from-cache, no errors
+
+
+# --- response-schema validation at the ingest boundary --------------------
+
+def test_response_schema_gate_unit():
+    """Only well-formed ``key -> (json value, error-or-None)`` entries
+    land clean; everything else degrades to the per-key malformed
+    error, and non-str keys (nothing requested them) drop."""
+    from gatekeeper_tpu.extdata.lane import _MALFORMED, validate_landed
+
+    clean, bad = validate_landed({
+        "ok": ("v", None),
+        "ok-err": (None, "boom"),
+        "ok-nested": ({"a": [1, None]}, None),
+        "wrong-arity": ("v",),
+        "wrong-value": (object(), None),
+        "wrong-error": ("v", 7),
+        "not-a-pair": "v",
+        3: ("v", None),
+    })
+    assert bad == 5
+    assert clean["ok"] == ("v", None)
+    assert clean["ok-err"] == (None, "boom")
+    assert clean["ok-nested"] == ({"a": [1, None]}, None)
+    for k in ("wrong-arity", "wrong-value", "wrong-error", "not-a-pair"):
+        assert clean[k] == (None, _MALFORMED)
+    assert 3 not in clean
+
+
+def test_malformed_provider_response_degrades_per_key():
+    """A rogue transport smuggling schema-breaking entries through the
+    bulk fetch: the good key lands, each malformed key becomes the
+    pinned per-key error semantics (counted, resident, no crash), and
+    the poisoned entries never reach the column as values."""
+    from gatekeeper_tpu.extdata.lane import _MALFORMED
+    from gatekeeper_tpu.metrics.registry import (EXTDATA_KEYS,
+                                                 MetricsRegistry)
+
+    lanes, _tr = make_pair()
+    lane = lanes["batched"]
+    lane.metrics = MetricsRegistry()
+    orig = lane.cache.fetch
+
+    def rogue(provider, keys):
+        res = dict(orig(provider, keys))
+        if "k1" in res:
+            res["k1"] = "not a pair"
+        if "k2" in res:
+            res["k2"] = ("v", 123)
+        return res
+
+    lane.cache.fetch = rogue
+    with activate(lane):
+        res = lane.resolve_keys("trusted", ["k0", "k1", "k2"])
+    assert res["k0"] == ("k0", None)
+    assert res["k1"] == (None, _MALFORMED)
+    assert res["k2"] == (None, _MALFORMED)
+    assert lane.metrics.get_counter(
+        EXTDATA_KEYS, {"provider": "trusted", "outcome": "malformed"}) == 2
+    # malformed entries are resident AS errors: the next resolve is
+    # answered from the column, no refetch storm
+    calls = [0]
+
+    def counting(provider, keys):
+        calls[0] += 1
+        return rogue(provider, keys)
+
+    lane.cache.fetch = counting
+    with activate(lane):
+        again = lane.resolve_keys("trusted", ["k0", "k1", "k2"])
+    assert again == res and calls[0] == 0

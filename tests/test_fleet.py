@@ -313,6 +313,41 @@ def test_fleet_spill_restart_warm(fleet_ctx):
         fleet2.stop()
 
 
+def test_fleet_warm_root_round_trip(fleet_ctx, tmp_path):
+    """``warm_root`` wires persisted warm EXECUTION state (sweep
+    traces) per library runtime: save_warm_all() persists, and a fresh
+    fleet's runtime replays it at build time — a full sweep of the same
+    geometry then retraces nothing."""
+    warm_root = str(tmp_path / "warm")
+    fleet1 = FleetEvaluator(chunk_size=64, exact_totals=False,
+                            warm_root=warm_root)
+    try:
+        fleet1.add_cluster("wa", _source(24, seed=17), "libA",
+                           _builder(fleet_ctx["cache_dir"],
+                                    fleet_ctx["skip_a"]))
+        rt1 = fleet1.clusters["wa"].runtime
+        assert rt1.warm_cache is not None
+        assert not rt1.warm_replayed["hit"]  # nothing persisted yet
+        fleet1.sweep(full=True)
+        assert fleet1.save_warm_all() == 1
+    finally:
+        fleet1.stop()
+    fleet2 = FleetEvaluator(chunk_size=64, exact_totals=False,
+                            warm_root=warm_root)
+    try:
+        fleet2.add_cluster("wa", _source(24, seed=17), "libA",
+                           _builder(fleet_ctx["cache_dir"],
+                                    fleet_ctx["skip_a"]))
+        rt2 = fleet2.clusters["wa"].runtime
+        assert rt2.warm_replayed["hit"]
+        assert rt2.warm_replayed["sweep_traces"] > 0
+        tc0 = rt2.evaluator.trace_count
+        fleet2.sweep(full=True)
+        assert rt2.evaluator.trace_count == tc0  # geometry replayed
+    finally:
+        fleet2.stop()
+
+
 def test_spill_cluster_mismatch_counted_not_deleted(fleet_ctx):
     """Pointing cluster x at b's spill dir: a counted ``cluster`` miss
     and a clean relist; the foreign spill survives untouched."""

@@ -175,6 +175,8 @@ def test_pipelined_vs_serial_differential_on_library_corpus():
     assert run_diff.total_violations == run_serial.total_violations
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 27s; the non-exact
+# pipelined-vs-serial differential above stays in tier 1.
 def test_pipelined_exact_totals_matches_serial():
     """Exact-totals mode ships verdict bitmaps; the pipelined fold must
     count and render them identically."""

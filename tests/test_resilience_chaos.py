@@ -74,6 +74,8 @@ def baseline_run(corpus):
 
 # --- 1. chaos differential: empty plan is bit-identical -------------------
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 28s; the resilience-
+# knobs differential below pins the same seam-is-free contract.
 def test_differential_empty_plan_bit_identical(corpus, baseline_run):
     """An INSTALLED chaos plan with no firing spec must not perturb a
     single verdict, total, kept message, or the incomplete flag — the

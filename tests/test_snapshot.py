@@ -562,6 +562,8 @@ def test_webhook_namespace_lookup_served_from_snapshot(corpus):
 
 # --- 6. bench smoke --------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 40s bench smoke; the
+# snapshot contracts it exercises are pinned by the tests above.
 def test_bench_snapshot_smoke():
     spec = importlib.util.spec_from_file_location(
         "bench_snapshot", os.path.join(ROOT, "tools", "bench_snapshot.py"))

@@ -97,6 +97,8 @@ def library_client():
     return client, tpu
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 16): 43s full-library
+# differential; the module's cheaper routing pins stay in tier 1.
 def test_routed_audit_matches_unrouted(library_client):
     """Kind-bucketed routing must be invisible: EXACT totals equality vs
     the unrouted device sweep (both count violating objects), and

@@ -1,0 +1,137 @@
+"""The CompileCache vocab snapshot-replay rule under adversarial churn.
+
+Every cached lowering entry records the FULL interned-vocab string
+table at lowering completion; a hit replays that snapshot into the
+current vocab.  The rule (``drivers/generation.py:CompileCache.get``):
+the current table must be a PREFIX of the snapshot — then the tail
+interns in recorded order, reproducing every sid the cached program
+baked.  Anything else (a vocab grown past the snapshot, a different
+intern order, a colliding sid) is a counted ``vocab`` miss that leaves
+the entry on disk — it is perfectly fine for the NEXT process that
+boots in recorded order.
+
+These are the pure-vocab unit pins; the end-to-end spill-side two-way
+rule (snapshot ⊆ current also hits, for fleet mode) is pinned in
+tests/test_replay.py, and the whole-library restart differential in
+tests/test_snapshot_persist.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from gatekeeper_tpu.drivers.generation import (MISS_COLD, MISS_VOCAB,
+                                               CompileCache)
+from gatekeeper_tpu.ops.flatten import Vocab
+
+TDIG = "t" * 64
+ENGINE = "rego"
+
+
+def _vocab(*strings):
+    v = Vocab()
+    for s in strings:
+        v.intern(s)
+    return v
+
+
+@pytest.fixture()
+def seeded(tmp_path):
+    """One stored entry whose vocab snapshot is ["", a, b, c] (an
+    error-payload entry: the vocab rule is payload-agnostic, and an
+    error entry needs no real lowered program)."""
+    cc = CompileCache(str(tmp_path))
+    writer = _vocab("a", "b", "c")
+    cc.put(TDIG, ENGINE, None, "lower fallback: pinned", writer)
+    assert cc.stores == 1
+    return {"cc": cc, "root": str(tmp_path),
+            "snap": list(writer._to_str), "writer": writer}
+
+
+def _entry_paths(seeded):
+    key = seeded["cc"].entry_key(TDIG, ENGINE)
+    return [os.path.join(seeded["root"], key + ".json"),
+            os.path.join(seeded["root"], key + ".pkl")]
+
+
+def test_prefix_vocab_hits_and_replays_tail(seeded):
+    reader = _vocab("a")  # strict prefix: ["", "a"]
+    cc = CompileCache(seeded["root"])
+    assert cc.get(TDIG, ENGINE, reader) == \
+        ("error", "lower fallback: pinned")
+    assert cc.stats()["hits"] == 1
+    # the tail replayed in recorded order: every sid matches the writer
+    assert reader._to_str == seeded["snap"]
+    for s in ("a", "b", "c"):
+        assert reader.intern(s) == seeded["writer"].intern(s)
+
+
+def test_identical_vocab_hits_with_nothing_to_replay(seeded):
+    reader = _vocab("a", "b", "c")
+    assert seeded["cc"].get(TDIG, ENGINE, reader) is not None
+    assert reader._to_str == seeded["snap"]
+
+
+def test_empty_vocab_hits_cold_boot_shape(seeded):
+    reader = Vocab()  # a cold process: [""], always a prefix
+    assert seeded["cc"].get(TDIG, ENGINE, reader) is not None
+    assert reader._to_str == seeded["snap"]
+
+
+def test_vocab_grown_past_snapshot_misses(seeded):
+    reader = _vocab("a", "b", "c", "d")  # longer than the snapshot
+    cc = CompileCache(seeded["root"])
+    assert cc.get(TDIG, ENGINE, reader) is None
+    assert cc.stats()["miss_reasons"] == {MISS_VOCAB: 1}
+    # the reader's table is untouched: no partial replay on a miss
+    assert reader._to_str == ["", "a", "b", "c", "d"]
+
+
+def test_reordered_intern_misses(seeded):
+    reader = _vocab("b", "a")  # same strings, different sids
+    cc = CompileCache(seeded["root"])
+    assert cc.get(TDIG, ENGINE, reader) is None
+    assert cc.stats()["miss_reasons"] == {MISS_VOCAB: 1}
+
+
+def test_colliding_sid_misses(seeded):
+    reader = _vocab("a", "x")  # sid 2 points at "x" here, "b" there
+    cc = CompileCache(seeded["root"])
+    assert cc.get(TDIG, ENGINE, reader) is None
+    assert cc.stats()["miss_reasons"] == {MISS_VOCAB: 1}
+
+
+def test_vocab_miss_keeps_entry_for_the_next_boot(seeded):
+    """A vocab miss is about THIS process's intern history, not the
+    entry: the files stay, and a prefix-ordered reader still hits."""
+    bad = _vocab("z")
+    cc = CompileCache(seeded["root"])
+    assert cc.get(TDIG, ENGINE, bad) is None
+    assert all(os.path.exists(p) for p in _entry_paths(seeded))
+    good = _vocab("a", "b")
+    cc2 = CompileCache(seeded["root"])
+    assert cc2.get(TDIG, ENGINE, good) is not None
+    assert good._to_str == seeded["snap"]
+
+
+def test_churn_storm_interleaving(seeded):
+    """Adversarial churn: hit, grow, then re-ask — the same process
+    that replayed a snapshot and kept interning must MISS the same
+    entry afterwards (its table is now longer than the snapshot), and
+    the sids it already baked stay stable throughout."""
+    reader = _vocab("a")
+    cc = CompileCache(seeded["root"])
+    assert cc.get(TDIG, ENGINE, reader) is not None
+    sid_c = reader.intern("c")
+    reader.intern("churned-later")
+    assert cc.get(TDIG, ENGINE, reader) is None
+    assert cc.stats()["miss_reasons"] == {MISS_VOCAB: 1}
+    assert reader.intern("c") == sid_c  # append-only: sids never move
+
+
+def test_cold_miss_reason(tmp_path):
+    cc = CompileCache(str(tmp_path))
+    assert cc.get(TDIG, ENGINE, Vocab()) is None
+    assert cc.stats()["miss_reasons"] == {MISS_COLD: 1}

@@ -1,0 +1,289 @@
+"""Replay bench: the `gator replay` time machine, end to end.
+
+The headline number for the replay subsystem (ROADMAP "policy time
+machine"): a serving webhook stack records N admission decisions into a
+capture-mode flight-recorder sink (the raw request rides each JSONL
+line), then `gator replay`'s core re-evaluates the corpus against a
+CANDIDATE template set and diffs verdicts.  Two lanes:
+
+- **identical** — the candidate IS the serving library.  Pins the
+  subsystem's three invariants: the verdict diff is EMPTY, the
+  ``--differential`` check is bit-identical (decision + message + code
+  per record), and the candidate loads with ZERO fresh lowerings (every
+  template comes out of the shared on-disk CompileCache the serving
+  stack populated — replay never pays compile wall).
+- **modified** — the candidate drops one constraint that produced
+  recorded denies, so the diff must attribute ``newly_allowed``
+  divergences to exactly that constraint.  When the recorded corpus
+  contains no denies the lane SKIPS with a recorded reason (the
+  FLATTEN_BENCH skip convention) instead of asserting on noise.
+
+Appends the previous latest record to the ``history`` list in
+``REPLAY_BENCH.json`` (the FLEET_BENCH convention).  Run:
+
+    python tools/bench_replay.py [--smoke] [--out PATH]
+
+``--smoke`` (fewer requests, template subset) runs in tier-1 via
+tests/test_replay.py so the bench script itself cannot rot; it pins
+bit-identity and the zero-fresh-lowering claim.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_KEEP = 5  # template-subset library: bounded compile wall (1-core host)
+
+
+def _library_docs(keep: int = _KEEP) -> list:
+    """The first ``keep`` shipped library templates + their sample
+    constraints, as unstructured docs (the `--candidate` input shape)."""
+    from gatekeeper_tpu.utils.synthetic import library_dir
+    from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+    docs: list = []
+    tpaths = sorted(
+        glob.glob(os.path.join(library_dir(), "general", "*",
+                               "template.yaml")) +
+        glob.glob(os.path.join(library_dir(), "pod-security-policy", "*",
+                               "template.yaml")))[:keep]
+    for tpath in tpaths:
+        docs.append(load_yaml_file(tpath)[0])
+        cpath = os.path.join(os.path.dirname(tpath), "samples",
+                             "constraint.yaml")
+        if os.path.exists(cpath):
+            docs.extend(load_yaml_file(cpath))
+    return docs
+
+
+def _admission_bodies(n: int, seed: int = 7) -> list:
+    """AdmissionReview bodies over the synthetic cluster mix (the
+    loadtest shape: CREATE of the object, a non-gatekeeper user)."""
+    from gatekeeper_tpu.utils.synthetic import make_cluster_objects
+
+    bodies = []
+    for i, obj in enumerate(make_cluster_objects(n, seed=seed)):
+        api = obj.get("apiVersion", "v1")
+        group, _, version = api.rpartition("/")
+        meta = obj.get("metadata") or {}
+        bodies.append({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": f"bench-{i:06d}",
+                "kind": {"group": group, "version": version,
+                         "kind": obj.get("kind", "")},
+                "operation": "CREATE",
+                "name": meta.get("name", "") or f"obj-{i}",
+                "namespace": meta.get("namespace", "") or "",
+                "userInfo": {"username": "bench@replay"},
+                "object": obj,
+            },
+        })
+    return bodies
+
+
+def _serve_and_record(docs: list, bodies: list, sink_path: str,
+                      cache_dir: str) -> dict:
+    """The serving pass: a real ValidationHandler + capture-mode flight
+    recorder answers every body; the sink becomes the replay corpus."""
+    from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.drivers.cel_driver import CELDriver
+    from gatekeeper_tpu.drivers.generation import CompileCache
+    from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+    from gatekeeper_tpu.gator import reader
+    from gatekeeper_tpu.observability import flightrec
+    from gatekeeper_tpu.target.target import K8sValidationTarget
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel, compile_cache=CompileCache(cache_dir))
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[WEBHOOK_EP, AUDIT_EP])
+    for doc in docs:
+        if reader.is_template(doc):
+            client.add_template(doc)
+    for doc in docs:
+        if reader.is_constraint(doc):
+            client.add_constraint(doc)
+    if getattr(tpu, "gen_coord", None) is not None:
+        tpu.gen_coord.constraints_fn = client.constraints
+    handler = ValidationHandler(client)
+    rec = flightrec.FlightRecorder(capacity=64, sink_path=sink_path,
+                                   capture=True)
+    denies = 0
+    t0 = time.perf_counter()
+    with flightrec.activate(rec):
+        for body in bodies:
+            resp = handler.handle(body)
+            denies += 0 if resp.allowed else 1
+    wall = time.perf_counter() - t0
+    rec.close()
+    gc = getattr(tpu, "gen_coord", None)
+    if gc is not None:
+        gc.stop()
+    return {"wall_s": round(wall, 3), "served": len(bodies),
+            "denies": denies,
+            "compile_cache": tpu._compile_cache.stats()}
+
+
+def _replay_lane(records, docs: list, cache_dir: str,
+                 differential: bool) -> dict:
+    """One candidate replay pass over the corpus (a fresh runtime per
+    lane: the zero-lowering claim is about the ON-DISK cache, not a
+    shared in-process driver)."""
+    from gatekeeper_tpu.replay import core
+
+    runtime = core.load_candidate(docs, compile_cache_dir=cache_dir)
+    try:
+        report = core.replay_decisions(records, runtime,
+                                       differential=differential)
+    finally:
+        gc = getattr(runtime.driver, "gen_coord", None)
+        if gc is not None:
+            gc.stop()
+    return report
+
+
+def run_bench(n_requests: int = 400, keep: int = _KEEP,
+              out_path: str = None, write: bool = True,
+              cache_dir: str = None) -> dict:
+    """``cache_dir``: reuse a warm on-disk compile cache (the tier-1
+    smoke shares the test module's, so the bench measures replay
+    throughput instead of template lowering)."""
+    import contextlib
+
+    from gatekeeper_tpu.replay import core
+
+    record = {
+        "kind": "replay_bench",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_cpus": os.cpu_count() or 1,
+        "n_requests": n_requests,
+        "templates_kept": keep,
+    }
+    docs = _library_docs(keep)
+    bodies = _admission_bodies(n_requests)
+    ctx = (contextlib.nullcontext(cache_dir) if cache_dir
+           else tempfile.TemporaryDirectory(prefix="gtpu-replay-cc-"))
+    with ctx as d, tempfile.TemporaryDirectory(
+            prefix="gtpu-replay-corpus-") as cd:
+        sink = os.path.join(cd, "decisions.jsonl")
+        record["serve"] = _serve_and_record(docs, bodies, sink, d)
+        records, counts = core.read_corpus(sink)
+        record["corpus"] = {"records": len(records), **counts}
+
+        ident = _replay_lane(records, docs, d, differential=True)
+        cc = ident.get("compile_cache") or {}
+        zero_lowerings = (cc.get("misses", -1) == 0
+                          and cc.get("hits", 0) > 0)
+        if not zero_lowerings:
+            raise AssertionError(
+                f"candidate replay paid fresh lowerings: {cc}")
+        if ident["divergences_total"]:
+            raise AssertionError(
+                "identical candidate diverged: "
+                f"{ident['divergences'][:3]}")
+        if not ident["differential"]["bit_identical"]:
+            raise AssertionError(
+                "differential replay not bit-identical: "
+                f"{ident['differential']}")
+        record["identical"] = {
+            "wall_s": ident["wall_s"],
+            "decisions_per_s": ident["decisions_per_s"],
+            "divergences_total": ident["divergences_total"],
+            "differential": ident["differential"],
+            "compile_cache": cc,
+            "lowering": ident.get("lowering") or {},
+        }
+
+        # modified lane: drop the first constraint with recorded denies
+        denied_cons = set()
+        for r in records:
+            if r.get("decision") == "deny":
+                denied_cons.update(
+                    core.recorded_constraints(r.get("message", "")))
+        if denied_cons:
+            from gatekeeper_tpu.gator import reader
+            from gatekeeper_tpu.utils.unstructured import name_of
+
+            drop = sorted(denied_cons)[0]
+            mod_docs = [doc for doc in docs
+                        if not (reader.is_constraint(doc)
+                                and name_of(doc) == drop)]
+            mod = _replay_lane(records, mod_docs, d, differential=False)
+            per_con = (mod.get("by_constraint") or {}).get(drop) or {}
+            record["modified"] = {
+                "dropped_constraint": drop,
+                "wall_s": mod["wall_s"],
+                "decisions_per_s": mod["decisions_per_s"],
+                "divergences_total": mod["divergences_total"],
+                "newly_allowed": mod["newly_allowed"],
+                "dropped_constraint_diff": per_con,
+                "top_offenders": mod.get("top_offenders") or {},
+            }
+            if not mod["newly_allowed"]:
+                raise AssertionError(
+                    f"dropping {drop} produced no newly_allowed "
+                    "divergences")
+        else:
+            record["modified"] = {
+                "skipped": "corpus recorded zero denies; the drop-a-"
+                           "constraint lane would assert on noise"}
+        record["headline"] = {
+            "decisions_per_s": ident["decisions_per_s"],
+            "bit_identical": True,
+            "zero_fresh_lowerings": True,
+            "modified_divergences": record["modified"].get(
+                "divergences_total", None),
+        }
+    if write:
+        out = out_path or os.path.join(os.path.dirname(__file__), "..",
+                                       "REPLAY_BENCH.json")
+        history = []
+        if os.path.exists(out):
+            try:
+                with open(out) as fh:
+                    prev = json.load(fh)
+                history = prev.pop("history", [])
+                history.append(prev)  # previous latest becomes history
+            except Exception:
+                history = []
+        record_out = dict(record)
+        record_out["history"] = history
+        with open(out, "w") as fh:
+            json.dump(record_out, fh, indent=1)
+    return record
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+    argv = [a for a in argv if a != "--smoke"]
+    out = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        out = argv[i + 1]
+        del argv[i: i + 2]
+    if smoke:
+        rec = run_bench(n_requests=120, out_path=out,
+                        write=out is not None)
+    else:
+        rec = run_bench(out_path=out)
+    print(json.dumps({"headline": rec["headline"],
+                      "identical": rec["identical"],
+                      "modified": rec["modified"]}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ PKG = REPO / "gatekeeper_tpu"
 REGISTRY_MD = REPO / "tools" / "observability_registry.md"
 METRICS_PY = PKG / "metrics" / "registry.py"
 SLO_PY = PKG / "observability" / "slo.py"
+SHADOW_PY = PKG / "replay" / "shadow.py"
 
 _FAULT_CALL = re.compile(r'fault_point\(\s*(f?)"([^"]+)"')
 # tracer span call sites: tracing.span("..."), otel.span("..."),
@@ -105,28 +106,40 @@ def span_names_in_source() -> dict:
     return out
 
 
+def _objective_names(node) -> list:
+    """``name`` values from an objective literal: a list of dicts
+    (DEFAULT_OBJECTIVES) or one bare dict (SHADOW_OBJECTIVE)."""
+    dicts = node.elts if isinstance(node, ast.List) else [node]
+    names: list = []
+    for elt in dicts:
+        if not isinstance(elt, ast.Dict):
+            continue
+        for k, v in zip(elt.keys, elt.values):
+            if isinstance(k, ast.Constant) and k.value == "name" \
+                    and isinstance(v, ast.Constant):
+                names.append(v.value)
+    return names
+
+
 def slo_objectives_in_source() -> dict:
-    """objective name -> "slo.py" for every entry of
-    ``DEFAULT_OBJECTIVES`` (AST scan of the literal list — the names are
-    the values dashboards and the breach counter key on)."""
-    tree = ast.parse(SLO_PY.read_text())
+    """objective name -> defining file, for every entry of
+    ``slo.py:DEFAULT_OBJECTIVES`` plus opt-in objectives other modules
+    define as module-level literals (``replay/shadow.py:
+    SHADOW_OBJECTIVE``) — the names are the values dashboards and the
+    breach counter key on."""
     out: dict = {}
-    for node in tree.body:
-        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+    for path, wanted in ((SLO_PY, "DEFAULT_OBJECTIVES"),
+                         (SHADOW_PY, "SHADOW_OBJECTIVE")):
+        if not path.exists():
             continue
-        target = node.targets[0]
-        if not isinstance(target, ast.Name) or \
-                target.id != "DEFAULT_OBJECTIVES":
-            continue
-        if not isinstance(node.value, ast.List):
-            continue
-        for elt in node.value.elts:
-            if not isinstance(elt, ast.Dict):
+        for node in ast.parse(path.read_text()).body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
                 continue
-            for k, v in zip(elt.keys, elt.values):
-                if isinstance(k, ast.Constant) and k.value == "name" \
-                        and isinstance(v, ast.Constant):
-                    out[v.value] = str(SLO_PY.relative_to(REPO))
+            target = node.targets[0]
+            if not isinstance(target, ast.Name) or target.id != wanted:
+                continue
+            for name in _objective_names(node.value):
+                out[name] = str(path.relative_to(REPO))
     return out
 
 
@@ -199,7 +212,8 @@ def check() -> list:
     for name in sorted(doc_slo - set(src_slo)):
         problems.append(
             f"stale documented SLO objective {name!r} — not in "
-            f"{SLO_PY.relative_to(REPO)}:DEFAULT_OBJECTIVES; remove it "
+            f"{SLO_PY.relative_to(REPO)}:DEFAULT_OBJECTIVES or "
+            f"{SHADOW_PY.relative_to(REPO)}:SHADOW_OBJECTIVE; remove it "
             "from the registry")
     return problems
 
