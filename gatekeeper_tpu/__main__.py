@@ -738,8 +738,15 @@ def main(argv=None) -> int:
                 candidate_docs=_cand_docs, recorder=_shadow_rec,
                 metrics=metrics).start()
             _shadow.install(shadow_lane)
+            if slo_engine is not None:
+                # divergence-rate breach -> automatic canary abort (the
+                # objective only rides the engine when the shadow lane
+                # is configured, so the hook always has its metric)
+                shadow_lane.bind_slo(slo_engine)
             print(f"shadow canary active: {len(_cand_docs)} candidate "
-                  f"docs (/debug/shadow)", file=sys.stderr)
+                  f"docs (/debug/shadow"
+                  + (", slo auto-abort armed" if slo_engine is not None
+                     else "") + ")", file=sys.stderr)
         except Exception as e:
             print(f"shadow canary disabled: {e}", file=sys.stderr)
     kube_cluster = None

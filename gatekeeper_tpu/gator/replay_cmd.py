@@ -114,6 +114,13 @@ def run_cli(argv: list) -> int:
                    help="candidate library file/dir (repeatable): "
                         "templates + constraints + cluster fixtures "
                         "(v1 Namespaces resolve namespace selectors)")
+    p.add_argument("--namespaces-from-spill", default="",
+                   metavar="DIR",
+                   help="take v1/Namespace fixtures from this "
+                        "--snapshot-spill directory (the RECORDED "
+                        "cluster's labels) instead of the candidate "
+                        "docs — pins namespace-selector fidelity; "
+                        "point it at the --from-spill dir to reuse it")
     p.add_argument("--differential", action="store_true",
                    help="candidate IS the recorded library: assert "
                         "bit-identity to the record (exit 1 on any "
@@ -155,8 +162,18 @@ def run_cli(argv: list) -> int:
     if not docs:
         print("error: no candidate docs found", file=sys.stderr)
         return 1
+    ns_override = None
+    if args.namespaces_from_spill:
+        try:
+            ns_override = core.namespaces_from_spill(
+                core.read_spill(args.namespaces_from_spill))
+        except (OSError, ValueError) as e:
+            print(f"error: reading namespace spill: {e}",
+                  file=sys.stderr)
+            return 1
     runtime = core.load_candidate(docs,
-                                  compile_cache_dir=args.compile_cache)
+                                  compile_cache_dir=args.compile_cache,
+                                  namespaces=ns_override)
     try:
         if args.filename:
             records, counts = core.read_corpus(args.filename,

@@ -527,3 +527,13 @@ SHADOW_QUEUE_DEPTH = "shadow_queue_depth"  # gauge
 REPLAY_RECORDS = "replay_records_count"  # {outcome}
 REPLAY_DIVERGENCE = "replay_divergence_count"  # {kind}
 REPLAY_SECONDS = "replay_seconds"  # gauge
+# adversarial corpus + chaos soak (gatekeeper_tpu/fuzz/): corpus cases
+# generated per scenario family, soak requests driven per endpoint,
+# divergences any armed differential lane reported (zero on a clean
+# run), verdicts lost at drain (requests that never answered), and the
+# last soak's wall seconds
+FUZZ_CASES = "fuzz_corpus_cases_count"  # {family}
+FUZZ_SOAK_REQUESTS = "fuzz_soak_requests_count"  # {endpoint}
+FUZZ_SOAK_DIVERGENCE = "fuzz_soak_divergence_count"  # {lane}
+FUZZ_SOAK_LOST = "fuzz_soak_lost_verdicts_count"
+FUZZ_SOAK_SECONDS = "fuzz_soak_seconds"  # gauge

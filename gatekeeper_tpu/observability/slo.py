@@ -176,6 +176,9 @@ class SLOEngine:
         # SLO burn feeds the brownout ladder (set_slo_input must point
         # back at self.pressure for the signal to be consumed)
         self.brownout = brownout
+        # (objective_filter, fn) called on each breach RISING EDGE —
+        # "" matches every objective; see on_breach()
+        self._breach_hooks: list = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -210,6 +213,15 @@ class SLOEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+
+    # --- breach hooks -----------------------------------------------------
+    def on_breach(self, fn, objective: str = "") -> None:
+        """Register ``fn(objective_name, eval_dict)`` to fire on a
+        breach RISING EDGE only (not on every breached tick — re-arming
+        requires the objective to recover first).  ``objective`` filters
+        to one objective name; ``""`` fires for all.  Hook exceptions
+        are swallowed: the engine must never take the server down."""
+        self._breach_hooks.append((objective, fn))
 
     # --- evaluation -------------------------------------------------------
     def tick(self) -> dict:
@@ -257,6 +269,13 @@ class SLOEngine:
                               sli=ev["sli"], tier=ev["breach_tier"])
                 except Exception:
                     pass
+                for want, fn in list(self._breach_hooks):
+                    if want and want != o_name:
+                        continue
+                    try:
+                        fn(o_name, ev)
+                    except Exception:
+                        pass
             self._breached[o_name] = ev["breach"]
         payload = {
             "generated_at": wall,

@@ -112,12 +112,17 @@ class CandidateRuntime:
 
 
 def load_candidate(docs, compile_cache_dir: str = "",
-                   metrics=None) -> CandidateRuntime:
+                   metrics=None, namespaces=None) -> CandidateRuntime:
     """Build the candidate evaluation runtime from unstructured docs
     (templates + constraints + cluster fixtures).  With a warm
     ``compile_cache_dir`` every template loads via the shared compile
     cache — zero fresh lowerings, the replay-at-sweep-speed invariant
-    ``REPLAY_BENCH.json`` pins."""
+    ``REPLAY_BENCH.json`` pins.
+
+    ``namespaces`` (name -> v1/Namespace object) overrides the fixtures
+    found in ``docs`` — pass :func:`namespaces_from_spill` output to
+    replay namespace-selector matches against the labels the RECORDED
+    cluster had, not whatever the candidate doc set happens to carry."""
     from gatekeeper_tpu.apis.constraints import AUDIT_EP, WEBHOOK_EP
     from gatekeeper_tpu.client.client import Client
     from gatekeeper_tpu.drivers.cel_driver import CELDriver
@@ -135,7 +140,7 @@ def load_candidate(docs, compile_cache_dir: str = "",
     client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
                     enforcement_points=[WEBHOOK_EP, AUDIT_EP])
     errors: list = []
-    namespaces: dict = {}
+    ns_fixtures: dict = {}
     rest: list = []
     for doc in docs:
         if reader.is_template(doc):
@@ -154,14 +159,17 @@ def load_candidate(docs, compile_cache_dir: str = "",
         elif not reader.is_admission_review(doc):
             group, _, kind = gvk_of(doc)
             if kind == "Namespace" and not group:
-                namespaces[(doc.get("metadata") or {}).get("name", "")] \
+                ns_fixtures[(doc.get("metadata") or {}).get("name", "")] \
                     = doc
             client.add_data(doc)
     if getattr(tpu, "gen_coord", None) is not None:
         tpu.gen_coord.constraints_fn = client.constraints
     handler = ValidationHandler(client)
+    if namespaces:
+        # recorded fixtures override the doc set's (same-name wins)
+        ns_fixtures = {**ns_fixtures, **namespaces}
     return CandidateRuntime(client=client, driver=tpu, handler=handler,
-                            namespaces=namespaces, compile_cache=cc,
+                            namespaces=ns_fixtures, compile_cache=cc,
                             load_errors=errors)
 
 
@@ -483,6 +491,25 @@ def read_spill(root: str) -> dict:
                           for gid, count, msgs in rows if count}
     return {"header": header, "objects": objects, "verdicts": verdicts,
             "rows": state.get("rows", len(objects))}
+
+
+def namespaces_from_spill(spill: dict) -> dict:
+    """Namespace fixtures AS RECORDED: every resident ``v1/Namespace``
+    object in the spill, keyed by name.
+
+    Candidate doc sets rarely carry the cluster's Namespaces, so a
+    namespace-selector match replayed against candidate-doc fixtures
+    silently sees different labels than the recorded cluster did — a
+    verdict flip that looks like a library change but is corpus skew.
+    Feed this to ``load_candidate(namespaces=...)`` to pin fidelity."""
+    out: dict = {}
+    for _gid, obj in spill.get("objects", []):
+        api = obj.get("apiVersion") or "v1"
+        if obj.get("kind") == "Namespace" and "/" not in api:
+            name = (obj.get("metadata") or {}).get("name", "")
+            if name:
+                out[name] = obj
+    return out
 
 
 def replay_spill(spill: dict, runtime: CandidateRuntime,

@@ -278,6 +278,32 @@ class ShadowLane:
         self.stop()
         return {"state": self.state, "reason": reason}
 
+    def bind_slo(self, engine) -> "ShadowLane":
+        """Auto-abort on candidate SLO breach: a rising-edge breach of
+        the shadow divergence objective aborts the canary — the call a
+        human would make from the dashboard, taken at tick speed.
+        Manual promote/abort via POST /debug/shadow stay authoritative;
+        a lane already promoted (or aborted) is immune."""
+        name = SHADOW_OBJECTIVE["name"]
+
+        def _on_breach(objective, ev):
+            if self.state != "shadowing":
+                return
+            self.abort(reason=f"slo auto-abort: {objective} "
+                              f"sli={ev.get('sli', 0.0):.4f} "
+                              f"tier={ev.get('breach_tier', '')}")
+            try:
+                from gatekeeper_tpu.utils.logging import log_event
+
+                log_event("warning", "shadow canary auto-aborted on "
+                          "SLO breach", event_type="shadow_auto_abort",
+                          objective=objective, sli=ev.get("sli", 0.0))
+            except Exception:
+                pass
+
+        engine.on_breach(_on_breach, objective=name)
+        return self
+
     def snapshot(self) -> dict:
         """The ``/debug/shadow`` payload."""
         return {

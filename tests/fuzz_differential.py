@@ -25,6 +25,12 @@ jax.config.update("jax_platforms", "cpu")
 from gatekeeper_tpu.apis.constraints import Constraint  # noqa: E402
 from gatekeeper_tpu.apis.templates import ConstraintTemplate  # noqa: E402
 from gatekeeper_tpu.drivers.tpu_driver import TpuDriver  # noqa: E402
+# the seeded object generator moved to the shared corpus module (ISSUE 17)
+# so this manual fuzzer, tests/test_fuzz.py, and the soak harness draw
+# identical populations per seed; re-exported here for callers that
+# imported it from this module
+from gatekeeper_tpu.fuzz.corpus import (IMAGES, VALUES,  # noqa: E402,F401
+                                        rand_obj, rand_value)
 from gatekeeper_tpu.target.review import AugmentedUnstructured  # noqa: E402
 from gatekeeper_tpu.target.target import K8sValidationTarget  # noqa: E402
 from gatekeeper_tpu.utils.unstructured import load_yaml_file  # noqa: E402
@@ -33,130 +39,6 @@ LIB = os.path.join(os.path.dirname(__file__), "..", "library", "general")
 LIB_PSP = os.path.join(os.path.dirname(__file__), "..", "library",
                        "pod-security-policy")
 TARGET = "admission.k8s.gatekeeper.sh"
-
-IMAGES = ["openpolicyagent/opa:0.9.2", "nginx", "nginx:latest", "a/b:v1",
-          "registry.corp:5000/x/y@sha256:ab", "", ":weird", "latest",
-          "openpolicyagent/opa@sha256:" + "1" * 64]
-VALUES = [True, False, 0, 1, -1, 2.5, "", "x", None, [], {},
-          "user.agilebank.demo", "user"]
-
-
-def rand_value(rng, depth=0):
-    r = rng.random()
-    if depth > 2 or r < 0.6:
-        return rng.choice(VALUES)
-    if r < 0.8:
-        return [rand_value(rng, depth + 1) for _ in range(rng.randint(0, 3))]
-    return {f"k{i}": rand_value(rng, depth + 1)
-            for i in range(rng.randint(0, 3))}
-
-
-def rand_obj(rng, i):
-    kind = rng.choice(["Pod", "Deployment", "Service", "Namespace",
-                       "Ingress", "RoleBinding"])
-    group = {"Deployment": "apps", "Ingress": "networking.k8s.io",
-             "RoleBinding": "rbac.authorization.k8s.io"}.get(kind, "")
-    meta = {"name": f"o{i}"}
-    if rng.random() < 0.7:
-        meta["namespace"] = rng.choice(["default", "prod", "kube-system"])
-    if rng.random() < 0.4:
-        # stresses map key+value iteration (requiredannotations clause 2)
-        meta["annotations"] = {
-            k: rng.choice(["x", "", "a-b", 0, False, None, ["x"]])
-            for k in rng.sample(["a8r.io/owner", "a-2", "owner"],
-                                rng.randint(1, 2))}
-    if rng.random() < 0.5:
-        meta["labels"] = {
-            k: rng.choice([str(rand_value(rng))[:20], False, None, 1])
-            for k in rng.sample(["owner", "app", "team", "env"],
-                                rng.randint(1, 3))}
-    spec = {}
-    if rng.random() < 0.8:
-        containers = []
-        for j in range(rng.randint(0, 4)):
-            c = {}
-            if rng.random() < 0.9:
-                c["name"] = f"c{j}"
-            if rng.random() < 0.9:
-                c["image"] = rng.choice(IMAGES)
-            if rng.random() < 0.4:
-                c["resources"] = {"limits": {
-                    k: rng.choice(["100m", "1", "2Gi", "64Mi", "bogus", 3])
-                    for k in rng.sample(["cpu", "memory"],
-                                        rng.randint(1, 2))}}
-            if rng.random() < 0.3:
-                c["ports"] = [{"hostPort": rng.choice(
-                    [79, 80, 9000, 9001, "80"])}
-                    for _ in range(rng.randint(0, 2))]
-            if rng.random() < 0.3:
-                # False-valued probes stress truthy-key semantics
-                c[rng.choice(["readinessProbe", "livenessProbe"])] = \
-                    rng.choice([{}, {"httpGet": {}}, False, None])
-            if rng.random() < 0.4:
-                sc = {}
-                if rng.random() < 0.6:
-                    sc["readOnlyRootFilesystem"] = rng.choice(
-                        [True, False, "true", None])
-                if rng.random() < 0.6:
-                    sc["capabilities"] = {
-                        k: rng.sample(["NET_BIND_SERVICE", "SYS_ADMIN",
-                                       "NET_RAW", "ALL", "*"],
-                                      rng.randint(0, 3))
-                        for k in rng.sample(["add", "drop"],
-                                            rng.randint(1, 2))}
-                c["securityContext"] = sc
-            containers.append(c)
-        spec["containers"] = containers
-    if kind == "Pod" and rng.random() < 0.4:
-        spec["automountServiceAccountToken"] = rng.choice(
-            [True, False, "false", None])
-    if kind == "RoleBinding" and rng.random() < 0.8:
-        return {"apiVersion": "rbac.authorization.k8s.io/v1",
-                "kind": "RoleBinding", "metadata": meta,
-                "subjects": [
-                    {"kind": "User",
-                     "name": rng.choice(["system:anonymous", "alice",
-                                         "system:unauthenticated", 7])}
-                    for _ in range(rng.randint(0, 2))]}
-    for key in ("hostPID", "hostIPC", "hostNetwork"):
-        if rng.random() < 0.15:
-            spec[key] = rng.choice([True, False, "yes"])
-    if kind == "Deployment" and rng.random() < 0.7:
-        spec["replicas"] = rng.choice([0, 1, 3, 50, 51, "3"])
-    if kind == "Service":
-        spec["type"] = rng.choice(["ClusterIP", "NodePort", "LoadBalancer"])
-        if rng.random() < 0.5:
-            spec["externalIPs"] = [
-                rng.choice(["203.0.113.0", "10.0.0.1", "", 8, None])
-                for _ in range(rng.randint(1, 2))]
-    if kind == "Pod" and rng.random() < 0.25:
-        spec["securityContext"] = {"sysctls": rng.choice([
-            [{"name": "kernel.msgmax", "value": "1"}],
-            [{"name": "net.core.somaxconn"}],
-            [{"name": "net.ipv4.tcp_syncookies", "value": "1"},
-             {"name": "kernel.shm_rmid_forced"}],
-            [{"name": 5}], [{}], "oops",
-        ])}
-    if rng.random() < 0.3:
-        spec["volumes"] = [
-            rng.choice([{"hostPath": {"path": p}},
-                        {"hostPath": {}}, {"emptyDir": {}}, {}])
-            for p in rng.sample(["/var/log/app", "/etc", "/var", ""],
-                                rng.randint(1, 2))]
-    if kind == "Ingress":
-        if rng.random() < 0.4:
-            spec["tls"] = rng.choice([[], [{"hosts": ["a.com"]}], "bad"])
-        if rng.random() < 0.4:
-            meta.setdefault("annotations", {})[
-                "kubernetes.io/ingress.allow-http"] = rng.choice(
-                ["false", "true", False, ""])
-    if kind == "Ingress" and rng.random() < 0.8:
-        spec["rules"] = [{"host": rng.choice(
-            ["a.com", "b.com", ""])} for _ in range(rng.randint(0, 2))]
-    if rng.random() < 0.1:
-        spec["extra"] = rand_value(rng)
-    av = f"{group}/v1" if group else "v1"
-    return {"apiVersion": av, "kind": kind, "metadata": meta, "spec": spec}
 
 
 def build_fuzz_driver():

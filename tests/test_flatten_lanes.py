@@ -493,3 +493,114 @@ def test_shard_chunks_coalesces_same_group_only():
     mgr._chunk_source_impl = impl
     out = list(mgr._chunk_source(None, None, False, [0]))
     assert out == [([1, 2, 4, 5], [ca]), ([3], [cb]), ([6], [ca])]
+
+
+# --- 6. hostile raw-JSON semantics (the fuzz corpus's weapons) ---------
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_hostile_deep_docs_fall_back_never_crash(corpus):
+    """256+-deep documents overflow the C parser's depth budget: the
+    raw lane must FALL BACK to the dict walk (reported via lane_used),
+    never crash, and the differential lane stays green on the fallback
+    route."""
+    from gatekeeper_tpu.fuzz.corpus import raw_deep_doc
+
+    _, tpu, _ = corpus
+    schema = _union_schema(tpu)
+    docs = [raw_deep_doc(d, name=f"deep{d}") for d in (257, 300, 512)]
+    f = Flattener(schema, Vocab(), lane="raw")
+    f.flatten([RawJSON(d) for d in docs], pad_n=8)
+    assert f.lane_used == "dict"
+    f2 = Flattener(schema, Vocab(), lane="differential")
+    f2.flatten([RawJSON(d) for d in docs], pad_n=8)
+    assert f2.lane_used == "differential:dict"
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_hostile_dup_key_docs_raw_lane_last_wins(corpus):
+    """Duplicate-key docs do NOT trip the raw lane.  (ISSUE 17 guessed
+    they would; the pinned truth is stronger: the C parser's last-wins
+    is bit-identical to json.loads, so the differential passes WITH the
+    raw kernel still engaged — no fallback, no divergence.)"""
+    from gatekeeper_tpu.fuzz.corpus import raw_dup_key_doc
+
+    _, tpu, _ = corpus
+    schema = _union_schema(tpu)
+    doc = raw_dup_key_doc()
+    f = Flattener(schema, Vocab(), lane="differential")
+    f.flatten([RawJSON(doc)], pad_n=8)
+    assert f.lane_used == "differential:raw"
+    # the lazy parse view agrees with json.loads last-wins
+    assert RawJSON(doc)["metadata"]["labels"]["k"] == "last"
+    assert json.loads(doc)["spec"]["x"] == 2
+    assert RawJSON(doc)["spec"]["c"]["a"] == {"b": 2}
+
+
+@pytest.mark.skipif(jmod is None, reason="native json build unavailable")
+def test_hostile_unicode_and_near_collision_keys(corpus):
+    """Unicode keys (escaped \\uXXXX in one doc, literal UTF-8 in the
+    next) and near-collision strings intern to identical columns across
+    raw and dict lanes."""
+    from gatekeeper_tpu.fuzz.corpus import NEAR_COLLISIONS, UNICODE_KEYS
+
+    _, tpu, _ = corpus
+    schema = _union_schema(tpu)
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": f"u{i}", "namespace": "default",
+                          "labels": {k: "v", "app": k}},
+             "spec": {}}
+            for i, k in enumerate(UNICODE_KEYS + NEAR_COLLISIONS)]
+    vocab = Vocab()
+    f_raw = Flattener(schema, vocab, lane="raw")
+    # as_raw() dumps with ensure_ascii (escaped); the second batch uses
+    # literal UTF-8 bytes of the SAME objects — both must match dict
+    b_raw = f_raw.flatten([as_raw(o) for o in objs], pad_n=16)
+    assert f_raw.lane_used == "raw"
+    f_dict = Flattener(schema, vocab, lane="dict")
+    b_dict = f_dict.flatten(objs, pad_n=16)
+    assert diff_batches(schema, b_raw, b_dict) is None
+    f_utf8 = Flattener(schema, Vocab(), lane="differential")
+    f_utf8.flatten([RawJSON(json.dumps(o, ensure_ascii=False).encode())
+                    for o in objs], pad_n=16)
+    assert f_utf8.lane_used == "differential:raw"
+
+
+def test_split_list_items_survives_unicode_and_nested_items_trap():
+    """A List page whose ITEMS contain their own "items" arrays, brace
+    strings and unicode keys still splits span-exact."""
+    from gatekeeper_tpu.fuzz.corpus import UNICODE_KEYS
+
+    inner = {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "trap",
+                          "labels": {UNICODE_KEYS[0]: "v"}},
+             "spec": {"items": [{"items": [1, 2]}], "k": '}],"items":['}}
+    page_doc = {"apiVersion": "v1", "kind": "PodList",
+                "metadata": {"resourceVersion": "9"},
+                "items": [inner,
+                          {"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": "pлain"}}]}
+    for kw in ({"ensure_ascii": False}, {"separators": (",", ":")}):
+        page = json.dumps(page_doc, **kw).encode()
+        spans, envelope = split_list_items(page)
+        assert [json.loads(s) for s in spans] == page_doc["items"]
+        assert envelope["kind"] == "PodList"
+
+
+def test_backfill_gvk_survives_unicode_and_dup_keys():
+    """backfill_gvk splices bytes blind: unicode payloads stay intact
+    and its prepend-plus-last-wins contract composes with docs that
+    ALREADY contain duplicate keys."""
+    from gatekeeper_tpu.fuzz.corpus import raw_dup_key_doc
+
+    raw = json.dumps({"metadata": {"name": "ки"},
+                      "spec": {"☃": 1}},
+                     ensure_ascii=False).encode()
+    r = json.loads(backfill_gvk(raw, "fuzz.example.com/v1", "Widget"))
+    assert r["apiVersion"] == "fuzz.example.com/v1"
+    assert r["kind"] == "Widget"
+    assert r["metadata"]["name"] == "ки"
+    # a dup-key doc keeps ITS OWN gvk (present keys win) and its
+    # last-wins fields survive the splice
+    r2 = json.loads(backfill_gvk(raw_dup_key_doc(), "v2", "Other"))
+    assert r2["apiVersion"] == "v1" and r2["kind"] == "Pod"
+    assert r2["metadata"]["labels"]["k"] == "last"

@@ -434,3 +434,178 @@ def test_flightrec_sink_torn_tail_repaired_on_append(tmp_path):
     assert counts.get("truncated_tail", 0) == 0
     assert [r["uid"] for r in records] == ["after-crash"]
     assert records[0]["request"] == {"uid": "after-crash"}
+
+
+# --- 4. namespace-selector replay fidelity ---------------------------------
+
+NS_SEL_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8snssel"},
+    "spec": {"crd": {"spec": {"names": {"kind": "K8sNsSel"}}},
+             "targets": [{
+                 "target": "admission.k8s.gatekeeper.sh",
+                 "rego": """
+package k8snssel
+
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  msg := "pod in selected namespace"
+}
+"""}]},
+}
+NS_SEL_CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sNsSel",
+    "metadata": {"name": "deny-team-a-pods"},
+    "spec": {"match": {
+        "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+        "namespaceSelector": {"matchLabels": {"team": "a"}}}},
+}
+NS_AUDIT_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8snsspill"},
+    "spec": {"crd": {"spec": {"names": {"kind": "K8sNsSpill"}}},
+             "targets": [{
+                 "target": "admission.k8s.gatekeeper.sh",
+                 "rego": """
+package k8snsspill
+
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  msg := "audited"
+}
+"""}]},
+}
+NS_AUDIT_CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sNsSpill",
+    "metadata": {"name": "ns-spill-audit"},
+    "spec": {"match": {
+        "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}},
+}
+
+
+def _ns_doc(name, team):
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": name, "labels": {"team": team}}}
+
+
+def _ns_pod(i, ns):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": ns}, "spec": {}}
+
+
+@pytest.fixture(scope="module")
+def ns_corpus(tmp_path_factory):
+    """Recorded decisions whose verdicts depended on the RECORDED
+    cluster's Namespace labels (alpha: team=a denied), plus a snapshot
+    spill of that cluster — the namespace source of record."""
+    from gatekeeper_tpu.observability import flightrec
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    sink = os.path.join(str(tmp_path_factory.mktemp("ns-sink")),
+                        "decisions.jsonl")
+    runtime = core.load_candidate([NS_SEL_TEMPLATE, NS_SEL_CONSTRAINT])
+    ns_live = {"alpha": _ns_doc("alpha", "a"),
+               "beta": _ns_doc("beta", "b")}
+    handler = ValidationHandler(runtime.client,
+                                namespace_lookup=ns_live.get)
+    bodies = []
+    for i, ns in enumerate(["alpha", "beta"] * 6):
+        bodies.append({"apiVersion": "admission.k8s.io/v1",
+                       "kind": "AdmissionReview",
+                       "request": {"uid": f"ns-{i:04d}",
+                                   "kind": {"group": "", "version": "v1",
+                                            "kind": "Pod"},
+                                   "operation": "CREATE",
+                                   "name": f"p{i}", "namespace": ns,
+                                   "userInfo": {"username": "t@ns"},
+                                   "object": _ns_pod(i, ns)}})
+    rec = flightrec.FlightRecorder(capacity=64, sink_path=sink,
+                                   capture=True)
+    denies = 0
+    with flightrec.activate(rec):
+        for b in bodies:
+            resp = handler.handle(b)
+            denies += 0 if resp.allowed else 1
+    rec.close()
+    gc = getattr(runtime.driver, "gen_coord", None)
+    if gc is not None:
+        gc.stop()
+    records, _counts = core.read_corpus(sink)
+    assert denies == 6 and len(records) == 12
+    # spill the recorded cluster (Namespaces included) as rows
+    root = str(tmp_path_factory.mktemp("ns-spill"))
+    audit_rt = core.load_candidate([NS_AUDIT_TEMPLATE,
+                                    NS_AUDIT_CONSTRAINT])
+    evaluator = ShardedEvaluator(audit_rt.driver, make_mesh(),
+                                 violations_limit=20)
+    cluster = FakeCluster()
+    for o in list(ns_live.values()) + [_ns_pod(i, "alpha")
+                                       for i in (90, 91)]:
+        cluster.apply(copy.deepcopy(o))
+    snap = ClusterSnapshot(evaluator, SnapshotConfig())
+    mgr = AuditManager(
+        audit_rt.client, lister=lambda: iter(cluster.list()),
+        config=AuditConfig(audit_source="snapshot", chunk_size=64,
+                           exact_totals=False, pipeline="off"),
+        evaluator=evaluator, snapshot=snap)
+    mgr.audit()
+    wrote = SnapshotSpill(root).save(
+        snap, templates=templates_digest(audit_rt.client))
+    assert wrote["ok"]
+    gc = getattr(audit_rt.driver, "gen_coord", None)
+    if gc is not None:
+        gc.stop()
+    return {"records": records, "sink": sink, "root": root}
+
+
+def test_namespaces_from_spill_extracts_recorded_fixtures(ns_corpus):
+    ns = core.namespaces_from_spill(core.read_spill(ns_corpus["root"]))
+    assert set(ns) == {"alpha", "beta"}
+    assert ns["alpha"]["metadata"]["labels"] == {"team": "a"}
+
+
+def test_namespace_selector_replay_pins_recorded_labels(ns_corpus):
+    """Stale candidate Namespace fixtures flip namespace-selector
+    verdicts (looks like a library change, is corpus skew); sourcing
+    fixtures from the recorded spill restores bit-identity."""
+    stale = [NS_SEL_TEMPLATE, NS_SEL_CONSTRAINT,
+             _ns_doc("alpha", "b"), _ns_doc("beta", "b")]
+
+    def run(**kw):
+        rt = core.load_candidate(stale, **kw)
+        try:
+            return core.replay_decisions(ns_corpus["records"], rt,
+                                         differential=True)
+        finally:
+            gc = getattr(rt.driver, "gen_coord", None)
+            if gc is not None:
+                gc.stop()
+
+    skewed = run()
+    assert not skewed["differential"]["bit_identical"]
+    assert skewed["newly_allowed"] == 6  # every alpha deny flipped
+    fixed = run(namespaces=core.namespaces_from_spill(
+        core.read_spill(ns_corpus["root"])))
+    assert fixed["differential"]["bit_identical"]
+    assert fixed["newly_allowed"] == 0
+
+
+def test_replay_cli_namespaces_from_spill_flag(ns_corpus, tmp_path,
+                                               capsys):
+    """--namespaces-from-spill: opt-in; without it the stale-fixture
+    skew exits 1, with it the same corpus is bit-identical (exit 0)."""
+    f = _docs_file(tmp_path, [NS_SEL_TEMPLATE, NS_SEL_CONSTRAINT,
+                              _ns_doc("alpha", "b"),
+                              _ns_doc("beta", "b")], "ns-cand.json")
+    base = ["-f", ns_corpus["sink"], "--candidate", f,
+            "--differential", "-o", "json"]
+    assert replay_cmd.run_cli(base) == 1
+    capsys.readouterr()
+    rc = replay_cmd.run_cli(base + ["--namespaces-from-spill",
+                                    ns_corpus["root"]])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["differential"]["bit_identical"]

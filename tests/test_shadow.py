@@ -324,3 +324,70 @@ def test_debug_shadow_abort_endpoint(ctx):
             assert out == {"state": "aborted", "reason": "slo"}
     finally:
         srv.stop()
+
+
+def test_slo_breach_auto_aborts_shadow_lane():
+    """bind_slo: a RISING-EDGE breach of the shadow divergence
+    objective aborts a shadowing lane; a promoted lane is immune, and
+    a continued breach never re-fires (edge, not level)."""
+    from gatekeeper_tpu.observability.slo import SLOEngine
+
+    fake = {"t": 0.0}
+    m = MetricsRegistry()
+    eng = SLOEngine(
+        m, objectives=[SHADOW_OBJECTIVE],
+        tiers=[{"name": "page", "short_s": 60.0, "long_s": 300.0,
+                "burn": 2.0}],
+        clock=lambda: fake["t"], wall=lambda: 1_000_000.0 + fake["t"])
+    lane = ShadowLane(runtime=None)  # never started: abort() is a
+    lane.bind_slo(eng)               # state flip + no-op stop()
+    eng.tick()  # t=0 baseline
+    m.inc_counter("shadow_decisions_count", value=100.0)
+    fake["t"] = 60.0
+    out = eng.tick()
+    assert not out["objectives"][0]["breach"]
+    assert lane.state == "shadowing"
+    # a divergent minute: 50/50 bad >> the 1% budget at burn 2.0
+    m.inc_counter("shadow_divergence_count", {"kind": "verdict"},
+                  value=50.0)
+    m.inc_counter("shadow_decisions_count", value=50.0)
+    fake["t"] = 120.0
+    out = eng.tick()
+    assert out["objectives"][0]["breach"]
+    assert lane.state == "aborted"
+    assert "slo auto-abort" in lane.abort_reason
+    assert SHADOW_OBJECTIVE["name"] in lane.abort_reason
+    # edge semantics: still breached on the next tick, but the hook
+    # does not fire again (a lane resurrected by hand stays put)
+    lane.state = "shadowing"
+    m.inc_counter("shadow_divergence_count", {"kind": "verdict"},
+                  value=50.0)
+    m.inc_counter("shadow_decisions_count", value=50.0)
+    fake["t"] = 121.0
+    out = eng.tick()
+    assert out["objectives"][0]["breach"]
+    assert lane.state == "shadowing"
+
+
+def test_slo_auto_abort_spares_promoted_lane():
+    """The hook must never touch a lane that already promoted — the
+    canary decision is done; only a shadowing lane may auto-abort."""
+    from gatekeeper_tpu.observability.slo import SLOEngine
+
+    fake = {"t": 0.0}
+    m = MetricsRegistry()
+    eng = SLOEngine(
+        m, objectives=[SHADOW_OBJECTIVE],
+        tiers=[{"name": "page", "short_s": 60.0, "long_s": 300.0,
+                "burn": 2.0}],
+        clock=lambda: fake["t"], wall=lambda: 1_000_000.0 + fake["t"])
+    lane = ShadowLane(runtime=None)
+    lane.bind_slo(eng)
+    lane.state = "promoted"
+    eng.tick()
+    m.inc_counter("shadow_divergence_count", value=50.0)
+    m.inc_counter("shadow_decisions_count", value=50.0)
+    fake["t"] = 60.0
+    out = eng.tick()
+    assert out["objectives"][0]["breach"]
+    assert lane.state == "promoted"

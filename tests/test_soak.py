@@ -1,0 +1,152 @@
+"""Adversarial corpus + chaos soak harness (gatekeeper_tpu/fuzz/).
+
+Tier-1 runs the property smoke (corpus determinism + one full-family
+soak pass under chaos, every differential lane armed, serial drive —
+the 1-core CI shape) and the two seeded-bug sensitivity checks: a soak
+that cannot catch a planted divergence is worthless, so blindness here
+is a test failure, not a shrug.  The multi-minute concurrent soak is
+slow-marked (ROADMAP: deferred to multicore hosts).
+"""
+
+import json
+
+import pytest
+
+from gatekeeper_tpu.fuzz import corpus
+
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    # one compile cache across every soak in this module: the harness
+    # rebuilds per run, the lowered programs shouldn't
+    return str(tmp_path_factory.mktemp("soak-cc"))
+
+
+# --- corpus properties (no jax, no harness) -------------------------------
+
+def test_corpus_deterministic_and_seed_sensitive():
+    a = corpus.generate_all(seed=3, size=1)
+    b = corpus.generate_all(seed=3, size=1)
+    c = corpus.generate_all(seed=4, size=1)
+    assert [x.family for x in a] == list(corpus.FAMILIES)
+    key = lambda bs: json.dumps(
+        [[x.objects, [d.decode() for d in x.raw_docs], x.mutators,
+          x.match_specs, x.extdata_keys] for x in bs],
+        sort_keys=True, default=str)
+    assert key(a) == key(b), "same seed must replay bit-identically"
+    assert key(a) != key(c), "different seed must differ"
+
+
+def test_corpus_size_dial_and_stats():
+    small = corpus.generate_all(seed=0, size=1)
+    big = corpus.generate_all(seed=0, size=4)
+    s_small = corpus.corpus_stats(small)
+    s_big = corpus.corpus_stats(big)
+    assert s_big["total"]["objects"] > s_small["total"]["objects"]
+    assert s_big["total"]["object_bytes"] > s_small["total"]["object_bytes"]
+    for fam in corpus.FAMILIES:
+        assert fam in s_small["families"]
+    # every raw byte doc is parseable JSON (dup keys and 256+ depth are
+    # hostile to the C lane, not malformed)
+    import sys
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(5000)
+    try:
+        for b in small:
+            for d in b.raw_docs:
+                json.loads(d)
+    finally:
+        sys.setrecursionlimit(old)
+
+
+def test_corpus_families_carry_their_weapons():
+    bundles = {b.family: b for b in corpus.generate_all(seed=1, size=1)}
+    assert len({o.get("kind") for o in
+                bundles["crd_heavy"].objects}) >= 8
+    assert any(len(json.dumps(o)) > 60000
+               for o in bundles["megabyte_objects"].objects)
+    assert any(d.count(b'{"n":') > 256
+               for d in bundles["deep_nesting"].raw_docs)
+    assert any("namespaceSelector" in s
+               for s in map(json.dumps, bundles["selectors"].match_specs))
+    assert len(bundles["alias_mutators"].mutators) >= 8
+    assert bundles["expansion"].expansion_templates
+    assert any("err-" in k for k in bundles["extdata_hostile"].extdata_keys)
+
+
+def test_admission_bodies_shape():
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "p", "namespace": "default"}}]
+    (body,) = corpus.admission_bodies(objs, seed=9, prefix="t")
+    req = body["request"]
+    assert body["kind"] == "AdmissionReview"
+    assert req["uid"].startswith("t-9-")
+    assert req["kind"]["kind"] == "Pod"
+    assert req["object"]["metadata"]["name"] == "p"
+
+
+# --- the soak: clean run + sensitivity ------------------------------------
+
+def test_soak_smoke_all_families_all_lanes(cache_dir):
+    """One full pass, every family, every differential lane armed,
+    chaos on, serial drive: zero divergences, zero lost verdicts, zero
+    crashes, clean drain — the PR's headline acceptance gate."""
+    from gatekeeper_tpu.fuzz.soak import run_soak
+
+    report = run_soak(seed=0, size=1, rounds=1, chaos=True,
+                      cache_dir=cache_dir)
+    assert report["ok"], report
+    assert report["divergences"] == []
+    assert report["crashes"] == []
+    assert report["lost_verdicts"] == 0
+    assert report["drain_ok"]
+    assert report["requests"]["admit"] > 50
+    assert report["requests"]["mutate"] > 20
+    # the chaos plan actually fired, and the extdata differential
+    # actually reached the hostile transport
+    assert sum(report["faults_fired"].values()) > 0
+    assert report["extdata_transport_calls"] > 0
+
+
+def test_soak_sensitivity_corrupted_mutation(cache_dir):
+    """A corrupted batched patch (the lowered-program-corruption
+    analogue) MUST surface as a mutate-lane divergence carrying the
+    reproducing family + seed."""
+    from gatekeeper_tpu.fuzz.soak import _repro_line, run_soak
+
+    report = run_soak(seed=0, size=1, families=["alias_mutators"],
+                      rounds=1, chaos=False,
+                      inject_bug="mutate_program", cache_dir=cache_dir)
+    assert not report["ok"]
+    assert any(d["lane"] == "mutate" and d["family"] == "alias_mutators"
+               for d in report["divergences"]), report["divergences"]
+    line = _repro_line(report)
+    assert "--seed 0" in line and "alias_mutators" in line
+
+
+def test_soak_sensitivity_tampered_extdata_column(cache_dir):
+    """A tampered resident provider column MUST surface as an
+    extdata-lane divergence (batched join vs per-key reference)."""
+    from gatekeeper_tpu.fuzz.soak import _repro_line, run_soak
+
+    report = run_soak(seed=0, size=1, families=["extdata_hostile"],
+                      rounds=1, chaos=False,
+                      inject_bug="extdata_column", cache_dir=cache_dir)
+    assert not report["ok"]
+    assert any(d["lane"] == "extdata" and
+               d["family"] == "extdata_hostile"
+               for d in report["divergences"]), report["divergences"]
+    assert "extdata_hostile" in _repro_line(report)
+
+
+@pytest.mark.slow
+def test_soak_minutes_concurrent(cache_dir):
+    """The real soak: multi-minute clock, concurrent admit/mutate
+    drive while the audit loop runs, bigger corpus.  Deferred out of
+    tier-1 (1-core CI); run on multicore via tools/soak.py or -m slow."""
+    from gatekeeper_tpu.fuzz.soak import run_soak
+
+    report = run_soak(seed=0, size=4, duration_s=120.0, chaos=True,
+                      concurrent=True, cache_dir=cache_dir)
+    assert report["ok"], report
+    assert report["rounds"] >= 2
