@@ -408,6 +408,16 @@ def main(argv=None) -> int:
                         "optional work (stale lookups, audit device-"
                         "lane yield) BEFORE the admission queue backs "
                         "up (off keeps the ladder queue-driven only)")
+    p.add_argument("--slo-degradation", default="off",
+                   choices=["on", "off"],
+                   help="targeted degradation maps: each objective's "
+                        "ordered, revocable action list (ns_cache_stale "
+                        "-> extdata_stale -> shed_harder; "
+                        "audit_yield_release -> resync_defer) activates "
+                        "step-by-step on burn breach and releases in "
+                        "reverse on recovery — the surgical alternative "
+                        "to the scalar --slo-brownout ladder (both can "
+                        "run together)")
     p.add_argument("--flight-recorder", type=int, default=2048,
                    help="admission flight recorder: ring capacity of "
                         "structured admission/mutation/shed decision "
@@ -418,6 +428,15 @@ def main(argv=None) -> int:
                         "JSONL file (the operator's black box; decision "
                         "metadata only, never object bodies — unless "
                         "--flight-recorder-capture)")
+    p.add_argument("--flight-recorder-sink-max-mb", type=float,
+                   default=0.0,
+                   help="rotate the sink when it reaches this many MB "
+                        "(sink -> sink.1 -> sink.2 ...; 0 = unbounded). "
+                        "gator decisions/triage read rotated sets "
+                        "transparently")
+    p.add_argument("--flight-recorder-sink-keep", type=int, default=3,
+                   help="rotated sink files retained past the live one "
+                        "(oldest dropped on rotation)")
     p.add_argument("--flight-recorder-capture", action="store_true",
                    help="capture mode: sink lines additionally carry "
                         "the raw admission request (the `gator replay` "
@@ -630,13 +649,29 @@ def main(argv=None) -> int:
             capacity=args.flight_recorder,
             sink_path=args.flight_recorder_sink or None,
             metrics=metrics,
-            capture=args.flight_recorder_capture)
+            capture=args.flight_recorder_capture,
+            sink_max_bytes=int(args.flight_recorder_sink_max_mb
+                               * 1024 * 1024),
+            sink_keep=args.flight_recorder_sink_keep)
         _flightrec.install(flight_rec)
     slo_engine = None
     if args.slo == "on" and not args.once:
-        slo_kw: dict = {}
+        degradations = None
+        if args.slo_degradation == "on":
+            # targeted per-objective degradation maps: the registry the
+            # overload controller / ProviderCache / AuditManager consult
+            # (degradation_active) and the engine drives edges into
+            degradations = _overload.DegradationRegistry(metrics=metrics)
+            _overload.install_degradations(degradations)
+        slo_kw: dict = {"degradations": degradations}
         if args.slo_config:
-            cfg = _slo.load_config(args.slo_config)
+            try:
+                cfg = _slo.load_config(args.slo_config, degradations)
+            except _slo.SLOConfigError as e:
+                # fail fast at boot: a malformed objective silently
+                # dropped is an SLO that never pages
+                print(f"slo config: {e}", file=sys.stderr)
+                return 2
             slo_kw["objectives"] = cfg["objectives"]
             if cfg["tiers"]:
                 slo_kw["tiers"] = cfg["tiers"]
@@ -655,7 +690,9 @@ def main(argv=None) -> int:
         slo_engine.start(interval_s=args.slo_interval)
         print(f"SLO engine active: "
               f"{len(slo_engine.objectives)} objectives, tick every "
-              f"{args.slo_interval:.0f}s (/debug/slo)", file=sys.stderr)
+              f"{args.slo_interval:.0f}s (/debug/slo)"
+              + (", degradation maps armed"
+                 if degradations is not None else ""), file=sys.stderr)
     if args.qos == "on" and args.qos_ledger_decay == "slo-window" \
             and overload_ctl is not None:
         # displacement-ledger decay on the SLO window clock (default

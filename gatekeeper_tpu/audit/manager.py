@@ -216,6 +216,7 @@ class AuditManager:
         snapshot=None,  # snapshot.ClusterSnapshot (audit_source=snapshot)
         expansion_system=None,  # expansion.ExpansionSystem (expand stage)
         spiller=None,  # snapshot.SnapshotSpiller (--snapshot-spill)
+        cluster: str = "",  # fleet scope: labels staleness gauges
     ):
         self.client = client
         self.lister = lister
@@ -227,6 +228,12 @@ class AuditManager:
         self.log_violations = log_violations
         self.metrics = metrics
         self.snapshot = snapshot
+        # fleet mode (fleet/evaluator.py): a non-empty cluster id adds
+        # a {cluster}-labeled copy of the last-run gauges so the
+        # per-cluster audit-staleness SLO objectives (observability/
+        # slo.py per_cluster_objectives) can age each cluster's audit
+        # independently off one shared registry
+        self.cluster = cluster
         self.expansion_system = expansion_system
         # expansion generator stage state: the batched stage (lazy), the
         # per-sweep generator-object tee, the Namespace inventory the
@@ -292,7 +299,8 @@ class AuditManager:
             every = max(0, getattr(self.config, "resync_every", 0))
             while not self._stop.wait(self.config.interval_s):
                 n += 1
-                if every and n % every == 0:
+                if every and n % every == 0 and \
+                        not self._resync_deferred():
                     self.audit_resync()
                 else:
                     self.audit_tick()
@@ -1112,13 +1120,34 @@ class AuditManager:
         """Brownout level-2 hook: while the webhook admission queue is
         under heavy pressure, the sweep yields the device lane before
         submitting its next chunk (bounded per call — audit slows, never
-        stalls).  A no-op without an installed OverloadController."""
+        stalls).  A no-op without an installed OverloadController, and
+        released entirely while a breaching audit-staleness objective
+        holds ``audit_yield_release`` (yield_device_lane checks it)."""
         from gatekeeper_tpu.resilience import overload
 
-        waited = overload.yield_device_lane()
+        waited = overload.yield_device_lane(cluster=self.cluster)
         if waited:
             self.perf["brownout_yield_s"] = (
                 self.perf.get("brownout_yield_s", 0.0) + waited)
+
+    def _resync_deferred(self) -> bool:
+        """``resync_defer`` degradation action: a breaching
+        audit-staleness objective defers the periodic full-resync
+        differential (an expensive relist + full re-evaluation) so the
+        interval budget goes to catching the dirty set up.  Deferrals
+        are counted — a resync deferred is visible, not silent."""
+        from gatekeeper_tpu.resilience import overload
+
+        if not overload.degradation_active(overload.RESYNC_DEFER,
+                                           self.cluster):
+            return False
+        if self.metrics is not None:
+            from gatekeeper_tpu.metrics import registry as M
+
+            self.metrics.inc_counter(
+                M.RESILIENCE_DEGRADED,
+                {"component": "audit", "to": "resync_defer"})
+        return True
 
     # --- expansion generator stage (mutlane/expand_stage.py) -------------
     def _gen_stage(self):
@@ -1756,6 +1785,16 @@ class AuditManager:
         self.metrics.set_gauge(M.AUDIT_LAST_RUN_END, now)
         self.metrics.set_gauge(M.AUDIT_LAST_RUN_INCOMPLETE,
                                1.0 if run.incomplete else 0.0)
+        if self.cluster:
+            # fleet: the per-cluster staleness series the cluster-scoped
+            # objectives sample (the unlabeled gauges above keep their
+            # process-wide meaning: last sweep of ANY cluster)
+            lab = {"cluster": self.cluster}
+            self.metrics.set_gauge(M.AUDIT_LAST_RUN,
+                                   now - run.duration_s, lab)
+            self.metrics.set_gauge(M.AUDIT_LAST_RUN_END, now, lab)
+            self.metrics.set_gauge(M.AUDIT_LAST_RUN_INCOMPLETE,
+                                   1.0 if run.incomplete else 0.0, lab)
         if not self.pipe_stats:
             return
         for name, s in self.pipe_stats.get("stages", {}).items():

@@ -253,11 +253,14 @@ class ProviderCache:
             return out
         from gatekeeper_tpu.resilience import overload as _overload
 
-        if _overload.current_brownout() >= 1:
-            # overload brownout (resilience/overload.py): external-data
-            # joins are the expensive optional work degraded BEFORE any
-            # admission is shed — expired cache entries serve stale, keys
-            # never fetched flow into the placeholder failure policy
+        if _overload.current_brownout() >= 1 or \
+                _overload.degradation_active(_overload.EXTDATA_STALE):
+            # overload brownout (resilience/overload.py) — or a
+            # breaching SLO objective holding the extdata_stale
+            # degradation action: external-data joins are the expensive
+            # optional work degraded BEFORE any admission is shed —
+            # expired cache entries serve stale, keys never fetched
+            # flow into the placeholder failure policy
             self._serve_stale(provider_name, missing, out,
                               "overload brownout")
             return out

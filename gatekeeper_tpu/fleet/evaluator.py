@@ -329,7 +329,7 @@ class FleetEvaluator:
             # con.raw would make the last-swept cluster win
             status_writer=lambda con, status:
                 statuses.__setitem__(con.key(), status),
-            metrics=self.metrics)
+            metrics=self.metrics, cluster=cluster_id)
         if spill is not None:
             spiller = SnapshotSpiller(
                 spill, snapshot,

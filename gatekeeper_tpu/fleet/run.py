@@ -117,6 +117,37 @@ def run_fleet(args) -> int:
           f"{len(fleet.runtimes())} library runtimes "
           f"({fleet.shared_boots} shared boots)", file=sys.stderr)
 
+    # fleet-scoped SLOs: each cluster gets its own audit-staleness
+    # objective over the {cluster}-labeled last-run gauges, burning and
+    # degrading independently (--slo-degradation arms the per-objective
+    # maps: a stale cluster releases ITS audit's device-lane yield and
+    # defers ITS resyncs — no other cluster's lane moves)
+    slo_engine = None
+    if getattr(args, "slo", "on") == "on":
+        from gatekeeper_tpu.observability import slo as slo_mod
+        from gatekeeper_tpu.resilience import overload as ovl
+
+        degradations = None
+        if getattr(args, "slo_degradation", "off") == "on":
+            degradations = ovl.DegradationRegistry(metrics=metrics)
+            ovl.install_degradations(degradations)
+        base = list(slo_mod.DEFAULT_OBJECTIVES)
+        if getattr(args, "slo_config", ""):
+            try:
+                base = [o.spec for o in slo_mod.load_config(
+                    args.slo_config, degradations)["objectives"]]
+            except slo_mod.SLOConfigError as e:
+                print(f"slo config: {e}", file=sys.stderr)
+                return 2
+        # the fleet control plane has no admission lane: scope the
+        # audit-side objectives per cluster, skip the webhook ones
+        base = [o for o in base if o.get("type") == "staleness"]
+        slo_engine = slo_mod.SLOEngine(
+            metrics,
+            objectives=slo_mod.per_cluster_objectives(
+                sorted(fleet.clusters), base=base),
+            degradations=degradations)
+
     for rt in fleet.runtimes():
         rep = rt.warm_replayed
         if rep and rep.get("hit"):
@@ -135,6 +166,8 @@ def run_fleet(args) -> int:
 
     if args.once:
         runs = fleet.sweep(full=True)
+        if slo_engine is not None:
+            slo_engine.tick()
         summarize(runs)
         print(f"fleet sweep: {fleet.packed_dispatches} packed + "
               f"{fleet.unpacked_dispatches} unpacked dispatches, "
@@ -152,8 +185,12 @@ def run_fleet(args) -> int:
     signal.signal(signal.SIGTERM, _on_term)
     try:
         summarize(fleet.sweep(full=None))
+        if slo_engine is not None:
+            slo_engine.tick()
         while not stopping.wait(args.audit_interval):
             summarize(fleet.sweep(full=None))
+            if slo_engine is not None:
+                slo_engine.tick()
     except KeyboardInterrupt:
         pass
     finally:

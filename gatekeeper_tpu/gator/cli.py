@@ -143,6 +143,7 @@ cmd_policy = _delegate("policy_cmd")
 cmd_decisions = _delegate("decisions_cmd")
 cmd_generate_vap = _delegate("generate_vap_cmd")
 cmd_replay = _delegate("replay_cmd")
+cmd_triage = _delegate("triage_cmd")
 
 
 COMMANDS = {
@@ -155,6 +156,7 @@ COMMANDS = {
     "decisions": cmd_decisions,
     "generate-vap": cmd_generate_vap,
     "replay": cmd_replay,
+    "triage": cmd_triage,
 }
 
 
@@ -164,7 +166,7 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: gator [--chaos spec.json] "
               "{test|verify|expand|bench|sync|policy|decisions|"
-              "generate-vap|replay} [options]")
+              "generate-vap|replay|triage} [options]")
         return 0
     # global --chaos spec.json: install the deterministic fault-injection
     # plan before any subcommand runs (README 'Failure semantics')
@@ -187,7 +189,7 @@ def main(argv=None) -> int:
     if not argv:
         print("usage: gator [--chaos spec.json] "
               "{test|verify|expand|bench|sync|policy|decisions|"
-              "generate-vap|replay} [options]")
+              "generate-vap|replay|triage} [options]")
         return 0
     cmd = argv[0]
     fn = COMMANDS.get(cmd)

@@ -49,55 +49,66 @@ def read_decisions(path: str, uid: str = "",
     """Load + filter a flight-recorder JSONL sink.  Returns the same
     payload shape as ``FlightRecorder.snapshot`` (``decisions`` most
     recent first, ``matched`` when any filter applied) so tooling built
-    against ``/debug/decisions`` reads both.  Malformed lines are
-    counted, never fatal — a black box that crashes its reader is no
-    black box."""
+    against ``/debug/decisions`` reads both.  A size-rotated sink set
+    (``path.N`` … ``path.1`` + ``path``, see ``--flight-recorder-sink-
+    max-mb``) reads transparently oldest-first as one stream.
+    Malformed lines are counted, never fatal — a black box that
+    crashes its reader is no black box."""
+    from gatekeeper_tpu.observability.flightrec import rotated_paths
+
     decisions: list = []
     malformed = 0
     truncated = 0
     total = 0
-    with open(path) as f:
-        for raw in f:
-            ends_nl = raw.endswith("\n")
-            line = raw.strip()
-            if not line:
-                continue
-            total += 1
-            try:
-                e = json.loads(line)
-            except ValueError:
-                # a final line with no newline is a crashed recorder's
-                # torn tail, not sink corruption — count it apart
-                if ends_nl:
+    paths = rotated_paths(path) or [path]
+    for part in paths:
+        with open(part) as f:
+            for raw in f:
+                ends_nl = raw.endswith("\n")
+                line = raw.strip()
+                if not line:
+                    continue
+                total += 1
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    # a final line with no newline is a crashed
+                    # recorder's torn tail, not sink corruption —
+                    # count it apart
+                    if ends_nl:
+                        malformed += 1
+                    else:
+                        truncated += 1
+                    continue
+                if not isinstance(e, dict):
+                    # valid JSON but not a record (e.g. a bare number
+                    # from a corrupted merge) — same skip-and-count
+                    # contract
                     malformed += 1
-                else:
-                    truncated += 1
-                continue
-            if not isinstance(e, dict):
-                # valid JSON but not a record (e.g. a bare number from
-                # a corrupted merge) — same skip-and-count contract
-                malformed += 1
-                continue
-            if uid and e.get("uid") != uid:
-                continue
-            ts = float(e.get("ts", 0.0) or 0.0)
-            if since is not None and ts < since:
-                continue
-            if until is not None and ts >= until:
-                continue
-            if kinds and e.get("decision") not in kinds:
-                continue
-            if tenant is not None and e.get("tenant", "") != tenant:
-                continue
-            if cluster is not None and e.get("cluster", "") != cluster:
-                continue
-            decisions.append(e)
+                    continue
+                if uid and e.get("uid") != uid:
+                    continue
+                ts = float(e.get("ts", 0.0) or 0.0)
+                if since is not None and ts < since:
+                    continue
+                if until is not None and ts >= until:
+                    continue
+                if kinds and e.get("decision") not in kinds:
+                    continue
+                if tenant is not None and e.get("tenant", "") != tenant:
+                    continue
+                if cluster is not None and \
+                        e.get("cluster", "") != cluster:
+                    continue
+                decisions.append(e)
     filtered = bool(uid or since is not None or until is not None
                     or kinds or tenant is not None
                     or cluster is not None)
     decisions.reverse()  # most recent first, like /debug/decisions
     out = {"recorded": total, "sink": path,
            "decisions": decisions[: max(0, limit)]}
+    if len(paths) > 1:
+        out["rotated_files"] = len(paths)
     if filtered:
         out["matched"] = len(decisions)
     if malformed:

@@ -128,11 +128,15 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[(name, self._bounded_labels(name, labels))] = value
 
-    def counter_total(self, name: str) -> float:
-        """Sum of a counter across all label sets (test/introspection)."""
+    def counter_total(self, name: str,
+                      match: Optional[dict] = None) -> float:
+        """Sum of a counter across all label sets (test/introspection).
+        ``match`` keeps only labelsets carrying every given (k, v) pair
+        — the fleet-scoped SLO lookups sum one cluster's series."""
+        want = set((match or {}).items())
         with self._lock:
-            return sum(v for (n, _), v in self._counters.items()
-                       if n == name)
+            return sum(v for (n, lk), v in self._counters.items()
+                       if n == name and want.issubset(set(lk)))
 
     def set_buckets(self, name: str, bounds: Sequence[float]) -> None:
         """Override the bucket bounds a metric name will use.  Applies to
@@ -490,6 +494,10 @@ SLO_SLI = "slo_sli_value"  # gauge {objective}
 SLO_BURN_RATE = "slo_burn_rate"  # gauge {objective, window}
 SLO_COMPLIANT = "slo_compliant"  # gauge {objective} (1 in-SLO)
 SLO_BREACHES = "slo_breach_count"  # {objective}
+# per-objective degradation maps: 1 while the named action is held
+# active by a breaching objective ({cluster} added for fleet-scoped
+# objectives), 0 on the falling-edge release
+SLO_DEGRADATION = "slo_degradation_active"  # gauge {objective, action}
 # admission flight recorder (observability/flightrec.py): decisions
 # captured into the bounded ring (served at /debug/decisions)
 FLIGHTREC_DECISIONS = "flightrec_decisions_recorded_count"  # {decision}

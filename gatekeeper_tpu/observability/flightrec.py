@@ -26,6 +26,7 @@ nanoseconds against a millisecond admission path.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -59,13 +60,33 @@ def _open_sink(path: str):
     return sink
 
 
+def rotated_paths(path: str) -> list:
+    """Every existing file of a (possibly rotated) sink set, OLDEST
+    first: ``path.N`` … ``path.1`` then ``path`` itself.  Readers
+    (``gator decisions`` / ``gator triage`` offline mode) concatenate
+    these to see the full retained decision stream; each file repairs /
+    counts its own torn tail independently, so rotation never corrupts
+    a read."""
+    out: list = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    for i in range(n - 1, 0, -1):
+        out.append(f"{path}.{i}")
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 class FlightRecorder:
     def __init__(self, capacity: int = 2048,
                  sink_path: Optional[str] = None,
                  metrics=None,
                  wall=time.time,
                  max_message: int = 512,
-                 capture: bool = False):
+                 capture: bool = False,
+                 sink_max_bytes: int = 0,
+                 sink_keep: int = 3):
         self._ring: deque = deque(maxlen=max(1, capacity))
         self._lock = threading.Lock()
         self.metrics = metrics
@@ -75,8 +96,21 @@ class FlightRecorder:
         self.recorded = 0
         self._sink = None
         self.sink_path = sink_path
+        # size-based sink rotation (--flight-recorder-sink-max-mb): a
+        # sink past sink_max_bytes rotates to path.1 (path.1 -> path.2
+        # ... up to sink_keep rotated files, oldest dropped) and a
+        # fresh sink opens.  0 = unbounded (the pre-rotation shape)
+        self.sink_max_bytes = max(0, int(sink_max_bytes))
+        self.sink_keep = max(1, int(sink_keep))
+        self.rotations = 0
+        self._sink_lock = threading.Lock()
+        self._sink_bytes = 0
         if sink_path:
             self._sink = _open_sink(sink_path)
+            try:
+                self._sink_bytes = os.path.getsize(sink_path)
+            except OSError:
+                self._sink_bytes = 0
 
     # --- recording -----------------------------------------------------
     def record(self, endpoint: str, decision: str, uid: str = "",
@@ -138,6 +172,20 @@ class FlightRecorder:
                 }
             except Exception:
                 pass
+        # targeted SLO degradations in force at decision time (the
+        # overload-state change the degradation maps make visible in
+        # the black box — "this allow served a stale namespace")
+        try:
+            from gatekeeper_tpu.resilience import overload as _ovl
+
+            reg = _ovl.active_degradations()
+            if reg is not None:
+                degraded = reg.active_names()
+                if degraded:
+                    entry.setdefault("overload", {})["degraded"] = \
+                        degraded
+        except Exception:
+            pass
         for k, v in extra.items():
             if v not in (None, "", 0):
                 entry[k] = v
@@ -153,7 +201,15 @@ class FlightRecorder:
                 line = dict(entry)
                 line["request"] = request
             try:
-                sink.write(json.dumps(line, default=str) + "\n")
+                data = json.dumps(line, default=str) + "\n"
+                with self._sink_lock:
+                    sink = self._sink  # re-read: rotation swaps it
+                    if sink is not None:
+                        sink.write(data)
+                        self._sink_bytes += len(data)
+                        if self.sink_max_bytes and \
+                                self._sink_bytes >= self.sink_max_bytes:
+                            self._rotate_locked()
             except Exception:
                 pass  # the recorder must never fail an admission
         if self.metrics is not None:
@@ -218,8 +274,35 @@ class FlightRecorder:
             out["matched"] = len(ring)
         return out
 
+    def _rotate_locked(self) -> None:
+        """Shift the sink set one slot (call under ``_sink_lock``):
+        close, ``path.k -> path.k+1`` newest-first (the file past
+        ``sink_keep`` is dropped), ``path -> path.1``, reopen fresh.
+        The shift preserves per-file line integrity, so torn-tail
+        repair and readers work unchanged across the set."""
+        path = self.sink_path
+        try:
+            self._sink.close()
+        except Exception:
+            pass
+        self._sink = None
+        try:
+            drop = f"{path}.{self.sink_keep}"
+            if os.path.exists(drop):
+                os.remove(drop)
+            for i in range(self.sink_keep - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            os.replace(path, f"{path}.1")
+        except OSError:
+            pass  # rotation best-effort: keep recording into `path`
+        self._sink = _open_sink(path)
+        self._sink_bytes = 0
+        self.rotations += 1
+
     def close(self) -> None:
-        with self._lock:
+        with self._sink_lock:
             sink, self._sink = self._sink, None
         if sink is not None:
             try:

@@ -48,6 +48,12 @@ DEFAULT_OBJECTIVES = [
         "threshold": 0.25,
         "target": 0.99,
         "description": "99% of admission reviews answer within 250ms",
+        # the objective's degradation map (--slo-degradation): on a
+        # burn breach the engine activates these IN ORDER — cheapest
+        # reversible action first, shedding last — and releases them
+        # all on the falling edge.  Inert without a DegradationRegistry
+        "degradation": ["ns_cache_stale", "extdata_stale",
+                        "shed_harder"],
     },
     {
         "name": "mutation-latency-p99",
@@ -56,6 +62,7 @@ DEFAULT_OBJECTIVES = [
         "threshold": 0.25,
         "target": 0.99,
         "description": "99% of mutate reviews answer within 250ms",
+        "degradation": ["ns_cache_stale", "shed_harder"],
     },
     {
         "name": "admission-shed-rate",
@@ -65,6 +72,9 @@ DEFAULT_OBJECTIVES = [
         "total_metric": "validation_request_count",
         "target": 0.99,
         "description": "at most 1% of admissions shed under overload",
+        # shedding too much: make everything else cheaper before
+        # touching the gate itself
+        "degradation": ["ns_cache_stale", "extdata_stale"],
     },
     {
         "name": "audit-snapshot-staleness",
@@ -72,8 +82,26 @@ DEFAULT_OBJECTIVES = [
         "gauge": "audit_last_run_end_time",
         "threshold": 600.0,
         "description": "audit verdicts at most 10 minutes stale",
+        # a stale audit stops being polite: reclaim the device lane,
+        # then stop paying for full resyncs until caught up
+        "degradation": ["audit_yield_release", "resync_defer"],
     },
 ]
+
+# every objective field load_config / SLOObjective accepts — an unknown
+# key fails at parse time (the boot-time --slo-config contract), not as
+# a mid-run KeyError
+_OBJECTIVE_FIELDS = frozenset({
+    "name", "type", "metric", "threshold", "target", "description",
+    "labels", "bad_metric", "bad_labels", "total_metric",
+    "total_labels", "gauge", "degradation", "cluster",
+})
+
+
+class SLOConfigError(ValueError):
+    """A ``--slo-config`` document failed validation; the message
+    carries the file, line/field, and what was wrong — boot fails fast
+    instead of KeyError-ing mid-run."""
 
 # burn-rate alert tiers: (name, short window s, long window s, burn
 # threshold) — the SRE-workbook page/ticket pair scaled to a 30d budget
@@ -87,15 +115,29 @@ class SLOObjective:
     """One parsed objective (see module docstring for the dict format)."""
 
     def __init__(self, spec: dict):
+        if not isinstance(spec, dict):
+            raise ValueError(f"objective must be a JSON object, got "
+                             f"{type(spec).__name__}")
         self.spec = dict(spec)
+        if not spec.get("name"):
+            raise ValueError("objective is missing the 'name' field")
         self.name = spec["name"]
+        unknown = sorted(set(spec) - _OBJECTIVE_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"objective {self.name!r}: unknown field "
+                f"{unknown[0]!r} (accepted: {sorted(_OBJECTIVE_FIELDS)})")
         self.type = spec.get("type", "latency")
         if self.type not in ("latency", "ratio", "staleness"):
             raise ValueError(f"objective {self.name!r}: unknown type "
                              f"{self.type!r}")
         self.description = spec.get("description", "")
-        self.target = float(spec.get("target", 0.99))
-        self.threshold = float(spec.get("threshold", 0.0))
+        try:
+            self.target = float(spec.get("target", 0.99))
+            self.threshold = float(spec.get("threshold", 0.0))
+        except (TypeError, ValueError):
+            raise ValueError(f"objective {self.name!r}: 'target'/"
+                             f"'threshold' must be numbers") from None
         self.metric = spec.get("metric", "")
         self.labels = spec.get("labels")
         self.bad_metric = spec.get("bad_metric", "")
@@ -103,7 +145,33 @@ class SLOObjective:
         self.total_metric = spec.get("total_metric", "")
         self.total_labels = spec.get("total_labels")
         self.gauge = spec.get("gauge", "")
+        # ordered degradation map: the named actions this objective may
+        # activate on breach (validated against the DegradationRegistry
+        # when one is wired; inert otherwise)
+        deg = spec.get("degradation", [])
+        if not isinstance(deg, (list, tuple)) or \
+                any(not isinstance(a, str) or not a for a in deg):
+            raise ValueError(f"objective {self.name!r}: 'degradation' "
+                             f"must be a list of action names")
+        self.degradation = list(deg)
+        # fleet scope: a non-empty cluster pins every metric lookup to
+        # that cluster's labeled series, and scopes the objective's
+        # degradation activations so cluster A never degrades cluster B
+        cluster = spec.get("cluster", "")
+        if not isinstance(cluster, str):
+            raise ValueError(f"objective {self.name!r}: 'cluster' must "
+                             f"be a string")
+        self.cluster = cluster
         self.budget = max(1e-9, 1.0 - self.target)
+
+    def _scoped(self, base):
+        """Metric labels in force: the spec's, plus the cluster axis
+        when this objective is fleet-scoped."""
+        if not self.cluster:
+            return base
+        out = dict(base or {})
+        out["cluster"] = self.cluster
+        return out
 
     # --- cumulative (bad, total) sampling --------------------------------
     def sample(self, metrics, wall: float):
@@ -111,7 +179,8 @@ class SLOObjective:
         entries burn rates difference over.  Staleness objectives return
         their instantaneous age instead (no accumulation)."""
         if self.type == "latency":
-            h = metrics.get_histogram(self.metric, self.labels)
+            h = metrics.get_histogram(self.metric,
+                                      self._scoped(self.labels))
             if h is None:
                 return (0.0, 0.0)
             within = 0
@@ -125,7 +194,17 @@ class SLOObjective:
         if self.type == "ratio":
             # labels=None sums ACROSS labelsets (shadow divergence is
             # labeled {kind} but the objective wants the sum); an exact
-            # labelset filters to one series
+            # labelset filters to one series.  Cluster-scoped
+            # objectives sum across the labelsets carrying their
+            # cluster (plus any configured label pairs) — one
+            # cluster's series out of the fleet's shared registry
+            if self.cluster:
+                bad = metrics.counter_total(
+                    self.bad_metric, match=self._scoped(self.bad_labels))
+                total = metrics.counter_total(
+                    self.total_metric,
+                    match=self._scoped(self.total_labels))
+                return (float(bad), float(total))
             if self.bad_labels is None:
                 bad = metrics.counter_total(self.bad_metric)
             else:
@@ -139,7 +218,7 @@ class SLOObjective:
             return (float(bad), float(total))
         # staleness: age of the gauge timestamp (gauge unset = age 0 —
         # nothing has run yet, nothing is stale yet)
-        ts = metrics.get_gauge(self.gauge, self.labels)
+        ts = metrics.get_gauge(self.gauge, self._scoped(self.labels))
         age = max(0.0, wall - float(ts)) if ts else 0.0
         return (age, -1.0)  # total=-1 marks "instantaneous value"
 
@@ -155,7 +234,9 @@ class SLOEngine:
                  clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
                  ring_capacity: int = 4096,
-                 brownout=None):
+                 brownout=None,
+                 degradations=None,
+                 escalate_hold_s: float = 30.0):
         self.metrics = metrics
         self.objectives = [
             o if isinstance(o, SLOObjective) else SLOObjective(o)
@@ -176,6 +257,23 @@ class SLOEngine:
         # SLO burn feeds the brownout ladder (set_slo_input must point
         # back at self.pressure for the signal to be consumed)
         self.brownout = brownout
+        # optional DegradationRegistry (resilience/overload.py): tick()
+        # then drives each breaching objective's degradation MAP —
+        # activate the next mapped action after escalate_hold_s of
+        # sustained breach, release them all on the falling edge.  None
+        # keeps the scalar --slo-brownout path the only feedback loop
+        # (bit-identical to the pre-map engine)
+        self.degradations = degradations
+        self.escalate_hold_s = float(escalate_hold_s)
+        if degradations is not None:
+            for o in self.objectives:
+                degradations.validate(
+                    o.degradation, where=f"objective {o.name!r}")
+        self._deg_level: dict = {}  # objective -> active action count
+        self._deg_at: dict = {}  # objective -> clock of last transition
+        # every activation/release edge in decision order — identical
+        # (config, clock, metric sequence) replays it exactly (pinned)
+        self.degradation_trajectory: deque = deque(maxlen=4096)
         # (objective_filter, fn) called on each breach RISING EDGE —
         # "" matches every objective; see on_breach()
         self._breach_hooks: list = []
@@ -238,7 +336,7 @@ class SLOEngine:
             self._ring.append((now, sample))
             evals = [self._evaluate_locked(o, now, sample[o.name])
                      for o in self.objectives]
-        for ev in evals:
+        for o, ev in zip(self.objectives, evals):
             o_name = ev["name"]
             self.metrics.set_gauge(M.SLO_SLI, ev["sli"],
                                    {"objective": o_name})
@@ -277,6 +375,7 @@ class SLOEngine:
                     except Exception:
                         pass
             self._breached[o_name] = ev["breach"]
+            self._degrade_step(o, ev, now)
         payload = {
             "generated_at": wall,
             "pressure": self._pressure_from(evals),
@@ -348,6 +447,7 @@ class SLOEngine:
             "name": o.name,
             "type": o.type,
             "description": o.description,
+            "cluster": o.cluster,
             "target": o.target,
             "threshold": o.threshold,
             "sli": round(sli, 6),
@@ -356,6 +456,79 @@ class SLOEngine:
             "breach": breach,
             "breach_tier": breach_tier,
         }
+
+    # --- degradation maps -------------------------------------------------
+    def _degrade_step(self, o: SLOObjective, ev: dict,
+                      now: float) -> None:
+        """Drive one objective's degradation map off its breach state:
+        rising edge activates the first mapped action; a breach held
+        past ``escalate_hold_s`` since the last transition escalates to
+        the next; the falling edge releases every held action in
+        reverse order.  Pure function of (map, clock, breach sequence)
+        — an injected clock replays the exact trajectory."""
+        reg = self.degradations
+        ev["degradation"] = list(o.degradation)
+        if reg is None or not o.degradation:
+            ev["degradation_active"] = []
+            return
+        level = self._deg_level.get(o.name, 0)
+        if ev["breach"]:
+            if level == 0:
+                self._deg_transition(o, o.degradation[0], ev, now, True)
+                level = 1
+            elif level < len(o.degradation) and \
+                    now - self._deg_at.get(o.name, now) >= \
+                    self.escalate_hold_s:
+                self._deg_transition(o, o.degradation[level], ev, now,
+                                     True)
+                level += 1
+            else:
+                ev["degradation_active"] = list(o.degradation[:level])
+                return
+            self._deg_level[o.name] = level
+            self._deg_at[o.name] = now
+        elif level > 0:
+            # falling edge: revoke deepest-first — the map unwinds the
+            # way it wound up
+            for action in reversed(o.degradation[:level]):
+                self._deg_transition(o, action, ev, now, False)
+            level = 0
+            self._deg_level[o.name] = 0
+            self._deg_at[o.name] = now
+        ev["degradation_active"] = list(o.degradation[:level])
+
+    def _deg_transition(self, o: SLOObjective, action: str, ev: dict,
+                        now: float, activate: bool) -> None:
+        from gatekeeper_tpu.observability import tracing
+
+        if activate:
+            self.degradations.activate(action, objective=o.name,
+                                       cluster=o.cluster)
+        else:
+            self.degradations.release(action, objective=o.name,
+                                      cluster=o.cluster)
+        event = "activate" if activate else "release"
+        self.degradation_trajectory.append({
+            "t": round(now, 6), "objective": o.name, "action": action,
+            "cluster": o.cluster, "event": event,
+        })
+        # the transition lands in the trace timeline (a root span,
+        # visible without any ambient request) and the event stream
+        with tracing.span("slo.degrade", objective=o.name,
+                          action=action, cluster=o.cluster,
+                          event=event, sli=ev["sli"]):
+            pass
+        tracing.add_event("slo_degrade", objective=o.name,
+                          action=action, event=event)
+        try:
+            from gatekeeper_tpu.utils.logging import log_event
+
+            log_event("warning" if activate else "info",
+                      f"SLO degradation {event}",
+                      event_type="slo_degrade", objective=o.name,
+                      action=action, cluster=o.cluster, sli=ev["sli"])
+        except Exception:
+            pass
 
     def _pressure_from(self, evals) -> float:
         """0..1 brownout input: the hottest objective's fastest-tier burn
@@ -378,21 +551,96 @@ class SLOEngine:
         with self._lock:
             return float(self._last_eval.get("pressure", 0.0))
 
-    def snapshot(self) -> dict:
-        """The ``/debug/slo`` payload (last tick; {} before the first)."""
+    def snapshot(self, cluster: Optional[str] = None) -> dict:
+        """The ``/debug/slo`` payload (last tick; {} before the first).
+        ``cluster`` filters to one cluster's fleet-scoped objectives
+        plus the global (unscoped) ones — the ``?cluster=`` view."""
         with self._lock:
-            return dict(self._last_eval)
+            out = dict(self._last_eval)
+        if cluster is not None and out:
+            out = dict(out)
+            out["cluster"] = cluster
+            out["objectives"] = [
+                ev for ev in out.get("objectives", [])
+                if ev.get("cluster", "") in ("", cluster)]
+        return out
+
+    def degraded(self) -> dict:
+        """objective -> [active actions], for every objective holding
+        at least one (the triage cross-link source)."""
+        out: dict = {}
+        for o in self.objectives:
+            lvl = self._deg_level.get(o.name, 0)
+            if lvl:
+                out[o.name] = list(o.degradation[:lvl])
+        return out
 
 
-def load_config(path: str) -> dict:
-    """{"objectives": [SLOObjective...], "tiers": [...] or None}."""
+def per_cluster_objectives(cluster_ids: Sequence[str],
+                           base: Optional[Sequence] = None) -> list:
+    """Fleet-scoped objective set: every base objective cloned once per
+    cluster as ``name@cluster`` with the ``cluster`` axis set, so SLIs
+    read that cluster's labeled series and degradation actions scope to
+    it.  ``base`` defaults to :data:`DEFAULT_OBJECTIVES`."""
+    out: list = []
+    for cid in cluster_ids:
+        for spec in (base if base is not None else DEFAULT_OBJECTIVES):
+            spec = dict(spec.spec if isinstance(spec, SLOObjective)
+                        else spec)
+            spec["name"] = f"{spec['name']}@{cid}"
+            spec["cluster"] = cid
+            out.append(SLOObjective(spec))
+    return out
+
+
+def load_config(path: str, degradations=None) -> dict:
+    """{"objectives": [SLOObjective...], "tiers": [...] or None}.
+
+    Fails fast with :class:`SLOConfigError` naming the line (malformed
+    JSON) or the objective index + field (bad spec); degradation-map
+    action names are validated against ``degradations`` (a
+    DegradationRegistry) when given."""
     import json
 
-    with open(path) as f:
-        doc = json.load(f)
-    if isinstance(doc, list):
-        return {"objectives": [SLOObjective(o) for o in doc],
-                "tiers": None}
-    return {"objectives": [SLOObjective(o)
-                           for o in doc.get("objectives", [])],
-            "tiers": doc.get("tiers") or None}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise SLOConfigError(
+            f"{path}:{e.lineno}:{e.colno}: malformed JSON: "
+            f"{e.msg}") from None
+    specs = doc if isinstance(doc, list) else doc.get("objectives", [])
+    tiers = None if isinstance(doc, list) else (doc.get("tiers") or None)
+    if not isinstance(specs, list):
+        raise SLOConfigError(f"{path}: 'objectives' must be a list")
+    objectives: list = []
+    for i, spec in enumerate(specs):
+        try:
+            objectives.append(SLOObjective(spec))
+        except ValueError as e:
+            raise SLOConfigError(
+                f"{path}: objectives[{i}]: {e}") from None
+    if tiers is not None:
+        if not isinstance(tiers, list):
+            raise SLOConfigError(f"{path}: 'tiers' must be a list")
+        for i, t in enumerate(tiers):
+            if not isinstance(t, dict) or not t.get("name"):
+                raise SLOConfigError(
+                    f"{path}: tiers[{i}]: must be an object with a "
+                    f"'name'")
+            for field in ("short_s", "long_s", "burn"):
+                try:
+                    float(t[field])
+                except (KeyError, TypeError, ValueError):
+                    raise SLOConfigError(
+                        f"{path}: tiers[{i}]: missing or non-numeric "
+                        f"field {field!r}") from None
+    if degradations is not None:
+        for i, o in enumerate(objectives):
+            try:
+                degradations.validate(
+                    o.degradation, where=f"objective {o.name!r}")
+            except ValueError as e:
+                raise SLOConfigError(
+                    f"{path}: objectives[{i}]: {e}") from None
+    return {"objectives": objectives, "tiers": tiers}

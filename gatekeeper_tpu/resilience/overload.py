@@ -228,6 +228,167 @@ def estimate_cost(review_body: dict, cost_hint: int = 0,
     return float(max(1, nbytes)) * n_cons
 
 
+# --- degradation registry (per-objective SLO degradation maps) ------------
+
+# built-in action names: the vocabulary objectives' ``degradation``
+# maps draw from.  Consumers poll :func:`degradation_active` — the
+# registry holds WHO degraded WHAT and why; the consumers stay dumb.
+NS_CACHE_STALE = "ns_cache_stale"
+EXTDATA_STALE = "extdata_stale"
+SHED_HARDER = "shed_harder"
+AUDIT_YIELD_RELEASE = "audit_yield_release"
+RESYNC_DEFER = "resync_defer"
+
+BUILTIN_ACTIONS = {
+    NS_CACHE_STALE:
+        "serve namespace-label lookups stale-from-cache",
+    EXTDATA_STALE:
+        "serve external-data joins stale from resident columns",
+    SHED_HARDER:
+        "halve the admission queue bounds so overload sheds earlier",
+    AUDIT_YIELD_RELEASE:
+        "stop yielding the device lane to admissions (audit catches up)",
+    RESYNC_DEFER:
+        "defer the audit's periodic full resync",
+}
+
+
+class DegradationRegistry:
+    """Named, revocable degradation actions the SLO engine activates
+    per objective (observability/slo.py degradation maps).
+
+    Where the brownout ladder is one scalar — queue pressure degrades
+    EVERYTHING a level at a time — the registry is targeted: a
+    breaching ``admission-latency-p99`` activates ``ns_cache_stale``
+    without touching the audit lane, and a breaching
+    ``audit-snapshot-staleness`` releases the audit's device-lane
+    yield without staling the webhook's caches.  Activations are
+    reference-held per (action, cluster): several objectives may hold
+    the same action; the action releases only when the last holder
+    lets go.  Cluster-scoped activations (fleet mode) never leak:
+    a consumer asking with ``cluster="b"`` sees only global (``""``)
+    and ``"b"``-scoped activations."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._known = dict(BUILTIN_ACTIONS)
+        self._hooks: dict = {}  # name -> (on_activate, on_release)
+        # (action, cluster) -> set of holder objective names
+        self._active: dict = {}
+        self.transitions = 0  # total activate/release edges
+
+    # --- registration ----------------------------------------------------
+    def register(self, name: str, description: str = "",
+                 on_activate=None, on_release=None) -> None:
+        """Declare an action (consumers: overload controller, the
+        ProviderCache, the AuditManager).  ``on_activate(cluster)`` /
+        ``on_release(cluster)`` fire on the action's rising/falling
+        edge; exceptions are swallowed — degradation must never take
+        the server down."""
+        with self._lock:
+            self._known[name] = description or self._known.get(name, "")
+            if on_activate is not None or on_release is not None:
+                self._hooks[name] = (on_activate, on_release)
+
+    def known(self) -> set:
+        with self._lock:
+            return set(self._known)
+
+    def describe(self, name: str) -> str:
+        with self._lock:
+            return self._known.get(name, "")
+
+    def validate(self, actions, where: str = "") -> None:
+        """Raise ``ValueError`` naming the first unknown action — the
+        boot-time check behind ``--slo-config`` degradation maps."""
+        known = self.known()
+        for a in actions:
+            if a not in known:
+                raise ValueError(
+                    f"{where or 'degradation map'}: unknown degradation "
+                    f"action {a!r} (registered: {sorted(known)})")
+
+    # --- activation ------------------------------------------------------
+    def activate(self, name: str, objective: str = "",
+                 cluster: str = "") -> bool:
+        """Hold ``name`` active on behalf of ``objective`` (scoped to
+        ``cluster``; ``""`` = global).  True on the rising edge."""
+        with self._lock:
+            if name not in self._known:
+                raise ValueError(f"unknown degradation action {name!r}")
+            holders = self._active.setdefault((name, cluster), set())
+            rising = not holders
+            holders.add(objective or "")
+            if rising:
+                self.transitions += 1
+        self._export(name, objective, cluster, 1.0)
+        if rising:
+            self._fire(name, cluster, 0)
+        return rising
+
+    def release(self, name: str, objective: str = "",
+                cluster: str = "") -> bool:
+        """Let go of ``name``; True on the falling edge (last holder
+        released)."""
+        with self._lock:
+            holders = self._active.get((name, cluster))
+            if holders is None:
+                return False
+            holders.discard(objective or "")
+            falling = not holders
+            if falling:
+                del self._active[(name, cluster)]
+                self.transitions += 1
+        self._export(name, objective, cluster, 0.0)
+        if falling:
+            self._fire(name, cluster, 1)
+        return falling
+
+    def is_active(self, name: str, cluster: str = "") -> bool:
+        """Does this action bind a consumer scoped to ``cluster``?
+        Global activations bind every scope; cluster-scoped ones bind
+        only their own cluster (the fleet isolation pin)."""
+        with self._lock:
+            if self._active.get((name, "")):
+                return True
+            return bool(cluster and self._active.get((name, cluster)))
+
+    def active(self) -> list:
+        """[{action, cluster, objectives}] snapshot, sorted — the
+        ``/debug/overload`` + flight-recorder view."""
+        with self._lock:
+            return [{"action": n, "cluster": c,
+                     "objectives": sorted(hs)}
+                    for (n, c), hs in sorted(self._active.items())]
+
+    def active_names(self) -> list:
+        """Compact ``action`` / ``action@cluster`` strings (the
+        flight-recorder overload snapshot)."""
+        with self._lock:
+            return [n if not c else f"{n}@{c}"
+                    for (n, c) in sorted(self._active)]
+
+    def _export(self, name, objective, cluster, value) -> None:
+        if self.metrics is None:
+            return
+        from gatekeeper_tpu.metrics import registry as M
+
+        labels = {"objective": objective or "", "action": name}
+        if cluster:
+            labels["cluster"] = cluster
+        self.metrics.set_gauge(M.SLO_DEGRADATION, value, labels)
+
+    def _fire(self, name, cluster, which) -> None:
+        hooks = self._hooks.get(name)
+        if hooks is None or hooks[which] is None:
+            return
+        try:
+            hooks[which](cluster)
+        except Exception:
+            pass
+
+
 class OverloadController:
     """The admission gate: limiter slot or bounded cost-aware queue or
     shed.  ``admit(cost)`` is the single seam the webhook wraps its
@@ -401,6 +562,7 @@ class OverloadController:
 
         c = self.config
         cap = self._tenant_cap()
+        q_depth, q_cost = self._queue_bounds()
         with self._cv:
             t = Ticket(self._seq, tenant, level, cost)
             self._seq += 1
@@ -414,7 +576,7 @@ class OverloadController:
                 self._grant_locked(t)
                 return
             admitted, victim, reason = self._queue_qos.enqueue(
-                t, c.queue_depth, c.queue_cost)
+                t, q_depth, q_cost)
             if victim is not None:
                 # tenant-aware displacement: the heaviest tenant's
                 # newest ticket pays instead of this arrival
@@ -489,11 +651,22 @@ class OverloadController:
             self._pressure_locked()
             self._cv.notify_all()
 
+    def _queue_bounds(self) -> tuple:
+        """(depth, cost) queue bounds in force: the configured bounds,
+        halved while the ``shed_harder`` degradation action is active
+        (a breaching latency objective's last resort — shed earlier
+        instead of queueing deeper).  Inactive = bit-identical."""
+        c = self.config
+        if degradation_active(SHED_HARDER):
+            return max(1, c.queue_depth // 2), c.queue_cost / 2.0
+        return c.queue_depth, c.queue_cost
+
     def _queue_for_slot(self, cost: float) -> None:
         c = self.config
+        q_depth, q_cost = self._queue_bounds()
         with self._cv:
-            depth_full = self._queue_len + 1 > c.queue_depth
-            cost_full = self._queue_cost + cost > c.queue_cost
+            depth_full = self._queue_len + 1 > q_depth
+            cost_full = self._queue_cost + cost > q_cost
             if depth_full or cost_full:
                 self._shed_locked(
                     "queue_cost" if cost_full and not depth_full
@@ -676,6 +849,11 @@ class OverloadController:
                     else self._queue_cost, 1),
                 "shed_count": self.shed_count,
             }
+            reg = active_degradations()
+            if reg is not None:
+                # targeted SLO degradations in force (the /debug/
+                # overload + gator triage view of the maps)
+                out["degraded"] = reg.active()
             if self._queue_qos is not None:
                 cfg = self.config.qos
                 out["qos"] = self._queue_qos.snapshot()
@@ -731,13 +909,58 @@ def current_brownout() -> int:
     return ctl.brownout_level()
 
 
+# the degradation registry rides the same pattern, separately
+# installable: scalar brownout (--slo-brownout) and targeted maps
+# (--slo-degradation) compose — consumers OR the two signals
+_degradations: list = [None]
+
+
+def install_degradations(reg: Optional[DegradationRegistry]) -> None:
+    """Process-global DegradationRegistry (the serving entrypoint)."""
+    _degradations[0] = reg
+
+
+def uninstall_degradations() -> None:
+    _degradations[0] = None
+
+
+@contextmanager
+def activate_degradations(reg: DegradationRegistry):
+    """Scoped registry activation for tests."""
+    prev = _degradations[0]
+    _degradations[0] = reg
+    try:
+        yield reg
+    finally:
+        _degradations[0] = prev
+
+
+def active_degradations() -> Optional[DegradationRegistry]:
+    return _degradations[0]
+
+
+def degradation_active(name: str, cluster: str = "") -> bool:
+    """Is the named degradation action in force for this scope?  The
+    cheap cross-layer read consumers OR with :func:`current_brownout`
+    (False when no registry is installed — bit-identical default)."""
+    reg = _degradations[0]
+    return reg is not None and reg.is_active(name, cluster)
+
+
 def yield_device_lane(level: int = 2, max_wait_s: float = 0.25,
-                      poll_s: float = 0.01) -> float:
+                      poll_s: float = 0.01, cluster: str = "") -> float:
     """Brownout level-2 hook for the audit sweep: while the webhook lane
     is under heavy queue pressure, the sweep pauses before submitting its
     next chunk so admission batches win the device.  Bounded by
     ``max_wait_s`` per call — audit degrades, it never stalls.  Returns
-    the seconds actually yielded."""
+    the seconds actually yielded.
+
+    A breaching audit-staleness objective activates
+    ``audit_yield_release`` (scoped to ``cluster`` in fleet mode):
+    the audit stops ceding the device so it can catch up — staleness
+    outranks latency once the staleness objective itself is paging."""
+    if degradation_active(AUDIT_YIELD_RELEASE, cluster):
+        return 0.0
     ctl = _active[0]
     if ctl is None or ctl.brownout_level() < level:
         return 0.0

@@ -93,6 +93,7 @@ class ValidationHandler:
         failure_policy: Optional[str] = None,  # "ignore" | "fail"
         overload=None,  # resilience.overload.OverloadController
         snapshot=None,  # snapshot.ClusterSnapshot (warm lookup cache)
+        cluster: str = "",  # fleet serving scope (labels SLIs/decisions)
     ):
         self.client = client
         self.expansion_system = expansion_system
@@ -128,6 +129,11 @@ class ValidationHandler:
         # ladder degrades onto — a bounded stale namespace-lookup cache
         # and a per-kind matched-constraint estimate for the cost model
         self.overload = overload
+        # fleet mode: a non-empty cluster id labels this handler's
+        # latency histogram / status counters / decisions with
+        # {cluster}, feeding the per-cluster SLO objectives; "" keeps
+        # the single-cluster series unlabeled (bit-identical)
+        self.cluster = cluster
         self._ns_stale: dict = {}
         self._kind_est: dict = {}
         self._kind_est_total = -1
@@ -200,7 +206,8 @@ class ValidationHandler:
 
         if flightrec.active() is None and costattr.active() is None:
             return "", None  # nobody consumes the axis: skip the lookup
-        return tenant_of_request(review_body.get("request") or {}), None
+        return tenant_of_request(review_body.get("request") or {},
+                                 cluster=self.cluster), None
 
     def _attr_tenant(self, tenant: str, seconds: float,
                      cost: float) -> None:
@@ -251,6 +258,7 @@ class ValidationHandler:
             code=resp.code if not resp.allowed else 0,
             overload=self.overload,
             tenant=tenant,
+            cluster=self.cluster,
             priority=getattr(lane, "name", "") or "",
             # capture mode: the raw admission request rides the JSONL
             # sink line (never the ring) as the `gator replay` corpus
@@ -264,7 +272,8 @@ class ValidationHandler:
 
         status = "error"  # count even when _handle itself raises
         try:
-            with self.metrics.timed(m.REQUEST_DURATION):
+            with self.metrics.timed(m.REQUEST_DURATION,
+                                    self._cluster_labels()):
                 resp = self._guarded(review_body)
             if not resp.allowed and resp.code == 500:
                 status = "error"  # internal error surfaced as Errored deny
@@ -272,8 +281,18 @@ class ValidationHandler:
                 status = "allow" if resp.allowed else "deny"
             return resp
         finally:
-            self.metrics.inc_counter(m.REQUEST_COUNT,
-                                     {"admission_status": status})
+            self.metrics.inc_counter(
+                m.REQUEST_COUNT,
+                self._cluster_labels({"admission_status": status}))
+
+    def _cluster_labels(self, base: Optional[dict] = None):
+        """Metric labels with the fleet cluster axis when configured;
+        the single-cluster shape (no cluster label) is unchanged."""
+        if not self.cluster:
+            return base
+        out = dict(base or {})
+        out["cluster"] = self.cluster
+        return out
 
     # --- overload plumbing ------------------------------------------------
     def _constraint_estimate(self, kind: str) -> int:
@@ -315,8 +334,9 @@ class ValidationHandler:
         if self.metrics is not None:
             from gatekeeper_tpu.metrics import registry as m
 
-            self.metrics.inc_counter(m.REQUEST_COUNT,
-                                     {"admission_status": "shed"})
+            self.metrics.inc_counter(
+                m.REQUEST_COUNT,
+                self._cluster_labels({"admission_status": "shed"}))
         from gatekeeper_tpu.utils.logging import log_event
 
         log_event("warning", "admission request shed under overload",
@@ -507,12 +527,17 @@ class ValidationHandler:
 
     def _lookup_namespace(self, name: str):
         """Namespace lookup with brownout degradation: at brownout level
-        >= 1 the (possibly apiserver-backed) lookup is skipped and the
-        last-seen value serves STALE — the first rung of the ladder,
-        degraded before any request is shed."""
-        if self.overload is not None and \
-                self.overload.brownout_level() >= 1 and \
-                name in self._ns_stale:
+        >= 1 — or while a breaching SLO objective holds the
+        ``ns_cache_stale`` degradation action for this scope — the
+        (possibly apiserver-backed) lookup is skipped and the last-seen
+        value serves STALE — the first rung of the ladder, degraded
+        before any request is shed."""
+        from gatekeeper_tpu.resilience import overload as _ovl
+
+        degraded = (self.overload is not None
+                    and self.overload.brownout_level() >= 1) or \
+            _ovl.degradation_active(_ovl.NS_CACHE_STALE, self.cluster)
+        if degraded and name in self._ns_stale:
             if self.metrics is not None:
                 from gatekeeper_tpu.metrics import registry as m
 
@@ -528,7 +553,8 @@ class ValidationHandler:
             ns_obj = self.snapshot.namespace(name)
         if ns_obj is None:
             ns_obj = self.namespace_lookup(name)
-        if self.overload is not None:
+        if self.overload is not None or \
+                _ovl.active_degradations() is not None:
             if len(self._ns_stale) >= 4096 and name not in self._ns_stale:
                 self._ns_stale.pop(next(iter(self._ns_stale)))
             self._ns_stale[name] = ns_obj

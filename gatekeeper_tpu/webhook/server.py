@@ -183,16 +183,27 @@ class WebhookServer:
                                                    "--cost-attribution on)"})
                     else:
                         self._reply(200, attr.snapshot())
-                elif self.path == SLO_PATH:
+                elif self.path == SLO_PATH or \
+                        self.path.startswith(SLO_PATH + "?"):
                     # the SLO engine's last evaluation: objectives, SLI
-                    # values, multi-window burn rates, breach state
+                    # values, multi-window burn rates, breach state,
+                    # active degradations; ?cluster= filters to one
+                    # cluster's fleet-scoped objectives (+ the global
+                    # ones)
                     eng = outer._slo_engine
                     if eng is None:
                         self._reply(404, {"error": "SLO engine not "
                                                    "enabled (run with "
                                                    "--slo on)"})
                     else:
-                        snap = eng.snapshot() or eng.tick()
+                        from urllib.parse import parse_qs, urlparse
+
+                        q = parse_qs(urlparse(self.path).query)
+                        cluster = (q.get("cluster") or [None])[0]
+                        snap = eng.snapshot(cluster=cluster)
+                        if not snap:
+                            eng.tick()
+                            snap = eng.snapshot(cluster=cluster)
                         self._reply(200, snap)
                 elif self.path == OVERLOAD_PATH:
                     # the overload gate's lane view: limiter + brownout

@@ -1,0 +1,271 @@
+"""Targeted SLO degradation maps (ISSUE 18): DegradationRegistry
+semantics (refcounted activation, cluster scoping, gauge export),
+engine edges (rising-edge activate, hold-based escalation, falling-edge
+release in reverse), deterministic trajectory replay under an injected
+clock, fleet-scoped breach isolation, consumer wiring (shed_harder
+queue bounds), and --slo-config fail-fast validation."""
+
+import pytest
+
+from gatekeeper_tpu.metrics import registry as M
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import slo
+from gatekeeper_tpu.resilience import overload as ovl
+
+STALE = {
+    "name": "stale", "type": "staleness", "gauge": "last_end",
+    "threshold": 60.0,
+    "degradation": ["audit_yield_release", "resync_defer"],
+}
+
+
+def _engine(m, objectives, reg, hold=30.0):
+    fake = {"t": 0.0, "w": 1_000_000.0}
+    eng = slo.SLOEngine(m, objectives=list(objectives),
+                        clock=lambda: fake["t"],
+                        wall=lambda: fake["w"],
+                        degradations=reg, escalate_hold_s=hold)
+    return eng, fake
+
+
+# --- registry semantics ----------------------------------------------------
+
+def test_registry_refcounted_activation_and_gauge():
+    m = MetricsRegistry()
+    reg = ovl.DegradationRegistry(metrics=m)
+    assert reg.activate(ovl.NS_CACHE_STALE, objective="a") is True
+    # second holder: no new edge, still active
+    assert reg.activate(ovl.NS_CACHE_STALE, objective="b") is False
+    assert reg.is_active(ovl.NS_CACHE_STALE)
+    assert m.get_gauge(M.SLO_DEGRADATION,
+                       {"objective": "a",
+                        "action": ovl.NS_CACHE_STALE}) == 1.0
+    # releasing one holder keeps the action held by the other
+    assert reg.release(ovl.NS_CACHE_STALE, objective="a") is False
+    assert reg.is_active(ovl.NS_CACHE_STALE)
+    assert reg.release(ovl.NS_CACHE_STALE, objective="b") is True
+    assert not reg.is_active(ovl.NS_CACHE_STALE)
+    assert m.get_gauge(M.SLO_DEGRADATION,
+                       {"objective": "b",
+                        "action": ovl.NS_CACHE_STALE}) == 0.0
+
+
+def test_registry_unknown_action_rejected():
+    reg = ovl.DegradationRegistry()
+    with pytest.raises(ValueError, match="nope"):
+        reg.activate("nope")
+    with pytest.raises(ValueError, match="rogue"):
+        reg.validate(["ns_cache_stale", "rogue"], where="objective 'x'")
+    # custom actions register with a description and then validate
+    reg.register("dim_the_lights", description="for tests")
+    reg.validate(["dim_the_lights"])
+
+
+def test_registry_cluster_scoping():
+    reg = ovl.DegradationRegistry()
+    reg.activate(ovl.NS_CACHE_STALE, objective="o@a", cluster="a")
+    # cluster A's activation is invisible to B and to the global scope
+    assert reg.is_active(ovl.NS_CACHE_STALE, cluster="a")
+    assert not reg.is_active(ovl.NS_CACHE_STALE, cluster="b")
+    assert not reg.is_active(ovl.NS_CACHE_STALE)
+    # a GLOBAL activation is visible in every cluster scope
+    reg.activate(ovl.EXTDATA_STALE, objective="g")
+    assert reg.is_active(ovl.EXTDATA_STALE, cluster="a")
+    assert reg.is_active(ovl.EXTDATA_STALE, cluster="b")
+    names = reg.active_names()
+    assert f"{ovl.NS_CACHE_STALE}@a" in names
+    assert ovl.EXTDATA_STALE in names
+
+
+def test_module_degradation_active_defaults_off():
+    # no registry installed: every consumer check reads False — the
+    # bit-identity guarantee of the un-armed build
+    assert ovl.active_degradations() is None
+    assert not ovl.degradation_active(ovl.SHED_HARDER)
+    assert not ovl.degradation_active(ovl.NS_CACHE_STALE, "a")
+
+
+# --- engine edges ----------------------------------------------------------
+
+def _set_age(m, fake, age, labels=None):
+    m.set_gauge("last_end", fake["w"] - age, labels)
+
+
+def test_breach_activates_escalates_and_releases_in_reverse():
+    m = MetricsRegistry()
+    reg = ovl.DegradationRegistry(metrics=m)
+    eng, fake = _engine(m, [STALE], reg, hold=30.0)
+
+    _set_age(m, fake, 10.0)
+    ev = eng.tick()["objectives"][0]
+    assert not ev["breach"] and ev["degradation_active"] == []
+
+    # breach: the first mapped action activates on the rising edge
+    _set_age(m, fake, 120.0)
+    fake["t"] = 10.0
+    ev = eng.tick()["objectives"][0]
+    assert ev["breach"]
+    assert ev["degradation_active"] == ["audit_yield_release"]
+    assert reg.is_active(ovl.AUDIT_YIELD_RELEASE)
+    assert not reg.is_active(ovl.RESYNC_DEFER)
+
+    # still breaching but inside the hold: no escalation yet
+    fake["t"] = 25.0
+    ev = eng.tick()["objectives"][0]
+    assert ev["degradation_active"] == ["audit_yield_release"]
+
+    # held past escalate_hold_s: the next action activates
+    fake["t"] = 45.0
+    ev = eng.tick()["objectives"][0]
+    assert ev["degradation_active"] == ["audit_yield_release",
+                                        "resync_defer"]
+    assert reg.is_active(ovl.RESYNC_DEFER)
+
+    # recovery: falling edge releases EVERYTHING, deepest-first
+    _set_age(m, fake, 1.0)
+    fake["t"] = 60.0
+    ev = eng.tick()["objectives"][0]
+    assert not ev["breach"] and ev["degradation_active"] == []
+    assert not reg.is_active(ovl.AUDIT_YIELD_RELEASE)
+    assert not reg.is_active(ovl.RESYNC_DEFER)
+    events = [(e["action"], e["event"])
+              for e in eng.degradation_trajectory]
+    assert events == [
+        ("audit_yield_release", "activate"),
+        ("resync_defer", "activate"),
+        ("resync_defer", "release"),       # reverse order on the way out
+        ("audit_yield_release", "release"),
+    ]
+
+
+def _scripted_run():
+    """One full breach/escalate/recover pass; returns the trajectory."""
+    m = MetricsRegistry()
+    reg = ovl.DegradationRegistry(metrics=m)
+    eng, fake = _engine(m, [STALE], reg, hold=30.0)
+    script = [(0.0, 10.0), (10.0, 120.0), (25.0, 130.0), (45.0, 140.0),
+              (60.0, 150.0), (90.0, 1.0), (120.0, 5.0)]
+    for t, age in script:
+        fake["t"] = t
+        _set_age(m, fake, age)
+        eng.tick()
+    return list(eng.degradation_trajectory)
+
+
+def test_trajectory_replays_exactly():
+    """Identical (config, injected clock, metric sequence) => identical
+    activation/release trajectory — the determinism pin."""
+    first = _scripted_run()
+    second = _scripted_run()
+    assert first == second
+    assert first  # non-vacuous: the script really drives transitions
+    assert any(e["event"] == "activate" for e in first)
+    assert any(e["event"] == "release" for e in first)
+
+
+# --- fleet-scoped isolation ------------------------------------------------
+
+def test_cluster_breach_isolation():
+    """Cluster A stale, cluster B fresh, one shared registry: A's
+    objective breaches and degrades A only — B stays compliant and
+    undegraded (the fleet isolation pin)."""
+    m = MetricsRegistry()
+    reg = ovl.DegradationRegistry(metrics=m)
+    objectives = slo.per_cluster_objectives(["a", "b"], base=[STALE])
+    eng, fake = _engine(m, objectives, reg)
+
+    _set_age(m, fake, 900.0, {"cluster": "a"})   # A: very stale
+    _set_age(m, fake, 2.0, {"cluster": "b"})     # B: fresh
+    out = eng.tick()
+    by_name = {ev["name"]: ev for ev in out["objectives"]}
+    assert by_name["stale@a"]["breach"]
+    assert by_name["stale@a"]["degradation_active"] == \
+        ["audit_yield_release"]
+    assert not by_name["stale@b"]["breach"]
+    assert by_name["stale@b"]["degradation_active"] == []
+    # the registry scopes the action: active for A, NOT for B, NOT
+    # globally — cluster A's breach never degrades cluster B
+    assert reg.is_active(ovl.AUDIT_YIELD_RELEASE, cluster="a")
+    assert not reg.is_active(ovl.AUDIT_YIELD_RELEASE, cluster="b")
+    assert not reg.is_active(ovl.AUDIT_YIELD_RELEASE)
+    # the ?cluster= views split the same way
+    snap_a = eng.snapshot(cluster="a")
+    snap_b = eng.snapshot(cluster="b")
+    assert [ev["name"] for ev in snap_a["objectives"]] == ["stale@a"]
+    assert [ev["name"] for ev in snap_b["objectives"]] == ["stale@b"]
+    assert eng.degraded() == {"stale@a": ["audit_yield_release"]}
+
+
+# --- consumer wiring -------------------------------------------------------
+
+def test_shed_harder_halves_queue_bounds():
+    ctl = ovl.OverloadController(ovl.OverloadConfig(
+        queue_depth=8, queue_cost=100.0))
+    reg = ovl.DegradationRegistry()
+    assert ctl._queue_bounds() == (8, 100.0)
+    with ovl.activate_degradations(reg):
+        assert ctl._queue_bounds() == (8, 100.0)  # armed but inactive
+        reg.activate(ovl.SHED_HARDER, objective="o")
+        assert ctl._queue_bounds() == (4, 50.0)
+        reg.release(ovl.SHED_HARDER, objective="o")
+        assert ctl._queue_bounds() == (8, 100.0)
+    # degradations appear in the /debug/overload payload while held
+    with ovl.activate_degradations(reg):
+        reg.activate(ovl.SHED_HARDER, objective="o")
+        snap = ctl.snapshot()
+        assert snap["degraded"][0]["action"] == ovl.SHED_HARDER
+        reg.release(ovl.SHED_HARDER, objective="o")
+
+
+def test_audit_yield_release_skips_device_yield():
+    ctl = ovl.OverloadController(ovl.OverloadConfig())
+    reg = ovl.DegradationRegistry()
+    with ovl.activate(ctl), ovl.activate_degradations(reg):
+        ctl._brownout = 2  # deep brownout: audit normally yields
+        assert ovl.yield_device_lane(max_wait_s=0.01, poll_s=0.005) \
+            >= 0.0
+        reg.activate(ovl.AUDIT_YIELD_RELEASE, objective="o")
+        # released: the audit reclaims the lane instantly, no wait
+        assert ovl.yield_device_lane(max_wait_s=5.0) == 0.0
+        # cluster-scoped release only frees that cluster's audit
+        reg.release(ovl.AUDIT_YIELD_RELEASE, objective="o")
+        reg.activate(ovl.AUDIT_YIELD_RELEASE, objective="o@a",
+                     cluster="a")
+        assert ovl.yield_device_lane(max_wait_s=5.0, cluster="a") == 0.0
+
+
+# --- config validation -----------------------------------------------------
+
+def test_config_malformed_json_names_line(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text('{"objectives": [\n  {"name": "x",}\n]}')
+    with pytest.raises(slo.SLOConfigError) as ei:
+        slo.load_config(str(p))
+    msg = str(ei.value)
+    assert str(p) in msg and "malformed JSON" in msg
+    assert ":2:" in msg  # the offending line
+
+
+def test_config_unknown_field_and_bad_types(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text('{"objectives": [{"name": "x", "typo_field": 1}]}')
+    with pytest.raises(slo.SLOConfigError, match=r"objectives\[0\].*"
+                                                 r"typo_field"):
+        slo.load_config(str(p))
+    p.write_text('{"objectives": [{"name": "x", "target": "fast"}]}')
+    with pytest.raises(slo.SLOConfigError, match="must be numbers"):
+        slo.load_config(str(p))
+    p.write_text('{"objectives": [], "tiers": [{"name": "t"}]}')
+    with pytest.raises(slo.SLOConfigError, match="short_s"):
+        slo.load_config(str(p))
+
+
+def test_config_unknown_degradation_action(tmp_path):
+    p = tmp_path / "slo.json"
+    p.write_text('{"objectives": [{"name": "x", "type": "staleness", '
+                 '"gauge": "g", "threshold": 5, '
+                 '"degradation": ["warp_drive"]}]}')
+    # without a registry the names pass through (inert maps)
+    assert slo.load_config(str(p))["objectives"]
+    with pytest.raises(slo.SLOConfigError, match="warp_drive"):
+        slo.load_config(str(p), ovl.DegradationRegistry())

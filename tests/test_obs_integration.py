@@ -106,14 +106,17 @@ def test_observability_on_vs_off_bit_identical(library_setup, tmp_path):
 
     # ON: everything installed — tracer (keep-all), attribution, flight
     # recorder with a JSONL sink, metrics, SLO engine ticking mid-run
+    # with the degradation maps ARMED (registry installed, all
+    # objectives healthy — the --slo-degradation on steady state)
     m = MetricsRegistry()
     attr = costattr.CostAttribution(metrics=m)
     rec = flightrec.FlightRecorder(
         metrics=m, sink_path=str(tmp_path / "d.jsonl"))
-    eng = slo.SLOEngine(m)
+    reg = ovl.DegradationRegistry(metrics=m)
+    eng = slo.SLOEngine(m, degradations=reg)
     tracer = tracing.Tracer(seed=0, ring_capacity=512)
     with tracing.activate(tracer), costattr.activate(attr), \
-            flightrec.activate(rec):
+            flightrec.activate(rec), ovl.activate_degradations(reg):
         eng.tick()
         on_sweep = sweep(metrics=m)
         eng.tick()
@@ -123,11 +126,13 @@ def test_observability_on_vs_off_bit_identical(library_setup, tmp_path):
     assert on_sweep == base_sweep
     assert on_adm == base_adm
     # and the observability actually observed: spans kept, costs
-    # attributed, every admission decision recorded, SLOs evaluated
+    # attributed, every admission decision recorded, SLOs evaluated,
+    # and the armed-but-healthy maps never fired
     assert tracer.kept > 0
     assert attr.total_seconds() > 0
     assert rec.recorded == len(bodies)
     assert eng.snapshot()["objectives"]
+    assert reg.active() == [] and not eng.degradation_trajectory
 
 
 def test_mutation_on_vs_off_bit_identical():

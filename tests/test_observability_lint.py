@@ -46,6 +46,31 @@ def test_source_scan_sees_known_sites_and_metrics():
     slo = lint.slo_objectives_in_source()
     assert "admission-latency-p99" in slo
     assert "audit-snapshot-staleness" in slo
+    endpoints = lint.debug_endpoints_in_source()
+    # the triage five plus profile/shadow — all route constants
+    assert "/debug/slo" in endpoints
+    assert "/debug/decisions" in endpoints
+    assert "/debug/overload" in endpoints
+    # serving paths (non-debug) stay out of the registry check
+    assert not any(not p.startswith("/debug/") for p in endpoints)
+
+
+def test_lint_flags_endpoint_drift(monkeypatch):
+    """An undocumented /debug endpoint (or a stale documented one)
+    must produce a problem in the matching direction."""
+    lint = _load_lint()
+    doc = lint.documented_endpoints()
+    monkeypatch.setattr(
+        lint, "debug_endpoints_in_source",
+        lambda: {**{p: "OK_PATH" for p in doc},
+                 "/debug/rogue": "ROGUE_PATH"})
+    problems = lint.check()
+    assert any("/debug/rogue" in p for p in problems)
+    monkeypatch.setattr(
+        lint, "debug_endpoints_in_source",
+        lambda: {p: "OK_PATH" for p in sorted(doc)[1:]})
+    problems = lint.check()
+    assert any("stale documented debug endpoint" in p for p in problems)
 
 
 def test_lint_flags_undocumented_additions(tmp_path, monkeypatch):

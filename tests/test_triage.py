@@ -1,0 +1,350 @@
+"""``gator triage`` (ISSUE 18): the one-command incident picture.
+
+Three layers: (1) ``build_report``/``render`` are pure over a bundle
+dict, so the cross-linking logic is pinned on a synthetic incident;
+(2) offline mode reconstructs degradations-in-force from the
+``overload.degraded`` stamps in a ROTATED flight-recorder sink set and
+inventories the snapshot-spill root; (3) the live e2e chain — a real
+WebhookServer + SLOEngine (injected clock) + DegradationRegistry:
+a chaos-slowed admission breaches ``admission-latency-p99`` at page
+tier, the map activates ``ns_cache_stale``, a shed lands, and
+``collect_live`` + ``build_report`` walk objective -> degradation ->
+top template -> slowest trace -> shed in one chain entry."""
+
+import json
+import time
+
+import pytest
+
+from gatekeeper_tpu.apis.constraints import WEBHOOK_EP
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.gator import triage_cmd
+from gatekeeper_tpu.metrics.registry import MetricsRegistry
+from gatekeeper_tpu.observability import costattr, flightrec, slo, tracing
+from gatekeeper_tpu.resilience import overload as ovl
+from gatekeeper_tpu.resilience.faults import FaultPlan, inject
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+from gatekeeper_tpu.webhook.policy import Batcher, ValidationHandler
+from gatekeeper_tpu.webhook.server import WebhookServer
+
+LIB = "/root/repo/library/general"
+
+
+# --- (1) build_report / render: pure over a synthetic bundle ---------------
+
+def _synthetic_bundle():
+    return {
+        "mode": "live", "url": "http://test",
+        "slo": {
+            "generated_at": 100.0, "pressure": 0.8,
+            "objectives": [
+                {"name": "admission-latency-p99", "type": "latency",
+                 "cluster": "", "target": 0.99, "sli": 0.5,
+                 "compliant": False,
+                 "burn": {"300s": 50.0, "3600s": 50.0},
+                 "breach": True, "breach_tier": "page",
+                 "degradation": ["ns_cache_stale", "extdata_stale",
+                                 "shed_harder"],
+                 "degradation_active": ["ns_cache_stale"]},
+                {"name": "audit-snapshot-staleness", "type": "staleness",
+                 "cluster": "", "sli": 12.0, "compliant": True,
+                 "burn": {}, "breach": False, "breach_tier": "",
+                 "degradation": ["audit_yield_release", "resync_defer"],
+                 "degradation_active": []},
+            ],
+        },
+        "cost": {"top": [
+            {"template": "K8sRequiredLabels", "seconds": 4.2,
+             "passes": 90},
+            {"template": "K8sContainerLimits", "seconds": 0.3,
+             "passes": 9},
+        ], "tenants": [{"tenant": "team-a", "seconds": 4.0}]},
+        "overload": {"mode": "serving", "brownout": 1, "degraded": [
+            {"action": "ns_cache_stale", "cluster": "",
+             "objectives": ["admission-latency-p99"]}]},
+        "traces": {"kept": 2, "traces": [
+            {"trace_id": "aaaa", "root": "webhook.request",
+             "duration_s": 0.05, "n_spans": 3},
+            {"trace_id": "bbbb", "root": "webhook.request",
+             "duration_s": 0.44, "n_spans": 5},
+        ]},
+        "decisions": {"recorded": 3, "decisions": [
+            {"ts": 103.0, "decision": "shed", "uid": "shed-9",
+             "reason": "chaos", "tenant": "team-a",
+             "overload": {"degraded": ["ns_cache_stale"]}},
+            {"ts": 102.0, "decision": "allow", "uid": "ok-1",
+             "trace_id": "aaaa"},
+            {"ts": 101.0, "decision": "deny", "uid": "slow-0",
+             "trace_id": "bbbb", "cost": 0.4},
+        ]},
+    }
+
+
+def test_build_report_cross_links_the_chain():
+    bundle = _synthetic_bundle()
+    report = triage_cmd.build_report(bundle)
+
+    assert report["objectives_total"] == 2
+    assert [ev["name"] for ev in report["breaching"]] == \
+        ["admission-latency-p99"]
+    # authoritative overload view wins over the per-objective fallback
+    assert report["degraded"][0]["action"] == "ns_cache_stale"
+    assert report["top_templates"][0]["template"] == "K8sRequiredLabels"
+    # slowest-first, and the exemplar links the slowest trace that has
+    # a decision — bbbb (0.44s) -> the deny of slow-0
+    assert report["slowest_traces"][0]["trace_id"] == "bbbb"
+    assert report["exemplar"]["trace"]["trace_id"] == "bbbb"
+    assert report["exemplar"]["decisions"][0]["uid"] == "slow-0"
+    assert report["decision_counts"] == {"shed": 1, "allow": 1,
+                                         "deny": 1}
+    assert [e["uid"] for e in report["recent_sheds"]] == ["shed-9"]
+
+    (chain,) = report["chains"]
+    assert chain["objective"] == "admission-latency-p99"
+    assert chain["tier"] == "page"
+    assert chain["degradations"] == ["ns_cache_stale"]
+    # one active of three mapped: next escalation step is named
+    assert chain["next_degradation"] == "extdata_stale"
+    assert chain["top_template"] == "K8sRequiredLabels"
+    assert chain["slowest_trace"] == "bbbb"
+    assert chain["recent_sheds"] == 1
+
+
+def test_render_names_every_chain_segment():
+    bundle = _synthetic_bundle()
+    text = triage_cmd.render(bundle, triage_cmd.build_report(bundle))
+    assert "SLO: 1/2 objectives breaching" in text
+    assert "admission-latency-p99" in text
+    assert "degradations active: ns_cache_stale" in text
+    assert "next if sustained: extdata_stale" in text
+    assert "Degradations in force:" in text
+    assert "K8sRequiredLabels" in text
+    assert "Slowest exemplar trace: bbbb" in text
+    assert "uid=shed-9" in text and "reason=chaos" in text
+    assert "Chain:" in text
+    chain_line = [ln for ln in text.splitlines()
+                  if "admission-latency-p99 breaching" in ln][0]
+    for seg in ("activated ns_cache_stale",
+                "top template K8sRequiredLabels",
+                "slowest trace bbbb", "1 recent sheds"):
+        assert seg in chain_line, chain_line
+
+
+def test_render_flags_unavailable_endpoints_and_healthy_chain():
+    bundle = {"mode": "live", "url": "http://test",
+              "slo": {"objectives": []},
+              "cost": {"error": "/debug/cost: boom"},
+              "overload": {}, "traces": {}, "decisions": {}}
+    text = triage_cmd.render(bundle, triage_cmd.build_report(bundle))
+    assert "!! cost: unavailable" in text
+    assert "nothing to triage" in text
+
+
+# --- (2) offline mode: rotated sink + degraded stamps + spill --------------
+
+def test_triage_offline_reconstructs_from_rotated_sink(tmp_path):
+    sink = tmp_path / "decisions.jsonl"
+    wall = {"t": 1000.0}
+    rec = flightrec.FlightRecorder(
+        sink_path=str(sink), wall=lambda: wall["t"],
+        sink_max_bytes=300, sink_keep=8)
+    reg = ovl.DegradationRegistry()
+    ovl.install_degradations(reg)
+    try:
+        for i in range(6):  # healthy stretch
+            wall["t"] += 1
+            rec.record("validate", "allow", uid=f"ok-{i}",
+                       tenant="team-a")
+        reg.activate("ns_cache_stale", "admission-latency-p99")
+        for i in range(4):  # degraded stretch: stamps ride each line
+            wall["t"] += 1
+            rec.record("validate", "shed" if i == 3 else "allow",
+                       uid=f"deg-{i}", reason="chaos" if i == 3 else "")
+    finally:
+        ovl.uninstall_degradations()
+        rec.close()
+    assert rec.rotations > 0  # the 300-byte cap really rotated
+
+    spill = tmp_path / "spill"
+    (spill / "cluster-a").mkdir(parents=True)
+    (spill / "cluster-a" / "snap.npz").write_bytes(b"x" * 8)
+
+    bundle = triage_cmd.collect_offline(str(sink), spill=str(spill))
+    # the rotated set reads as one stream, newest first
+    assert bundle["decisions"]["recorded"] == 10
+    assert bundle["decisions"]["rotated_files"] > 1
+    assert bundle["decisions"]["decisions"][0]["uid"] == "deg-3"
+    # degradations-in-force reconstructed from the decision stamps
+    assert bundle["overload"]["reconstructed"] is True
+    assert [d["action"] for d in bundle["overload"]["degraded"]] == \
+        ["ns_cache_stale"]
+    assert bundle["spill"]["clusters"][0]["cluster"] == "cluster-a"
+    assert bundle["spill"]["clusters"][0]["files"] == 1
+
+    report = triage_cmd.build_report(bundle)
+    assert report["degraded"][0]["action"] == "ns_cache_stale"
+    assert [e["uid"] for e in report["recent_sheds"]] == ["deg-3"]
+    text = triage_cmd.render(bundle, report)
+    assert "ns_cache_stale" in text
+    assert "Audit snapshot spills" in text and "cluster-a" in text
+
+
+def test_triage_cli_arg_validation_and_json(tmp_path, capsys):
+    # exactly one of --url / -f
+    assert triage_cmd.run_cli([]) == 2
+    assert triage_cmd.run_cli(["--url", "http://x", "-f", "y"]) == 2
+    capsys.readouterr()
+
+    sink = tmp_path / "d.jsonl"
+    rec = flightrec.FlightRecorder(sink_path=str(sink))
+    rec.record("validate", "allow", uid="u0")
+    rec.close()
+    # nothing breaching offline -> exit 0, and --json round-trips
+    assert triage_cmd.run_cli(["-f", str(sink), "-o", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["bundle"]["mode"] == "offline"
+    assert out["report"]["chains"] == []
+    assert out["bundle"]["decisions"]["decisions"][0]["uid"] == "u0"
+
+
+# --- (3) live e2e: breach -> degradation -> triage chain -------------------
+
+def test_triage_live_chain_end_to_end():
+    """The ISSUE 18 acceptance chain, against the real HTTP surface:
+    slow admission -> admission-latency-p99 breaches page tier on the
+    injected SLO clock -> the degradation map activates ns_cache_stale
+    -> a later admission sheds (stamped degraded) -> one collect_live
+    bundle cross-links all of it."""
+    client = Client(target=K8sValidationTarget(), drivers=[TpuDriver()],
+                    enforcement_points=[WEBHOOK_EP])
+    client.add_template(load_yaml_file(
+        f"{LIB}/requiredlabels/template.yaml")[0])
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "everything-labeled"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}},
+    })
+
+    m = MetricsRegistry()
+    attr = costattr.CostAttribution(metrics=m)
+    rec = flightrec.FlightRecorder(metrics=m)
+    ctl = ovl.OverloadController(ovl.OverloadConfig(), metrics=m)
+    tracer = tracing.Tracer(seed=0, ring_capacity=256)
+    reg = ovl.DegradationRegistry(metrics=m)
+    clk = {"t": 0.0}
+    eng = slo.SLOEngine(
+        m, objectives=[slo.DEFAULT_OBJECTIVES[0]],  # admission-latency
+        degradations=reg, clock=lambda: clk["t"])
+    batcher = Batcher(client, small_batch=0, metrics=m).start()
+    handler = ValidationHandler(client, batcher=batcher, metrics=m,
+                                overload=ctl, failure_policy="fail")
+    srv = WebhookServer(validation_handler=handler, metrics=m, port=0,
+                        cost_attribution=attr, slo_engine=eng,
+                        flight_recorder=rec).start()
+
+    import urllib.request
+
+    def post(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/admit",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def body(uid):
+        return {"apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": uid, "operation": "CREATE",
+                            "kind": {"group": "", "version": "v1",
+                                     "kind": "Namespace"},
+                            "name": uid, "namespace": "",
+                            "userInfo": {"username": "it"},
+                            "object": {"apiVersion": "v1",
+                                       "kind": "Namespace",
+                                       "metadata": {"name": uid}}}}
+
+    plan = FaultPlan([
+        {"site": "webhook.review", "mode": "sleep", "delay_s": 0.4,
+         "times": 1},
+        {"site": "webhook.overload", "mode": "error", "after": 6,
+         "times": 1},
+    ])
+    try:
+        with tracing.activate(tracer), costattr.activate(attr), \
+                flightrec.activate(rec), ovl.activate(ctl), \
+                ovl.activate_degradations(reg), inject(plan):
+            eng.tick()  # t=0 baseline sample: nothing served yet
+            out = post(body("slow-0"))  # chaos: 0.4s > 250ms threshold
+            assert out["response"]["allowed"] is False  # missing label
+            # one slow of one total against a 1% budget: burn 100 >=
+            # 14.4 on both page windows once the window has aged
+            clk["t"] = 300.0
+            eng.tick()
+            assert reg.is_active("ns_cache_stale")
+            for i in range(1, 6):
+                post(body(f"ns-{i}"))
+            shed = post(body("shed-6"))  # gate call 7: chaos shed
+            assert shed["response"]["status"]["code"] == 429
+
+            bundle = triage_cmd.collect_live(
+                f"http://127.0.0.1:{srv.port}")
+            bundle["collected_at"] = time.time()
+            report = triage_cmd.build_report(bundle)
+    finally:
+        srv.stop()
+        batcher.stop()
+
+    for key in triage_cmd.ENDPOINTS:
+        assert "error" not in bundle[key], bundle[key]
+
+    (chain,) = report["chains"]
+    assert chain["objective"] == "admission-latency-p99"
+    assert chain["tier"] == "page"
+    assert chain["burn"]["300s"] >= 14.4
+    assert chain["degradations"] == ["ns_cache_stale"]
+    assert chain["next_degradation"] == "extdata_stale"
+    assert chain["top_template"] == "K8sRequiredLabels"
+    assert chain["recent_sheds"] == 1
+
+    # the authoritative /debug/overload view carries the holder
+    assert report["degraded"][0]["action"] == "ns_cache_stale"
+    assert report["degraded"][0]["objectives"] == \
+        ["admission-latency-p99"]
+    # slowest trace is the chaos-slowed request, linked to its decision
+    ex = report["exemplar"]
+    assert ex["trace"]["duration_s"] >= 0.4
+    assert chain["slowest_trace"] == ex["trace"]["trace_id"]
+    assert any(d["uid"] == "slow-0" for d in ex["decisions"])
+    # the shed happened AFTER activation: its record is stamped
+    shed_rec = next(e for e in report["recent_sheds"]
+                    if e["uid"] == "shed-6")
+    assert shed_rec["overload"]["degraded"] == ["ns_cache_stale"]
+
+    text = triage_cmd.render(bundle, report)
+    assert "admission-latency-p99" in text
+    assert "ns_cache_stale" in text
+    assert "K8sRequiredLabels" in text
+    assert "uid=shed-6" in text
+    assert "Chain:" in text
+
+
+def test_collect_live_survives_a_dead_endpoint():
+    calls = []
+
+    def fetch(url, timeout):
+        calls.append(url)
+        if "/debug/cost" in url:
+            raise OSError("connection refused")
+        return {"ok": True}
+
+    bundle = triage_cmd.collect_live("http://h:1", cluster="a",
+                                     fetch=fetch)
+    assert bundle["cost"]["error"].startswith("/debug/cost")
+    assert bundle["slo"] == {"ok": True}
+    # cluster scopes the slo + decisions views
+    assert any(u.endswith("/debug/slo?cluster=a") for u in calls)
+    assert any(u.endswith("/debug/decisions?cluster=a") for u in calls)

@@ -9,7 +9,12 @@ bare path, on both enforcement surfaces:
   (the per-request seams: duration histogram, decision record, request
   spans, query_batch attribution);
 - **sweep**: one library-corpus audit pass (the per-chunk seams:
-  dispatch/flatten attribution, chunk spans, pipeline gauges).
+  dispatch/flatten attribution, chunk spans, pipeline gauges);
+- **degradation engine**: the bare webhook path with the targeted
+  degradation maps ARMED but healthy (``--slo-degradation on``, a
+  DegradationRegistry installed, an SLOEngine holding the default
+  maps, nothing active) — the per-request cost of the
+  ``degradation_active()`` checks the hot paths grew.
 
 Passes interleave bare/instrumented (ABAB...) so clock drift and cache
 warmth cancel, and the comparison uses medians.  Appends a history
@@ -118,7 +123,11 @@ def run(n_objects: int = 200, passes: int = 5,
     for b in bodies[:4]:
         bare_handler.handle(b)
 
+    from gatekeeper_tpu.observability import slo as slo_mod
+    from gatekeeper_tpu.resilience import overload as ovl
+
     bare_web, inst_web, bare_sweep, inst_sweep = [], [], [], []
+    deg_web = []
     # round 0 is a discarded warmup (lazy imports, first-touch caches on
     # BOTH variants) — medians are robust but the noise-spread guard the
     # smoke keys on must not see the one-time costs
@@ -135,6 +144,18 @@ def run(n_objects: int = 200, passes: int = 5,
                 inst_handler.handle(b)
             inst_web.append(time.perf_counter() - t0)
 
+        # degradation-engine lane: registry installed + engine holding
+        # the default maps, all objectives healthy — measures only the
+        # armed checks (is_active reads) on the bare serving path
+        reg = ovl.DegradationRegistry()
+        eng = slo_mod.SLOEngine(MetricsRegistry(), degradations=reg)
+        eng.tick()
+        with ovl.activate_degradations(reg):
+            t0 = time.perf_counter()
+            for b in bodies:
+                bare_handler.handle(b)
+            deg_web.append(time.perf_counter() - t0)
+
         t0 = time.perf_counter()
         mgr.audit()
         bare_sweep.append(time.perf_counter() - t0)
@@ -150,6 +171,7 @@ def run(n_objects: int = 200, passes: int = 5,
             inst_web.clear()
             bare_sweep.clear()
             inst_sweep.clear()
+            deg_web.clear()
 
     def med(xs):
         return statistics.median(xs)
@@ -191,6 +213,13 @@ def run(n_objects: int = 200, passes: int = 5,
             100.0 * (min(inst_web) / min(bare_web) - 1.0), 2),
         "sweep_overhead_min_pct": round(
             100.0 * (min(inst_sweep) / min(bare_sweep) - 1.0), 2),
+        # armed-but-healthy degradation maps vs bare: the marginal cost
+        # of the degradation_active() reads on the serving path
+        "webhook_degradation_armed_s": round(med(deg_web), 4),
+        "degradation_overhead_pct": round(
+            100.0 * (med(deg_web) / med(bare_web) - 1.0), 2),
+        "degradation_overhead_min_pct": round(
+            100.0 * (min(deg_web) / min(bare_web) - 1.0), 2),
         "noise_spread_pct": round(100.0 * max(
             spread(bare_web), spread(bare_sweep)), 2),
     }

@@ -13,9 +13,12 @@ registry (``tools/observability_registry.md``):
 - every built-in SLO objective name
   (``observability/slo.py:DEFAULT_OBJECTIVES``) must be documented —
   dashboards key on ``gatekeeper_slo_*{objective=...}`` values;
-- stale documentation (a documented site/metric/span/objective that no
-  longer exists in the source) fails too, so the registry can be
-  trusted.
+- every ``/debug/*`` endpoint constant in ``webhook/server.py``
+  (``*_PATH = "/debug/..."``) must be documented — runbooks and
+  ``gator triage`` depend on those paths existing;
+- stale documentation (a documented site/metric/span/objective/
+  endpoint that no longer exists in the source) fails too, so the
+  registry can be trusted.
 
 Run standalone (``python tools/lint_observability.py``) or via tier-1
 (``tests/test_observability_lint.py``).
@@ -34,6 +37,7 @@ REGISTRY_MD = REPO / "tools" / "observability_registry.md"
 METRICS_PY = PKG / "metrics" / "registry.py"
 SLO_PY = PKG / "observability" / "slo.py"
 SHADOW_PY = PKG / "replay" / "shadow.py"
+SERVER_PY = PKG / "webhook" / "server.py"
 
 _FAULT_CALL = re.compile(r'fault_point\(\s*(f?)"([^"]+)"')
 # tracer span call sites: tracing.span("..."), otel.span("..."),
@@ -41,6 +45,10 @@ _FAULT_CALL = re.compile(r'fault_point\(\s*(f?)"([^"]+)"')
 _SPAN_CALL = re.compile(r'\b(?:span|start_span)\(\s*(f?)"([^"]+)"')
 _DOC_ENTRY = re.compile(r"^\s*-\s+`([^`]+)`")
 _FSTRING_FIELD = re.compile(r"\{[^}]*\}")
+# route constants at the top of webhook/server.py; only the /debug/*
+# surface is registry-checked (the serving paths are API, not debug)
+_ENDPOINT_CONST = re.compile(
+    r'^([A-Z][A-Z0-9_]*_PATH)\s*=\s*"(/debug/[^"]*)"', re.M)
 
 
 def documented() -> tuple[set, set, set, set]:
@@ -67,6 +75,30 @@ def documented() -> tuple[set, set, set, set]:
         elif section.startswith("slo objectives"):
             objectives.add(m.group(1))
     return sites, metrics, spans, objectives
+
+
+def documented_endpoints() -> set:
+    """Debug endpoint paths parsed from the registry markdown's
+    ``## Debug endpoints`` section (kept apart from :func:`documented`
+    so its 4-tuple shape stays stable for callers)."""
+    endpoints: set = set()
+    section = ""
+    for line in REGISTRY_MD.read_text().splitlines():
+        if line.startswith("## "):
+            section = line[3:].strip().lower()
+            continue
+        m = _DOC_ENTRY.match(line)
+        if m and section.startswith("debug endpoints"):
+            endpoints.add(m.group(1))
+    return endpoints
+
+
+def debug_endpoints_in_source() -> dict:
+    """path -> constant name for every ``*_PATH = "/debug/..."`` route
+    constant in webhook/server.py — the surface ``gator triage``
+    snapshots and runbooks link to."""
+    return {m.group(2): m.group(1)
+            for m in _ENDPOINT_CONST.finditer(SERVER_PY.read_text())}
 
 
 def fault_sites_in_source() -> dict:
@@ -215,6 +247,19 @@ def check() -> list:
             f"{SLO_PY.relative_to(REPO)}:DEFAULT_OBJECTIVES or "
             f"{SHADOW_PY.relative_to(REPO)}:SHADOW_OBJECTIVE; remove it "
             "from the registry")
+    doc_endpoints = documented_endpoints()
+    src_endpoints = debug_endpoints_in_source()
+    for path, const in sorted(src_endpoints.items()):
+        if path not in doc_endpoints:
+            problems.append(
+                f"undocumented debug endpoint {path!r} (constant {const} "
+                f"in {SERVER_PY.relative_to(REPO)}) — add it to "
+                f"{REGISTRY_MD.relative_to(REPO)}")
+    for path in sorted(doc_endpoints - set(src_endpoints)):
+        problems.append(
+            f"stale documented debug endpoint {path!r} — no *_PATH "
+            f"constant in {SERVER_PY.relative_to(REPO)} matches; remove "
+            "it from the registry")
     return problems
 
 
@@ -226,7 +271,8 @@ def main() -> int:
         sites, metrics, spans, slo = documented()
         print(f"observability registry in sync: {len(sites)} fault "
               f"sites, {len(metrics)} metrics, {len(spans)} spans, "
-              f"{len(slo)} SLO objectives")
+              f"{len(slo)} SLO objectives, "
+              f"{len(documented_endpoints())} debug endpoints")
     return 1 if problems else 0
 
 
