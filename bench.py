@@ -59,6 +59,12 @@ SHARD_CHUNKS = 0
 # --trace out.json: span-trace the timed sweeps and export a Chrome
 # trace-event file at exit (Perfetto-loadable device timeline)
 TRACE_PATH = ""
+# --resident[=N]: after the streaming sweep, run the device-resident
+# snapshot tick lane over N rows (default min(n, 100k) — the snapshot
+# holds full columns in host memory, unlike the O(chunk) stream) and
+# record upload/clean-tick/dirty-sliver phases + h2d_bytes into the
+# same SWEEP1M.json history entry
+RESIDENT_LANE = 0
 
 
 def _parse_pipeline_flag(argv: list) -> list:
@@ -72,7 +78,7 @@ def _parse_pipeline_flag(argv: list) -> list:
     sampling) and writes the Chrome trace-event artifact — with --chaos
     the injected faults show up as instant events on the spans they hit."""
     global PIPELINE_MODE, TRACE_PATH, FLATTEN_LANE, COLLECT_LANE, \
-        FLATTEN_WORKERS, SHARD_CHUNKS
+        FLATTEN_WORKERS, SHARD_CHUNKS, RESIDENT_LANE
     out = []
     chaos = ""
     it = iter(argv)
@@ -97,6 +103,10 @@ def _parse_pipeline_flag(argv: list) -> list:
             COLLECT_LANE = next(it, "reduced")
         elif a.startswith("--collect="):
             COLLECT_LANE = a.split("=", 1)[1]
+        elif a == "--resident":
+            RESIDENT_LANE = -1
+        elif a.startswith("--resident="):
+            RESIDENT_LANE = int(a.split("=", 1)[1] or -1)
         elif a == "--chaos":
             chaos = next(it, "")
         elif a.startswith("--chaos="):
@@ -471,9 +481,87 @@ def _sweep_timed(jax, client, tpu, nt, nc, cpu_fallback, spill_fd, spill,
         out["pipeline"].update(mgr.pipe_stats)
     if cpu_fallback:
         out["cpu_fallback"] = True
+    if RESIDENT_LANE:
+        rows = RESIDENT_LANE if RESIDENT_LANE > 0 else min(n, 100_000)
+        out["device_resident"] = _resident_lane(client, tpu, rows, chunk)
     sweep_history_append(out)
     export_trace()
     print(_json.dumps(out))
+
+
+def _resident_lane(client, tpu, rows: int, chunk: int) -> dict:
+    """The --resident sweep lane: HBM-resident snapshot columns ticked
+    against watch churn.  Three timed phases — (1) full rebuild + first
+    upload, (2) warm clean-rows tick (the zero-H2D pin: gather indices
+    cached, no bytes cross the tunnel), (3) dirty-sliver tick (~1% rows
+    churned; only the sliver's scatter-patch ships)."""
+    import copy as _copy
+    import time as _time
+
+    from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+    from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+    from gatekeeper_tpu.snapshot import (ClusterSnapshot, DeviceResidency,
+                                         SnapshotConfig, WatchIngester,
+                                         gvks_of)
+    from gatekeeper_tpu.sync.source import FakeCluster
+    from gatekeeper_tpu.utils.synthetic import iter_cluster_objects
+
+    log(f"device-resident lane: {rows} snapshot rows...")
+    # single-device mesh: the resident lane is single-chip by design
+    ev = ShardedEvaluator(tpu, make_mesh(1), violations_limit=20)
+    cluster = FakeCluster()
+    churn_pool = []
+    for o in iter_cluster_objects(rows):
+        if len(churn_pool) < max(1, rows // 100):
+            churn_pool.append(_copy.deepcopy(o))
+        cluster.apply(o)
+    residency = DeviceResidency(ev, mode="on")
+    snap = ClusterSnapshot(ev, SnapshotConfig())
+    mgr = AuditManager(
+        client, lister=lambda: iter(cluster.list()),
+        config=AuditConfig(violations_limit=20, chunk_size=chunk,
+                           exact_totals=False, pipeline="off",
+                           audit_source="snapshot"),
+        evaluator=ev, snapshot=snap, residency=residency)
+    ing = WatchIngester(snap, cluster, gvks_of(cluster.list())).start()
+    try:
+        phases = {}
+        t0 = _time.perf_counter()
+        mgr.audit()
+        phases["rebuild_upload_s"] = round(_time.perf_counter() - t0, 3)
+        mgr.audit_tick()  # prime the gather-index + param-table caches
+        t0 = _time.perf_counter()
+        mgr.audit_tick()
+        phases["clean_tick_s"] = round(_time.perf_counter() - t0, 3)
+        h2d_clean = int(mgr.perf.get("tick_h2d_bytes", 0))
+        for o in churn_pool:
+            o.setdefault("metadata", {}).setdefault(
+                "labels", {})["bench-churn"] = "r1"
+            cluster.apply(o)
+        ing.pump()
+        dirty = sum(len(v) for v in snap.dirty_rows().values())
+        t0 = _time.perf_counter()
+        mgr.audit_tick()
+        phases["dirty_sliver_tick_s"] = round(_time.perf_counter() - t0, 3)
+        h2d_dirty = int(mgr.perf.get("tick_h2d_bytes", 0))
+    finally:
+        ing.stop()
+    lane = {
+        "rows": rows,
+        "resident_mb": round(residency.resident_bytes() / 1e6, 2),
+        "uploads": residency.upload_count,
+        "patches": residency.patch_count,
+        "dirty_rows": dirty,
+        "h2d_bytes_clean_tick": h2d_clean,
+        "h2d_bytes_dirty_tick": h2d_dirty,
+        "h2d_clean_ok": h2d_clean == 0,  # the acceptance pin
+        "phase_s": phases,
+    }
+    log(f"device-resident lane: {lane}")
+    if h2d_clean != 0:
+        log(f"WARNING: warm clean-rows tick shipped {h2d_clean} bytes "
+            "(expected 0)")
+    return lane
 
 
 def sweep_history_append(entry: dict) -> None:

@@ -92,6 +92,33 @@ def main(argv=None) -> int:
                         "spill worker).  The header records the codec; "
                         "the loader auto-detects either, so flipping "
                         "the flag never strands an existing spill")
+    p.add_argument("--snapshot-spill-delta", action="store_true",
+                   help="incremental spills: groups split into per-group "
+                        "section files and a spill rewrites ONLY the "
+                        "groups whose mutation mark moved since the last "
+                        "write — O(churn) disk instead of O(cluster). "
+                        "Every --snapshot-spill-full-every'th spill is a "
+                        "full rewrite that prunes orphaned group files "
+                        "(the compaction path); off keeps the inline "
+                        "single-section format byte-identical")
+    p.add_argument("--snapshot-spill-full-every", type=int, default=8,
+                   help="delta spills: force a full rewrite (and orphan "
+                        "prune) every Nth spill (default 8)")
+    p.add_argument("--snapshot-residency", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="device-resident snapshot columns: keep each "
+                        "group's tall packed columns + match masks in "
+                        "device HBM, apply watch patches as device "
+                        "scatter from dirty-row slivers, and dispatch "
+                        "audit chunks as an index gather — a warm clean "
+                        "tick uploads ZERO bytes (README 'Device-"
+                        "resident snapshot').  'auto' promotes only when "
+                        "an accelerator backs the mesh (CPU hosts keep "
+                        "host columns, logged once); 'on' forces "
+                        "promotion (the CPU differential shape); 'off' "
+                        "disables the lane.  The built-in "
+                        "device_residency_evict degradation action "
+                        "demotes resident groups on SLO breach")
     p.add_argument("--audit-expand", action="store_true",
                    help="expansion generator stage in the audit sweep: "
                         "generator objects (per ExpansionTemplate "
@@ -845,6 +872,7 @@ def main(argv=None) -> int:
     snapshot = None
     snap_ingester = None
     snap_spiller = None
+    snap_residency = None
     spill_load = None
     warm_cache = None
     evaluator = None
@@ -924,7 +952,9 @@ def main(argv=None) -> int:
                 if args.snapshot_spill:
                     snap_spill = SnapshotSpill(
                         args.snapshot_spill, metrics=metrics,
-                        compress=args.snapshot_spill_compress)
+                        compress=args.snapshot_spill_compress,
+                        delta=args.snapshot_spill_delta,
+                        full_every=args.snapshot_spill_full_every)
                     from gatekeeper_tpu.apis.constraints import AUDIT_EP \
                         as _AEP
 
@@ -967,6 +997,17 @@ def main(argv=None) -> int:
                         rvs_fn=lambda: dict(snap_ingester.rvs),
                         extdata_lane=extdata_lane,
                         templates_fn=lambda: templates_digest(client))
+                if args.snapshot_residency != "off":
+                    from gatekeeper_tpu.snapshot import DeviceResidency
+
+                    snap_residency = DeviceResidency(
+                        evaluator, metrics=metrics,
+                        mode=args.snapshot_residency)
+                    _gc = getattr(tpu, "gen_coord", None)
+                    if _gc is not None:
+                        # generation swaps drop the device mirrors
+                        # eagerly (new schemas/layouts)
+                        _gc.attach_residency(snap_residency)
                 print(f"resident snapshot active: watching "
                       f"{len(watch_gvks)} GVKs, resync every "
                       f"{args.snapshot_resync_every} intervals",
@@ -994,6 +1035,7 @@ def main(argv=None) -> int:
             snapshot=snapshot,
             expansion_system=mgr.expansion_system,
             spiller=snap_spiller,
+            residency=snap_residency,
         )
         if snapshot is not None and snapshot.warm_loaded \
                 and spill_load is not None:

@@ -217,6 +217,7 @@ class AuditManager:
         expansion_system=None,  # expansion.ExpansionSystem (expand stage)
         spiller=None,  # snapshot.SnapshotSpiller (--snapshot-spill)
         cluster: str = "",  # fleet scope: labels staleness gauges
+        residency=None,  # snapshot.DeviceResidency (resident tick lane)
     ):
         self.client = client
         self.lister = lister
@@ -234,6 +235,11 @@ class AuditManager:
         # slo.py per_cluster_objectives) can age each cluster's audit
         # independently off one shared registry
         self.cluster = cluster
+        # device-resident snapshot lane (snapshot/device_residency.py):
+        # when set, _snapshot_eval prefers resident chunks (gather-index
+        # H2D only) and falls back to host columns per group whenever
+        # the residency declines (no device, extdata, eviction)
+        self.residency = residency
         self.expansion_system = expansion_system
         # expansion generator stage state: the batched stage (lazy), the
         # per-sweep generator-object tee, the Namespace inventory the
@@ -561,6 +567,13 @@ class AuditManager:
         self.perf["snapshot_rows_evaluated"] = (
             self.perf.get("snapshot_rows_evaluated", 0.0)
             + sum(len(v) for v in rows.values()))
+        # tick H2D meter: bytes this tick shipped host->device, summed
+        # over the resident lane's honest counter (gather indices, cache
+        # misses, residency patches) and the host lane's wire pack — a
+        # warm clean-rows resident tick reads ZERO
+        ev = self.evaluator
+        h2d0 = (ev.perf.get("resident_h2d_bytes", 0.0)
+                + ev.perf.get("wire_bytes", 0.0)) if ev is not None else 0.0
         self._snapshot_eval(rows, run)
         # generator stage rides the same dirty set: only (re)evaluated
         # parents re-expand, clean parents keep their generated verdicts
@@ -570,6 +583,17 @@ class AuditManager:
         run.total_violations = totals
         run.kept = kept
         run.duration_s = time.time() - t0
+        if ev is not None:
+            tick_h2d = (ev.perf.get("resident_h2d_bytes", 0.0)
+                        + ev.perf.get("wire_bytes", 0.0)) - h2d0
+            self.perf["tick_h2d_bytes"] = tick_h2d
+            if self.metrics is not None:
+                from gatekeeper_tpu.metrics import registry as M
+
+                labels = {"cluster": self.cluster} if self.cluster \
+                    else None
+                self.metrics.set_gauge(M.TICK_H2D_BYTES,
+                                       float(tick_h2d), labels)
         snap.publish_metrics()
         self._write_statuses(run, constraints)
         self._publish_metrics(run)
@@ -600,9 +624,22 @@ class AuditManager:
 
         for store, rowlist in rows_by_store.items():
             cons_g = store.cons
+            # resident lane: sync the device mirror ONCE per store per
+            # tick (scatter-patch for dirty rows, nothing when clean);
+            # None means this group serves host columns this tick
+            rg = None
+            if self.residency is not None and ev is not None \
+                    and store.lowered:
+                rg = self.residency.prepare(store)
             window: deque = deque()
 
-            def submit_chunk(gids, positions, objects):
+            def submit_chunk(gids, positions, objects, _rg=rg):
+                if _rg is not None:
+                    flat = ev.sweep_flatten_resident(
+                        _rg, positions, return_bits=True)
+                    if flat is not None:
+                        return ev.sweep_dispatch(flat)
+                    # generation swapped mid-tick: host path handles it
                 batch = store.slice_rows(positions,
                                          pad_n=ev._pad(len(positions)))
                 flat = ev.sweep_flatten_from_batch(

@@ -515,6 +515,16 @@ class GenerationCoordinator:
         # programs ride the same background machinery):
         # name -> (current_key_fn, build_fn, install_fn, installed_key)
         self._aux: dict = {}
+        # DeviceResidency instances to evict at swap (stale-HBM release;
+        # see snapshot/device_residency.py)
+        self._residencies: list = []
+
+    def attach_residency(self, residency) -> None:
+        """Register a snapshot DeviceResidency for proactive eviction at
+        every generation swap (its mirrors were packed under the old
+        programs' schemas)."""
+        with self._lock:
+            self._residencies.append(residency)
 
     # --- lifecycle ------------------------------------------------------
     @property
@@ -823,6 +833,16 @@ class GenerationCoordinator:
             self.last_error = None
             self._installed_digests = {
                 k: s.digest for k, s in desired.items()}
+            residencies = list(self._residencies)
+        # device-resident snapshot mirrors were packed under the OLD
+        # generation's schemas: correctness is already covered (each
+        # mirror's program-uid signature misses on next prepare), this
+        # eviction just frees the stale HBM now instead of one tick later
+        for res in residencies:
+            try:
+                res.invalidate()
+            except Exception:
+                pass
         if self.metrics is not None:
             from gatekeeper_tpu.metrics import registry as M
 

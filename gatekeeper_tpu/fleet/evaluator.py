@@ -110,6 +110,10 @@ class LibraryRuntime:
         # WarmStateCache), wired by FleetEvaluator when warm_root is set
         self.warm_cache = None
         self.warm_replayed: Optional[dict] = None
+        # device-resident snapshot lane (snapshot/device_residency.py):
+        # ONE residency per runtime — member clusters' stores are
+        # distinct objects, so each gets its own mirror under it
+        self.residency = None
 
     @property
     def gen_coord(self):
@@ -173,8 +177,10 @@ class FleetEvaluator:
     def __init__(self, metrics=None, chunk_size: int = 500,
                  violations_limit: int = 20, exact_totals: bool = True,
                  pack_chunks: int = 0, spill_root: str = "",
-                 spill_compress: str = "none", submit_window: int = 64,
-                 chunk_retries: int = 1, warm_root: str = ""):
+                 spill_compress: str = "none", spill_delta: bool = False,
+                 spill_full_every: int = 8, submit_window: int = 64,
+                 chunk_retries: int = 1, warm_root: str = "",
+                 residency: str = "auto"):
         self.metrics = metrics
         # warm execution state root (normally the compile-cache dir):
         # each runtime replays its persisted sweep traces at build time
@@ -191,8 +197,14 @@ class FleetEvaluator:
         self.pack_chunks = max(0, int(pack_chunks))
         self.spill_root = spill_root
         self.spill_compress = spill_compress
+        self.spill_delta = spill_delta
+        self.spill_full_every = spill_full_every
         self.submit_window = max(1, submit_window)
         self.chunk_retries = max(0, chunk_retries)
+        # residency mode for per-runtime DeviceResidency ('auto' / 'on'
+        # / 'off'); single-cluster (unpacked) dispatches prefer the
+        # resident lane, multi-cluster packs keep host columns (NEXT)
+        self.residency_mode = residency
         self._runtimes: dict = {}  # library key -> LibraryRuntime
         self.clusters: dict = {}   # cluster id -> FleetCluster
         self._lock = threading.Lock()
@@ -219,6 +231,16 @@ class FleetEvaluator:
             return rt
         client, driver, evaluator = build()
         rt = LibraryRuntime(key, client, driver, evaluator)
+        if self.residency_mode != "off" and evaluator is not None:
+            from gatekeeper_tpu.snapshot.device_residency import (
+                DeviceResidency)
+
+            rt.residency = DeviceResidency(evaluator,
+                                           metrics=self.metrics,
+                                           mode=self.residency_mode)
+            gc = rt.gen_coord
+            if gc is not None:
+                gc.attach_residency(rt.residency)
         if self.warm_root:
             self._attach_warm(rt)
         with self._lock:
@@ -301,7 +323,8 @@ class FleetEvaluator:
             spill = SnapshotSpill(
                 os.path.join(self.spill_root, cluster_id),
                 metrics=self.metrics, compress=self.spill_compress,
-                cluster_id=cluster_id)
+                cluster_id=cluster_id, delta=self.spill_delta,
+                full_every=self.spill_full_every)
             spill_load = spill.load(
                 snapshot, rt.audit_constraints(),
                 templates=rt.library_digest())
@@ -329,7 +352,8 @@ class FleetEvaluator:
             # con.raw would make the last-swept cluster win
             status_writer=lambda con, status:
                 statuses.__setitem__(con.key(), status),
-            metrics=self.metrics, cluster=cluster_id)
+            metrics=self.metrics, cluster=cluster_id,
+            residency=rt.residency)
         if spill is not None:
             spiller = SnapshotSpiller(
                 spill, snapshot,
@@ -487,8 +511,6 @@ class FleetEvaluator:
         n_clusters = len({p[0].id for p in parts})
         with tracing.span("fleet.pack", clusters=n_clusters,
                           chunks=len(parts), rows=total):
-            batch = concat_group_rows(
-                [(p[1], p[3]) for p in parts], pad_n)
             # the cluster-id column rides the packed batch: cluster
             # index per packed row (pad region -1) — the fold's segment
             # map and the per-cluster cost-attribution row weights,
@@ -496,16 +518,38 @@ class FleetEvaluator:
             cluster_rows = np.full(pad_n, -1, np.int32)
             cluster_rows[:total] = np.repeat(
                 np.arange(len(parts), dtype=np.int32), lens)
-            batch.cluster_rows = cluster_rows
+            batch = None  # host gather happens only if a lane needs it
+
+            def host_batch():
+                nonlocal batch
+                if batch is None:
+                    batch = concat_group_rows(
+                        [(p[1], p[3]) for p in parts], pad_n)
+                    batch.cluster_rows = cluster_rows
+                return batch
+
             objects = [p[1].row_obj(pos) for p in parts for pos in p[3]]
+            # single-cluster (unpacked) chunks prefer the resident lane:
+            # the one store's device mirror serves the rows with a
+            # gather-index upload only; multi-cluster packs gather host
+            # columns (cross-store device concat is a ROADMAP NEXT)
+            rg = None
+            if len(parts) == 1 and rt.residency is not None \
+                    and store0.lowered:
+                rg = rt.residency.prepare(store0)
             retries = self.chunk_retries
             pending = None
             last = None
             for attempt in range(retries + 1):
                 try:
-                    flat = ev.sweep_flatten_from_batch(
-                        store0.cons, batch, objects, return_bits=True,
-                        alias=store0.alias)
+                    flat = None
+                    if rg is not None:
+                        flat = ev.sweep_flatten_resident(
+                            rg, parts[0][3], return_bits=True)
+                    if flat is None:
+                        flat = ev.sweep_flatten_from_batch(
+                            store0.cons, host_batch(), objects,
+                            return_bits=True, alias=store0.alias)
                     pending = ev.sweep_dispatch(flat)
                     break
                 except Exception as e:  # noqa: PERF203

@@ -94,6 +94,9 @@ def run_fleet(args) -> int:
         pack_chunks=cfg.pack_chunks,
         spill_root=args.snapshot_spill,
         spill_compress=args.snapshot_spill_compress,
+        spill_delta=args.snapshot_spill_delta,
+        spill_full_every=args.snapshot_spill_full_every,
+        residency=args.snapshot_residency,
         # per-library warm-state replay/save lives in the evaluator now
         # (FleetEvaluator._attach_warm): every runtime — including ones
         # born after boot — replays its persisted sweep traces from a

@@ -15,7 +15,11 @@ armed:
   joins vs the per-key transport reference, hostile keys included;
 - **snapshot** — the snapshot-sourced audit vs a fresh relist sweep
   each round (canonical verdict compare) + ``audit_resync()`` at the
-  end of the run.
+  end of the run;
+- **resident** — ``residency="on"`` promotes the snapshot lane's
+  columns to device-resident mirrors (single-device mesh), so the same
+  snapshot-vs-relist compare exercises HBM-resident gather +
+  scatter-patch ticks against the host reference under chaos churn.
 
 Any lane divergence, lost verdict at drain, or handler crash fails the
 run, and every failure record carries ``(seed, family)`` — ``python
@@ -162,11 +166,17 @@ class SoakHarness:
     lane armed.  Build is explicit (``start``); ``stop`` drains."""
 
     def __init__(self, bundles, keep_templates: int = 3,
-                 cache_dir: str = "", metrics=None):
+                 cache_dir: str = "", metrics=None,
+                 residency: str = "off"):
         self.bundles = bundles
         self.keep_templates = keep_templates
         self.cache_dir = cache_dir
         self.metrics = metrics
+        # "on" arms the device-resident snapshot lane on the snap-side
+        # manager: every round's snapshot-vs-relist compare then runs
+        # resident columns against the host reference under chaos
+        self.residency_mode = residency
+        self.residency = None
         self.divergences: list = []
         self.crashes: list = []
         self.sent = {"admit": 0, "mutate": 0}
@@ -251,12 +261,22 @@ class SoakHarness:
             "spec": {"crd": {"spec": {"names": {"kind": "K8sFuzzExtData"}}},
                      "targets": [{"target": TARGET, "rego": REGO_XD}]},
         })
-        docs.append({
+        xd_con = {
             "apiVersion": "constraints.gatekeeper.sh/v1beta1",
             "kind": "K8sFuzzExtData",
             "metadata": {"name": "fuzz-xd-errors"},
             "spec": {"match": {}, "parameters": {}},
-        })
+        }
+        if self.residency_mode != "off":
+            # extdata-join groups keep host columns by design, and the
+            # unscoped fuzz-xd constraint rides EVERY audit group — so
+            # arming the resident lane scopes it to the webhook EP,
+            # where its differential still fires on every /v1/admit
+            xd_con["spec"]["enforcementAction"] = "scoped"
+            xd_con["spec"]["scopedEnforcementActions"] = [
+                {"action": "deny",
+                 "enforcementPoints": [{"name": WEBHOOK_EP}]}]
+        docs.append(xd_con)
         # pathological selector constraints ride a sample constraint's
         # template + parameters, with the hostile match spec swapped in
         base_con = next((d for d in docs if reader.is_constraint(d)), None)
@@ -355,8 +375,12 @@ class SoakHarness:
         for b in self.bundles:
             for o in b.objects:
                 self.cluster.apply(copy.deepcopy(o))
+        # the resident lane is single-chip by design: arming it forces
+        # a one-device mesh so DeviceResidency actually promotes
+        mesh = (make_mesh(1) if self.residency_mode != "off"
+                else make_mesh())
         self.evaluator = ShardedEvaluator(
-            self.tpu, make_mesh(), violations_limit=20,
+            self.tpu, mesh, violations_limit=20,
             flatten_lane="differential", collect="differential",
             metrics=self.metrics)
         cfg = dict(exact_totals=False, chunk_size=64, pipeline="off")
@@ -364,11 +388,18 @@ class SoakHarness:
         def lister():
             return iter(self.cluster.list())
 
+        if self.residency_mode != "off":
+            from gatekeeper_tpu.snapshot import DeviceResidency
+
+            self.residency = DeviceResidency(
+                self.evaluator, mode=self.residency_mode,
+                metrics=self.metrics)
         self.snapshot = ClusterSnapshot(self.evaluator, SnapshotConfig())
         self.snap_mgr = AuditManager(
             self.client, lister=lister,
             config=AuditConfig(audit_source="snapshot", **cfg),
-            evaluator=self.evaluator, snapshot=self.snapshot)
+            evaluator=self.evaluator, snapshot=self.snapshot,
+            residency=self.residency)
         self.relist_mgr = AuditManager(
             self.client, lister=lister, config=AuditConfig(**cfg),
             evaluator=self.evaluator)
@@ -594,7 +625,8 @@ def run_soak(seed: int = 0, size: int = 1, families=None,
              chaos: bool = True, chaos_seed=None,
              keep_templates: int = 3, inject_bug=None,
              concurrent: bool = False, cache_dir: str = "",
-             metrics=None, quiet: bool = True) -> dict:
+             metrics=None, quiet: bool = True,
+             residency: str = "off") -> dict:
     """Run the soak; returns the report dict (``report["ok"]`` is the
     pass/fail).  ``duration_s`` > 0 loops rounds until the clock runs
     out; otherwise exactly ``rounds`` passes run.  Every failure path
@@ -616,7 +648,8 @@ def run_soak(seed: int = 0, size: int = 1, families=None,
     plan = (default_chaos_plan(seed if chaos_seed is None
                                else chaos_seed) if chaos else None)
     harness = SoakHarness(bundles, keep_templates=keep_templates,
-                          cache_dir=cache_dir, metrics=metrics)
+                          cache_dir=cache_dir, metrics=metrics,
+                          residency=residency)
     t0 = time.perf_counter()
     rounds_run = 0
     with tempfile.TemporaryDirectory(prefix="gtpu-soak-") as _tmp:
@@ -664,6 +697,11 @@ def run_soak(seed: int = 0, size: int = 1, families=None,
         "crashes": harness.crashes,
         "faults_fired": (_fault_counts(plan) if plan else {}),
         "extdata_transport_calls": harness.transport.calls,
+        "residency": residency,
+        "resident_uploads": (harness.residency.upload_count
+                             if harness.residency else 0),
+        "resident_patches": (harness.residency.patch_count
+                             if harness.residency else 0),
         "corpus": corpus_mod.corpus_stats(bundles),
         "wall_s": round(wall, 3),
     }

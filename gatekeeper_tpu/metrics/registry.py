@@ -469,6 +469,14 @@ SNAPSHOT_SPILL_SECONDS = "snapshot_spill_seconds"  # gauge
 SNAPSHOT_SPILL_BYTES = "snapshot_spill_bytes"  # gauge
 SNAPSHOT_SPILL_LOAD_HITS = "snapshot_spill_load_hits"
 SNAPSHOT_SPILL_LOAD_MISS = "snapshot_spill_load_miss_count"  # {reason}
+# device-resident snapshot lane (snapshot/device_residency.py): HBM
+# bytes held by resident column/mask mirrors, host->device bytes the
+# last audit tick actually shipped (a warm clean-rows resident tick
+# reads ZERO), and groups demoted back to host columns (generation
+# swaps, SLO `device_residency_evict` breaches)
+SNAPSHOT_RESIDENT_BYTES = "snapshot_resident_bytes"  # gauge
+TICK_H2D_BYTES = "tick_h2d_bytes"  # gauge {cluster}
+RESIDENCY_EVICTIONS = "residency_evictions_total"
 # batched mutation + expansion lane (gatekeeper_tpu/mutlane/): batched
 # lane passes, objects routed to the authoritative host walk {reason},
 # emitted RFC-6902 patch ops, and convergence iterations per applied

@@ -594,12 +594,23 @@ def per_cluster_objectives(cluster_ids: Sequence[str],
 
 
 def load_config(path: str, degradations=None) -> dict:
-    """{"objectives": [SLOObjective...], "tiers": [...] or None}.
+    """{"objectives": [SLOObjective...], "tiers": [...] or None,
+    "actions": [names registered]}.
 
     Fails fast with :class:`SLOConfigError` naming the line (malformed
     JSON) or the objective index + field (bad spec); degradation-map
     action names are validated against ``degradations`` (a
-    DegradationRegistry) when given."""
+    DegradationRegistry) when given.
+
+    A top-level ``"actions"`` list registers CUSTOM degradation actions
+    into ``degradations`` BEFORE the objective maps validate, so an
+    objective may name them: each entry is ``{"name": ...,
+    "description": ...}`` (description optional, unknown fields
+    rejected).  Config-registered actions have no built-in consumer —
+    they surface through ``degradation_active`` polls and the registry's
+    activate/release hooks, which is exactly what operator-side
+    consumers (and the built-in ``device_residency_evict`` poll in
+    snapshot/device_residency.py) key on."""
     import json
 
     try:
@@ -611,8 +622,32 @@ def load_config(path: str, degradations=None) -> dict:
             f"{e.msg}") from None
     specs = doc if isinstance(doc, list) else doc.get("objectives", [])
     tiers = None if isinstance(doc, list) else (doc.get("tiers") or None)
+    actions = [] if isinstance(doc, list) else (doc.get("actions") or [])
     if not isinstance(specs, list):
         raise SLOConfigError(f"{path}: 'objectives' must be a list")
+    if not isinstance(actions, list):
+        raise SLOConfigError(f"{path}: 'actions' must be a list")
+    registered: list = []
+    for i, a in enumerate(actions):
+        if not isinstance(a, dict):
+            raise SLOConfigError(
+                f"{path}: actions[{i}]: must be an object")
+        name = a.get("name")
+        if not name or not isinstance(name, str):
+            raise SLOConfigError(
+                f"{path}: actions[{i}]: missing or non-string 'name'")
+        desc = a.get("description", "")
+        if not isinstance(desc, str):
+            raise SLOConfigError(
+                f"{path}: actions[{i}]: 'description' must be a string")
+        unknown = set(a) - {"name", "description"}
+        if unknown:
+            raise SLOConfigError(
+                f"{path}: actions[{i}]: unknown field(s) "
+                f"{sorted(unknown)}")
+        if degradations is not None:
+            degradations.register(name, desc)
+        registered.append(name)
     objectives: list = []
     for i, spec in enumerate(specs):
         try:
@@ -643,4 +678,5 @@ def load_config(path: str, degradations=None) -> dict:
             except ValueError as e:
                 raise SLOConfigError(
                     f"{path}: objectives[{i}]: {e}") from None
-    return {"objectives": objectives, "tiers": tiers}
+    return {"objectives": objectives, "tiers": tiers,
+            "actions": registered}

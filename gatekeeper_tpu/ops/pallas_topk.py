@@ -108,3 +108,86 @@ def topk_violations_pallas(verdicts: jnp.ndarray, k: int):
     """Drop-in twin of parallel.sharded.topk_violations (no counts)."""
     idx, valid, _cnt = topk_violations_counts_pallas(verdicts, k)
     return idx, valid
+
+
+def _fused_fold_kernel(k: int, grid_ref, mask_ref, out_ref):
+    """mask -> violation totals -> first-k -> occupancy, one VMEM pass.
+
+    The resident-tick epilogue: the RAW verdict block and the match-mask
+    block meet here instead of materializing ``grid & mask`` as an XLA
+    intermediate — the masked grid, its row sum (violation totals), the
+    mask row sum (occupancy: in-scope rows per constraint, the
+    differential's device-vs-host-mirror invariant) and the first-k
+    selection all come from the same resident block.  Output row block:
+    lanes 0..k-1 indices, lane k count, lane k+1 occupancy."""
+    raw = grid_ref[:].astype(jnp.int32)    # [_ROWS, N]
+    msk = mask_ref[:].astype(jnp.int32)    # [_ROWS, N]
+    block = raw * msk
+    n = block.shape[1]
+    cnt = jnp.sum(block, axis=1, dtype=jnp.int32)  # [_ROWS]
+    occ = jnp.sum(msk, axis=1, dtype=jnp.int32)    # [_ROWS]
+    idxs = jax.lax.broadcasted_iota(jnp.int32, block.shape, 1)
+    cand = jnp.where(block != 0, idxs, n)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, _KPAD), 1)
+
+    def body(j, state):
+        cand, out = state
+        m = jnp.min(cand, axis=1)
+        out = jnp.where(lanes == j, m[:, None], out)
+        return jnp.where(cand == m[:, None], n, cand), out
+
+    out0 = jnp.full((_ROWS, _KPAD), n, jnp.int32)
+    _, out = jax.lax.fori_loop(0, k, body, (cand, out0))
+    out = jnp.where(lanes == k, cnt[:, None], out)
+    out = jnp.where(lanes == k + 1, occ[:, None], out)
+    out_ref[:] = out
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _fused_fold(grid: jnp.ndarray, mask: jnp.ndarray, k: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    c, n = grid.shape
+    c_pad = -(-c // _ROWS) * _ROWS
+    if c_pad != c:
+        grid = jnp.pad(grid, ((0, c_pad - c), (0, 0)))
+        mask = jnp.pad(mask, ((0, c_pad - c), (0, 0)))
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        functools.partial(_fused_fold_kernel, k),
+        grid=(c_pad // _ROWS,),
+        in_specs=[
+            pl.BlockSpec((_ROWS, n), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROWS, n), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_ROWS, _KPAD), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((c_pad, _KPAD), jnp.int32),
+        interpret=interpret,
+    )(grid, mask)
+    return out[:c, :k], out[:c, k], out[:c, k + 1]
+
+
+def fused_fold_pallas(grid_raw: jnp.ndarray, mask: jnp.ndarray, k: int):
+    """(idx [C,k] i32, valid [C,k] bool, counts [C] i32, occ [C] i32)
+    from the RAW (unmasked) verdict grid and the match mask in one
+    fused kernel.  Bit-identical to the XLA fold
+    (``topk_violations(grid & mask, k)`` + totals + ``mask.sum``);
+    tests/test_pallas_topk.py pins the equivalence in interpret mode,
+    and callers fall back to the XLA twin when ``k`` exceeds the output
+    tile's index+count+occupancy budget (k >= _KPAD - 1)."""
+    c, n = grid_raw.shape
+    k = min(k, n)
+    if k >= _KPAD - 1:
+        from gatekeeper_tpu.parallel.sharded import topk_violations
+
+        masked = grid_raw & mask
+        idx, valid = topk_violations(masked, k)
+        return (idx, valid, jnp.sum(masked, axis=1, dtype=jnp.int32),
+                jnp.sum(mask, axis=1, dtype=jnp.int32))
+    idx, cnt, occ = _fused_fold(grid_raw, mask, k)
+    valid = idx < n
+    return jnp.where(valid, idx, 0), valid, cnt, occ

@@ -628,6 +628,33 @@ class _FlatChunk:
         self.programs = programs
 
 
+class _ResidentChunk:
+    """A sweep chunk whose columns already LIVE on device — the
+    device-resident snapshot lane's twin of :class:`_FlatChunk`.  No
+    host batch, no host columns, no host masks: just the
+    :class:`ResidentGroup` (snapshot/device_residency.py) plus the row
+    positions to gather, so a clean-row dispatch ships only the gather
+    index vector (cached per chunk shape — a warm tick ships NOTHING)."""
+
+    __slots__ = ("rg", "by_kind", "kinds", "positions", "n", "pad_n",
+                 "return_bits", "source", "budget", "programs")
+
+    def __init__(self, rg, positions, n, pad_n, return_bits,
+                 budget=None, programs=None):
+        self.rg = rg
+        self.by_kind = rg.by_kind
+        self.kinds = rg.kinds
+        self.positions = tuple(positions)
+        self.n = n
+        self.pad_n = pad_n
+        self.return_bits = return_bits
+        # the snapshot lane always evaluates under the default source
+        # (audit relist semantics) — matches the host snapshot path
+        self.source = ""
+        self.budget = budget
+        self.programs = programs
+
+
 class ShardedEvaluator:
     """Runs a TpuDriver's compiled programs over a device mesh.
 
@@ -945,7 +972,7 @@ class ShardedEvaluator:
 
         if self.mesh.size == 1 and not complete:
             from gatekeeper_tpu.ops.pallas_topk import (
-                pallas_supported, topk_violations_counts_pallas)
+                fused_fold_pallas, pallas_supported)
 
             use_pallas = pallas_supported()
         else:
@@ -961,10 +988,19 @@ class ShardedEvaluator:
             mask = jnp.unpackbits(mask_bits, axis=1,
                                   count=pad_n).astype(jnp.bool_)
             grids = [b(t, cols) for b, t in zip(builders, tables)]
-            grid = jnp.concatenate(grids, axis=0) & mask
-            c_total = grid.shape[0]
-            counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
-            occ = jnp.sum(mask, axis=1, dtype=jnp.int32)
+            raw = jnp.concatenate(grids, axis=0)
+            c_total = raw.shape[0]
+            if use_pallas:
+                # Pallas fused fold: mask -> violation totals -> first-k
+                # -> occupancy in ONE VMEM pass over the raw grid (the
+                # masked grid never materializes as an XLA intermediate);
+                # the else-branch is the fallback + differential reference
+                idx, valid, counts, occ = fused_fold_pallas(raw, mask, k)
+                grid = None
+            else:
+                grid = raw & mask
+                counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+                occ = jnp.sum(mask, axis=1, dtype=jnp.int32)
             if pad_n <= 0xFFFF:
                 # counts and occupancy are both <= pad_n: one u16|u16
                 # word per constraint halves the per-chunk floor (the
@@ -987,10 +1023,7 @@ class ShardedEvaluator:
                 else:
                     hits = jnp.zeros((0,), jnp.int32)
             else:
-                if use_pallas:
-                    idx, valid, counts = topk_violations_counts_pallas(
-                        grid, k)
-                else:
+                if not use_pallas:
                     idx, valid = topk_violations(grid, k)
                 k_eff = idx.shape[1]
                 want = jnp.minimum(counts, budget)
@@ -1010,6 +1043,182 @@ class ShardedEvaluator:
                     hits = jnp.zeros((0,), jnp.int32)
             return jnp.concatenate(
                 head + [jnp.reshape(nsel, (1,)).astype(jnp.int32), hits])
+
+        fn = jax.jit(fused)
+        self._sweep_fns[key] = fn
+        return fn
+
+    def _gather_resident(self, idx, res_cols: dict, res_mask,
+                         cols_layout: tuple, pad_n: int):
+        """Device-side chunk materialization from the resident tall
+        buffers: gather the packed column rows and the mask columns by
+        ``idx`` (int32 [pad_n], -1 = pad slot).  Pad slots gather row 0
+        — always in-bounds, and their mask column is forced False, so
+        they contribute exactly what a host chunk's fill-padded rows
+        under a False mask contribute: nothing.  Gather commutes with
+        ``unpack_transfer_cols`` (both are row-wise), so the unpacked
+        columns are bit-identical to packing a host-gathered sliver."""
+        safe = jnp.maximum(idx, 0)
+        gathered = {dt: jnp.take(b, safe, axis=0)
+                    for dt, b in res_cols.items()}
+        cols = unpack_transfer_cols(gathered, cols_layout, pad_n)
+        mask = jnp.take(res_mask, safe, axis=1) & (idx >= 0)[None, :]
+        return cols, mask
+
+    def _sweep_fn_resident(self, kinds: tuple, k: int, return_bits: bool,
+                           cols_layout: tuple, tables_layout: tuple,
+                           pad_n: int, progs=None):
+        """Masks-lane twin of :meth:`_sweep_fn` over DEVICE-RESIDENT
+        columns: instead of a packed host chunk + bit-packed host mask,
+        the jitted program takes the resident tall buffers + tall mask
+        and a gather index vector — the only per-chunk H2D operand (and
+        it caches).  Epilogue identical to the host twin, so verdicts
+        are bit-identical by construction."""
+        progs = progs if progs is not None else self.driver._programs
+        uids = tuple(progs[kind].uid for kind in kinds)
+        key = ("resident", kinds, uids, k, return_bits, cols_layout,
+               tables_layout, pad_n)
+        fn = self._sweep_fns.get(key)
+        if fn is not None:
+            return fn
+        builders = [progs[kind]._build() for kind in kinds]
+        if self.mesh.size == 1:
+            from gatekeeper_tpu.ops.pallas_topk import (
+                pallas_supported, topk_violations_counts_pallas)
+
+            use_pallas = pallas_supported()
+        else:
+            use_pallas = False
+
+        def fused(tables_buf, idx, res_cols: dict, res_mask,
+                  table_cols: dict):
+            self.trace_count += 1  # runs at TRACE time only
+            cols, mask = self._gather_resident(idx, res_cols, res_mask,
+                                               cols_layout, pad_n)
+            cols.update(table_cols)
+            tables = unpack_flat_tables(tables_buf, tables_layout,
+                                        len(kinds))
+            grids = [b(t, cols) for b, t in zip(builders, tables)]
+            grid = jnp.concatenate(grids, axis=0) & mask
+            if use_pallas:
+                idx_k, valid, counts = topk_violations_counts_pallas(
+                    grid, k)
+            else:
+                idx_k, valid = topk_violations(grid, k)
+                counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+            packed = jnp.concatenate(
+                [idx_k, valid.astype(jnp.int32), counts[:, None]], axis=1)
+            if return_bits:
+                return packed, jnp.packbits(grid.astype(jnp.uint8),
+                                            axis=1)
+            return packed
+
+        fn = jax.jit(fused)
+        self._sweep_fns[key] = fn
+        return fn
+
+    def _sweep_fn_resident_reduced(self, kinds: tuple, k: int,
+                                   complete: bool, hit_cap: int,
+                                   cols_layout: tuple,
+                                   tables_layout: tuple, pad_n: int,
+                                   progs=None):
+        """Reduced-lane twin of :meth:`_sweep_fn_reduced` over resident
+        columns.  The COMPLETE variant (snapshot/exact-totals chunks —
+        the audit tick's shape) takes NO budget operand: the host twin
+        uploads an unused zeros budget every dispatch, and dropping it
+        here is what makes a warm clean-rows tick's H2D genuinely zero.
+        The non-complete variant routes the epilogue through the Pallas
+        fused fold (ops/pallas_topk.fused_fold_pallas) on single-chip
+        TPU meshes: mask -> totals -> first-k -> occupancy in one VMEM
+        pass over the raw grid."""
+        progs = progs if progs is not None else self.driver._programs
+        uids = tuple(progs[kind].uid for kind in kinds)
+        key = ("resident_reduced", kinds, uids, k, complete, hit_cap,
+               cols_layout, tables_layout, pad_n)
+        fn = self._sweep_fns.get(key)
+        if fn is not None:
+            return fn
+        builders = [progs[kind]._build() for kind in kinds]
+        if self.mesh.size == 1 and not complete:
+            from gatekeeper_tpu.ops.pallas_topk import (
+                fused_fold_pallas, pallas_supported)
+
+            use_pallas = pallas_supported()
+        else:
+            use_pallas = False
+
+        def epilogue(raw, mask, budget):
+            c_total = raw.shape[0]
+            sentinel = c_total * pad_n
+            if complete:
+                grid = raw & mask
+                counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+                occ = jnp.sum(mask, axis=1, dtype=jnp.int32)
+                nsel = jnp.sum(counts)
+                if hit_cap:
+                    (hits,) = jnp.nonzero(grid.reshape(-1), size=hit_cap,
+                                          fill_value=sentinel)
+                    hits = hits.astype(jnp.int32)
+                else:
+                    hits = jnp.zeros((0,), jnp.int32)
+            else:
+                if use_pallas:
+                    idx_k, valid, counts, occ = fused_fold_pallas(
+                        raw, mask, k)
+                else:
+                    grid = raw & mask
+                    counts = jnp.sum(grid, axis=1, dtype=jnp.int32)
+                    occ = jnp.sum(mask, axis=1, dtype=jnp.int32)
+                    idx_k, valid = topk_violations(grid, k)
+                k_eff = idx_k.shape[1]
+                want = jnp.minimum(counts, budget)
+                sel = valid & (jnp.arange(k_eff,
+                                          dtype=jnp.int32)[None, :]
+                               < want[:, None])
+                nsel = jnp.sum(sel, dtype=jnp.int32)
+                if hit_cap:
+                    (pos,) = jnp.nonzero(sel.reshape(-1), size=hit_cap,
+                                         fill_value=c_total * k_eff)
+                    safe = jnp.minimum(pos, c_total * k_eff - 1)
+                    oi = jnp.take(idx_k.reshape(-1), safe)
+                    hits = jnp.where(
+                        pos < c_total * k_eff,
+                        (pos // k_eff).astype(jnp.int32) * pad_n + oi,
+                        sentinel).astype(jnp.int32)
+                else:
+                    hits = jnp.zeros((0,), jnp.int32)
+            if pad_n <= 0xFFFF:
+                head = [jax.lax.bitcast_convert_type(
+                    counts.astype(jnp.uint32)
+                    | (occ.astype(jnp.uint32) << 16), jnp.int32)]
+            else:
+                head = [counts, occ]
+            return jnp.concatenate(
+                head + [jnp.reshape(nsel, (1,)).astype(jnp.int32), hits])
+
+        def grids_of(tables_buf, idx, res_cols, res_mask, table_cols):
+            self.trace_count += 1  # runs at TRACE time only
+            cols, mask = self._gather_resident(idx, res_cols, res_mask,
+                                               cols_layout, pad_n)
+            cols.update(table_cols)
+            tables = unpack_flat_tables(tables_buf, tables_layout,
+                                        len(kinds))
+            raw = jnp.concatenate(
+                [b(t, cols) for b, t in zip(builders, tables)], axis=0)
+            return raw, mask
+
+        if complete:
+            def fused(tables_buf, idx, res_cols: dict, res_mask,
+                      table_cols: dict):
+                raw, mask = grids_of(tables_buf, idx, res_cols, res_mask,
+                                     table_cols)
+                return epilogue(raw, mask, None)
+        else:
+            def fused(tables_buf, idx, res_cols: dict, res_mask,
+                      table_cols: dict, budget):
+                raw, mask = grids_of(tables_buf, idx, res_cols, res_mask,
+                                     table_cols)
+                return epilogue(raw, mask, budget)
 
         fn = jax.jit(fused)
         self._sweep_fns[key] = fn
@@ -1254,6 +1463,26 @@ class ShardedEvaluator:
                           objects, any_gen, n, batch.n, return_bits,
                           source=source, budget=budget, programs=programs)
 
+    def sweep_flatten_resident(self, rg, positions,
+                               return_bits: bool = False, budget=None):
+        """Stage-1 twin for DEVICE-RESIDENT snapshot rows: no flatten,
+        no host gather, no column pack — the chunk is just the resident
+        group + row positions.  Returns a :class:`_ResidentChunk` for
+        :meth:`sweep_dispatch`, or None when the resident mirror went
+        stale against the live generation (a swap landed between
+        ``prepare`` and here) — the caller falls back to the host
+        column path, which handles generations via _FlatChunk.programs."""
+        programs = self.driver._programs  # capture the generation once
+        if tuple(programs[k].uid for k in rg.kinds
+                 if k in programs) != rg.uids:
+            return None
+        n = len(positions)
+        if n == 0:
+            return {}
+        return _ResidentChunk(rg, positions, n, self._pad(n),
+                              return_bits, budget=budget,
+                              programs=programs)
+
     def sweep_flatten(self, constraints: Sequence, objects: Sequence[dict],
                       return_bits: bool = False, source: str = "",
                       budget=None):
@@ -1339,7 +1568,7 @@ class ShardedEvaluator:
         The collect lane is resolved here (``self.collect``): the
         differential lane dispatches the chunk through BOTH the reduced
         and the masks program so collect can assert them identical."""
-        if not isinstance(flat, _FlatChunk):
+        if not isinstance(flat, (_FlatChunk, _ResidentChunk)):
             return flat if isinstance(flat, dict) else {}
         from gatekeeper_tpu.observability import costattr, tracing
 
@@ -1383,6 +1612,12 @@ class ShardedEvaluator:
 
     def _sweep_dispatch_impl(self, flat, lane: str = "masks",
                              host_occ: bool = False):
+        if isinstance(flat, _ResidentChunk):
+            # the resident lane shares every downstream convention
+            # (lane resolution, differential pairing, the reduced
+            # collect's masks-lane overflow fallback re-enters here)
+            return self._dispatch_resident_impl(flat, lane=lane,
+                                                host_occ=host_occ)
         from gatekeeper_tpu.resilience.faults import fault_point
 
         fault_point("device.dispatch", lane="sweep", n=flat.n)
@@ -1571,6 +1806,146 @@ class ShardedEvaluator:
         pending = _PendingSweep(result, kinds, offsets, by_kind, n,
                                 return_bits, attr_weights=attr_weights,
                                 attr_rows=attr_rows, pad_n=pad_n)
+        pending.host_occ = host_occ_np
+        return pending
+
+    def _table_upload_bytes(self, table_cols: dict) -> int:
+        """Bytes ``shard_batch_arrays`` is ABOUT to upload given the
+        current content cache — the resident lane's honest H2D meter
+        (cache hits are free; a vocab bucket crossing pays once)."""
+        total = 0
+        for key, val in table_cols.items():
+            if key.startswith(("fn:", "st:", "inv:", "ext:")):
+                hit = self._table_dev_cache.get(key)
+                if hit is not None and (
+                        hit[0] is val
+                        or (hit[0].shape == val.shape
+                            and hit[0].dtype == val.dtype
+                            and np.array_equal(hit[0], val))):
+                    continue
+            total += val.nbytes
+        return total
+
+    def _dispatch_resident_impl(self, flat, lane: str = "masks",
+                                host_occ: bool = False):
+        """Resident twin of :meth:`_sweep_dispatch_impl`: no host masks
+        (they live in the resident mirror), no column wire pack, no
+        batch upload.  What still crosses the wire — and only on cache
+        miss — is the param-table pack (content-keyed LRU), vocab/
+        inventory tables (content cache), and the gather index vector
+        (per-position-tuple cache); every byte lands in
+        ``perf['resident_h2d_bytes']`` so the warm clean-tick zero is
+        measured, not asserted."""
+        from gatekeeper_tpu.resilience.faults import fault_point
+
+        fault_point("device.dispatch", lane="sweep_resident", n=flat.n)
+        self.dispatch_count += 1
+        rg = flat.rg
+        by_kind, kinds = flat.by_kind, flat.kinds
+        n, pad_n, return_bits = flat.n, flat.pad_n, flat.return_bits
+        progs = flat.programs if flat.programs is not None \
+            else self.driver._programs
+        k = self.violations_limit
+        h2d = 0
+        tables = []
+        offsets = {}
+        c_off = 0
+        for kind in kinds:
+            cons = by_kind[kind]
+            tables.append(build_param_table(progs[kind].program, cons,
+                                            self.driver.vocab))
+            offsets[kind] = (c_off, c_off + len(cons))
+            c_off += len(cons)
+        complete = bool(return_bits)
+        if lane == "reduced" and complete \
+                and self._hit_state_for(kinds, pad_n)["pinned"]:
+            lane = "masks"
+        host_occ_np = None
+        if host_occ:
+            # differential reference: the HOST mirror's per-constraint
+            # occupancy over these rows — asserting it against the
+            # device counts proves the resident mask never drifted
+            pos = np.asarray(flat.positions, np.intp)
+            host_occ_np = rg.mask_host[:, pos].sum(
+                axis=1, dtype=np.int64).astype(np.int32)
+        table_cols: dict = {}
+        for kind in kinds:
+            for tk, tv in vocab_tables(
+                    progs[kind].program, self.driver.vocab).items():
+                table_cols[tk] = tv
+            for tk, tv in self.driver.inventory_cols(
+                    kind, programs=progs)[0].items():
+                table_cols[tk] = tv
+        t0 = time.perf_counter()
+        tables_bufs, tables_layout = pack_flat_tables(tables)
+        pkey = (tables_layout,
+                tuple(sorted((dt, b.tobytes())
+                             for dt, b in tables_bufs.items())))
+        tables_bufs_dev = self._param_dev_cache.pop(pkey, None)
+        if tables_bufs_dev is None:
+            tables_bufs_dev = {
+                dt: jax.device_put(b, NamedSharding(self.mesh, P(None)))
+                for dt, b in tables_bufs.items()}
+            h2d += sum(b.nbytes for b in tables_bufs.values())
+        self._param_dev_cache[pkey] = tables_bufs_dev
+        while len(self._param_dev_cache) > 32:
+            self._param_dev_cache.pop(next(iter(self._param_dev_cache)))
+        h2d += self._table_upload_bytes(table_cols)
+        table_cols_dev = shard_batch_arrays(table_cols, self.mesh,
+                                            self._table_dev_cache)
+        idx_dev, idx_bytes = rg.chunk_idx(flat.positions, pad_n)
+        h2d += idx_bytes
+        cols_layout = rg.cols_layout
+        if lane == "reduced":
+            k_eff = min(k, pad_n)
+            if complete:
+                budget_np = None
+                st = self._hit_state_for(kinds, pad_n)
+                hit_cap = min(st["cap"], c_off * pad_n)
+            else:
+                if flat.budget is None:
+                    budget_np = np.full(c_off, k_eff, np.int32)
+                else:
+                    budget_np = np.fromiter(
+                        (min(k_eff, max(0, int(flat.budget(con))))
+                         for kind in kinds for con in by_kind[kind]),
+                        np.int32, count=c_off)
+                need = int(budget_np.sum())
+                blast = self._hit_state_for(kinds, pad_n)["blast"]
+                guess = need if blast is None else \
+                    min(need, max(_HIT_STEPS[1], 2 * blast))
+                hit_cap = hit_bucket(guess, c_off * k_eff)
+            fn = self._sweep_fn_resident_reduced(
+                kinds, k, complete, hit_cap, cols_layout, tables_layout,
+                pad_n, progs=progs)
+            if complete:
+                # NO budget operand: the warm clean tick's only inputs
+                # are already device-resident
+                result = fn(tables_bufs_dev, idx_dev, rg.cols_dev,
+                            rg.mask_dev, table_cols_dev)
+            else:
+                budget_dev = jax.device_put(
+                    budget_np, NamedSharding(self.mesh, P(None)))
+                h2d += budget_np.nbytes
+                result = fn(tables_bufs_dev, idx_dev, rg.cols_dev,
+                            rg.mask_dev, table_cols_dev, budget_dev)
+            self._perf_add("dispatch", time.perf_counter() - t0)
+            self._perf_add("resident_h2d_bytes", float(h2d))
+            pending = _PendingSweep(result, kinds, offsets, by_kind, n,
+                                    return_bits, lane="reduced",
+                                    pad_n=pad_n, hit_cap=hit_cap,
+                                    flat=flat)
+            pending.host_occ = host_occ_np
+            pending.budget_np = budget_np
+            return pending
+        fn = self._sweep_fn_resident(kinds, k, return_bits, cols_layout,
+                                     tables_layout, pad_n, progs=progs)
+        result = fn(tables_bufs_dev, idx_dev, rg.cols_dev, rg.mask_dev,
+                    table_cols_dev)
+        self._perf_add("dispatch", time.perf_counter() - t0)
+        self._perf_add("resident_h2d_bytes", float(h2d))
+        pending = _PendingSweep(result, kinds, offsets, by_kind, n,
+                                return_bits, pad_n=pad_n)
         pending.host_occ = host_occ_np
         return pending
 

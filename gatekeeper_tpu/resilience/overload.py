@@ -238,6 +238,7 @@ EXTDATA_STALE = "extdata_stale"
 SHED_HARDER = "shed_harder"
 AUDIT_YIELD_RELEASE = "audit_yield_release"
 RESYNC_DEFER = "resync_defer"
+DEVICE_RESIDENCY_EVICT = "device_residency_evict"
 
 BUILTIN_ACTIONS = {
     NS_CACHE_STALE:
@@ -250,6 +251,9 @@ BUILTIN_ACTIONS = {
         "stop yielding the device lane to admissions (audit catches up)",
     RESYNC_DEFER:
         "defer the audit's periodic full resync",
+    DEVICE_RESIDENCY_EVICT:
+        "demote device-resident snapshot groups back to host columns "
+        "(frees HBM; ticks re-pay the H2D wire until release)",
 }
 
 

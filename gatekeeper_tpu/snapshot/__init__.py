@@ -1,6 +1,10 @@
 """Watch-driven incremental audit: the resident columnar cluster
 snapshot (see :mod:`gatekeeper_tpu.snapshot.store` for the design)."""
 
+from gatekeeper_tpu.snapshot.device_residency import (  # noqa: F401
+    DeviceResidency,
+    ResidentGroup,
+)
 from gatekeeper_tpu.snapshot.ingest import WatchIngester, gvks_of  # noqa: F401
 from gatekeeper_tpu.snapshot.persist import (  # noqa: F401
     SnapshotSpill,

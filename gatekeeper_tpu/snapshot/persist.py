@@ -41,6 +41,7 @@ resync and at drain.
 
 from __future__ import annotations
 
+import glob
 import hashlib
 import json
 import os
@@ -126,7 +127,8 @@ class SnapshotSpill:
     """
 
     def __init__(self, root: str, metrics=None, compress: str = "none",
-                 cluster_id: str = ""):
+                 cluster_id: str = "", delta: bool = False,
+                 full_every: int = 8):
         if compress not in SPILL_CODECS:
             raise ValueError(
                 f"unknown spill codec {compress!r} (want one of "
@@ -136,12 +138,29 @@ class SnapshotSpill:
         self.metrics = metrics
         self.compress = compress
         self.cluster_id = cluster_id
+        # incremental spills (--snapshot-spill-delta): groups split into
+        # per-group section files and a spill rewrites ONLY the groups
+        # whose mutation mark moved since the last successful write —
+        # O(churn) disk instead of O(cluster).  Every ``full_every``-th
+        # spill (and the first, and any after a failure or delete) is a
+        # full rewrite that also prunes orphaned group files — the
+        # periodic compaction path.  delta=False keeps the inline
+        # single-section format byte-identical to PR 13/14.
+        self.delta = bool(delta)
+        self.full_every = max(1, int(full_every))
+        self._dlock = threading.Lock()
+        self._last_marks: dict = {}     # kinds-key -> mutations written
+        self._last_sections: dict = {}  # group file -> {"sha256","bytes"}
+        self._spills_since_full = 0
+        self._force_full = True
         self.load_hits = 0
         self.load_misses = 0
         self.miss_reasons: dict = {}
         self.spill_count = 0
         self.last_spill_s = 0.0
         self.last_spill_bytes = 0
+        self.delta_spills = 0       # spills that reused >= 1 group file
+        self.groups_skipped = 0     # group sections reused across spills
 
     # --- paths / accounting -------------------------------------------
     def _path(self, name: str) -> str:
@@ -173,12 +192,31 @@ class SnapshotSpill:
         self._count(False, reason)
         self.delete()
 
+    @staticmethod
+    def _group_file(kinds) -> str:
+        """Stable per-group section filename: the kinds-set IS the
+        group identity, so a group keeps one file across spills and a
+        delta rewrite replaces it in place (atomic ``os.replace``)."""
+        key = "|".join(kinds)
+        return ("snapshot.group-"
+                + hashlib.sha256(key.encode()).hexdigest()[:12] + ".pkl")
+
     def delete(self) -> None:
         for name in (HEADER,) + self._sections():
             try:
                 os.remove(self._path(name))
             except OSError:
                 pass
+        for p in glob.glob(self._path("snapshot.group-*.pkl")):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        # the next delta spill has nothing on disk to reuse
+        with self._dlock:
+            self._last_marks.clear()
+            self._last_sections.clear()
+            self._force_full = True
 
     @staticmethod
     def _versions() -> tuple:
@@ -198,8 +236,24 @@ class SnapshotSpill:
                 templates: str = "") -> dict:
         """Assemble the spill state.  Array copies happen inside
         ``snapshot.export_state`` under its lock; everything here is
-        cheap bookkeeping — pickling is :meth:`write`'s job."""
-        state = snapshot.export_state()
+        cheap bookkeeping — pickling is :meth:`write`'s job.
+
+        Delta mode: groups whose mutation mark still equals what the
+        last SUCCESSFUL write put on disk export a skipped stub and pay
+        zero array copies here too.  Marks only advance after a write
+        commits, so a stub can never reference bytes that aren't
+        durable."""
+        if self.delta:
+            known = None
+            with self._dlock:
+                if (not self._force_full and self._last_marks
+                        and self._spills_since_full + 1 < self.full_every):
+                    known = dict(self._last_marks)
+            state = snapshot.export_state(known_marks=known)
+        else:
+            # no kwarg off the delta path: snapshot doubles (and older
+            # exporters) need not know about delta marks
+            state = snapshot.export_state()
         vocab = snapshot.evaluator.driver.vocab
         ext = None
         if extdata_lane is not None:
@@ -229,12 +283,27 @@ class SnapshotSpill:
         with tracing.span("snapshot.spill", rows=state.get("rows", 0)):
             try:
                 jv, jlv = self._versions()
+                manifest: list = []
+                group_payloads: dict = {}
+                reused: dict = {}
+                any_skipped = False
+                rows_state = state
+                if self.delta:
+                    manifest, group_payloads, reused, any_skipped, err = \
+                        self._split_groups(state)
+                    if err is not None:
+                        return err
+                    rows_state = {k: v for k, v in state.items()
+                                  if k != "groups"}
+                    rows_state["group_files"] = manifest
                 payloads = {
-                    "snapshot.rows.pkl": pickle.dumps(state),
+                    "snapshot.rows.pkl": pickle.dumps(rows_state),
                     "snapshot.vocab.pkl": pickle.dumps(captured["vocab"]),
                     "snapshot.aux.pkl": pickle.dumps(
                         {"aux": captured.get("aux") or {},
                          "extdata": captured.get("extdata")}),
+                    **{name: pickle.dumps(gp)
+                       for name, gp in group_payloads.items()},
                 }
                 if self.compress == "zlib":
                     payloads = {name: zlib.compress(raw)
@@ -255,10 +324,16 @@ class SnapshotSpill:
                     "rows": state.get("rows", 0),
                     "rv": {_gvk_key(g): rv
                            for g, rv in captured["rvs"].items()},
+                    # skipped groups' on-disk sections are reused
+                    # verbatim: their recorded sha/bytes re-enter the
+                    # header so the loader validates every section the
+                    # same way, fresh or reused
                     "sections": {
-                        name: {"sha256": hashlib.sha256(raw).hexdigest(),
-                               "bytes": len(raw)}
-                        for name, raw in payloads.items()},
+                        **{name: {"sha256":
+                                  hashlib.sha256(raw).hexdigest(),
+                                  "bytes": len(raw)}
+                           for name, raw in payloads.items()},
+                        **reused},
                     "saved_at": time.time(),
                 }
                 for name, raw in payloads.items():
@@ -270,7 +345,15 @@ class SnapshotSpill:
                 with open(tmp, "w") as f:
                     json.dump(header, f)
                 os.replace(tmp, self._path(HEADER))
+                if self.delta:
+                    self._delta_commit(manifest, header["sections"],
+                                       reused, any_skipped)
             except Exception as e:
+                if self.delta:
+                    # on-disk group files may be torn relative to the
+                    # recorded marks: rebuild everything next spill
+                    with self._dlock:
+                        self._force_full = True
                 return {"ok": False, "error": str(e)}
         dt = time.perf_counter() - t0
         nbytes = sum(len(raw) for raw in payloads.values())
@@ -284,6 +367,70 @@ class SnapshotSpill:
             self.metrics.set_gauge(M.SNAPSHOT_SPILL_BYTES, nbytes)
         return {"ok": True, "seconds": dt, "bytes": nbytes,
                 "rows": state.get("rows", 0)}
+
+    def _split_groups(self, state: dict):
+        """Delta mode: map each exported group to its own section file.
+        Returns ``(manifest, payloads, reused, any_skipped, err)`` —
+        ``payloads`` holds groups captured fresh this round, ``reused``
+        the recorded header metadata for skipped stubs whose on-disk
+        section carries over unchanged."""
+        manifest: list = []
+        payloads: dict = {}
+        reused: dict = {}
+        any_skipped = False
+        for gp in state.get("groups") or []:
+            kinds = list(gp["kinds"])
+            fname = self._group_file(kinds)
+            manifest.append({"file": fname, "kinds": kinds,
+                             "mutations": int(gp.get("mutations", 0))})
+            if gp.get("skipped"):
+                any_skipped = True
+                with self._dlock:
+                    meta = self._last_sections.get(fname)
+                if meta is None \
+                        or not os.path.exists(self._path(fname)):
+                    # the stub references a section this dir does not
+                    # hold (failed/raced write, external delete): fail
+                    # closed, force the next spill full
+                    with self._dlock:
+                        self._force_full = True
+                    return None, None, None, False, {
+                        "ok": False,
+                        "error": f"delta stub without section {fname}"}
+                reused[fname] = dict(meta)
+            else:
+                payloads[fname] = gp
+        return manifest, payloads, reused, any_skipped, None
+
+    def _delta_commit(self, manifest, sections_meta, reused,
+                      any_skipped) -> None:
+        """Post-write bookkeeping for a committed delta-mode spill.
+        Marks and section metadata advance ONLY here, so a later
+        capture's stub can never outrun what is durably on disk.  A
+        spill that rewrote every group (the periodic full, or a fully
+        dirty delta) doubles as compaction: group files no longer in
+        the manifest are orphans of deleted groups and get pruned."""
+        group_meta = {m["file"]: sections_meta[m["file"]]
+                      for m in manifest}
+        full = not any_skipped
+        with self._dlock:
+            self._last_marks = {"|".join(m["kinds"]): m["mutations"]
+                                for m in manifest}
+            self._last_sections = group_meta
+            self._force_full = False
+            self._spills_since_full = \
+                0 if full else self._spills_since_full + 1
+        if any_skipped:
+            self.delta_spills += 1
+            self.groups_skipped += len(reused)
+        if full:
+            keep = set(group_meta)
+            for p in glob.glob(self._path("snapshot.group-*.pkl")):
+                if os.path.basename(p) not in keep:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
 
     def save(self, snapshot, rvs: Optional[dict] = None,
              extdata_lane=None, aux: Optional[dict] = None,
@@ -379,6 +526,18 @@ class SnapshotSpill:
         if state is None or vocab_snap is None:
             self._reject(MISS_CORRUPT)
             return None
+        if "group_files" in state:
+            # delta layout: rows.pkl carries a manifest; the group
+            # payloads live in their own (already sha-validated)
+            # sections.  Reassemble the classic state shape so
+            # adopt_spill is layout-agnostic.
+            try:
+                state = dict(state)
+                state["groups"] = [sections[gf["file"]]
+                                   for gf in state["group_files"]]
+            except (KeyError, TypeError):
+                self._reject(MISS_CORRUPT)
+                return None
         # constraint-set currency: the spilled digest must equal the
         # digest of the LIVE constraint set (spec + lowered kinds) — a
         # changed set means the verdicts/grouping no longer apply
@@ -434,7 +593,9 @@ class SnapshotSpill:
                 "miss_reasons": dict(self.miss_reasons),
                 "spills": self.spill_count,
                 "last_spill_s": self.last_spill_s,
-                "last_spill_bytes": self.last_spill_bytes}
+                "last_spill_bytes": self.last_spill_bytes,
+                "delta_spills": self.delta_spills,
+                "groups_skipped": self.groups_skipped}
 
 
 class SnapshotSpiller:
@@ -443,8 +604,11 @@ class SnapshotSpiller:
     ``spill()`` captures the state under the snapshot lock (array
     copies only) and enqueues it; a daemon worker pickles + writes.
     Coalescing: a request arriving while one is queued replaces it (the
-    newest capture wins — spills are full-state, not deltas).  ``wait``
-    blocks for the write (drain flush, benches)."""
+    newest capture wins — a capture is always a complete, loadable
+    description of the state: even delta-mode stubs name the durable
+    sections they reuse, and marks only advance after a write commits,
+    so dropping the older capture loses nothing).  ``wait`` blocks for
+    the write (drain flush, benches)."""
 
     def __init__(self, spill: SnapshotSpill, snapshot,
                  rvs_fn=None, extdata_lane=None, aux_fn=None,

@@ -292,6 +292,17 @@ class GroupStore:
         self.objrefs: list = []  # per slot: bytes | dict | None (tomb)
         self.gids: list = []  # per slot: global row id
         self.live: list = []  # per slot: bool
+        # device-residency + delta-spill bookkeeping: ``mutations`` is a
+        # monotonic mark (any write/tombstone/compact/import bumps it —
+        # delta spills skip groups whose mark hasn't moved),
+        # ``layout_version`` bumps when array SHAPES change (growth,
+        # ragged widening, compaction, import — a device mirror must
+        # full-re-upload, scatter offsets no longer line up), and
+        # ``patched`` holds positions dirtied since the device mirror
+        # last synced (the scatter sliver; residency clears it)
+        self.mutations = 0
+        self.layout_version = 0
+        self.patched: set = set()
 
     # --- row access ---------------------------------------------------
     @property
@@ -344,6 +355,7 @@ class GroupStore:
             _set_arr(self.batch, path, new)
         self.cap = new_cap
         self.batch.n = new_cap
+        self.layout_version += 1
 
     def _init_base(self, local: ColumnBatch, need: int) -> None:
         cap = 64
@@ -358,6 +370,7 @@ class GroupStore:
                                          arr.dtype))
         self.batch = base
         self.cap = cap
+        self.layout_version += 1
 
     def _write_rows(self, local: ColumnBatch, positions: Sequence[int],
                     k: int) -> None:
@@ -380,6 +393,7 @@ class GroupStore:
                     wider[region] = base_arr
                     _set_arr(self.batch, path, wider)
                     base_arr = wider
+                    self.layout_version += 1
             base_arr[idx] = fill  # reset the full row (old wide values)
             region = (idx,) + tuple(slice(0, s) for s in arr.shape[1:])
             base_arr[region] = arr[:k]
@@ -425,6 +439,8 @@ class GroupStore:
             positions.append(pos)
         if self.flattener is not None:
             self._write_rows(local, positions, len(entries))
+        self.mutations += 1
+        self.patched.update(positions)
         return positions
 
     def tombstone(self, pos: int) -> None:
@@ -433,6 +449,8 @@ class GroupStore:
         self.live[pos] = False
         self.objrefs[pos] = None
         self.tombstones += 1
+        self.mutations += 1
+        self.patched.add(pos)
 
     def needs_compaction(self, cfg: SnapshotConfig) -> bool:
         return (self.n_rows >= cfg.compact_min_rows
@@ -460,6 +478,9 @@ class GroupStore:
         self.live = [True] * k
         self.n_rows = k
         self.tombstones = 0
+        self.mutations += 1
+        self.layout_version += 1  # positions moved: scatter can't patch
+        self.patched.clear()
         return {self.gids[i]: i for i in range(k)}
 
     # --- reads (the sweep lane) ---------------------------------------
@@ -522,6 +543,7 @@ class GroupStore:
             "live": list(self.live),
             "objrefs": refs,
             "arrays": arrays,
+            "mutations": self.mutations,
         }
 
     def import_rows(self, payload: dict) -> None:
@@ -550,6 +572,11 @@ class GroupStore:
         self.live = list(payload["live"])
         self.objrefs = list(payload["objrefs"])
         self.tombstones = sum(1 for alive in self.live if not alive)
+        # resume the spiller's mutation clock so the first post-boot
+        # delta spill still skips groups that haven't moved since
+        self.mutations = int(payload.get("mutations", 0)) + 1
+        self.layout_version += 1
+        self.patched.clear()
 
 
 def concat_group_rows(parts: Sequence[tuple], pad_n: int) -> ColumnBatch:
@@ -966,20 +993,34 @@ class ClusterSnapshot:
             return self.live_count()
 
     # --- spill export / adopt (snapshot/persist.py) ----------------------
-    def export_state(self) -> dict:
+    def export_state(self, known_marks: Optional[dict] = None) -> dict:
         """Capture the complete resident state for a disk spill, under
         the lock: group arrays (trimmed copies), identity map, verdicts,
         dirty set, constraint digest.  The capture copies every array
         (memcpy-fast) so the caller can pickle + write OFF the audit
-        thread without holding the lock."""
+        thread without holding the lock.
+
+        ``known_marks`` (delta spills) maps a group's kinds-key
+        (``"|".join(sorted(kinds))``) to the mutation mark the spiller
+        last wrote; groups whose mark hasn't moved export a SKIPPED stub
+        (no array copies) and the spiller reuses the on-disk section."""
         with self.lock:
+            groups = []
+            for store in self._groups.values():
+                key = "|".join(sorted(store.group))
+                if known_marks is not None \
+                        and known_marks.get(key) == store.mutations:
+                    groups.append({"kinds": sorted(store.group),
+                                   "mutations": store.mutations,
+                                   "skipped": True})
+                else:
+                    groups.append(store.export_rows())
             return {
                 "digest": self._digest,
                 "ids": self.ids.export_state(),
                 "dirty": sorted(self._dirty),
                 "verdicts": self.verdicts.export_state(),
-                "groups": [store.export_rows()
-                           for store in self._groups.values()],
+                "groups": groups,
                 "rows": self.live_count(),
             }
 

@@ -1,0 +1,275 @@
+"""Device-resident snapshot columns: the HBM-as-cluster-cache lane.
+
+THE differential: an AuditManager ticking through the resident lane
+(mode "on" — promoted even on the CPU host, where the device buffers
+are just committed arrays) must be verdict-bit-identical to the
+host-column reference manager across
+
+1. the clean full tick (one upload, then index-gather-only dispatch);
+2. the dirty-sliver tick (watch churn lands as device scatter-patch);
+3. the post-evict tick (the ``device_residency_evict`` degradation
+   demotes to host columns mid-flight, release re-promotes lazily);
+
+plus the zero-H2D pin — a warm clean-rows tick reports
+``tick_h2d_bytes == 0`` — the mask-mirror differential, and the
+eviction/generation seams.
+
+Wall-budget note: one module corpus (6-template slice, 100 objects)
+behind a module-scoped compile cache dir, same shape as
+test_snapshot_persist.py.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.apis.constraints import AUDIT_EP
+from gatekeeper_tpu.audit.manager import AuditConfig, AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.drivers.cel_driver import CELDriver
+from gatekeeper_tpu.drivers.generation import CompileCache
+from gatekeeper_tpu.drivers.tpu_driver import TpuDriver
+from gatekeeper_tpu.parallel.sharded import ShardedEvaluator, make_mesh
+from gatekeeper_tpu.resilience.overload import (DEVICE_RESIDENCY_EVICT,
+                                                DegradationRegistry,
+                                                activate_degradations)
+from gatekeeper_tpu.snapshot import (ClusterSnapshot, DeviceResidency,
+                                     SnapshotConfig, WatchIngester,
+                                     gvks_of)
+from gatekeeper_tpu.sync.source import FakeCluster
+from gatekeeper_tpu.target.target import K8sValidationTarget
+from gatekeeper_tpu.utils.synthetic import (library_dir, load_library,
+                                            make_cluster_objects)
+from gatekeeper_tpu.utils.unstructured import load_yaml_file
+
+_KEEP = 6  # template-subset client: bounded compile wall (tier-1)
+
+
+def _all_kinds():
+    paths = sorted(
+        glob.glob(os.path.join(library_dir(), "general", "*",
+                               "template.yaml")) +
+        glob.glob(os.path.join(library_dir(), "pod-security-policy", "*",
+                               "template.yaml")))
+    return [load_yaml_file(p)[0]["spec"]["crd"]["spec"]["names"]["kind"]
+            for p in paths]
+
+
+def _snap_manager(client, evaluator, lister, snapshot, residency=None):
+    return AuditManager(
+        client, lister=lister,
+        config=AuditConfig(audit_source="snapshot", chunk_size=48,
+                           exact_totals=False, pipeline="off"),
+        evaluator=evaluator, snapshot=snapshot, residency=residency)
+
+
+def _assert_identical(run_a, run_b, limit=20):
+    diff = AuditManager._verdicts_differ_canonical(
+        run_a.kept, run_a.total_violations,
+        run_b.kept, run_b.total_violations, limit)
+    assert diff is None, diff
+
+
+def _churn_labels(cluster, objects, tag, idx):
+    for j in idx:
+        o = copy.deepcopy(objects[j])
+        o.setdefault("metadata", {}).setdefault("labels", {})["churn"] = \
+            tag
+        cluster.apply(o)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    cache_dir = tmp_path_factory.mktemp("resid-cache")
+    skip = tuple(_all_kinds()[_KEEP:])
+    cel = CELDriver()
+    tpu = TpuDriver(cel_driver=cel,
+                    compile_cache=CompileCache(str(cache_dir)))
+    client = Client(target=K8sValidationTarget(), drivers=[tpu, cel],
+                    enforcement_points=[AUDIT_EP])
+    load_library(client, skip_kinds=skip)
+    objects = make_cluster_objects(100, seed=7)
+    cluster = FakeCluster()
+    for o in objects:
+        cluster.apply(copy.deepcopy(o))
+    # single-device mesh: the resident lane is single-chip by design
+    # (conftest forces 8 host devices for the multichip tests)
+    evaluator = ShardedEvaluator(tpu, make_mesh(1), violations_limit=20)
+
+    def lister():
+        return iter(cluster.list())
+
+    ctx = {"client": client, "tpu": tpu, "objects": objects,
+           "cluster": cluster, "lister": lister, "evaluator": evaluator}
+    yield ctx
+
+
+def _paired_managers(corpus, residency):
+    """Two snapshots over the same cluster: one resident, one host."""
+    ev = corpus["evaluator"]
+    snap_r = ClusterSnapshot(ev, SnapshotConfig())
+    snap_h = ClusterSnapshot(ev, SnapshotConfig())
+    mgr_r = _snap_manager(corpus["client"], ev, corpus["lister"], snap_r,
+                          residency=residency)
+    mgr_h = _snap_manager(corpus["client"], ev, corpus["lister"], snap_h)
+    ing_r = WatchIngester(snap_r, corpus["cluster"],
+                          gvks_of(corpus["cluster"].list())).start()
+    ing_h = WatchIngester(snap_h, corpus["cluster"],
+                          gvks_of(corpus["cluster"].list())).start()
+    return snap_r, snap_h, mgr_r, mgr_h, ing_r, ing_h
+
+
+# --- 1-3. THE differential: clean / dirty-sliver / post-evict ticks ---------
+
+def test_resident_tick_differential_clean_dirty_evict(corpus):
+    residency = DeviceResidency(corpus["evaluator"], mode="on")
+    snap_r, snap_h, mgr_r, mgr_h, ing_r, ing_h = \
+        _paired_managers(corpus, residency)
+    try:
+        # full rebuild both lanes (the resident lane's first upload)
+        run_r = mgr_r.audit()
+        run_h = mgr_h.audit()
+        _assert_identical(run_r, run_h)
+        assert residency.upload_count >= 1
+        assert residency.resident_bytes() > 0
+
+        # clean tick: nothing changed — dispatch is gather-index only,
+        # and the SECOND clean tick's indices are cached: zero H2D
+        tick_r0 = mgr_r.audit_tick()
+        _assert_identical(tick_r0, mgr_h.audit_tick())
+        tick_r1 = mgr_r.audit_tick()
+        _assert_identical(tick_r1, mgr_h.audit_tick())
+        assert mgr_r.perf["tick_h2d_bytes"] == 0, \
+            "warm clean-rows resident tick uploaded bytes"
+
+        # dirty-sliver tick: churn a handful of rows; the resident lane
+        # scatter-patches exactly those and stays bit-identical
+        patches0 = residency.patch_count
+        _churn_labels(corpus["cluster"], corpus["objects"], "r1",
+                      range(7))
+        ing_r.pump()
+        ing_h.pump()
+        tick_r2 = mgr_r.audit_tick()
+        tick_h2 = mgr_h.audit_tick()
+        _assert_identical(tick_r2, tick_h2)
+        assert residency.patch_count > patches0
+        assert mgr_r.perf["tick_h2d_bytes"] > 0  # the sliver's bytes
+
+        # a delete lands as a False mask column, not a re-upload
+        gone = copy.deepcopy(corpus["objects"][3])
+        corpus["cluster"].delete(gone)
+        ing_r.pump()
+        ing_h.pump()
+        _assert_identical(mgr_r.audit_tick(), mgr_h.audit_tick())
+
+        # post-evict tick: the SLO degradation demotes to host columns
+        # (still bit-identical), release re-promotes lazily
+        reg = DegradationRegistry()
+        with activate_degradations(reg):
+            reg.activate(DEVICE_RESIDENCY_EVICT, "test-objective")
+            assert not residency.available()
+            assert residency.evictions >= 1
+            assert residency.resident_bytes() == 0
+            _assert_identical(mgr_r.audit_tick(), mgr_h.audit_tick())
+            reg.release(DEVICE_RESIDENCY_EVICT, "test-objective")
+            uploads0 = residency.upload_count
+            # re-promotion is lazy: the next tick that actually sweeps
+            # a group re-uploads its mirror
+            _churn_labels(corpus["cluster"], corpus["objects"], "r2",
+                          range(2))
+            ing_r.pump()
+            ing_h.pump()
+            _assert_identical(mgr_r.audit_tick(), mgr_h.audit_tick())
+            assert residency.upload_count > uploads0  # re-promoted
+    finally:
+        ing_r.stop()
+        ing_h.stop()
+
+
+# --- 4. mask-mirror differential -------------------------------------------
+
+def test_resident_mask_mirror_matches_host_masks(corpus):
+    """The device mask's host mirror equals the masks the host dispatch
+    path would compute per (constraint, row) — per-object purity is the
+    scatter-patch lane's correctness argument."""
+    from gatekeeper_tpu.ir import masks as masks_mod
+
+    ev = corpus["evaluator"]
+    residency = DeviceResidency(ev, mode="on")
+    snap = ClusterSnapshot(ev, SnapshotConfig())
+    mgr = _snap_manager(corpus["client"], ev, corpus["lister"], snap,
+                        residency=residency)
+    mgr.audit()
+    assert residency._groups, "no group promoted"
+    checked = 0
+    for store in snap._groups.values():
+        rg = residency.prepare(store)
+        if rg is None:
+            continue
+        live = store.live_positions()
+        batch = store.slice_rows(live, len(live))
+        objs = [store.row_obj(p) for p in live]
+        any_gen = any("generateName" in (o.get("metadata") or {})
+                      for o in objs)
+        ref_rows = [masks_mod.constraint_masks(
+            rg.by_kind[kind], batch, ev.driver.vocab, objs,
+            any_generate_name=any_gen) for kind in rg.kinds]
+        ref = np.concatenate(ref_rows, axis=0)[:, : len(objs)]
+        np.testing.assert_array_equal(rg.mask_host[:, live], ref)
+        # device mirror == host mirror (committed arrays on CPU)
+        np.testing.assert_array_equal(np.asarray(rg.mask_dev),
+                                      rg.mask_host)
+        # dead/pad columns are all-False
+        dead = [p for p in range(store.cap) if p not in set(live)]
+        assert not rg.mask_host[:, dead].any()
+        checked += 1
+    assert checked > 0
+
+
+# --- 5. seams: auto-fallback, off mode, swap invalidation -------------------
+
+def test_residency_auto_mode_declines_on_cpu_host(corpus):
+    import jax
+
+    residency = DeviceResidency(corpus["evaluator"], mode="auto")
+    if jax.default_backend() == "cpu":
+        assert not residency.available()
+        snap = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+        mgr = _snap_manager(corpus["client"], corpus["evaluator"],
+                            corpus["lister"], snap, residency=residency)
+        mgr.audit()  # serves fine through the host path
+        assert residency.upload_count == 0
+    else:  # accelerator host: auto promotes
+        assert residency.available()
+
+
+def test_residency_off_mode_and_bad_mode(corpus):
+    assert not DeviceResidency(corpus["evaluator"],
+                               mode="off").available()
+    with pytest.raises(ValueError):
+        DeviceResidency(corpus["evaluator"], mode="bogus")
+
+
+def test_generation_coordinator_invalidates_residency():
+    from gatekeeper_tpu.drivers.generation import GenerationCoordinator
+
+    class _Res:
+        def __init__(self):
+            self.calls = 0
+
+        def invalidate(self):
+            self.calls += 1
+
+    import threading
+
+    gc = GenerationCoordinator.__new__(GenerationCoordinator)
+    gc._lock = threading.RLock()
+    gc._residencies = []
+    res = _Res()
+    gc.attach_residency(res)
+    assert gc._residencies == [res]

@@ -6,7 +6,8 @@ mode — same kernel logic, plain-JAX execution."""
 import numpy as np
 import jax.numpy as jnp
 
-from gatekeeper_tpu.ops.pallas_topk import (topk_violations_counts_pallas,
+from gatekeeper_tpu.ops.pallas_topk import (fused_fold_pallas,
+                                            topk_violations_counts_pallas,
                                             topk_violations_pallas)
 from gatekeeper_tpu.parallel.sharded import topk_violations
 
@@ -52,6 +53,54 @@ def test_row_padding_to_sublane_tile():
     for c in (1, 7, 8, 9, 46):
         v = rng.random((c, 512)) < 0.05
         _agree(v, 20)
+
+
+def _fold_agree(grid_raw: np.ndarray, mask: np.ndarray, k: int):
+    """fused_fold_pallas == XLA reference fold, bit for bit: top-k of
+    the masked grid, masked row sums (violation totals), mask row sums
+    (occupancy — the resident lane's device-vs-host mirror invariant)."""
+    g, m = jnp.asarray(grid_raw), jnp.asarray(mask)
+    masked = grid_raw & mask
+    xi, xv = topk_violations(jnp.asarray(masked), min(k, masked.shape[1]))
+    pi, pv, pc, po = fused_fold_pallas(g, m, k)
+    xi, xv = np.asarray(xi), np.asarray(xv)
+    pi, pv = np.asarray(pi), np.asarray(pv)
+    assert np.array_equal(xv, pv), "valid masks differ"
+    assert np.array_equal(np.where(xv, xi, -1), np.where(pv, pi, -1)), \
+        "selected indices differ under the valid mask"
+    assert np.array_equal(np.asarray(pc), masked.sum(axis=1))
+    assert np.array_equal(np.asarray(po), mask.sum(axis=1))
+
+
+def test_fused_fold_matches_xla_fold():
+    rng = np.random.default_rng(4)
+    grid = rng.random((46, 4096)) < 0.02   # raw verdicts (pre-mask)
+    mask = rng.random((46, 4096)) < 0.7    # scope mask
+    grid[5] = True                          # full row
+    mask[9] = False                         # fully out-of-scope row
+    grid[13] = False                        # clean row
+    mask[21, :7] = True                     # sliver-scoped row
+    _fold_agree(grid, mask, 20)
+
+
+def test_fused_fold_shape_classes_and_k_edges():
+    rng = np.random.default_rng(5)
+    for c in (1, 7, 8, 46):
+        grid = rng.random((c, 512)) < 0.1
+        mask = rng.random((c, 512)) < 0.5
+        _fold_agree(grid, mask, 20)
+    grid = rng.random((4, 64)) < 0.3
+    mask = rng.random((4, 64)) < 0.5
+    _fold_agree(grid, mask, 64)    # k == n
+    _fold_agree(grid, mask, 200)   # k > n: clamped
+
+
+def test_fused_fold_k_beyond_lane_tile_falls_back():
+    rng = np.random.default_rng(6)
+    grid = rng.random((4, 512)) < 0.2
+    mask = rng.random((4, 512)) < 0.6
+    _fold_agree(grid, mask, 127)   # k == _KPAD - 1: XLA fallback
+    _fold_agree(grid, mask, 300)
 
 
 def test_first_k_are_lowest_indices():

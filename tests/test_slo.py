@@ -192,3 +192,67 @@ def test_load_config(tmp_path):
     cfg2 = slo.load_config(str(p2))
     assert [o.name for o in cfg2["objectives"]] == ["o2"]
     assert cfg2["tiers"] is None
+
+
+def test_load_config_registers_custom_actions(tmp_path):
+    """A top-level "actions" list registers custom degradation actions
+    BEFORE objective maps validate, so an objective may name one."""
+    import json
+
+    p = tmp_path / "slo_actions.json"
+    p.write_text(json.dumps({
+        "actions": [
+            {"name": "drain_extdata_pool",
+             "description": "park the external-data worker pool"},
+            {"name": "quiesce_gator"},
+        ],
+        "objectives": [{
+            "name": "o-act", "type": "latency", "metric": "x_seconds",
+            "threshold": 1.0,
+            "degradation": ["drain_extdata_pool",
+                            ovl.DEVICE_RESIDENCY_EVICT],
+        }],
+    }))
+    reg = ovl.DegradationRegistry()
+    cfg = slo.load_config(str(p), degradations=reg)
+    assert cfg["actions"] == ["drain_extdata_pool", "quiesce_gator"]
+    assert {"drain_extdata_pool", "quiesce_gator",
+            ovl.DEVICE_RESIDENCY_EVICT} <= reg.known()
+    # registered actions behave like builtins: activate/poll/release
+    assert reg.activate("drain_extdata_pool", "o-act")
+    assert "drain_extdata_pool" in reg.active_names()
+    reg.release("drain_extdata_pool", "o-act")
+    # without a registry the list still parses (names returned, inert)
+    assert slo.load_config(str(p))["actions"] == [
+        "drain_extdata_pool", "quiesce_gator"]
+
+
+def test_load_config_rejects_malformed_actions(tmp_path):
+    """Malformed action entries fail CLOSED with the actions[i] path —
+    the boot-time contract of --slo-config."""
+    import json
+
+    cases = [
+        ({"actions": "nope"}, "'actions' must be a list"),
+        ({"actions": ["bare-string"]}, "actions[0]"),
+        ({"actions": [{"description": "no name"}]}, "actions[0]"),
+        ({"actions": [{"name": ""}]}, "actions[0]"),
+        ({"actions": [{"name": "ok"}, {"name": "x", "desc": "typo"}]},
+         "actions[1]"),
+        ({"actions": [{"name": "x", "description": 7}]}, "actions[0]"),
+    ]
+    for i, (doc, needle) in enumerate(cases):
+        p = tmp_path / f"bad_{i}.json"
+        p.write_text(json.dumps({"objectives": [], **doc}))
+        with pytest.raises(slo.SLOConfigError) as ei:
+            slo.load_config(str(p), degradations=ovl.DegradationRegistry())
+        assert needle in str(ei.value), (doc, str(ei.value))
+    # an objective naming an UNREGISTERED action still fails validation
+    p = tmp_path / "bad_map.json"
+    p.write_text(json.dumps({
+        "objectives": [{"name": "o", "type": "latency",
+                        "metric": "x_seconds",
+                        "degradation": ["never_registered"]}]}))
+    with pytest.raises(slo.SLOConfigError) as ei:
+        slo.load_config(str(p), degradations=ovl.DegradationRegistry())
+    assert "never_registered" in str(ei.value)

@@ -313,6 +313,126 @@ def test_stale_spill_reconciles_through_replay_diff(corpus):
         ing.stop()
 
 
+# --- 2b. delta spills: per-group sections, only-dirty rewrites --------------
+# (placed after the pristine-spill loads above: these churn the module
+# cluster, interning fresh strings; gate-order misses in section 3 fire
+# before the vocab check, so they stay unaffected)
+
+
+def _group_marks(snapshot):
+    return {"|".join(sorted(st.group)): st.mutations
+            for st in snapshot._groups.values()}
+
+
+def test_delta_spill_reuses_clean_groups_and_roundtrips(corpus, tmp_path):
+    snap, cluster = corpus["snapshot"], corpus["cluster"]
+    d = str(tmp_path / "delta")
+    spill = SnapshotSpill(d, delta=True, full_every=100)
+    w0 = spill.save(snap, templates=corpus["tdig"])
+    assert w0["ok"]
+    gfiles = sorted(glob.glob(os.path.join(d, "snapshot.group-*.pkl")))
+    assert len(gfiles) == len(snap._groups)  # first spill is full
+    assert spill.groups_skipped == 0
+    bytes0 = {p: open(p, "rb").read() for p in gfiles}
+
+    # no churn: the second spill reuses EVERY group section, and the
+    # written payload collapses to the slim manifest + vocab + aux
+    w1 = spill.save(snap, templates=corpus["tdig"])
+    assert w1["ok"] and w1["bytes"] < w0["bytes"]
+    assert spill.delta_spills == 1
+    assert spill.groups_skipped == len(gfiles)
+    for p in gfiles:
+        assert open(p, "rb").read() == bytes0[p]  # untouched on disk
+
+    # churn a few rows: ONLY the stores whose mutation mark moved
+    # rewrite their section
+    marks0 = _group_marks(snap)
+    _churn_labels(cluster, corpus["objects"], "r1", n=6)
+    corpus["ingester"].pump()
+    corpus["mgr"].audit_tick()
+    marks1 = _group_marks(snap)
+    dirty = {k for k, m in marks1.items() if marks0.get(k) != m}
+    assert dirty and len(dirty) < len(marks1)
+    skipped0 = spill.groups_skipped
+    w2 = spill.save(snap, templates=corpus["tdig"])
+    assert w2["ok"]
+    assert spill.groups_skipped - skipped0 == len(marks1) - len(dirty)
+
+    # round-trip: a fresh snapshot adopts the reassembled groups and
+    # proves out row by row against a fresh relist
+    snap2 = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    out = spill.load(snap2, corpus["cons"], templates=corpus["tdig"])
+    assert out is not None and out["rows"] == snap.live_count()
+    assert dict(snap2.ids._ids) == dict(snap.ids._ids)
+    assert snap2.resync_differential(
+        lambda: iter(cluster.list())) is None
+
+
+def test_delta_spill_full_every_rewrite_prunes_orphans(corpus, tmp_path):
+    snap = corpus["snapshot"]
+    d = str(tmp_path / "delta-full")
+    spill = SnapshotSpill(d, delta=True, full_every=2)
+    assert spill.save(snap, templates=corpus["tdig"])["ok"]  # full
+    n = len(glob.glob(os.path.join(d, "snapshot.group-*.pkl")))
+    assert spill.save(snap, templates=corpus["tdig"])["ok"]  # delta
+    assert spill.groups_skipped == n
+    # plant an orphan (a deleted group's leftover section): the next
+    # spill is the full_every'th — a full rewrite that prunes it
+    orphan = os.path.join(d, "snapshot.group-deadbeefdead.pkl")
+    with open(orphan, "wb") as f:
+        f.write(b"stale")
+    assert spill.save(snap, templates=corpus["tdig"])["ok"]  # full again
+    assert spill.groups_skipped == n  # nothing reused on the full
+    assert not os.path.exists(orphan)
+    # loadable after the cycle
+    snapF = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    assert spill.load(snapF, corpus["cons"],
+                      templates=corpus["tdig"]) is not None
+
+
+def test_delta_spill_corrupt_group_section_rejected(corpus, tmp_path):
+    snap = corpus["snapshot"]
+    d = str(tmp_path / "delta-corrupt")
+    spill = SnapshotSpill(d, delta=True)
+    assert spill.save(snap, templates=corpus["tdig"])["ok"]
+    gfile = sorted(glob.glob(os.path.join(d, "snapshot.group-*.pkl")))[0]
+    with open(gfile, "r+b") as f:
+        f.seek(os.path.getsize(gfile) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    fresh = SnapshotSpill(d, delta=True)
+    snapC = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    assert fresh.load(snapC, corpus["cons"],
+                      templates=corpus["tdig"]) is None
+    assert fresh.miss_reasons == {MISS_CORRUPT: 1}
+    # the reject deleted the WHOLE spill, group sections included
+    assert glob.glob(os.path.join(d, "snapshot.group-*.pkl")) == []
+    assert not os.path.exists(os.path.join(d, HEADER))
+    # ...and the original writer fails CLOSED (its stubs reference the
+    # deleted sections), then recovers with a forced-full spill
+    assert not spill.save(snap, templates=corpus["tdig"])["ok"]
+    assert spill.save(snap, templates=corpus["tdig"])["ok"]
+    snapR = ClusterSnapshot(corpus["evaluator"], SnapshotConfig())
+    assert spill.load(snapR, corpus["cons"],
+                      templates=corpus["tdig"]) is not None
+
+
+def test_non_delta_spill_format_unchanged(corpus, tmp_path):
+    """delta=False keeps the PR 13/14 inline single-section layout: no
+    group files, no manifest key in rows.pkl."""
+    import pickle
+
+    d = str(tmp_path / "classic")
+    spill = SnapshotSpill(d)
+    assert spill.save(corpus["snapshot"],
+                      templates=corpus["tdig"])["ok"]
+    assert glob.glob(os.path.join(d, "snapshot.group-*.pkl")) == []
+    with open(os.path.join(d, "snapshot.rows.pkl"), "rb") as f:
+        state = pickle.load(f)
+    assert "group_files" not in state and "groups" in state
+
+
 # --- 3. torn / corrupt / drifted spills ------------------------------------
 
 def _copy_spill(corpus, tmp_path):

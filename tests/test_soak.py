@@ -108,6 +108,22 @@ def test_soak_smoke_all_families_all_lanes(cache_dir):
     assert report["extdata_transport_calls"] > 0
 
 
+def test_soak_resident_lane_armed(cache_dir):
+    """residency="on" promotes the snapshot lane's columns to device
+    mirrors; the per-round snapshot-vs-relist compare then runs
+    HBM-resident ticks against the host reference under chaos — zero
+    divergences, and the lane demonstrably uploaded."""
+    from gatekeeper_tpu.fuzz.soak import run_soak
+
+    report = run_soak(seed=0, size=1, families=["selectors"],
+                      rounds=2, chaos=True, cache_dir=cache_dir,
+                      residency="on")
+    assert report["ok"], report
+    assert report["residency"] == "on"
+    assert report["resident_uploads"] > 0, \
+        "resident lane never promoted — differential ran host-vs-host"
+
+
 def test_soak_sensitivity_corrupted_mutation(cache_dir):
     """A corrupted batched patch (the lowered-program-corruption
     analogue) MUST surface as a mutate-lane divergence carrying the

@@ -13,6 +13,10 @@ registry (``tools/observability_registry.md``):
 - every built-in SLO objective name
   (``observability/slo.py:DEFAULT_OBJECTIVES``) must be documented —
   dashboards key on ``gatekeeper_slo_*{objective=...}`` values;
+- every built-in degradation action
+  (``resilience/overload.py:BUILTIN_ACTIONS``) must be documented —
+  SLO degradation maps and ``--slo-config`` files name them, and
+  ``gatekeeper_slo_degradation_active{action=...}`` keys on them;
 - every ``/debug/*`` endpoint constant in ``webhook/server.py``
   (``*_PATH = "/debug/..."``) must be documented — runbooks and
   ``gator triage`` depend on those paths existing;
@@ -38,6 +42,7 @@ METRICS_PY = PKG / "metrics" / "registry.py"
 SLO_PY = PKG / "observability" / "slo.py"
 SHADOW_PY = PKG / "replay" / "shadow.py"
 SERVER_PY = PKG / "webhook" / "server.py"
+OVERLOAD_PY = PKG / "resilience" / "overload.py"
 
 _FAULT_CALL = re.compile(r'fault_point\(\s*(f?)"([^"]+)"')
 # tracer span call sites: tracing.span("..."), otel.span("..."),
@@ -91,6 +96,53 @@ def documented_endpoints() -> set:
         if m and section.startswith("debug endpoints"):
             endpoints.add(m.group(1))
     return endpoints
+
+
+def documented_actions() -> set:
+    """Degradation action names parsed from the registry markdown's
+    ``## Degradation actions`` section (kept apart from
+    :func:`documented` so its 4-tuple shape stays stable)."""
+    actions: set = set()
+    section = ""
+    for line in REGISTRY_MD.read_text().splitlines():
+        if line.startswith("## "):
+            section = line[3:].strip().lower()
+            continue
+        m = _DOC_ENTRY.match(line)
+        if m and section.startswith("degradation actions"):
+            actions.add(m.group(1))
+    return actions
+
+
+def degradation_actions_in_source() -> dict:
+    """action name -> defining constant, from the
+    ``BUILTIN_ACTIONS`` dict of resilience/overload.py.  Keys are
+    module-constant references (``NS_CACHE_STALE``), so constant
+    assignments resolve first; a literal string key works too."""
+    tree = ast.parse(OVERLOAD_PY.read_text())
+    consts: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    out: dict = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) \
+                or target.id != "BUILTIN_ACTIONS" \
+                or not isinstance(node.value, ast.Dict):
+            continue
+        for k in node.value.keys:
+            if isinstance(k, ast.Name) and k.id in consts:
+                out[consts[k.id]] = k.id
+            elif isinstance(k, ast.Constant) \
+                    and isinstance(k.value, str):
+                out[k.value] = "<literal>"
+    return out
 
 
 def debug_endpoints_in_source() -> dict:
@@ -247,6 +299,20 @@ def check() -> list:
             f"{SLO_PY.relative_to(REPO)}:DEFAULT_OBJECTIVES or "
             f"{SHADOW_PY.relative_to(REPO)}:SHADOW_OBJECTIVE; remove it "
             "from the registry")
+    doc_actions = documented_actions()
+    src_actions = degradation_actions_in_source()
+    for name, const in sorted(src_actions.items()):
+        if name not in doc_actions:
+            problems.append(
+                f"undocumented degradation action {name!r} (constant "
+                f"{const} in {OVERLOAD_PY.relative_to(REPO)}:"
+                f"BUILTIN_ACTIONS) — add it to "
+                f"{REGISTRY_MD.relative_to(REPO)}")
+    for name in sorted(doc_actions - set(src_actions)):
+        problems.append(
+            f"stale documented degradation action {name!r} — not in "
+            f"{OVERLOAD_PY.relative_to(REPO)}:BUILTIN_ACTIONS; remove "
+            "it from the registry")
     doc_endpoints = documented_endpoints()
     src_endpoints = debug_endpoints_in_source()
     for path, const in sorted(src_endpoints.items()):
@@ -272,6 +338,7 @@ def main() -> int:
         print(f"observability registry in sync: {len(sites)} fault "
               f"sites, {len(metrics)} metrics, {len(spans)} spans, "
               f"{len(slo)} SLO objectives, "
+              f"{len(documented_actions())} degradation actions, "
               f"{len(documented_endpoints())} debug endpoints")
     return 1 if problems else 0
 

@@ -51,6 +51,10 @@ def main() -> int:
                    choices=["mutate_program", "extdata_column"],
                    help="seeded-bug sensitivity check: the run MUST "
                         "report a divergence")
+    p.add_argument("--residency", default="off",
+                   choices=["off", "auto", "on"],
+                   help="arm the device-resident snapshot lane on the "
+                        "snapshot-side audit (single-device mesh)")
     p.add_argument("--out", default=DEFAULT_OUT,
                    help="bench record path ('' disables recording)")
     args = p.parse_args()
@@ -65,7 +69,8 @@ def main() -> int:
         seed=args.seed, size=args.size, families=families,
         duration_s=args.minutes * 60.0, rounds=args.rounds,
         chaos=chaos, chaos_seed=chaos_seed, inject_bug=args.inject_bug,
-        concurrent=args.concurrent, quiet=True)
+        concurrent=args.concurrent, quiet=True,
+        residency=args.residency)
 
     if args.inject_bug:
         # sensitivity inversion: the seeded bug MUST have been caught
